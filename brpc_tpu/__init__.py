@@ -1,0 +1,33 @@
+"""brpc_tpu — a TPU-native RPC and service framework.
+
+A from-scratch re-design of the capabilities of Apache brpc (reference:
+/root/reference) for TPU pods:
+
+- ``butil``   : base library — zero-copy chained buffers (IOBuf) over
+                pluggable block pools (host bytearray slabs or HBM-resident
+                device slabs), versioned-id resource pools, read-mostly
+                double-buffered data, endpoints that address both ip:port
+                and mesh device coordinates.
+- ``bvar``    : thread-local-aggregated metrics (write O(1), read merges),
+                windows, percentiles, latency recorders, Prometheus export.
+- ``fiber``   : the task runtime (M:N-shaped scheduler API; Python engine on
+                worker threads, native C++ engine for the hot paths),
+                versioned correlation ids, execution queues, timer thread.
+- ``transport``: Socket abstraction with wait-free write queue + keep-write
+                draining, event dispatcher, in-process loopback, TCP, and the
+                ICI device transport (device-resident payload path).
+- ``protocol``: pluggable struct-of-callbacks protocol registry; framed
+                pb-RPC (tpu_std), HTTP/1.1 + JSON bridge, streaming.
+- ``server`` / ``client``: Server, Channel/Controller with timeout/retry/
+                backup-request/cancel, naming services, load balancers,
+                circuit breakers, Parallel/Partition/Selective channels.
+- ``parallel``: mesh collectives layer (shard_map/ppermute rings) the combo
+                channels and streaming map onto when peers form an ICI mesh.
+- ``ops``     : pallas TPU kernels (checksum, chunked copy, ring transfer).
+- ``models``  : flagship workloads (sharded embedding parameter-server).
+
+Nothing here is a port: architecture follows SURVEY.md, not the reference's
+source. Reference citations in docstrings are for capability parity only.
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,19 @@
+"""Test harness config.
+
+Forces JAX onto a virtual 8-device CPU mesh so multi-chip sharding paths are
+exercised without TPU hardware (mirrors how the reference tests distributed
+behavior in-process on loopback — /root/reference/test/brpc_server_unittest.cpp:185).
+
+MUST run before any `import jax` anywhere in the test session.
+"""
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

@@ -1,0 +1,187 @@
+"""butil unit tests (≈ reference test/resource_pool_unittest.cpp,
+test/endpoint_unittest.cpp, test/crc32c_unittest.cc, etc.)."""
+
+import threading
+
+import pytest
+
+from brpc_tpu.butil import (ResourcePool, ObjectPool, DoublyBufferedData,
+                            EndPoint, parse_endpoint, device_endpoint,
+                            CaseIgnoredFlatMap, MRUCache, BoundedQueue,
+                            fast_rand, fast_rand_less_than, fast_rand_double,
+                            crc32c, crc32c_extend, Status, Errno,
+                            id_slot, id_version)
+
+
+class TestResourcePool:
+    def test_acquire_address_release(self):
+        pool = ResourcePool(factory=dict)
+        rid, obj = pool.acquire()
+        assert pool.address(rid) is obj
+        assert pool.release(rid)
+        assert pool.address(rid) is None          # stale id resolves to None
+        assert not pool.release(rid)               # double release rejected
+
+    def test_version_bump_on_reuse(self):
+        pool = ResourcePool(factory=dict)
+        rid1, _ = pool.acquire()
+        pool.release(rid1)
+        rid2, _ = pool.acquire()
+        assert id_slot(rid1) == id_slot(rid2)      # slot reused
+        assert id_version(rid1) != id_version(rid2)
+        assert pool.address(rid1) is None          # old id is dead
+        assert pool.address(rid2) is not None
+
+    def test_concurrent_churn(self):
+        pool = ResourcePool(factory=object)
+        errors = []
+
+        def churn():
+            try:
+                for _ in range(2000):
+                    rid, obj = pool.acquire()
+                    assert pool.address(rid) is obj
+                    assert pool.release(rid)
+            except Exception as e:  # pragma: no cover
+                errors.append(e)
+
+        ts = [threading.Thread(target=churn) for _ in range(4)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        assert not errors
+        assert pool.live_count == 0
+
+    def test_object_pool(self):
+        resets = []
+        pool = ObjectPool(factory=list, reset=lambda x: (x.clear(), resets.append(1)))
+        a = pool.get()
+        a.append(1)
+        pool.put(a)
+        b = pool.get()
+        assert b is a and b == []
+        assert pool.hits == 1
+
+
+class TestDoublyBuffered:
+    def test_read_modify(self):
+        d = DoublyBufferedData([1, 2, 3])
+        snap = d.read()
+        assert snap == [1, 2, 3]
+        d.modify(lambda lst: lst.append(4))
+        assert d.read() == [1, 2, 3, 4]
+        assert snap == [1, 2, 3]  # old snapshot untouched (RCU)
+
+    def test_modify_abort(self):
+        d = DoublyBufferedData({"a": 1})
+        assert d.modify(lambda m: False) is False
+        assert d.read() == {"a": 1}
+
+    def test_reader_during_writes(self):
+        d = DoublyBufferedData(list(range(10)))
+        stop = threading.Event()
+        bad = []
+
+        def reader():
+            while not stop.is_set():
+                snap = d.read()
+                if len(snap) not in (10, 11):
+                    bad.append(len(snap))
+
+        t = threading.Thread(target=reader)
+        t.start()
+        for i in range(200):
+            d.modify(lambda lst: (lst.append(i), None)[1] if len(lst) == 10 else lst.pop() and None)
+        stop.set()
+        t.join()
+        assert not bad
+
+
+class TestEndPoint:
+    def test_parse_ipv4(self):
+        ep = parse_endpoint("127.0.0.1:8000")
+        assert ep.host == "127.0.0.1" and ep.port == 8000
+        assert str(ep) == "127.0.0.1:8000"
+        assert not ep.is_device
+
+    def test_parse_ipv6(self):
+        ep = parse_endpoint("[::1]:80")
+        assert ep.host == "::1" and ep.port == 80
+        assert str(ep) == "[::1]:80"
+
+    def test_parse_unix(self):
+        ep = parse_endpoint("unix:/tmp/sock")
+        assert ep.is_unix
+
+    def test_parse_device(self):
+        ep = parse_endpoint("ici://pod0/3")
+        assert ep.is_device and ep.mesh == "pod0" and ep.device_index == 3
+        assert str(ep) == "ici://pod0/3"
+        assert ep == device_endpoint("pod0", 3)
+
+    def test_hashable_value_type(self):
+        s = {parse_endpoint("a:1"), parse_endpoint("a:1"), parse_endpoint("a:2")}
+        assert len(s) == 2
+
+    def test_bad(self):
+        with pytest.raises(ValueError):
+            parse_endpoint("")
+
+
+class TestContainers:
+    def test_case_ignored_map(self):
+        m = CaseIgnoredFlatMap()
+        m["Content-Type"] = "application/json"
+        assert m["content-type"] == "application/json"
+        assert "CONTENT-TYPE" in m
+        assert list(m.keys()) == ["Content-Type"]  # original casing kept
+        del m["Content-type"]
+        assert len(m) == 0
+
+    def test_mru_cache(self):
+        c = MRUCache(2)
+        c.put(1, "a")
+        c.put(2, "b")
+        c.get(1)
+        c.put(3, "c")  # evicts 2 (least recently used)
+        assert c.get(2) is None
+        assert c.get(1) == "a" and c.get(3) == "c"
+
+    def test_bounded_queue(self):
+        q = BoundedQueue(2)
+        assert q.push(1) and q.push(2) and not q.push(3)
+        assert q.full
+        q.push_force(3)  # evicts 1
+        assert q.pop() == 2 and q.pop() == 3 and q.pop() is None
+
+
+class TestRandAndHash:
+    def test_fast_rand_spread(self):
+        vals = {fast_rand_less_than(1000) for _ in range(200)}
+        assert len(vals) > 50
+
+    def test_fast_rand_double(self):
+        for _ in range(100):
+            v = fast_rand_double()
+            assert 0.0 <= v < 1.0
+
+    def test_crc32c_known_vectors(self):
+        # standard CRC32C test vectors
+        assert crc32c(b"") == 0
+        assert crc32c(b"123456789") == 0xE3069283
+        assert crc32c(b"a" * 32) == crc32c_extend(crc32c(b"a" * 16), b"a" * 16)
+
+
+class TestStatus:
+    def test_ok(self):
+        st = Status.ok()
+        assert st and st.is_ok() and st.error_str() == "OK"
+
+    def test_error(self):
+        st = Status(Errno.ERPCTIMEDOUT, "deadline 100ms exceeded")
+        assert not st
+        assert "ERPCTIMEDOUT" in st.error_str()
+        assert st == Errno.ERPCTIMEDOUT
+        st.reset()
+        assert st
