@@ -109,6 +109,12 @@ class BoundedQueue:
     def top(self):
         return self._buf[self._start] if self._count else None
 
+    def snapshot(self) -> list:
+        """Oldest-first copy of current contents (callers needing cross-
+        thread consistency must hold their own lock around push/snapshot)."""
+        return [self._buf[(self._start + i) % self._cap]
+                for i in range(self._count)]
+
     def __len__(self) -> int:
         return self._count
 

@@ -296,13 +296,18 @@ class IOBuf:
         return out
 
     def cut_into(self, writer) -> int:
-        """Write everything to a writable with ``write(view)`` semantics;
-        returns bytes written. Consumes the buffer."""
+        """Write to a writable with ``write(view)`` semantics; returns bytes
+        written and consumes exactly that many.  Handles short writes: stops
+        at the first partial/refused write, leaving the tail intact."""
         total = 0
         for v in self.backing_views():
-            writer.write(v)
-            total += len(v)
-        self.clear()
+            n = writer.write(v)
+            if n is None:          # e.g. io.BufferedWriter contract
+                n = len(v)
+            total += n
+            if n < len(v):
+                break
+        self.pop_front(total)
         return total
 
     # ---- reading without consuming ----

@@ -1,0 +1,87 @@
+"""LatencyRecorder — the composite every RPC method exposes
+(≈ /root/reference/src/bvar/latency_recorder.h:75): windowed average
+latency, max latency, qps, count, p50/p90/p99/p999.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from .percentile import Percentile
+from .reducer import Adder, IntRecorder, Maxer
+from .variable import Variable
+from .window import PerSecond, Window
+
+
+class LatencyRecorder(Variable):
+    def __init__(self, name: Optional[str] = None, window_size: int = 10):
+        super().__init__()
+        self._latency = IntRecorder()
+        self._max_latency = Maxer()
+        self._count = Adder()
+        self._percentile = Percentile()
+        self._latency_window = Window(self._latency, window_size)
+        self._max_window = Window(self._max_latency, window_size)
+        self._qps = PerSecond(self._count, window_size)
+        self.window_size = window_size
+        if name:
+            self.expose(name)
+
+    def update(self, latency_us: float) -> "LatencyRecorder":
+        self._latency.update(latency_us)
+        self._max_latency.update(latency_us)
+        self._count.update(1)
+        self._percentile.update(latency_us)
+        return self
+
+    def __lshift__(self, latency_us: float) -> "LatencyRecorder":
+        return self.update(latency_us)
+
+    # -- views --
+
+    def latency(self) -> float:
+        """Windowed average latency (us)."""
+        return self._latency_window.get_value()
+
+    def max_latency(self) -> float:
+        return self._max_window.get_value()
+
+    def qps(self) -> float:
+        return self._qps.get_value()
+
+    def count(self) -> int:
+        return self._count.get_value()
+
+    def latency_percentile(self, fraction: float) -> float:
+        return self._percentile.get_number(fraction, self.window_size)
+
+    def p50(self) -> float:
+        return self.latency_percentile(0.5)
+
+    def p90(self) -> float:
+        return self.latency_percentile(0.9)
+
+    def p99(self) -> float:
+        return self.latency_percentile(0.99)
+
+    def p999(self) -> float:
+        return self.latency_percentile(0.999)
+
+    def get_value(self):
+        return self.latency()
+
+    def describe(self) -> str:
+        return (f"latency={self.latency():.0f} max={self.max_latency():.0f} "
+                f"qps={self.qps():.1f} count={self.count()} "
+                f"p99={self.p99():.0f}")
+
+    def expose(self, name: str, prefix: str = "") -> bool:
+        """Expose the composite's sub-views too (latency/qps/count/...)."""
+        ok = super().expose(name, prefix)
+        if ok and self._name:
+            base = self._name
+            self._latency_window.expose(f"{base}_latency")
+            self._max_window.expose(f"{base}_max_latency")
+            self._qps.expose(f"{base}_qps")
+            self._count.expose(f"{base}_count")
+        return ok

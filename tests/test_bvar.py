@@ -1,0 +1,222 @@
+"""bvar tests (≈ reference test/bvar_reducer_unittest.cpp,
+bvar_percentile_unittest.cpp, bvar_sampler_unittest.cpp,
+bvar_multi_dimension_unittest.cpp): merge semantics and window math,
+using deterministic sampler ticks instead of sleeping."""
+
+import threading
+
+import pytest
+
+from brpc_tpu.bvar import (Adder, Maxer, Miner, IntRecorder, Window, PerSecond,
+                           Percentile, LatencyRecorder, PassiveStatus, StatusVar,
+                           MultiDimension, tick_once_for_tests, find_exposed,
+                           list_exposed, dump_exposed, render_prometheus,
+                           Collector, Collected, clear_registry_for_tests)
+
+
+@pytest.fixture(autouse=True)
+def _clean_registry():
+    clear_registry_for_tests()
+    yield
+    clear_registry_for_tests()
+
+
+class TestReducers:
+    def test_adder(self):
+        a = Adder()
+        a << 1 << 2 << 3
+        assert a.get_value() == 6
+        a.update(-10)
+        assert a.get_value() == -4
+
+    def test_maxer_miner(self):
+        m = Maxer()
+        m << 5 << 3 << 9
+        assert m.get_value() == 9
+        n = Miner()
+        n << 5 << 3 << 9
+        assert n.get_value() == 3
+
+    def test_int_recorder(self):
+        r = IntRecorder()
+        for v in (10, 20, 30):
+            r << v
+        assert r.average() == 20
+        assert r.sum == 60 and r.num == 3
+
+    def test_multithreaded_merge(self):
+        """Write-side is per-thread; read must merge all agents."""
+        a = Adder()
+
+        def w():
+            for _ in range(10000):
+                a << 1
+
+        ts = [threading.Thread(target=w) for _ in range(8)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        assert a.get_value() == 80000
+
+    def test_dead_thread_value_folds_into_residual(self):
+        a = Adder()
+        t = threading.Thread(target=lambda: a.update(42))
+        t.start()
+        t.join()
+        assert a.get_value() == 42  # dead thread's agent folded, not lost
+        assert a.get_value() == 42  # stable across repeated reads
+
+    def test_cumulative_survives_sampling(self):
+        a = Adder()
+        Window(a, window_size=2)      # attaches a delta sampler
+        a << 7
+        tick_once_for_tests()
+        tick_once_for_tests()
+        tick_once_for_tests()
+        assert a.get_value() == 7     # sampling never resets the reducer
+
+
+class TestWindows:
+    def test_window_sums_recent_seconds(self):
+        a = Adder()
+        w = Window(a, window_size=3)
+        for v in (10, 20, 30, 40):
+            a << v
+            tick_once_for_tests()     # one "second" boundary
+        # only last 3 seconds count: 20+30+40
+        assert w.get_value() == 90
+
+    def test_per_second(self):
+        a = Adder()
+        q = PerSecond(a, window_size=5)
+        for _ in range(5):
+            a << 100
+            tick_once_for_tests()
+        assert q.get_value() == 100
+
+    def test_window_of_maxer_is_truly_windowed(self):
+        m = Maxer()
+        w = Window(m, window_size=2)
+        m << 1000
+        tick_once_for_tests()
+        m << 5
+        tick_once_for_tests()
+        m << 7
+        tick_once_for_tests()
+        # the 1000 spike aged out of the 2-second window...
+        assert w.get_value() == 7
+        # ...but the all-time max is still visible on the reducer itself
+        assert m.get_value() == 1000
+
+
+class TestPercentile:
+    def test_quantiles(self):
+        p = Percentile()
+        for i in range(1, 1001):
+            p << i
+        tick_once_for_tests()
+        assert 400 <= p.get_number(0.5) <= 600
+        assert p.get_number(0.99) >= 900
+        assert p.get_number(0.0) >= 1
+
+    def test_multithreaded_updates(self):
+        p = Percentile()
+
+        def w(base):
+            for i in range(1000):
+                p << base + i
+
+        ts = [threading.Thread(target=w, args=(k * 1000,)) for k in range(4)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        tick_once_for_tests()
+        assert p.get_number(0.99) > p.get_number(0.01)
+
+
+class TestLatencyRecorder:
+    def test_composite(self):
+        lr = LatencyRecorder(window_size=5)
+        for v in (100, 200, 300, 400, 500):
+            lr << v
+        tick_once_for_tests()
+        assert lr.count() == 5
+        assert lr.latency() == 300
+        assert lr.max_latency() == 500
+        assert lr.qps() > 0
+        assert lr.p99() >= lr.p50() >= 100
+
+    def test_expose_subvars(self):
+        lr = LatencyRecorder(window_size=5)
+        lr.expose("echo_service")
+        names = list_exposed()
+        assert "echo_service" in names
+        assert "echo_service_qps" in names
+        assert "echo_service_latency" in names
+
+
+class TestRegistry:
+    def test_expose_find_hide(self):
+        a = Adder()
+        assert a.expose("my counter!")       # sanitized
+        assert find_exposed("my_counter_") is a
+        a << 3
+        assert dump_exposed()["my_counter_"] == "3"
+        assert a.hide()
+        assert find_exposed("my_counter_") is None
+
+    def test_duplicate_expose_rejected(self):
+        a, b = Adder(), Adder()
+        assert a.expose("dup")
+        assert not b.expose("dup")
+
+    def test_passive_and_status(self):
+        x = [1]
+        p = PassiveStatus(lambda: x[0], "passive_x")
+        s = StatusVar("hello", "status_s")
+        assert p.get_value() == 1
+        x[0] = 5
+        assert p.get_value() == 5
+        assert s.get_value() == "hello"
+        s.set_value("world")
+        assert find_exposed("status_s").get_value() == "world"
+
+
+class TestMultiDimension:
+    def test_labeled_stats(self):
+        md = MultiDimension(["method", "code"], Adder, "rpc_errors")
+        md.get_stats(["echo", "0"]).update(3)
+        md.get_stats(["echo", "1008"]).update(1)
+        md.get_stats(["echo", "0"]).update(2)
+        assert md.count_stats() == 2
+        assert md.get_value()[("echo", "0")] == 5
+        with pytest.raises(ValueError):
+            md.get_stats(["only-one"])
+
+
+class TestPrometheus:
+    def test_render(self):
+        a = Adder()
+        a.expose("requests_total")
+        a << 17
+        md = MultiDimension(["method"], Adder, "per_method")
+        md.get_stats(["echo"]).update(4)
+        text = render_prometheus()
+        assert "requests_total 17" in text
+        assert 'per_method{method="echo"} 4' in text
+
+
+class TestCollector:
+    def test_rate_limit_and_drain(self):
+        sunk = []
+        c = Collector(sink=sunk.extend, max_per_second=10)
+
+        class S(Collected):
+            pass
+
+        ok = sum(1 for _ in range(50) if c.submit(S()))
+        assert ok == 10 and c.dropped == 40
+        drained = c.drain()
+        assert len(drained) == 10 and len(sunk) == 10
