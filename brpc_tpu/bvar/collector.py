@@ -26,7 +26,8 @@ class Collector:
     def __init__(self, sink: Optional[Callable[[List[Collected]], None]] = None,
                  max_per_second: int = _MAX_PER_SECOND):
         self._sink = sink
-        self._queue: Deque[Collected] = deque(maxlen=4 * max_per_second)
+        self._capacity = 4 * max_per_second
+        self._queue: Deque[Collected] = deque()
         self._lock = threading.Lock()
         self._max_per_second = max_per_second
         self._second_start = time.monotonic()
@@ -40,7 +41,11 @@ class Collector:
             if now - self._second_start >= 1.0:
                 self._second_start = now
                 self._taken_this_second = 0
-            if self._taken_this_second >= self._max_per_second:
+            if (self._taken_this_second >= self._max_per_second
+                    or len(self._queue) >= self._capacity):
+                # over rate budget OR drainer is lagging: refuse admission
+                # (never silently evict a sample the producer was told we
+                # accepted)
                 self.dropped += 1
                 return False
             self._taken_this_second += 1

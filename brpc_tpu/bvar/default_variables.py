@@ -54,9 +54,13 @@ _exposed = []
 
 
 def expose_default_variables() -> None:
-    """Idempotently expose process_* vars (called by Server start)."""
-    if _exposed:
+    """Idempotently expose process_* vars (called by Server start).
+    Keyed on registry state, not module state, so a registry reset
+    (tests) can re-expose."""
+    from .variable import find_exposed
+    if find_exposed("process_pid") is not None:
         return
+    _exposed.clear()
     _exposed.extend([
         PassiveStatus(_rss_bytes, "process_memory_resident"),
         PassiveStatus(_fd_count, "process_fd_count"),

@@ -15,12 +15,15 @@ from .variable import Variable
 
 
 class _Agent:
-    __slots__ = ("value", "thread", "epoch")
+    __slots__ = ("value", "thread", "lock")
 
     def __init__(self, identity, thread):
         self.value = identity
         self.thread = thread
-        self.epoch = 0
+        # taken only in window mode (extremum windows): the sampler
+        # reads-and-resets under the same lock writers combine under, so
+        # no update can fall between two sampling epochs and vanish
+        self.lock = threading.Lock()
 
 
 class Reducer(Variable):
@@ -35,10 +38,9 @@ class Reducer(Variable):
         self._residual = identity
         self._tls = threading.local()
         # Window-of-extremum support: when a Window attaches to a Maxer/
-        # Miner it flips window-mode on; agents then restart from identity
-        # each sampling epoch, and closed epochs fold into _residual so
-        # get_value() stays the all-time extremum.
-        self._epoch = 0
+        # Miner it flips window-mode on; the sampler then drains (reads and
+        # resets) agents each second, and drained values fold into
+        # _residual so get_value() stays the all-time extremum.
         self._window_mode = False
         if name:
             self.expose(name)
@@ -47,19 +49,20 @@ class Reducer(Variable):
         agent = getattr(self._tls, "agent", None)
         if agent is None:
             agent = _Agent(self._identity, threading.current_thread())
-            agent.epoch = self._epoch
             with self._agents_lock:
                 self._agents.append(agent)
             self._tls.agent = agent
         return agent
 
     def update(self, value) -> "Reducer":
-        """O(1), contention-free: only touches this thread's agent."""
+        """O(1), contention-free: only touches this thread's agent.
+        (Window mode adds an uncontended per-agent lock acquire.)"""
         agent = self._my_agent()
-        if self._window_mode and agent.epoch != self._epoch:
-            agent.value = self._identity
-            agent.epoch = self._epoch
-        agent.value = self._op(agent.value, value)
+        if not self._window_mode:
+            agent.value = self._op(agent.value, value)
+            return self
+        with agent.lock:
+            agent.value = self._op(agent.value, value)
         return self
 
     def __lshift__(self, value) -> "Reducer":  # adder << 1, like the reference
@@ -86,17 +89,18 @@ class Reducer(Variable):
         self._window_mode = True
 
     def take_epoch_sample(self):
-        """Close the current epoch: combined value of this epoch's agents.
-        Called by the sampler thread once per second in window mode.
-        Closed-epoch values fold into the residual so the plain
-        ``get_value()`` remains the all-time aggregate."""
+        """Close the current epoch: drain (read + reset) every agent under
+        its lock and return the combined value.  Called by the sampler
+        thread once per second in window mode.  Drained values fold into
+        the residual so the plain ``get_value()`` remains the all-time
+        aggregate."""
         cur = self._identity
         with self._agents_lock:
             for agent in self._agents:
-                if agent.epoch == self._epoch:
+                with agent.lock:
                     cur = self._op(cur, agent.value)
+                    agent.value = self._identity
             self._residual = self._op(self._residual, cur)
-            self._epoch += 1
             self._agents = [a for a in self._agents if a.thread.is_alive()]
         return cur
 

@@ -34,6 +34,7 @@ class _SamplerThread:
         self._lock = threading.Lock()
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
+        self._manual = False     # tests drive ticks; background thread idles
         self.rounds = 0
 
     def add(self, s: Sampler) -> None:
@@ -68,7 +69,8 @@ class _SamplerThread:
 
     def _run(self) -> None:
         while not self._stop.wait(SAMPLE_INTERVAL_S):
-            self.tick()
+            if not self._manual:
+                self.tick()
 
 
 _sampler_thread = _SamplerThread()
@@ -83,7 +85,10 @@ def remove_sampler(s: Sampler) -> None:
 
 
 def tick_once_for_tests() -> None:
-    """Deterministically run one sampling round (tests don't sleep)."""
+    """Deterministically run one sampling round. The first call switches
+    the process to manual sampling (the background thread stops ticking)
+    so test windows can't be double-sampled by the 1s daemon."""
+    _sampler_thread._manual = True
     _sampler_thread.tick()
 
 
@@ -118,6 +123,17 @@ class ReducerSampler(Sampler):
         self._ring = BoundedQueue(self.MAX_WINDOW)
         self._ring_lock = threading.Lock()
         add_sampler(self)
+
+    @staticmethod
+    def shared_for(reducer, use_delta: bool) -> "ReducerSampler":
+        """One sampler per reducer (as in the reference): multiple Windows
+        over the same reducer must share the ring — a second epoch-mode
+        sampler would close every epoch twice and read zeros."""
+        s = getattr(reducer, "_shared_sampler", None)
+        if s is None:
+            s = ReducerSampler(reducer, use_delta)
+            reducer._shared_sampler = s
+        return s
 
     def take_sample(self) -> None:
         if self._use_delta:

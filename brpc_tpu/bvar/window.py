@@ -34,7 +34,7 @@ class Window(Variable):
             self._use_delta = True
             self._combine = reducer._op
             self._identity = reducer._identity
-        self._sampler = ReducerSampler(reducer, self._use_delta)
+        self._sampler = ReducerSampler.shared_for(reducer, self._use_delta)
         if name:
             self.expose(name)
 

@@ -1,0 +1,8 @@
+"""fiber — the task runtime (L1). SURVEY.md §2.2 inventory."""
+
+from .runtime import (TaskRuntime, TaskHandle, spawn, global_runtime,
+                      set_concurrency, blocking, DEFAULT_CONCURRENCY)
+from .butex import Butex, CountdownEvent
+from .versioned_id import IdPool, global_id_pool, INVALID_CALL_ID
+from .execution_queue import ExecutionQueue, TaskIterator
+from .timer_thread import TimerThread, global_timer_thread

@@ -1,0 +1,82 @@
+"""Butex — futex-shaped blocking primitive
+(≈ /root/reference/src/bthread/butex.cpp:283): wait iff the value still
+equals the expected value; wakers bump the value and wake waiters.  All
+higher-level blocking (call join, stream windows, countdown) builds on it,
+mirroring the reference's layering.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+from .runtime import blocking
+
+
+class Butex:
+    __slots__ = ("_value", "_cond")
+
+    def __init__(self, value: int = 0):
+        self._value = value
+        self._cond = threading.Condition()
+
+    @property
+    def value(self) -> int:
+        return self._value
+
+    def set_value(self, v: int) -> None:
+        with self._cond:
+            self._value = v
+
+    def wait(self, expected: int, timeout: Optional[float] = None) -> bool:
+        """Block while value == expected (futex semantics: returns False
+        immediately if the value already changed — the lost-wakeup guard).
+        Returns True if woken/changed, False on timeout."""
+        with self._cond:
+            if self._value != expected:
+                return True
+            with blocking():
+                return self._cond.wait_for(lambda: self._value != expected,
+                                           timeout)
+
+    def wake(self, n: int = 1) -> None:
+        with self._cond:
+            self._cond.notify(n)
+
+    def wake_all(self) -> None:
+        with self._cond:
+            self._cond.notify_all()
+
+    def add_and_wake(self, delta: int = 1, all: bool = True) -> int:
+        """Atomically bump the value and wake waiters — the common
+        signal pattern."""
+        with self._cond:
+            self._value += delta
+            if all:
+                self._cond.notify_all()
+            else:
+                self._cond.notify(1)
+            return self._value
+
+
+class CountdownEvent:
+    """≈ bthread::CountdownEvent — join N things."""
+
+    def __init__(self, count: int = 1):
+        self._butex = Butex(count)
+
+    def signal(self, n: int = 1) -> None:
+        self._butex.add_and_wake(-n)
+
+    def add_count(self, n: int = 1) -> None:
+        self._butex.add_and_wake(n, all=False)
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        with self._butex._cond:
+            with blocking():
+                return self._butex._cond.wait_for(
+                    lambda: self._butex._value <= 0, timeout)
+
+    @property
+    def count(self) -> int:
+        return self._butex.value

@@ -95,6 +95,23 @@ class TestWindows:
             tick_once_for_tests()
         assert q.get_value() == 100
 
+    def test_two_windows_share_one_sampler(self):
+        m = Maxer()
+        w1 = Window(m, 10)
+        w2 = Window(m, 10)
+        m << 5
+        tick_once_for_tests()
+        assert w1.get_value() == 5
+        assert w2.get_value() == 5   # shared ring: no double epoch close
+
+    def test_default_variables_survive_registry_reset(self):
+        from brpc_tpu.bvar import expose_default_variables
+        expose_default_variables()
+        assert find_exposed("process_pid") is not None
+        clear_registry_for_tests()
+        expose_default_variables()   # must re-expose after reset
+        assert find_exposed("process_pid") is not None
+
     def test_window_of_maxer_is_truly_windowed(self):
         m = Maxer()
         w = Window(m, window_size=2)

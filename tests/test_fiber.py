@@ -1,0 +1,298 @@
+"""Fiber runtime tests (≈ reference test/bthread_unittest.cpp,
+bthread_id_unittest.cpp, bthread_execution_queue_unittest.cpp,
+bthread_butex_unittest.cpp)."""
+
+import threading
+import time
+
+import pytest
+
+from brpc_tpu.fiber import (TaskRuntime, spawn, Butex, CountdownEvent,
+                            IdPool, ExecutionQueue, TaskIterator, TimerThread)
+
+
+class TestRuntime:
+    def test_spawn_join_result(self):
+        h = spawn(lambda a, b: a + b, 2, 3)
+        assert h.result(5) == 5
+        assert h.done
+
+    def test_exception_propagates(self):
+        def boom():
+            raise ValueError("x")
+        h = spawn(boom)
+        h.join(5)
+        with pytest.raises(ValueError):
+            h.result(1)
+
+    def test_many_tasks(self):
+        rt = TaskRuntime(concurrency=4)
+        counter = []
+        lock = threading.Lock()
+
+        def inc():
+            with lock:
+                counter.append(1)
+
+        handles = [rt.spawn(inc) for _ in range(200)]
+        for h in handles:
+            assert h.join(10)
+        assert len(counter) == 200
+
+    def test_blocking_tasks_dont_deadlock_pool(self):
+        """More blocked tasks than core workers: pool must grow
+        (the usercode_in_pthread deadlock-avoidance property)."""
+        rt = TaskRuntime(concurrency=2, max_workers=64)
+        gate = threading.Event()
+        started = CountdownEvent(8)
+
+        def block():
+            started.signal()
+            gate.wait(10)
+
+        hs = [rt.spawn(block) for _ in range(8)]
+        assert started.wait(5), "pool failed to grow past blocked workers"
+        gate.set()
+        for h in hs:
+            assert h.join(5)
+
+    def test_urgent_goes_first(self):
+        rt = TaskRuntime(concurrency=1)
+        order = []
+        gate = threading.Event()
+        rt.spawn(lambda: gate.wait(5))
+        rt.spawn(lambda: order.append("bg"))
+        rt.spawn(lambda: order.append("urgent"), urgent=True)
+        gate.set()
+        time.sleep(0.3)
+        assert order and order[0] == "urgent"
+
+
+class TestButex:
+    def test_wait_returns_immediately_on_changed_value(self):
+        b = Butex(5)
+        assert b.wait(expected=4) is True  # value != expected: no block
+
+    def test_wake(self):
+        b = Butex(0)
+        woken = []
+
+        def waiter():
+            b.wait(expected=0, timeout=5)
+            woken.append(1)
+
+        t = threading.Thread(target=waiter)
+        t.start()
+        time.sleep(0.05)
+        b.add_and_wake(1)
+        t.join(5)
+        assert woken
+
+    def test_timeout(self):
+        b = Butex(0)
+        t0 = time.monotonic()
+        assert b.wait(expected=0, timeout=0.1) is False
+        assert time.monotonic() - t0 < 2
+
+    def test_countdown(self):
+        ev = CountdownEvent(3)
+        for _ in range(3):
+            spawn(ev.signal)
+        assert ev.wait(5)
+        assert ev.count <= 0
+
+
+class TestVersionedId:
+    def test_create_lock_unlock_destroy(self):
+        pool = IdPool()
+        cid = pool.create(data={"x": 1})
+        ok, data = pool.lock(cid)
+        assert ok and data == {"x": 1}
+        pool.unlock(cid)
+        assert pool.valid(cid)
+        ok, _ = pool.lock(cid)
+        assert ok
+        assert pool.unlock_and_destroy(cid)
+        assert not pool.valid(cid)
+        ok, _ = pool.lock(cid)
+        assert not ok  # stale id
+
+    def test_error_runs_handler_when_unlocked(self):
+        pool = IdPool()
+        seen = []
+
+        def on_error(cid, data, code, text):
+            seen.append((code, text))
+            pool.unlock_and_destroy(cid)
+
+        cid = pool.create(data="d", on_error=on_error)
+        assert pool.error(cid, 1008, "timeout")
+        assert seen == [(1008, "timeout")]
+        assert not pool.valid(cid)
+
+    def test_error_queued_while_locked(self):
+        pool = IdPool()
+        seen = []
+
+        def on_error(cid, data, code, text):
+            seen.append(code)
+            pool.unlock_and_destroy(cid)
+
+        cid = pool.create(data="d", on_error=on_error)
+        ok, _ = pool.lock(cid)
+        assert ok
+        assert pool.error(cid, 1009)
+        assert seen == []          # queued, not run
+        pool.unlock(cid)           # delivery happens here
+        assert seen == [1009]
+        assert not pool.valid(cid)
+
+    def test_ranged_versions_address_same_call(self):
+        """Retry attempt k uses id+k; all address the call, all die
+        together on destroy (≈ bthread_id_create_ranged)."""
+        pool = IdPool()
+        cid = pool.create_ranged("call", None, version_range=4)
+        for k in range(4):
+            assert pool.valid(cid + k)
+        ok, data = pool.lock(cid + 2)
+        assert ok and data == "call"
+        assert pool.unlock_and_destroy(cid + 2)
+        for k in range(4):
+            assert not pool.valid(cid + k)
+
+    def test_join_wakes_on_destroy(self):
+        pool = IdPool()
+        cid = pool.create("c")
+        done = []
+
+        def joiner():
+            pool.join(cid, timeout=10)
+            done.append(1)
+
+        t = threading.Thread(target=joiner)
+        t.start()
+        time.sleep(0.05)
+        ok, _ = pool.lock(cid)
+        pool.unlock_and_destroy(cid)
+        t.join(5)
+        assert done
+
+    def test_lock_contention_serializes(self):
+        pool = IdPool()
+        cid = pool.create([])
+        order = []
+
+        def worker(tag):
+            ok, data = pool.lock(cid)
+            assert ok
+            order.append(tag)
+            time.sleep(0.01)
+            pool.unlock(cid)
+
+        ts = [threading.Thread(target=worker, args=(i,)) for i in range(5)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        assert sorted(order) == list(range(5))
+
+
+class TestExecutionQueue:
+    def test_batched_consumption(self):
+        got = []
+        done = threading.Event()
+
+        def executor(it: TaskIterator):
+            for item in it:
+                got.append(item)
+            if len(got) >= 100:
+                done.set()
+
+        q = ExecutionQueue(executor)
+        for i in range(100):
+            q.execute(i)
+        assert done.wait(5)
+        assert q.join(5)
+        assert got == list(range(100))  # MPSC: single consumer, in order
+
+    def test_high_priority_lane(self):
+        got = []
+        gate = threading.Event()
+
+        def executor(it: TaskIterator):
+            gate.wait(5)
+            for item in it:
+                got.append(item)
+
+        q = ExecutionQueue(executor)
+        q.execute("a")            # consumer starts, blocks on gate
+        time.sleep(0.05)
+        q.execute("b")
+        q.execute("hi", high_priority=True)
+        gate.set()
+        assert q.join(5)
+        assert got.index("hi") < got.index("b")
+
+    def test_stop_rejects(self):
+        q = ExecutionQueue(lambda it: [x for x in it])
+        q.stop()
+        assert q.execute(1) is False
+
+    def test_concurrent_producers(self):
+        got = []
+
+        def executor(it):
+            for item in it:
+                got.append(item)
+
+        q = ExecutionQueue(executor)
+
+        def produce(base):
+            for i in range(100):
+                q.execute(base + i)
+
+        ts = [threading.Thread(target=produce, args=(k * 1000,)) for k in range(4)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        assert q.join(5)
+        assert len(got) == 400 and len(set(got)) == 400
+
+
+class TestTimerThread:
+    def test_schedule_fires(self):
+        tt = TimerThread()
+        fired = threading.Event()
+        tt.schedule(fired.set, delay_s=0.05)
+        assert fired.wait(5)
+        assert tt.triggered_count >= 1
+
+    def test_unschedule(self):
+        tt = TimerThread()
+        fired = []
+        tid = tt.schedule(lambda: fired.append(1), delay_s=0.2)
+        assert tt.unschedule(tid)
+        time.sleep(0.4)
+        assert not fired
+        assert not tt.unschedule(tid)  # already cancelled
+
+    def test_ordering(self):
+        tt = TimerThread()
+        order = []
+        done = threading.Event()
+        tt.schedule(lambda: order.append("b"), delay_s=0.15)
+        tt.schedule(lambda: (order.append("a"), None), delay_s=0.05)
+        tt.schedule(lambda: (order.append("c"), done.set()), delay_s=0.25)
+        assert done.wait(5)
+        assert order == ["a", "b", "c"]
+
+    def test_nearer_deadline_preempts_sleep(self):
+        tt = TimerThread()
+        fired = threading.Event()
+        tt.schedule(lambda: None, delay_s=30)   # sleeping until far future
+        time.sleep(0.05)
+        t0 = time.monotonic()
+        tt.schedule(fired.set, delay_s=0.05)    # must wake the thread
+        assert fired.wait(5)
+        assert time.monotonic() - t0 < 5
