@@ -98,6 +98,9 @@ def _sub(a, b):
     return a - b
 
 
+_shared_sampler_lock = threading.Lock()
+
+
 class ReducerSampler(Sampler):
     """Samples a reducer every second into a bounded ring.
 
@@ -129,10 +132,11 @@ class ReducerSampler(Sampler):
         """One sampler per reducer (as in the reference): multiple Windows
         over the same reducer must share the ring — a second epoch-mode
         sampler would close every epoch twice and read zeros."""
-        s = getattr(reducer, "_shared_sampler", None)
-        if s is None:
-            s = ReducerSampler(reducer, use_delta)
-            reducer._shared_sampler = s
+        with _shared_sampler_lock:
+            s = getattr(reducer, "_shared_sampler", None)
+            if s is None:
+                s = ReducerSampler(reducer, use_delta)
+                reducer._shared_sampler = s
         return s
 
     def take_sample(self) -> None:

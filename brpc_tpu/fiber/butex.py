@@ -14,10 +14,18 @@ from .runtime import blocking
 
 
 class Butex:
-    __slots__ = ("_value", "_cond")
+    """Futex semantics: ``wait`` sleeps only if the value still equals
+    ``expected`` at entry, and then ANY ``wake`` releases it regardless of
+    the value (a generation counter prevents re-blocking on a stale
+    predicate — the lost-wakeup guard the reference gets from the kernel
+    futex). Spurious wakeups are allowed, as with real futexes: callers
+    re-check their own condition in a loop."""
+
+    __slots__ = ("_value", "_gen", "_cond")
 
     def __init__(self, value: int = 0):
         self._value = value
+        self._gen = 0
         self._cond = threading.Condition()
 
     @property
@@ -25,26 +33,30 @@ class Butex:
         return self._value
 
     def set_value(self, v: int) -> None:
+        """Plain store, no wake — exactly a memory write to the futex word."""
         with self._cond:
             self._value = v
 
     def wait(self, expected: int, timeout: Optional[float] = None) -> bool:
-        """Block while value == expected (futex semantics: returns False
-        immediately if the value already changed — the lost-wakeup guard).
-        Returns True if woken/changed, False on timeout."""
+        """Returns True if woken (or the value had already changed),
+        False on timeout."""
         with self._cond:
             if self._value != expected:
                 return True
+            g = self._gen
             with blocking():
-                return self._cond.wait_for(lambda: self._value != expected,
-                                           timeout)
+                return self._cond.wait_for(
+                    lambda: self._gen != g or self._value != expected,
+                    timeout)
 
     def wake(self, n: int = 1) -> None:
         with self._cond:
+            self._gen += 1
             self._cond.notify(n)
 
     def wake_all(self) -> None:
         with self._cond:
+            self._gen += 1
             self._cond.notify_all()
 
     def add_and_wake(self, delta: int = 1, all: bool = True) -> int:
@@ -52,6 +64,7 @@ class Butex:
         signal pattern."""
         with self._cond:
             self._value += delta
+            self._gen += 1
             if all:
                 self._cond.notify_all()
             else:

@@ -22,6 +22,7 @@ start.  Design differences, deliberate:
 from __future__ import annotations
 
 import threading
+import time
 import traceback
 from collections import deque
 from typing import Any, Callable, Deque, List, Optional
@@ -58,7 +59,9 @@ class TaskHandle:
         return self._done.is_set()
 
     def result(self, timeout: Optional[float] = None):
-        if not self._done.wait(timeout):
+        with blocking():
+            done = self._done.wait(timeout)
+        if not done:
             raise TimeoutError(f"task {self.fn_name} not done")
         if self._exc is not None:
             raise self._exc
@@ -213,6 +216,14 @@ class TaskRuntime:
         with self._lock:
             self._shutdown = True
             self._not_empty.notify_all()
+        if wait:
+            # workers drain the queue then retire; poll until none remain
+            deadline = time.monotonic() + 30.0
+            while time.monotonic() < deadline:
+                with self._lock:
+                    if self._workers == 0 and not self._queue:
+                        return
+                time.sleep(0.005)
 
 
 _global_runtime: Optional[TaskRuntime] = None

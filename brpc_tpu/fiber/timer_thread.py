@@ -17,6 +17,7 @@ import threading
 import time
 from typing import Callable, Dict, Optional
 
+from ..butil.logging_util import LOG
 from .runtime import TaskRuntime, global_runtime
 
 
@@ -85,7 +86,13 @@ class TimerThread:
                         self._cond.wait()
             for fn, args in fire:
                 self.triggered_count += 1
-                self._runtime.spawn(fn, *args, urgent=True, name="timer_cb")
+                try:
+                    self._runtime.spawn(fn, *args, urgent=True,
+                                        name="timer_cb")
+                except Exception:
+                    # a dead runtime must not kill the timer thread — every
+                    # future RPC deadline would silently never fire
+                    LOG.exception("timer callback spawn failed")
 
     def stop(self) -> None:
         with self._cond:

@@ -22,8 +22,9 @@ import threading
 from collections import deque
 from typing import Any, Callable, List, Optional, Tuple
 
-_SLOT_BITS = 28
-_SLOT_MASK = (1 << _SLOT_BITS) - 1
+# id layout: (slot_index << VERSION_BITS) | version
+VERSION_BITS = 36
+_VERSION_MASK = (1 << VERSION_BITS) - 1
 
 INVALID_CALL_ID = 0
 
@@ -71,7 +72,7 @@ class IdPool:
             slot.range = max(1, version_range)
             slot.locked = False
             slot.pending.clear()
-            return (idx << 36) | slot.base
+            return (idx << VERSION_BITS) | slot.base
 
     def create_ranged(self, data: Any, on_error: Optional[ErrorHandler],
                       version_range: int) -> int:
@@ -80,8 +81,8 @@ class IdPool:
         return self.create(data, on_error, version_range)
 
     def _resolve(self, call_id: int) -> Tuple[Optional[_Slot], int]:
-        idx = call_id >> 36
-        version = call_id & ((1 << 36) - 1)
+        idx = call_id >> VERSION_BITS
+        version = call_id & _VERSION_MASK
         try:
             slot = self._slots[idx]
         except IndexError:
@@ -123,9 +124,11 @@ class IdPool:
             return
         run: Optional[Tuple[int, str]] = None
         with slot.cond:
-            if not slot.locked:
+            # a stale id must not release a lock now owned by the slot's
+            # next incarnation (slot indexes are recycled)
+            if not slot.locked or not self._valid_locked(slot, version):
                 return
-            if slot.pending and self._valid_locked(slot, version):
+            if slot.pending:
                 run = slot.pending.popleft()
                 # keep slot.locked = True: handler owns the lock now
             else:
@@ -141,16 +144,14 @@ class IdPool:
             return False
         with slot.cond:
             if not self._valid_locked(slot, version):
-                slot.locked = False
-                slot.cond.notify_all()
-                return False
+                return False             # stale id: never touch lock state
             slot.base += slot.range      # all versions in range die at once
             slot.locked = False
             slot.data = None
             slot.pending.clear()
             slot.cond.notify_all()       # wake joiners & lock waiters
         with self._alloc_lock:
-            self._free.append(call_id >> 36)
+            self._free.append(call_id >> VERSION_BITS)
         return True
 
     # -- async error delivery --

@@ -1,0 +1,29 @@
+"""Protocol layer — pluggable struct-of-callbacks wire protocols.
+
+Capability parity with the reference's protocol registry
+(/root/reference/src/brpc/protocol.h:77-196): a protocol is a bundle of
+callbacks (parse / serialize_request / pack_request / process_request /
+process_response / verify), registered by name+id, and the transport's
+input messenger tries registered parsers to auto-detect the wire format
+on a shared port.
+"""
+
+from .base import (
+    ParseError,
+    ParseResult,
+    Protocol,
+    ProtocolType,
+    get_protocol,
+    list_protocols,
+    register_protocol,
+)
+
+__all__ = [
+    "ParseError",
+    "ParseResult",
+    "Protocol",
+    "ProtocolType",
+    "get_protocol",
+    "list_protocols",
+    "register_protocol",
+]

@@ -1,0 +1,25 @@
+"""Transport layer — sockets, event dispatch, message ingestion.
+
+TPU-native re-design of the reference's L3 core runtime
+(/root/reference/src/brpc/socket.h, event_dispatcher_epoll.cpp,
+acceptor.cpp, input_messenger.cpp): versioned-id addressed Socket objects
+with an ordered write queue drained by a keep-write task, an epoll-backed
+event dispatcher that wakes fiber tasks, an acceptor, and a
+protocol-agnostic input messenger with adaptive read sizing and
+multi-protocol detection.
+"""
+
+from .socket import Socket, SocketOptions, socket_pool
+from .event_dispatcher import EventDispatcher, global_dispatcher
+from .acceptor import Acceptor
+from .input_messenger import InputMessenger
+
+__all__ = [
+    "Socket",
+    "SocketOptions",
+    "socket_pool",
+    "EventDispatcher",
+    "global_dispatcher",
+    "Acceptor",
+    "InputMessenger",
+]

@@ -1,0 +1,94 @@
+"""Acceptor — turns a listening fd into per-connection Sockets.
+
+Capability parity with /root/reference/src/brpc/acceptor.cpp:50,243,327:
+the listener is itself a Socket whose edge-triggered callback accepts in
+a loop and creates a connection Socket wired to the server's
+InputMessenger; connections are tracked so Join can drain them.
+"""
+
+from __future__ import annotations
+
+import socket as _socket
+import threading
+from typing import Dict, Optional
+
+from ..butil.endpoint import EndPoint
+from ..butil.logging_util import LOG
+from ..butil.status import Errno
+from .event_dispatcher import EventDispatcher, global_dispatcher
+from .input_messenger import InputMessenger
+from .socket import Socket, SocketOptions
+
+
+class Acceptor:
+    def __init__(self, messenger: InputMessenger,
+                 dispatcher: Optional[EventDispatcher] = None):
+        self._messenger = messenger
+        self._dispatcher = dispatcher or global_dispatcher()
+        self._listen_sid = 0
+        self._conn_lock = threading.Lock()
+        self._connections: Dict[int, int] = {}   # sid -> sid (set)
+        self._stopped = False
+
+    def start_accept(self, listen_fd: _socket.socket) -> int:
+        """≈ Acceptor::StartAccept (acceptor.cpp:50)."""
+        listen_fd.setblocking(False)
+        sid = Socket.create(SocketOptions(
+            fd=listen_fd,
+            on_edge_triggered_events=self._on_new_connections))
+        self._listen_sid = sid
+        s = Socket.address(sid)
+        s.attach_dispatcher(self._dispatcher)
+        self._dispatcher.add_consumer(listen_fd, s.start_input_event)
+        return 0
+
+    def _on_new_connections(self, listen_sock: Socket) -> None:
+        """≈ OnNewConnections (acceptor.cpp:243): accept until EAGAIN."""
+        while not self._stopped:
+            try:
+                conn, addr = listen_sock.fd.accept()
+            except (BlockingIOError, OSError):
+                return
+            conn.setblocking(False)
+            try:
+                conn.setsockopt(_socket.IPPROTO_TCP, _socket.TCP_NODELAY, 1)
+            except OSError:
+                pass
+            remote = EndPoint(host=addr[0], port=addr[1]) \
+                if isinstance(addr, tuple) else EndPoint(host=str(addr), port=0)
+            sid = Socket.create(SocketOptions(
+                fd=conn, remote_side=remote,
+                on_edge_triggered_events=self._messenger.on_new_messages))
+            s = Socket.address(sid)
+            s.attach_dispatcher(self._dispatcher)
+            with self._conn_lock:
+                self._connections[sid] = sid
+            self._dispatcher.add_consumer(conn, s.start_input_event)
+
+    def connection_count(self) -> int:
+        self._gc()
+        with self._conn_lock:
+            return len(self._connections)
+
+    def _gc(self) -> None:
+        with self._conn_lock:
+            dead = [sid for sid in self._connections
+                    if Socket.address(sid) is None
+                    or Socket.address(sid).failed]
+            for sid in dead:
+                del self._connections[sid]
+
+    def stop_accept(self) -> None:
+        """≈ Acceptor::StopAccept: close listener, fail connections."""
+        self._stopped = True
+        ls = Socket.address(self._listen_sid)
+        if ls is not None:
+            ls.set_failed(Errno.ELOGOFF, "server stopping")
+        with self._conn_lock:
+            sids = list(self._connections)
+        for sid in sids:
+            s = Socket.address(sid)
+            if s is not None:
+                s.set_failed(Errno.ELOGOFF, "server stopping")
+        with self._conn_lock:
+            self._connections.clear()

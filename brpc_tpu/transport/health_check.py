@@ -1,0 +1,51 @@
+"""Health checking — revive failed connections by periodic re-connect.
+
+Capability parity with /root/reference/src/brpc/details/health_check.cpp:
+70,146,161,237: when a Socket with a health-check interval fails, a
+periodic task re-connects; on success the socket is revived (and the
+channel's load balancer sees it usable again). An optional app-level
+check RPC (``health_check_path``) can gate revival — wired in by the
+client layer once HTTP is available.
+"""
+
+from __future__ import annotations
+
+import socket as _socket
+from typing import Optional
+
+from ..butil.logging_util import LOG
+from ..bvar.reducer import Adder
+from ..fiber.timer_thread import global_timer_thread
+from .socket import Socket
+
+_revived = Adder("socket_revive_count")
+
+
+def start_health_check(sid: int, interval_s: float,
+                       max_attempts: int = 0) -> None:
+    """Schedule periodic reconnect attempts for the failed socket ``sid``
+    every ``interval_s`` (reference default 3s, socket_map.cpp:33)."""
+    attempt = {"n": 0}
+
+    def check() -> None:
+        s = Socket.address(sid)
+        if s is None or not s.failed or s.remote_side is None:
+            return                       # destroyed or already revived
+        attempt["n"] += 1
+        try:
+            fd = _socket.create_connection(
+                s.remote_side.to_sockaddr(), timeout=s.connect_timeout_s)
+            fd.setblocking(False)
+            fd.setsockopt(_socket.IPPROTO_TCP, _socket.TCP_NODELAY, 1)
+            s.fd = fd
+            s.revive()
+            _revived << 1
+            return
+        except OSError:
+            if max_attempts and attempt["n"] >= max_attempts:
+                LOG.warning("health check giving up on socket %d (%s)",
+                            sid, s.remote_side)
+                return
+            global_timer_thread().schedule(check, delay_s=interval_s)
+
+    global_timer_thread().schedule(check, delay_s=interval_s)

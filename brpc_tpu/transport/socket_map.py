@@ -1,0 +1,158 @@
+"""SocketMap — client-side connection management.
+
+Capability parity with /root/reference/src/brpc/socket_map.cpp and the
+connection-type matrix (protocol.h:174-181):
+
+- **single**: one shared connection per peer, responses matched by
+  correlation id (the default; cheapest, what multiplexing protocols use);
+- **pooled**: a free-list of connections per peer; a connection carries
+  one in-flight call then returns to the pool (for protocols without
+  multiplexing — HTTP/1 without pipelining);
+- **short**: connect per call, close after.
+
+All connections are wired to the process-wide client InputMessenger so
+responses flow back through the protocol's process_response.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+from typing import Deque, Dict, Optional, Tuple
+
+from ..butil.endpoint import EndPoint
+from ..butil.status import Errno
+from .event_dispatcher import global_dispatcher
+from .input_messenger import client_messenger
+from .socket import Socket, SocketOptions
+
+DEFAULT_HEALTH_CHECK_INTERVAL_S = 3.0   # reference socket_map.cpp:33
+
+
+def _new_connection(remote: EndPoint,
+                    health_check_interval_s: float = 0.0) -> Tuple[int, int]:
+    """Create+connect a client Socket wired for responses.
+    Returns (socket_id, error_code)."""
+    sid = Socket.create(SocketOptions(
+        remote_side=remote,
+        on_edge_triggered_events=client_messenger().on_new_messages,
+        health_check_interval_s=health_check_interval_s))
+    s = Socket.address(sid)
+    rc = s.connect_if_not()
+    if rc != 0:
+        return sid, rc
+    disp = global_dispatcher()
+    s.attach_dispatcher(disp)
+    disp.add_consumer(s.fd, s.start_input_event)
+    return sid, 0
+
+
+class SocketMap:
+    """Peer → shared "single" connection dedup map (socket_map.cpp)."""
+
+    def __init__(self, health_check_interval_s: float =
+                 DEFAULT_HEALTH_CHECK_INTERVAL_S):
+        self._lock = threading.Lock()
+        self._map: Dict[EndPoint, int] = {}
+        self._hc = health_check_interval_s
+
+    def get_socket(self, remote: EndPoint) -> Tuple[int, int]:
+        """Return (socket_id, 0) for the shared connection to ``remote``,
+        creating it on first use. A failed socket stays in the map —
+        health check revives it in place, exactly the reference behavior
+        (callers see EFAILEDSOCKET meanwhile and may retry elsewhere)."""
+        with self._lock:
+            sid = self._map.get(remote)
+            if sid is not None:
+                s = Socket.address(sid)
+                if s is not None:
+                    return sid, 0
+            sid, rc = _new_connection(remote, self._hc)
+            if rc == 0 or Socket.address(sid) is not None:
+                self._map[remote] = sid
+            return sid, rc
+
+    def remove(self, remote: EndPoint) -> None:
+        with self._lock:
+            sid = self._map.pop(remote, None)
+        if sid is not None:
+            s = Socket.address(sid)
+            if s is not None:
+                s.release()
+
+    def clear(self) -> None:
+        with self._lock:
+            sids = list(self._map.values())
+            self._map.clear()
+        for sid in sids:
+            s = Socket.address(sid)
+            if s is not None:
+                s.release()
+
+
+class SocketPool:
+    """Per-peer pooled connections (≈ Socket::GetPooledSocket,
+    socket.cpp:2650)."""
+
+    def __init__(self, remote: EndPoint, max_pooled: int = 32):
+        self._remote = remote
+        self._lock = threading.Lock()
+        self._free: Deque[int] = deque()
+        self._max = max_pooled
+
+    def get(self) -> Tuple[int, int]:
+        while True:
+            with self._lock:
+                sid = self._free.popleft() if self._free else None
+            if sid is None:
+                break
+            s = Socket.address(sid)
+            if s is not None and not s.failed:
+                return sid, 0
+        sid, rc = _new_connection(self._remote)
+        s = Socket.address(sid)
+        if s is not None:
+            s._pooled_home = self
+        return sid, rc
+
+    def put(self, sid: int) -> None:
+        s = Socket.address(sid)
+        if s is None or s.failed:
+            return
+        with self._lock:
+            if len(self._free) < self._max:
+                self._free.append(sid)
+                return
+        s.release()
+
+
+_global_map: Optional[SocketMap] = None
+_global_map_lock = threading.Lock()
+_pools_lock = threading.Lock()
+_pools: Dict[EndPoint, SocketPool] = {}
+
+
+def global_socket_map() -> SocketMap:
+    global _global_map
+    with _global_map_lock:
+        if _global_map is None:
+            _global_map = SocketMap()
+        return _global_map
+
+
+def pooled_socket(remote: EndPoint) -> Tuple[int, int]:
+    with _pools_lock:
+        pool = _pools.get(remote)
+        if pool is None:
+            pool = _pools[remote] = SocketPool(remote)
+    return pool.get()
+
+
+def return_pooled_socket(sid: int) -> None:
+    s = Socket.address(sid)
+    if s is not None and s._pooled_home is not None:
+        s._pooled_home.put(sid)
+
+
+def short_socket(remote: EndPoint) -> Tuple[int, int]:
+    return _new_connection(remote)
