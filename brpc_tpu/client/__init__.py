@@ -1,0 +1,13 @@
+"""Client side — Channel/Controller with deadline, retry, backup request,
+cancel; naming/load-balancing/circuit-breaking layers on top.
+
+Capability parity with /root/reference/src/brpc/channel.h:160-190 and
+controller.h:110: every call is guarded by a versioned correlation id —
+response threads, timers, cancellation, and socket failures all rendezvous
+through the id lock, never a global table.
+"""
+
+from .channel import Channel, ChannelOptions
+from .controller import Controller, start_cancel
+
+__all__ = ["Channel", "ChannelOptions", "Controller", "start_cancel"]

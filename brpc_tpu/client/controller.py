@@ -1,0 +1,343 @@
+"""Controller — the per-RPC state machine.
+
+≈ /root/reference/src/brpc/controller.cpp: IssueRPC (:985),
+OnVersionedRPCReturned (:568), Call::OnComplete (:726), HandleSocketFailed,
+HandleTimeout, HandleBackupRequest (channel.cpp:402), StartCancel (:358).
+
+Rendezvous design (the reference's, re-expressed):
+
+- a ranged correlation id spans ``max_retry + 2`` versions; attempt k
+  writes ``cid_base + k`` into the frame meta, so a response names the
+  attempt that produced it;
+- the response path, the deadline timer, the backup-request timer, socket
+  failure, and user cancel ALL deliver through the IdPool — whoever locks
+  the id owns the controller for that moment; stale attempts fail the
+  version check and are dropped.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Optional, Set
+
+from ..butil.iobuf import IOBuf
+from ..butil.logging_util import LOG
+from ..butil.status import Errno
+from ..butil.time_utils import monotonic_us
+from ..fiber.timer_thread import global_timer_thread
+from ..fiber.versioned_id import global_id_pool
+from ..protocol import compress as compress_mod
+from ..protocol.meta import CompressType, RpcMeta
+from ..protocol.tpu_std import RpcMessage, pack_frame, parse_payload
+from ..transport.socket import Socket
+from ..transport.socket_map import (global_socket_map, pooled_socket,
+                                    return_pooled_socket, short_socket)
+
+_idp = global_id_pool()
+
+# errors worth retrying on another attempt (≈ DefaultRetryPolicy,
+# /root/reference/src/brpc/retry_policy.cpp)
+_RETRIABLE = {int(Errno.EFAILEDSOCKET), int(Errno.EEOF),
+              int(Errno.ELOGOFF), int(Errno.EUNUSED)}
+
+
+def default_retry_policy(cntl: "Controller", error_code: int) -> bool:
+    return error_code in _RETRIABLE
+
+
+class Controller:
+    # user-facing knobs (None = inherit from ChannelOptions)
+    __slots__ = (
+        "timeout_ms", "max_retry", "backup_request_ms",
+        "request_attachment", "response_attachment",
+        "request_compress_type", "connection_type", "retry_policy",
+        # results
+        "response", "latency_us", "remote_side", "retried_count",
+        "has_backup_request",
+        # internals
+        "_error_code", "_error_text", "_cid_base", "_nretry",
+        "_live_versions", "_done", "_response_type", "_request_payload",
+        "_method_full", "_remote", "_begin_us", "_ended",
+        "_timeout_timer", "_backup_timer", "_sending_sid",
+        "_channel", "_lb_ctx", "trace_id", "span_id",
+    )
+
+    def __init__(self):
+        self.timeout_ms: Optional[int] = None
+        self.max_retry: Optional[int] = None
+        self.backup_request_ms: Optional[int] = None
+        self.request_attachment = IOBuf()
+        self.response_attachment = IOBuf()
+        self.request_compress_type = CompressType.NONE
+        self.connection_type: Optional[str] = None
+        self.retry_policy: Callable = default_retry_policy
+        self.response: Any = None
+        self.latency_us = 0
+        self.remote_side = None
+        self.retried_count = 0
+        self.has_backup_request = False
+        self._error_code = 0
+        self._error_text = ""
+        self._cid_base = 0
+        self._nretry = 0
+        self._live_versions: Set[int] = set()
+        self._done: Optional[Callable] = None
+        self._response_type: Any = None
+        self._request_payload = IOBuf()
+        self._method_full = ""
+        self._remote = None
+        self._begin_us = 0
+        self._ended = threading.Event()
+        self._timeout_timer = 0
+        self._backup_timer = 0
+        self._sending_sid = 0
+        self._channel = None
+        self._lb_ctx = None
+        self.trace_id = 0
+        self.span_id = 0
+
+    # -- results -----------------------------------------------------------
+
+    @property
+    def failed(self) -> bool:
+        return self._error_code != 0
+
+    @property
+    def error_code(self) -> int:
+        return self._error_code
+
+    @property
+    def error_text(self) -> str:
+        return self._error_text
+
+    def set_failed(self, code: int, text: str = "") -> None:
+        self._error_code = int(code)
+        self._error_text = text
+
+    @property
+    def call_id(self) -> int:
+        """Cancel handle (≈ Controller::call_id, controller.cpp:358)."""
+        return self._cid_base
+
+    def join(self, timeout: Optional[float] = None) -> bool:
+        return _idp.join(self._cid_base, timeout) if self._cid_base \
+            else self._ended.wait(timeout)
+
+    # -- launch (called by Channel) ---------------------------------------
+
+    def _launch(self, channel, method_full: str, payload: IOBuf,
+                response_type: Any, done: Optional[Callable]) -> None:
+        opts = channel.options
+        self._channel = channel
+        self._method_full = method_full
+        self._request_payload = payload
+        self._response_type = response_type
+        self._done = done
+        if self.timeout_ms is None:
+            self.timeout_ms = opts.timeout_ms
+        if self.max_retry is None:
+            self.max_retry = opts.max_retry
+        if self.backup_request_ms is None:
+            self.backup_request_ms = opts.backup_request_ms
+        if self.connection_type is None:
+            self.connection_type = opts.connection_type
+        self._begin_us = monotonic_us()
+        self._cid_base = _idp.create_ranged(
+            self, Controller._on_id_error, self.max_retry + 2)
+        self._live_versions = {0}
+        if self.timeout_ms and self.timeout_ms > 0:
+            self._timeout_timer = global_timer_thread().schedule(
+                _idp.error, self.timeout_ms / 1e3, None,
+                self._cid_base, int(Errno.ERPCTIMEDOUT),
+                f"deadline {self.timeout_ms}ms exceeded")
+        if self.backup_request_ms and self.backup_request_ms > 0 \
+                and self.backup_request_ms < (self.timeout_ms or 1 << 30):
+            self._backup_timer = global_timer_thread().schedule(
+                _idp.error, self.backup_request_ms / 1e3, None,
+                self._cid_base, int(Errno.EBACKUPREQUEST), "")
+        self._issue_rpc()
+
+    # -- attempt issuing ---------------------------------------------------
+
+    def _select_remote(self):
+        """Single server or LB selection (≈ IssueRPC :1020-1036)."""
+        ch = self._channel
+        if ch.load_balancer is not None:
+            return ch.load_balancer.select_server(self)
+        return ch.single_server
+
+    def _issue_rpc(self) -> None:
+        """Send attempt ``self._nretry``. Runs with the id logically held
+        (either at launch or inside an error handler)."""
+        remote = self._select_remote()
+        if remote is None:
+            self._finish_locked_or_now(Errno.EINTERNAL,
+                                       "no server available", locked=False)
+            return
+        self.remote_side = remote
+        attempt_id = self._cid_base + self._nretry
+        ctype = self.connection_type or "single"
+        if ctype == "pooled":
+            sid, rc = pooled_socket(remote)
+        elif ctype == "short":
+            sid, rc = short_socket(remote)
+        else:
+            sid, rc = global_socket_map().get_socket(remote)
+        self._sending_sid = sid
+        sock = Socket.address(sid)
+        if sock is None or (rc != 0 and sock.failed):
+            # connection failed synchronously: deliver through the id so
+            # the retry path is uniform
+            _idp.error(attempt_id, int(Errno.EFAILEDSOCKET),
+                       f"connect to {remote} failed")
+            return
+        meta = RpcMeta()
+        meta.correlation_id = attempt_id
+        svc, mth = self._method_full.rsplit(".", 1)
+        meta.service_name = svc
+        meta.method_name = mth
+        meta.trace_id = self.trace_id
+        meta.span_id = self.span_id
+        if self.timeout_ms and self.timeout_ms > 0:
+            elapsed_ms = (monotonic_us() - self._begin_us) // 1000
+            meta.timeout_ms = max(1, int(self.timeout_ms - elapsed_ms))
+        payload = self._request_payload
+        if self.request_compress_type:
+            data = compress_mod.compress(payload.to_bytes(),
+                                         self.request_compress_type)
+            if data is not None:
+                meta.compress_type = self.request_compress_type
+                payload = IOBuf(data)
+        frame = pack_frame(meta, payload, attachment=self.request_attachment)
+        sock.write(frame, id_wait=attempt_id)
+
+    # -- asynchronous events (timers / socket failures / cancel) ----------
+
+    @staticmethod
+    def _on_id_error(call_id: int, cntl: "Controller", code: int,
+                     text: str) -> None:
+        """Runs with the correlation id LOCKED (IdPool contract)."""
+        if cntl is None:
+            _idp.unlock_and_destroy(call_id)
+            return
+        if code == int(Errno.EBACKUPREQUEST):
+            if cntl._nretry < cntl.max_retry:
+                cntl.has_backup_request = True
+                cntl._nretry += 1
+                cntl.retried_count = cntl._nretry
+                cntl._live_versions.add(cntl._nretry)
+                cntl._issue_rpc()
+            _idp.unlock(cntl._cid_base)
+            return
+        if code == int(Errno.ECANCELLED) or code == int(Errno.ERPCTIMEDOUT):
+            cntl._finish_locked(code, text or "cancelled")
+            return
+        # socket-level failure of some attempt
+        version = (call_id - cntl._cid_base) & ((1 << 36) - 1)
+        cntl._live_versions.discard(version)
+        if cntl.retry_policy(cntl, code) and cntl._nretry < cntl.max_retry:
+            cntl._nretry += 1
+            cntl.retried_count = cntl._nretry
+            cntl._live_versions.add(cntl._nretry)
+            cntl._issue_rpc()
+            _idp.unlock(cntl._cid_base)
+            return
+        if cntl._live_versions:
+            # another attempt (e.g. the original besides a failed backup)
+            # is still in flight — let it decide the call's fate
+            _idp.unlock(cntl._cid_base)
+            return
+        cntl._finish_locked(code, text)
+
+    # -- response path -----------------------------------------------------
+
+    def _on_response(self, msg: RpcMessage) -> None:
+        """Runs with the id LOCKED. ≈ OnVersionedRPCReturned."""
+        version = msg.meta.correlation_id - self._cid_base
+        if version not in self._live_versions:
+            _idp.unlock(self._cid_base)      # stale attempt's response
+            return
+        code = msg.meta.error_code
+        if code != 0:
+            self._live_versions.discard(version)
+            if self.retry_policy(self, code) \
+                    and self._nretry < self.max_retry:
+                self._nretry += 1
+                self.retried_count = self._nretry
+                self._live_versions.add(self._nretry)
+                self._issue_rpc()
+                _idp.unlock(self._cid_base)
+                return
+            self._finish_locked(code, msg.meta.error_text)
+            return
+        attachment = msg.split_attachment()
+        raw = msg.payload.to_bytes()
+        if msg.meta.compress_type:
+            raw = compress_mod.decompress(raw, msg.meta.compress_type)
+            if raw is None:
+                self._finish_locked(Errno.ERESPONSE,
+                                    "undecompressable response")
+                return
+        try:
+            self.response = parse_payload(raw, self._response_type)
+        except Exception as e:
+            self._finish_locked(Errno.ERESPONSE,
+                                f"response parse failed: {e}")
+            return
+        self.response_attachment = attachment
+        self._finish_locked(0, "")
+
+    # -- completion --------------------------------------------------------
+
+    def _finish_locked(self, code: int, text: str) -> None:
+        """Final rendezvous: set results, destroy the id (wakes sync
+        joiners), then run the async done callback if any."""
+        self._error_code = int(code)
+        self._error_text = text
+        self.latency_us = monotonic_us() - self._begin_us
+        if self._timeout_timer:
+            global_timer_thread().unschedule(self._timeout_timer)
+        if self._backup_timer:
+            global_timer_thread().unschedule(self._backup_timer)
+        if self.connection_type == "pooled" and self._sending_sid \
+                and code == 0:
+            return_pooled_socket(self._sending_sid)
+        elif self.connection_type == "short" and self._sending_sid:
+            s = Socket.address(self._sending_sid)
+            if s is not None:
+                s.release()
+        ch = self._channel
+        if ch is not None and ch.load_balancer is not None:
+            ch.load_balancer.feedback(self)
+        _idp.unlock_and_destroy(self._cid_base)
+        self._ended.set()
+        done = self._done
+        if done is not None:
+            try:
+                done(self)
+            except Exception:
+                LOG.exception("rpc done callback raised")
+
+    def _finish_locked_or_now(self, code: int, text: str,
+                              locked: bool) -> None:
+        if locked:
+            self._finish_locked(code, text)
+        else:
+            _idp.error(self._cid_base, int(code), text)
+
+
+def process_rpc_response(msg: RpcMessage, sock: Socket) -> None:
+    """Entry from the client InputMessenger (≈ ProcessRpcResponse,
+    baidu_rpc_protocol.cpp:565)."""
+    cid = msg.meta.correlation_id
+    ok, cntl = _idp.lock(cid)
+    if not ok or cntl is None:
+        if ok:
+            _idp.unlock(cid)
+        return                          # late response of a finished call
+    cntl._on_response(msg)
+
+
+def start_cancel(call_id: int) -> None:
+    """≈ brpc::StartCancel(CallId): asynchronous, idempotent."""
+    _idp.error(call_id, int(Errno.ECANCELLED), "cancelled by caller")

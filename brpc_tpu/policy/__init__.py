@@ -1,0 +1,4 @@
+"""Pluggable policies — concurrency limiters, load balancers, naming
+services, retry/backup policies (≈ /root/reference/src/brpc/policy/).
+Each sub-module registers its implementations in the relevant extension
+registry on import."""

@@ -1,0 +1,119 @@
+"""Concurrency limiters
+(≈ /root/reference/src/brpc/concurrency_limiter.h:29-52 and
+policy/auto_concurrency_limiter.h:28,55-63):
+
+- **constant**: fixed in-flight cap ("constant:100" or an int);
+- **auto**: gradient/Vegas-style adaptive limit — tracks a smoothed
+  no-load latency estimate; when recent latency inflates beyond it the
+  limit shrinks, when the pipeline is full and latency is flat it grows.
+  Fresh implementation of the reference's algorithm *shape* (EMA minimum
+  latency + qps-driven limit), not its code.
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+import time
+from typing import Optional
+
+
+class ConcurrencyLimiter:
+    """Plugin interface: max_concurrency() read per-request;
+    on_responded(error_code, latency_us) feeds the controller."""
+
+    def max_concurrency(self) -> int:
+        raise NotImplementedError
+
+    def on_responded(self, error_code: int, latency_us: float) -> None:
+        pass
+
+
+class ConstantLimiter(ConcurrencyLimiter):
+    def __init__(self, limit: int):
+        self._limit = int(limit)
+
+    def max_concurrency(self) -> int:
+        return self._limit
+
+
+class AutoLimiter(ConcurrencyLimiter):
+    """Adaptive limit ≈ auto_concurrency_limiter.h: sampling windows of
+    (qps, latency); min-latency EMA as the no-load estimate; limit =
+    peak_qps × min_latency × (1 + alpha) with shrink on latency blow-up."""
+
+    def __init__(self,
+                 min_limit: int = 8,
+                 max_limit: int = 4096,
+                 sample_window_s: float = 0.1,
+                 min_sample_count: int = 50,
+                 alpha_factor: float = 0.3):
+        self._lock = threading.Lock()
+        self._limit = min_limit * 4
+        self._min_limit = min_limit
+        self._max_limit = max_limit
+        self._window_s = sample_window_s
+        self._min_samples = min_sample_count
+        self._alpha = alpha_factor
+        self._win_start = time.monotonic()
+        self._win_count = 0
+        self._win_err = 0
+        self._win_lat_sum = 0.0
+        self._nolat_ema: Optional[float] = None   # no-load latency (us)
+        self._peak_qps = 0.0
+
+    def max_concurrency(self) -> int:
+        return self._limit
+
+    def on_responded(self, error_code: int, latency_us: float) -> None:
+        with self._lock:
+            self._win_count += 1
+            if error_code != 0:
+                self._win_err += 1
+            else:
+                self._win_lat_sum += latency_us
+            now = time.monotonic()
+            dt = now - self._win_start
+            if dt < self._window_s or self._win_count < self._min_samples:
+                return
+            ok = self._win_count - self._win_err
+            if ok > 0:
+                avg_lat = self._win_lat_sum / ok
+                qps = ok / dt
+                self._peak_qps = max(self._peak_qps * 0.98, qps)
+                if self._nolat_ema is None or avg_lat < self._nolat_ema:
+                    self._nolat_ema = avg_lat
+                else:   # slow drift up so the estimate can recover
+                    self._nolat_ema += (avg_lat - self._nolat_ema) * 0.02
+                base = self._peak_qps * (self._nolat_ema / 1e6)
+                if avg_lat > self._nolat_ema * (1.0 + self._alpha):
+                    new_limit = base * (1.0 - self._alpha / 2)
+                else:
+                    new_limit = base * (1.0 + self._alpha)
+                self._limit = int(min(self._max_limit,
+                                      max(self._min_limit,
+                                          math.ceil(new_limit))))
+            self._win_start = now
+            self._win_count = 0
+            self._win_err = 0
+            self._win_lat_sum = 0.0
+
+
+def make_limiter(spec) -> Optional[ConcurrencyLimiter]:
+    """Parse an AdaptiveMaxConcurrency-style spec
+    (≈ src/brpc/adaptive_max_concurrency.h): int / "constant:N" /
+    "auto" / "unlimited"."""
+    if spec is None:
+        return None
+    if isinstance(spec, int):
+        return ConstantLimiter(spec) if spec > 0 else None
+    s = str(spec).strip().lower()
+    if s in ("", "unlimited", "0"):
+        return None
+    if s == "auto":
+        return AutoLimiter()
+    if s.startswith("constant:"):
+        return ConstantLimiter(int(s.split(":", 1)[1]))
+    if s.isdigit():
+        return ConstantLimiter(int(s))
+    raise ValueError(f"unknown concurrency limiter spec: {spec!r}")

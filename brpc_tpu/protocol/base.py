@@ -101,9 +101,9 @@ class Protocol:
         RPC (not per retry). On failure, set error on controller.
     pack_request(payload: IOBuf, controller, correlation_id) -> IOBuf
         Frame the serialized payload for one attempt (adds header/meta).
-    process_request(msg, messenger_arg) -> None
+    process_request(msg, socket, server) -> None
         Server-side: full service dispatch for one cut message.
-    process_response(msg) -> None
+    process_response(msg, socket) -> None
         Client-side: rendezvous with the waiting call via correlation id.
     verify(msg) -> bool
         Server-side auth check on first message of a connection.

@@ -1,0 +1,147 @@
+"""RpcMeta — the framed-RPC meta block and its wire codec.
+
+Capability parity with the reference's baidu_std RpcMeta
+(/root/reference/src/brpc/policy/baidu_rpc_meta.proto): correlation id,
+request (service/method/attachment) or response (error code/text) halves,
+compression, auth, and trace context riding every frame.
+
+Fresh design: the wire codec is a deterministic tag-length-value format
+(not protobuf) so the framework has zero codegen dependencies for its own
+control plane; payloads remain opaque bytes and MAY be protobuf — any
+object with SerializeToString/ParseFromString plugs in at the user layer.
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Optional
+
+# field tags (u8). 0 terminates.
+_T_CORRELATION = 1      # u64
+_T_COMPRESS = 2         # u8
+_T_ATTACHMENT = 3       # u32 size of attachment tail within payload
+_T_SERVICE = 4          # utf-8
+_T_METHOD = 5           # utf-8
+_T_ERROR_CODE = 6       # i32
+_T_ERROR_TEXT = 7       # utf-8
+_T_AUTH = 8             # bytes
+_T_TRACE_ID = 9         # u64
+_T_SPAN_ID = 10         # u64
+_T_PARENT_SPAN = 11     # u64
+_T_STREAM_ID = 12       # u64 (streaming rpc settlement)
+_T_TIMEOUT_MS = 13      # u32 remaining-deadline propagation
+
+
+class CompressType:
+    NONE = 0
+    GZIP = 1
+    ZLIB = 2
+    SNAPPY = 3
+
+
+class RpcMeta:
+    __slots__ = ("correlation_id", "compress_type", "attachment_size",
+                 "service_name", "method_name", "error_code", "error_text",
+                 "auth_data", "trace_id", "span_id", "parent_span_id",
+                 "stream_id", "timeout_ms")
+
+    def __init__(self):
+        self.correlation_id = 0
+        self.compress_type = CompressType.NONE
+        self.attachment_size = 0
+        self.service_name = ""
+        self.method_name = ""
+        self.error_code = 0
+        self.error_text = ""
+        self.auth_data = b""
+        self.trace_id = 0
+        self.span_id = 0
+        self.parent_span_id = 0
+        self.stream_id = 0
+        self.timeout_ms = 0
+
+    @property
+    def is_request(self) -> bool:
+        return bool(self.method_name)
+
+    # -- codec -------------------------------------------------------------
+
+    def encode(self) -> bytes:
+        out = bytearray()
+
+        def put(tag: int, data: bytes) -> None:
+            out.append(tag)
+            out.extend(struct.pack("<I", len(data)))
+            out.extend(data)
+
+        if self.correlation_id:
+            put(_T_CORRELATION, struct.pack("<Q", self.correlation_id))
+        if self.compress_type:
+            put(_T_COMPRESS, bytes([self.compress_type]))
+        if self.attachment_size:
+            put(_T_ATTACHMENT, struct.pack("<I", self.attachment_size))
+        if self.service_name:
+            put(_T_SERVICE, self.service_name.encode())
+        if self.method_name:
+            put(_T_METHOD, self.method_name.encode())
+        if self.error_code:
+            put(_T_ERROR_CODE, struct.pack("<i", self.error_code))
+        if self.error_text:
+            put(_T_ERROR_TEXT, self.error_text.encode())
+        if self.auth_data:
+            put(_T_AUTH, self.auth_data)
+        if self.trace_id:
+            put(_T_TRACE_ID, struct.pack("<Q", self.trace_id))
+        if self.span_id:
+            put(_T_SPAN_ID, struct.pack("<Q", self.span_id))
+        if self.parent_span_id:
+            put(_T_PARENT_SPAN, struct.pack("<Q", self.parent_span_id))
+        if self.stream_id:
+            put(_T_STREAM_ID, struct.pack("<Q", self.stream_id))
+        if self.timeout_ms:
+            put(_T_TIMEOUT_MS, struct.pack("<I", self.timeout_ms))
+        return bytes(out)
+
+    @staticmethod
+    def decode(data: bytes) -> Optional["RpcMeta"]:
+        m = RpcMeta()
+        off, end = 0, len(data)
+        try:
+            while off < end:
+                tag = data[off]
+                (ln,) = struct.unpack_from("<I", data, off + 1)
+                off += 5
+                field = data[off:off + ln]
+                if len(field) != ln:
+                    return None
+                off += ln
+                if tag == _T_CORRELATION:
+                    (m.correlation_id,) = struct.unpack("<Q", field)
+                elif tag == _T_COMPRESS:
+                    m.compress_type = field[0]
+                elif tag == _T_ATTACHMENT:
+                    (m.attachment_size,) = struct.unpack("<I", field)
+                elif tag == _T_SERVICE:
+                    m.service_name = field.decode()
+                elif tag == _T_METHOD:
+                    m.method_name = field.decode()
+                elif tag == _T_ERROR_CODE:
+                    (m.error_code,) = struct.unpack("<i", field)
+                elif tag == _T_ERROR_TEXT:
+                    m.error_text = field.decode()
+                elif tag == _T_AUTH:
+                    m.auth_data = field
+                elif tag == _T_TRACE_ID:
+                    (m.trace_id,) = struct.unpack("<Q", field)
+                elif tag == _T_SPAN_ID:
+                    (m.span_id,) = struct.unpack("<Q", field)
+                elif tag == _T_PARENT_SPAN:
+                    (m.parent_span_id,) = struct.unpack("<Q", field)
+                elif tag == _T_STREAM_ID:
+                    (m.stream_id,) = struct.unpack("<Q", field)
+                elif tag == _T_TIMEOUT_MS:
+                    (m.timeout_ms,) = struct.unpack("<I", field)
+                # unknown tags are skipped: forward compatibility
+        except (struct.error, IndexError, UnicodeDecodeError):
+            return None
+        return m

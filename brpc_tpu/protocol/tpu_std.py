@@ -1,0 +1,160 @@
+"""tpu_std — the default framed pb-RPC protocol.
+
+Capability parity with baidu_std
+(/root/reference/src/brpc/policy/baidu_rpc_protocol.cpp:58,101-105):
+
+    [ "TRPC" ][ u32 body_size ][ u32 meta_size ]  -- 12-byte header
+    [ meta (RpcMeta TLV) ][ payload ][ attachment ]
+
+where body_size = meta_size + len(payload) + len(attachment). The
+attachment rides uncompressed after the (possibly compressed) payload —
+the zero-copy side channel for bulk bytes (tensors!) that must not pass
+through a serializer.
+
+Server dispatch and client rendezvous live in brpc_tpu.server / .client;
+this module owns framing only (the reference's layering: protocol parse
+vs ProcessRpcRequest policy glue).
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Any, Optional
+
+from ..butil.iobuf import IOBuf
+from .base import (MAX_BODY_SIZE, ParseResult, Protocol, ProtocolType,
+                   register_protocol)
+from .meta import RpcMeta
+
+MAGIC = b"TRPC"
+HEADER_SIZE = 12
+
+
+class RpcMessage:
+    """One cut frame: meta + payload IOBuf (attachment still inside;
+    split by the dispatch layer using meta.attachment_size)."""
+
+    __slots__ = ("meta", "payload", "socket_id")
+
+    def __init__(self, meta: RpcMeta, payload: IOBuf, socket_id: int = 0):
+        self.meta = meta
+        self.payload = payload
+        self.socket_id = socket_id
+
+    def split_attachment(self) -> IOBuf:
+        """Cut the attachment tail off the payload; returns it (empty if
+        none)."""
+        n = self.meta.attachment_size
+        if n <= 0 or n > len(self.payload):
+            return IOBuf()
+        body_len = len(self.payload) - n
+        body = self.payload.cutn(body_len)
+        attachment = self.payload
+        self.payload = body
+        return attachment
+
+
+def parse(source: IOBuf, sock, read_eof: bool, arg) -> ParseResult:
+    """≈ ParseRpcMessage (baidu_rpc_protocol.cpp:95)."""
+    avail = len(source)
+    if avail < HEADER_SIZE:
+        got = source.fetch(min(4, avail))
+        if MAGIC.startswith(got):
+            return ParseResult.not_enough_data()
+        return ParseResult.try_others()
+    header = source.fetch(HEADER_SIZE)
+    if header[:4] != MAGIC:
+        return ParseResult.try_others()
+    body_size, meta_size = struct.unpack_from("<II", header, 4)
+    if body_size > MAX_BODY_SIZE:
+        return ParseResult.too_big(MAX_BODY_SIZE)
+    if meta_size > body_size:
+        return ParseResult.absolutely_wrong()
+    if avail < HEADER_SIZE + body_size:
+        return ParseResult.not_enough_data()
+    source.pop_front(HEADER_SIZE)
+    meta_bytes = source.fetch(meta_size)
+    source.pop_front(meta_size)
+    meta = RpcMeta.decode(meta_bytes)
+    if meta is None:
+        return ParseResult.absolutely_wrong()
+    payload = source.cutn(body_size - meta_size)
+    sid = getattr(sock, "id", 0)
+    return ParseResult.make_message(RpcMessage(meta, payload, sid))
+
+
+def pack_frame(meta: RpcMeta, payload: IOBuf,
+               attachment: Optional[IOBuf] = None) -> IOBuf:
+    """Frame one message. ``attachment`` is appended after the payload and
+    its size recorded in the meta (zero-copy: the attachment IOBuf's
+    blocks are shared, not copied)."""
+    if attachment is not None and len(attachment) > 0:
+        meta.attachment_size = len(attachment)
+    meta_bytes = meta.encode()
+    body_size = len(meta_bytes) + len(payload) + meta.attachment_size
+    out = IOBuf(MAGIC + struct.pack("<II", body_size, len(meta_bytes)))
+    out.append(meta_bytes)
+    out.append_iobuf(payload)
+    if attachment is not None and len(attachment) > 0:
+        out.append_iobuf(attachment)
+    return out
+
+
+def serialize_payload(obj: Any) -> IOBuf:
+    """User object → payload IOBuf. bytes-likes pass through; protobuf-shaped
+    objects (SerializeToString) and this framework's light messages
+    (serialize()) are supported."""
+    if isinstance(obj, IOBuf):
+        return obj
+    if isinstance(obj, (bytes, bytearray, memoryview)):
+        return IOBuf(obj)
+    if hasattr(obj, "SerializeToString"):
+        return IOBuf(obj.SerializeToString())
+    if hasattr(obj, "serialize"):
+        return IOBuf(obj.serialize())
+    if obj is None:
+        return IOBuf()
+    raise TypeError(f"cannot serialize {type(obj).__name__} as RPC payload")
+
+
+def parse_payload(data: bytes, response_type: Any) -> Any:
+    """Payload bytes → user object of ``response_type`` (None = raw
+    bytes)."""
+    if response_type is None or response_type in (bytes, bytearray):
+        return data
+    if response_type is IOBuf:
+        return IOBuf(data)
+    if hasattr(response_type, "FromString"):
+        return response_type.FromString(data)
+    inst = response_type()
+    if hasattr(inst, "ParseFromString"):
+        inst.ParseFromString(data)
+        return inst
+    if hasattr(inst, "parse"):
+        inst.parse(data)
+        return inst
+    raise TypeError(f"cannot parse payload into {response_type!r}")
+
+
+def _process_request(msg: RpcMessage, sock, server) -> None:
+    # late import: server layer sits above the protocol layer
+    from ..server.rpc_dispatch import process_rpc_request
+    process_rpc_request(msg, sock, server)
+
+
+def _process_response(msg: RpcMessage, sock) -> None:
+    from ..client.controller import process_rpc_response
+    process_rpc_response(msg, sock)
+
+
+TPU_STD = Protocol(
+    ProtocolType.TPU_STD, "tpu_std", parse,
+    process_request=_process_request,
+    process_response=_process_response,
+)
+register_protocol(TPU_STD)
+
+# client-side connections must understand tpu_std responses
+from ..transport.input_messenger import client_messenger  # noqa: E402
+
+client_messenger().add_handler(TPU_STD)

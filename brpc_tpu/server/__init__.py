@@ -1,0 +1,15 @@
+"""Server side — service registry, request dispatch, lifecycle.
+
+Capability parity with /root/reference/src/brpc/server.h:409-451 (Server::
+AddService/Start/Stop/Join, ServerOptions) re-designed for the TPU stack:
+the request path runs on fiber tasks, every method gets latency/qps/
+concurrency bvars, and the builtin observability portal mounts on the
+same port via the multi-protocol messenger.
+"""
+
+from .server import Server, ServerOptions
+from .service import Service, method
+from .controller import ServerController
+
+__all__ = ["Server", "ServerOptions", "Service", "ServerController",
+           "method"]

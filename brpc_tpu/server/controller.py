@@ -1,0 +1,102 @@
+"""ServerController — per-request context handed to service methods.
+
+The server half of the reference's Controller god-object
+(/root/reference/src/brpc/controller.h:110): request meta, attachments in
+both directions, error reporting, async completion, and the hooks the
+dispatch layer uses to send the response exactly once.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Optional
+
+from ..butil.endpoint import EndPoint
+from ..butil.iobuf import IOBuf
+from ..butil.status import Errno
+from ..butil.time_utils import monotonic_us
+from ..protocol.meta import CompressType, RpcMeta
+
+
+class ServerController:
+    __slots__ = (
+        "request_meta", "remote_side", "socket_id",
+        "request_attachment", "response_attachment",
+        "response_compress_type",
+        "_error_code", "_error_text",
+        "_async", "_finished", "_finish_lock", "_send_response",
+        "begin_time_us", "trace_id", "span_id",
+        "auth_context", "server",
+    )
+
+    def __init__(self, request_meta: RpcMeta,
+                 remote_side: Optional[EndPoint],
+                 socket_id: int,
+                 send_response: Callable[["ServerController", Any], None]):
+        self.request_meta = request_meta
+        self.remote_side = remote_side
+        self.socket_id = socket_id
+        self.request_attachment = IOBuf()
+        self.response_attachment = IOBuf()
+        self.response_compress_type = CompressType.NONE
+        self._error_code = 0
+        self._error_text = ""
+        self._async = False
+        self._finished = False
+        self._finish_lock = threading.Lock()
+        self._send_response = send_response
+        self.begin_time_us = monotonic_us()
+        self.trace_id = request_meta.trace_id
+        self.span_id = request_meta.span_id
+        self.auth_context: Any = None
+        self.server: Any = None
+
+    # -- error reporting ---------------------------------------------------
+
+    @property
+    def failed(self) -> bool:
+        return self._error_code != 0
+
+    def set_failed(self, code_or_text, text: str = "") -> None:
+        """``cntl.set_failed("oops")`` or ``cntl.set_failed(EREQUEST, "x")``."""
+        if isinstance(code_or_text, str):
+            self._error_code = int(Errno.EINTERNAL)
+            self._error_text = code_or_text
+        else:
+            self._error_code = int(code_or_text)
+            self._error_text = text
+
+    @property
+    def error_code(self) -> int:
+        return self._error_code
+
+    @property
+    def error_text(self) -> str:
+        return self._error_text
+
+    # -- async completion --------------------------------------------------
+
+    def begin_async(self) -> None:
+        """Declare that the response will be sent later via
+        :meth:`finish` (≈ brpc's done->Run() ownership transfer)."""
+        self._async = True
+
+    @property
+    def is_async(self) -> bool:
+        return self._async
+
+    def finish(self, response: Any = None) -> None:
+        """Send the response for an async method. Idempotent — the first
+        call wins (mirrors SendRpcResponse's done-once guard)."""
+        with self._finish_lock:
+            if self._finished:
+                return
+            self._finished = True
+        self._send_response(self, response)
+
+    def _mark_finished_if_first(self) -> bool:
+        with self._finish_lock:
+            if self._finished:
+                return False
+            self._finished = True
+            return True

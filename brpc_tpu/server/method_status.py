@@ -1,0 +1,54 @@
+"""Per-method stats + concurrency accounting
+(≈ /root/reference/src/brpc/details/method_status.h): every method gets a
+LatencyRecorder (qps/latency/percentiles in windows), an error counter,
+and an in-flight gauge the concurrency limiter reads."""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+from ..bvar.latency_recorder import LatencyRecorder
+from ..bvar.reducer import Adder
+
+
+class MethodStatus:
+    __slots__ = ("full_name", "latency", "errors", "_inflight",
+                 "_inflight_lock", "max_concurrency", "limiter")
+
+    def __init__(self, full_name: str, max_concurrency: int = 0,
+                 limiter=None):
+        safe = full_name.replace(".", "_").lower()
+        self.full_name = full_name
+        self.latency = LatencyRecorder(f"rpc_server_{safe}")
+        self.errors = Adder(f"rpc_server_{safe}_error")
+        self._inflight = 0
+        self._inflight_lock = threading.Lock()
+        self.max_concurrency = max_concurrency
+        self.limiter = limiter
+
+    def on_requested(self) -> bool:
+        """≈ ConcurrencyLimiter::OnRequested via MethodStatus. Returns
+        False to reject (ELIMIT)."""
+        with self._inflight_lock:
+            limit = (self.limiter.max_concurrency()
+                     if self.limiter is not None else self.max_concurrency)
+            if limit > 0 and self._inflight >= limit:
+                return False
+            self._inflight += 1
+            return True
+
+    def on_responded(self, error_code: int, latency_us: float) -> None:
+        with self._inflight_lock:
+            if self._inflight > 0:
+                self._inflight -= 1
+        if error_code == 0:
+            self.latency << latency_us
+        else:
+            self.errors << 1
+        if self.limiter is not None:
+            self.limiter.on_responded(error_code, latency_us)
+
+    @property
+    def inflight(self) -> int:
+        return self._inflight

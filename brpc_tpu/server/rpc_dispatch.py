@@ -1,0 +1,149 @@
+"""Server-side request processing for tpu_std frames.
+
+≈ ProcessRpcRequest + SendRpcResponse
+(/root/reference/src/brpc/policy/baidu_rpc_protocol.cpp:314,139): find the
+method, run admission (interceptor, auth, concurrency), decompress+parse,
+call user code on the current fiber task, send exactly one response.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from ..butil.iobuf import IOBuf
+from ..butil.logging_util import LOG
+from ..butil.status import Errno
+from ..butil.time_utils import monotonic_us
+from ..protocol import compress as compress_mod
+from ..protocol.meta import RpcMeta
+from ..protocol.tpu_std import RpcMessage, pack_frame, parse_payload, serialize_payload
+from ..transport.socket import Socket
+from .controller import ServerController
+
+
+def _send_error(sock: Socket, correlation_id: int, code: int,
+                text: str) -> None:
+    meta = RpcMeta()
+    meta.correlation_id = correlation_id
+    meta.error_code = int(code)
+    meta.error_text = text
+    sock.write(pack_frame(meta, IOBuf()))
+
+
+def _send_response(server, entry, cntl: ServerController,
+                   response: Any) -> None:
+    sock = Socket.address(cntl.socket_id)
+    latency_us = monotonic_us() - cntl.begin_time_us
+    entry.status.on_responded(cntl.error_code, latency_us)
+    server.on_request_out()
+    if sock is None:
+        return      # connection died; response dropped like the reference
+    meta = RpcMeta()
+    meta.correlation_id = cntl.request_meta.correlation_id
+    if cntl.failed:
+        meta.error_code = cntl.error_code
+        meta.error_text = cntl.error_text
+        sock.write(pack_frame(meta, IOBuf()))
+        return
+    try:
+        payload = serialize_payload(response)
+    except TypeError as e:
+        meta.error_code = int(Errno.EINTERNAL)
+        meta.error_text = f"response serialization failed: {e}"
+        sock.write(pack_frame(meta, IOBuf()))
+        return
+    if cntl.response_compress_type:
+        compressed = compress_mod.compress(payload.to_bytes(),
+                                           cntl.response_compress_type)
+        if compressed is not None:
+            meta.compress_type = cntl.response_compress_type
+            payload = IOBuf(compressed)
+    sock.write(pack_frame(meta, payload,
+                          attachment=cntl.response_attachment))
+
+
+def process_rpc_request(msg: RpcMessage, sock: Socket, server) -> None:
+    meta = msg.meta
+    cid = meta.correlation_id
+
+    entry = server.find_method(meta.service_name, meta.method_name)
+    if entry is None:
+        known = meta.service_name in server.services
+        _send_error(sock, cid,
+                    Errno.ENOMETHOD if known else Errno.ENOSERVICE,
+                    f"unknown {meta.service_name}.{meta.method_name}")
+        return
+    if not server.running:
+        _send_error(sock, cid, Errno.ELOGOFF, "server is stopping")
+        return
+    if not server.on_request_in():
+        _send_error(sock, cid, Errno.ELIMIT, "server max_concurrency")
+        return
+    if not entry.status.on_requested():
+        server.on_request_out()
+        _send_error(sock, cid, Errno.ELIMIT,
+                    f"{entry.status.full_name} max_concurrency")
+        return
+
+    cntl = ServerController(
+        meta, sock.remote_side, sock.id,
+        send_response=lambda c, r: _send_response(server, entry, c, r))
+    cntl.server = server
+    cntl.request_attachment = msg.split_attachment()
+
+    # auth on first message of the connection (≈ Protocol::verify)
+    auth = server.options.auth
+    if auth is not None and sock.app_data is None:
+        try:
+            ok = auth.verify(meta.auth_data, cntl)
+        except Exception:
+            ok = False
+        if not ok:
+            cntl.set_failed(Errno.ERPCAUTH, "authentication failed")
+            cntl.finish(None)
+            return
+        sock.app_data = "authed"
+
+    # interceptor admission (≈ interceptor.h:26-36)
+    interceptor = server.options.interceptor
+    if interceptor is not None:
+        try:
+            verdict = interceptor(cntl)
+        except Exception as e:
+            verdict = (False, int(Errno.EINTERNAL), f"interceptor: {e}")
+        ok = verdict[0] if isinstance(verdict, tuple) else bool(verdict)
+        if not ok:
+            code = verdict[1] if isinstance(verdict, tuple) else Errno.EREJECT
+            text = verdict[2] if isinstance(verdict, tuple) and \
+                len(verdict) > 2 else "rejected"
+            cntl.set_failed(code, text)
+            cntl.finish(None)
+            return
+
+    # payload → request object
+    raw = msg.payload.to_bytes()
+    if meta.compress_type:
+        raw = compress_mod.decompress(raw, meta.compress_type)
+        if raw is None:
+            cntl.set_failed(Errno.EREQUEST,
+                            f"unsupported compress_type {meta.compress_type}")
+            cntl.finish(None)
+            return
+    try:
+        request = parse_payload(raw, entry.request_type)
+    except Exception as e:
+        cntl.set_failed(Errno.EREQUEST, f"request parse failed: {e}")
+        cntl.finish(None)
+        return
+
+    # ---- user code (already on a fiber task) ----
+    try:
+        response = entry.fn(cntl, request)
+    except Exception as e:
+        LOG.exception("method %s raised", entry.status.full_name)
+        cntl.set_failed(Errno.EINTERNAL, f"{type(e).__name__}: {e}")
+        cntl.finish(None)
+        return
+    if cntl.is_async:
+        return          # user owns completion via cntl.finish(resp)
+    cntl.finish(response)

@@ -1,0 +1,213 @@
+"""Server — lifecycle + service registry.
+
+Capability parity with /root/reference/src/brpc/server.cpp:746 (StartInternal),
+:464 (AddBuiltinServices), server.h:59 (ServerOptions). Differences by
+design: protocols already live in a process-global registry, so building
+the acceptor's handler table is collecting every server-capable protocol;
+worker sizing configures the fiber runtime.
+"""
+
+from __future__ import annotations
+
+import socket as _socket
+import threading
+from typing import Any, Callable, Dict, Optional, Tuple
+
+from ..butil.endpoint import EndPoint, parse_endpoint
+from ..butil.logging_util import LOG
+from ..butil.status import Errno
+from ..fiber import runtime as fiber_runtime
+from ..protocol.base import list_protocols
+from ..transport.acceptor import Acceptor
+from ..transport.event_dispatcher import global_dispatcher
+from ..transport.input_messenger import InputMessenger
+from .method_status import MethodStatus
+from .service import extract_methods, service_name_of
+
+
+class ServerOptions:
+    """≈ ServerOptions (server.h:59). Only capabilities the TPU build has
+    wired so far; grows with the build."""
+
+    __slots__ = ("num_workers", "max_concurrency", "method_max_concurrency",
+                 "auth", "interceptor", "idle_timeout_s",
+                 "internal_port", "server_info_name", "limiter_factory")
+
+    def __init__(self):
+        self.num_workers = 0            # 0 = leave fiber runtime defaults
+        self.max_concurrency = 0        # server-wide in-flight cap (0 = off)
+        self.method_max_concurrency: Dict[str, Any] = {}
+        self.auth: Optional[Any] = None          # .verify(auth_data, cntl)
+        self.interceptor: Optional[Callable] = None  # (cntl) -> (ok, code, text)
+        self.idle_timeout_s = -1
+        self.internal_port = -1
+        self.server_info_name = ""
+        self.limiter_factory: Optional[Callable] = None
+
+
+class _MethodEntry:
+    __slots__ = ("fn", "request_type", "status", "service", "method_name")
+
+    def __init__(self, fn, request_type, status, service, method_name):
+        self.fn = fn
+        self.request_type = request_type
+        self.status = status
+        self.service = service
+        self.method_name = method_name
+
+
+class Server:
+    def __init__(self, options: Optional[ServerOptions] = None):
+        self.options = options or ServerOptions()
+        self._services: Dict[str, Any] = {}
+        self._methods: Dict[Tuple[str, str], _MethodEntry] = {}
+        self._listener: Optional[_socket.socket] = None
+        self._acceptor: Optional[Acceptor] = None
+        self._messenger: Optional[InputMessenger] = None
+        self._listen_endpoint: Optional[EndPoint] = None
+        self._started = False
+        self._stopped_event = threading.Event()
+        self._inflight = 0
+        self._inflight_lock = threading.Lock()
+        self.version = ""
+
+    # -- registry ----------------------------------------------------------
+
+    def add_service(self, service: Any, name: str = "") -> int:
+        """≈ Server::AddService. Method set is extracted by reflection;
+        per-method request types come from the @method decorator."""
+        if self._started:
+            LOG.error("add_service after start")
+            return -1
+        sname = name or service_name_of(service)
+        if sname in self._services:
+            LOG.error("service %s already added", sname)
+            return -1
+        methods = extract_methods(service)
+        if not methods:
+            LOG.error("service %s has no public methods", sname)
+            return -1
+        self._services[sname] = service
+        from ..policy.concurrency_limiter import make_limiter
+        for mname, fn in methods.items():
+            full = f"{sname}.{mname}"
+            mc = self.options.method_max_concurrency.get(full, 0)
+            limiter = None
+            if isinstance(mc, str):
+                limiter = make_limiter(mc)
+                mc = 0
+            status = MethodStatus(full, max_concurrency=mc, limiter=limiter)
+            entry = _MethodEntry(
+                fn=fn,
+                request_type=getattr(fn, "_rpc_request_type", None),
+                status=status,
+                service=service,
+                method_name=mname,
+            )
+            self._methods[(sname, mname)] = entry
+        return 0
+
+    def find_method(self, service_name: str,
+                    method_name: str) -> Optional[_MethodEntry]:
+        return self._methods.get((service_name, method_name))
+
+    @property
+    def services(self) -> Dict[str, Any]:
+        return dict(self._services)
+
+    @property
+    def methods(self):
+        return self._methods
+
+    # -- server-wide concurrency ------------------------------------------
+
+    def on_request_in(self) -> bool:
+        limit = self.options.max_concurrency
+        with self._inflight_lock:
+            if limit > 0 and self._inflight >= limit:
+                return False
+            self._inflight += 1
+            return True
+
+    def on_request_out(self) -> None:
+        with self._inflight_lock:
+            if self._inflight > 0:
+                self._inflight -= 1
+
+    @property
+    def inflight(self) -> int:
+        return self._inflight
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self, addr: Any = "127.0.0.1:0") -> int:
+        """≈ Server::Start. ``addr`` is "ip:port" (port 0 = ephemeral),
+        an EndPoint, or a bare port int."""
+        if self._started:
+            return -1
+        if isinstance(addr, int):
+            ep = EndPoint(host="0.0.0.0", port=addr)
+        elif isinstance(addr, EndPoint):
+            ep = addr
+        else:
+            ep = parse_endpoint(str(addr))
+        if self.options.num_workers > 0:
+            fiber_runtime.set_concurrency(self.options.num_workers)
+
+        lst = _socket.socket(_socket.AF_INET, _socket.SOCK_STREAM)
+        lst.setsockopt(_socket.SOL_SOCKET, _socket.SO_REUSEADDR, 1)
+        try:
+            lst.bind(ep.to_sockaddr())
+        except OSError as e:
+            LOG.error("bind %s: %s", ep, e)
+            lst.close()
+            return -1
+        lst.listen(1024)
+        host, port = lst.getsockname()[:2]
+        self._listen_endpoint = EndPoint(host=host, port=port)
+        self._listener = lst
+
+        # handler table = every registered server-capable protocol
+        # (≈ Server::BuildAcceptor collecting protocols, server.cpp:572)
+        handlers = [p for p in list_protocols() if p.support_server]
+        self._messenger = InputMessenger(handlers, arg=self)
+        self._acceptor = Acceptor(self._messenger)
+        self._acceptor.start_accept(lst)
+        self._started = True
+        self._stopped_event.clear()
+        LOG.info("Server started at %s (%d services, %d methods)",
+                 self._listen_endpoint, len(self._services),
+                 len(self._methods))
+        return 0
+
+    @property
+    def listen_endpoint(self) -> Optional[EndPoint]:
+        return self._listen_endpoint
+
+    @property
+    def running(self) -> bool:
+        return self._started
+
+    def connection_count(self) -> int:
+        return self._acceptor.connection_count() if self._acceptor else 0
+
+    def stop(self) -> int:
+        """≈ Server::Stop: stop accepting, fail live connections."""
+        if not self._started:
+            return 0
+        self._started = False
+        if self._acceptor is not None:
+            self._acceptor.stop_accept()
+        self._listener = None
+        self._stopped_event.set()
+        return 0
+
+    def join(self, timeout: Optional[float] = None) -> None:
+        """≈ Server::Join (blocks until stop())."""
+        self._stopped_event.wait(timeout)
+
+    def run_until_asked_to_quit(self) -> None:
+        try:
+            self.join()
+        except KeyboardInterrupt:
+            self.stop()

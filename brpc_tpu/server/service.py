@@ -1,0 +1,64 @@
+"""Service definition layer.
+
+The reference dispatches protobuf-generated service stubs
+(google::protobuf::Service); this framework is Python-first: a Service is
+any object whose public methods take ``(controller, request)`` and return
+the response (or None for async completion via
+``controller.begin_async()`` + ``controller.finish(resp)``).
+
+Request typing: by default requests arrive as raw ``bytes``; a method can
+declare a richer type with the :func:`method` decorator — anything with
+``ParseFromString`` (protobuf) or ``parse`` (framework light messages).
+"""
+
+from __future__ import annotations
+
+import inspect
+from typing import Any, Callable, Dict, Optional
+
+
+def method(request_type: Any = None, response_compress: int = 0):
+    """Decorator declaring per-method options:
+
+        class Search(Service):
+            @method(request_type=SearchRequest)
+            def Query(self, cntl, request): ...
+    """
+    def mark(fn: Callable) -> Callable:
+        fn._rpc_request_type = request_type
+        fn._rpc_response_compress = response_compress
+        return fn
+    return mark
+
+
+class Service:
+    """Optional base class; any duck-typed object works via
+    :func:`extract_methods`."""
+
+    @classmethod
+    def service_name(cls) -> str:
+        return cls.__name__
+
+
+def extract_methods(service: Any) -> Dict[str, Callable]:
+    """Public callables of the service object = its RPC methods."""
+    out: Dict[str, Callable] = {}
+    for name in dir(service):
+        if name.startswith("_"):
+            continue
+        fn = getattr(service, name)
+        if not callable(fn):
+            continue
+        if name in ("service_name",):
+            continue
+        # only functions defined by the service class (not inherited
+        # object/Service plumbing)
+        if inspect.ismethod(fn) or inspect.isfunction(fn):
+            out[name] = fn
+    return out
+
+
+def service_name_of(service: Any) -> str:
+    if hasattr(service, "service_name"):
+        return service.service_name()
+    return type(service).__name__

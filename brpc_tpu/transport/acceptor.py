@@ -72,11 +72,17 @@ class Acceptor:
 
     def _gc(self) -> None:
         with self._conn_lock:
-            dead = [sid for sid in self._connections
-                    if Socket.address(sid) is None
-                    or Socket.address(sid).failed]
-            for sid in dead:
+            dead = []
+            for sid in self._connections:
+                s = Socket.address(sid)
+                if s is None or s.failed:
+                    dead.append((sid, s))
+            for sid, _ in dead:
                 del self._connections[sid]
+        for sid, s in dead:
+            if s is not None:
+                s.release()      # return the pool slot (no revival for
+                                 # server-side connections)
 
     def stop_accept(self) -> None:
         """≈ Acceptor::StopAccept: close listener, fail connections."""
@@ -86,9 +92,8 @@ class Acceptor:
             ls.set_failed(Errno.ELOGOFF, "server stopping")
         with self._conn_lock:
             sids = list(self._connections)
+            self._connections.clear()
         for sid in sids:
             s = Socket.address(sid)
             if s is not None:
-                s.set_failed(Errno.ELOGOFF, "server stopping")
-        with self._conn_lock:
-            self._connections.clear()
+                s.release()      # set_failed + free the pool slot

@@ -35,10 +35,10 @@ def start_health_check(sid: int, interval_s: float,
         try:
             fd = _socket.create_connection(
                 s.remote_side.to_sockaddr(), timeout=s.connect_timeout_s)
-            fd.setblocking(False)
             fd.setsockopt(_socket.IPPROTO_TCP, _socket.TCP_NODELAY, 1)
-            s.fd = fd
-            s.revive()
+            # clears stale read state and re-registers read interest —
+            # a revived socket must receive responses, not just write
+            s.reset_connection(fd)
             _revived << 1
             return
         except OSError:

@@ -81,7 +81,7 @@ class Socket:
     __slots__ = (
         "id", "fd", "remote_side", "local_side", "user",
         "on_edge_triggered_events", "app_data",
-        "_write_lock", "_write_queue", "_draining",
+        "_write_lock", "_write_queue", "_draining", "_drain_epoch",
         "_failed", "_error_code", "_error_text",
         "_nevent", "_nevent_lock",
         "_epollout_event", "_dispatcher",
@@ -104,6 +104,7 @@ class Socket:
         self._write_lock = threading.Lock()
         self._write_queue: Deque[Tuple[IOBuf, int]] = deque()
         self._draining = False
+        self._drain_epoch = 0
         self._failed = False
         self._error_code = 0
         self._error_text = ""
@@ -186,6 +187,10 @@ class Socket:
             self._error_text = text
             pending = list(self._write_queue)
             self._write_queue.clear()
+            # reset the drainer role: any running keep-write task belongs
+            # to the old epoch and will observe the bump and exit
+            self._draining = False
+            self._drain_epoch += 1
         self._epollout_event.set()   # unblock a parked drainer
         if self._dispatcher is not None and self.fd is not None:
             try:
@@ -218,6 +223,18 @@ class Socket:
             self._error_text = ""
         LOG.info("Revived socket %d to %s", self.id, self.remote_side)
 
+    def reset_connection(self, fd: _socket.socket) -> None:
+        """Install a fresh connected fd after a failure (health-check
+        revival): clears stale read state and re-registers read interest
+        so responses flow again."""
+        fd.setblocking(False)
+        self.fd = fd
+        self._read_portal.clear()
+        self._last_protocol = None
+        self.revive()
+        if self._dispatcher is not None:
+            self._dispatcher.add_consumer(fd, self.start_input_event)
+
     def release(self) -> None:
         """Destroy the socket id (returns slot to pool, bumps version)."""
         self.set_failed(Errno.ECLOSE, "released")
@@ -227,55 +244,63 @@ class Socket:
 
     def write(self, buf: IOBuf, id_wait: int = 0) -> int:
         """≈ Socket::Write (socket.cpp:1575): ordered, failure notifies
-        ``id_wait``. Returns 0 on accept (not necessarily flushed)."""
-        if self._failed:
-            if id_wait:
-                global_id_pool().error(id_wait, self._error_code,
-                                       self._error_text)
-            return self._error_code or int(Errno.EFAILEDSOCKET)
+        ``id_wait`` (exactly once — either here or by set_failed draining
+        the queue). Returns 0 on accept (not necessarily flushed)."""
         became_drainer = False
+        failed_code = 0
+        epoch = 0
         with self._write_lock:
             if self._failed:
-                pass
+                failed_code = self._error_code or int(Errno.EFAILEDSOCKET)
+                failed_text = self._error_text
             else:
                 self._write_queue.append((buf, id_wait))
                 if not self._draining:
                     self._draining = True
                     became_drainer = True
-        if self._failed:
+                epoch = self._drain_epoch
+        if failed_code:
+            # enqueue was refused, so set_failed could not have seen this
+            # id_wait — notifying here is the exactly-once path
             if id_wait:
-                global_id_pool().error(id_wait, self._error_code,
-                                       self._error_text)
-            return self._error_code or int(Errno.EFAILEDSOCKET)
+                global_id_pool().error(id_wait, failed_code, failed_text)
+            return failed_code
         if became_drainer:
             # Inline attempt: most writes complete without a context
             # switch (socket.cpp:1649 "write once before KeepWrite").
-            if not self._drain_once():
-                fiber_runtime.spawn(self._keep_write, name="keep_write")
+            if not self._drain_once(epoch):
+                fiber_runtime.spawn(self._keep_write, epoch,
+                                    name="keep_write")
         return 0
 
-    def _drain_once(self) -> bool:
-        """Try to flush the queue without blocking. Returns True when the
-        queue is empty (drainer role released), False if a KeepWrite task
-        must take over."""
+    def _drain_once(self, epoch: int) -> bool:
+        """Try to flush the queue without blocking. Returns True when done
+        with the drainer role (queue empty, socket failed, or the role was
+        revoked by a newer epoch), False if keep-write must park."""
         while True:
             with self._write_lock:
+                if self._drain_epoch != epoch:
+                    return True          # set_failed revoked this drainer
                 if self._failed or not self._write_queue:
                     self._draining = False
                     return True
                 head, id_wait = self._write_queue[0]
-            sent = self._try_send(head)
+            sent = self._try_send(head, epoch)
             if sent < 0:
                 return False            # EAGAIN: keep-write must park
             with self._write_lock:
+                if self._drain_epoch != epoch:
+                    return True
                 if not head.empty():
                     continue
                 if self._write_queue and self._write_queue[0][0] is head:
                     self._write_queue.popleft()
 
-    def _try_send(self, buf: IOBuf) -> int:
+    def _try_send(self, buf: IOBuf, epoch: int) -> int:
         """Send as much of ``buf`` as the kernel takes. Returns bytes sent
-        or -1 on EAGAIN. Failure marks the socket failed."""
+        or -1 on EAGAIN. Failure marks the socket failed — unless this
+        drainer's epoch is stale (a revival installed a fresh fd; a stale
+        drainer must not kill the new connection)."""
         if self.fd is None:
             rc = self.connect_if_not()
             if rc != 0:
@@ -290,20 +315,24 @@ class Socket:
             return total
         except BlockingIOError:
             return -1
-        except OSError as e:
-            if e.errno in (_errno.EAGAIN, _errno.EWOULDBLOCK):
+        except (OSError, ValueError) as e:
+            if isinstance(e, OSError) and e.errno in (_errno.EAGAIN,
+                                                      _errno.EWOULDBLOCK):
                 return -1
-            self.set_failed(Errno.EFAILEDSOCKET, f"send: {e}")
-            _write_errors << 1
+            with self._write_lock:
+                stale = self._drain_epoch != epoch
+            if not stale:
+                self.set_failed(Errno.EFAILEDSOCKET, f"send: {e}")
+                _write_errors << 1
             return total
 
-    def _keep_write(self) -> None:
+    def _keep_write(self, epoch: int) -> None:
         """≈ KeepWrite bthread (socket.cpp:1750): drain until empty,
         parking on writability instead of spinning."""
         while True:
-            if self._drain_once():
+            if self._drain_once(epoch):
                 return
-            if self._failed:
+            if self._failed or self._drain_epoch != epoch:
                 return
             if not self._wait_epollout(timeout=60.0):
                 self.set_failed(Errno.EFAILEDSOCKET,

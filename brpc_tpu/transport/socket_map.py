@@ -109,6 +109,8 @@ class SocketPool:
             s = Socket.address(sid)
             if s is not None and not s.failed:
                 return sid, 0
+            if s is not None:
+                s.release()      # failed pooled conn: free the slot
         sid, rc = _new_connection(self._remote)
         s = Socket.address(sid)
         if s is not None:

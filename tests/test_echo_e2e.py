@@ -1,0 +1,274 @@
+"""End-to-end RPC tests: real Server + Channel over loopback TCP —
+the reference's own integration pattern
+(/root/reference/test/brpc_server_unittest.cpp:185)."""
+
+import threading
+import time
+
+import pytest
+
+from brpc_tpu.butil.iobuf import IOBuf
+from brpc_tpu.butil.status import Errno
+from brpc_tpu.client import Channel, ChannelOptions, Controller, start_cancel
+from brpc_tpu.client.channel import RpcError
+from brpc_tpu.fiber.timer_thread import global_timer_thread
+from brpc_tpu.protocol.meta import CompressType
+from brpc_tpu.server import Server, ServerOptions, Service
+
+
+class EchoService(Service):
+    def Echo(self, cntl, request):
+        return request
+
+    def Upper(self, cntl, request):
+        return request.upper()
+
+    def WithAttachment(self, cntl, request):
+        cntl.response_attachment.append(cntl.request_attachment.to_bytes())
+        cntl.response_attachment.append(b"|tail")
+        return b"ok"
+
+    def Fail(self, cntl, request):
+        cntl.set_failed(Errno.EREQUEST, "deliberate failure")
+        return None
+
+    def Boom(self, cntl, request):
+        raise RuntimeError("kaboom")
+
+    def Slow(self, cntl, request):
+        time.sleep(0.4)
+        return b"slow done"
+
+    def AsyncEcho(self, cntl, request):
+        cntl.begin_async()
+        global_timer_thread().schedule(cntl.finish, 0.05, None,
+                                       b"async:" + request)
+        return None
+
+
+@pytest.fixture(scope="module")
+def server():
+    srv = Server()
+    assert srv.add_service(EchoService()) == 0
+    assert srv.start("127.0.0.1:0") == 0
+    yield srv
+    srv.stop()
+
+
+@pytest.fixture()
+def channel(server):
+    ch = Channel()
+    assert ch.init(str(server.listen_endpoint)) == 0
+    return ch
+
+
+def test_sync_echo(channel):
+    assert channel.call("EchoService.Echo", b"hello") == b"hello"
+    assert channel.call("EchoService.Upper", b"abc") == b"ABC"
+
+
+def test_large_payload(channel):
+    opts = ChannelOptions()
+    opts.timeout_ms = 10_000
+    big = bytes(range(256)) * 16 * 1024        # 4 MB
+    ch = channel
+    cntl = Controller()
+    cntl.timeout_ms = 10_000
+    c = ch.call_method("EchoService.Echo", big, cntl=cntl)
+    assert not c.failed, c.error_text
+    assert c.response == big
+
+
+def test_async_call(channel):
+    done_evt = threading.Event()
+    result = {}
+
+    def on_done(cntl):
+        result["failed"] = cntl.failed
+        result["resp"] = cntl.response
+        done_evt.set()
+
+    channel.call_method("EchoService.Echo", b"async-req", done=on_done)
+    assert done_evt.wait(5.0)
+    assert not result["failed"]
+    assert result["resp"] == b"async-req"
+
+
+def test_server_async_method(channel):
+    c = channel.call_method("EchoService.AsyncEcho", b"ping")
+    assert not c.failed, c.error_text
+    assert c.response == b"async:ping"
+
+
+def test_error_propagation(channel):
+    c = channel.call_method("EchoService.Fail", b"x")
+    assert c.failed
+    assert c.error_code == int(Errno.EREQUEST)
+    assert "deliberate" in c.error_text
+
+
+def test_exception_becomes_einternal(channel):
+    c = channel.call_method("EchoService.Boom", b"x")
+    assert c.failed
+    assert c.error_code == int(Errno.EINTERNAL)
+    assert "kaboom" in c.error_text
+
+
+def test_unknown_service_and_method(channel):
+    c = channel.call_method("Nope.Echo", b"x")
+    assert c.error_code == int(Errno.ENOSERVICE)
+    c = channel.call_method("EchoService.Nope", b"x")
+    assert c.error_code == int(Errno.ENOMETHOD)
+
+
+def test_timeout(channel):
+    cntl = Controller()
+    cntl.timeout_ms = 100
+    c = channel.call_method("EchoService.Slow", b"x", cntl=cntl)
+    assert c.failed
+    assert c.error_code == int(Errno.ERPCTIMEDOUT)
+    assert c.latency_us < 2_000_000
+
+
+def test_attachment_roundtrip(channel):
+    cntl = Controller()
+    cntl.request_attachment.append(b"BULKDATA" * 100)
+    c = channel.call_method("EchoService.WithAttachment", b"body",
+                            cntl=cntl)
+    assert not c.failed, c.error_text
+    assert c.response == b"ok"
+    att = c.response_attachment.to_bytes()
+    assert att == b"BULKDATA" * 100 + b"|tail"
+
+
+def test_compression(channel):
+    cntl = Controller()
+    cntl.request_compress_type = CompressType.GZIP
+    payload = b"compress me " * 1000
+    c = channel.call_method("EchoService.Echo", payload, cntl=cntl)
+    assert not c.failed, c.error_text
+    assert c.response == payload
+
+
+def test_concurrent_calls(channel):
+    n = 32
+    results = []
+    lock = threading.Lock()
+    threads = []
+
+    def one(i):
+        c = channel.call_method("EchoService.Echo", f"msg{i}".encode())
+        with lock:
+            results.append((i, c.failed, c.response))
+
+    for i in range(n):
+        t = threading.Thread(target=one, args=(i,))
+        t.start()
+        threads.append(t)
+    for t in threads:
+        t.join(10.0)
+    assert len(results) == n
+    for i, failed, resp in results:
+        assert not failed
+        assert resp == f"msg{i}".encode()
+
+
+def test_connect_failure_exhausts_retries():
+    ch = Channel()
+    # nothing listens on this port
+    assert ch.init("127.0.0.1:1") == 0
+    cntl = Controller()
+    cntl.timeout_ms = 3000
+    c = ch.call_method("EchoService.Echo", b"x", cntl=cntl)
+    assert c.failed
+    assert c.error_code in (int(Errno.EFAILEDSOCKET),
+                            int(Errno.ERPCTIMEDOUT))
+    assert c.retried_count == c.max_retry
+
+
+def test_cancel(channel):
+    cntl = Controller()
+    cntl.timeout_ms = 5000
+    done_evt = threading.Event()
+
+    def on_done(c):
+        done_evt.set()
+
+    channel.call_method("EchoService.Slow", b"x", done=on_done, cntl=cntl)
+    start_cancel(cntl.call_id)
+    assert done_evt.wait(2.0)
+    assert cntl.failed
+    assert cntl.error_code == int(Errno.ECANCELLED)
+
+
+def test_server_concurrency_limit():
+    opts = ServerOptions()
+    opts.max_concurrency = 2
+    srv = Server(opts)
+    srv.add_service(EchoService(), name="Echo2")
+    assert srv.start("127.0.0.1:0") == 0
+    try:
+        ch = Channel()
+        ch.init(str(srv.listen_endpoint))
+        hits = {"limit": 0, "ok": 0}
+        lock = threading.Lock()
+
+        def one():
+            cntl = Controller()
+            cntl.timeout_ms = 5000
+            c = ch.call_method("Echo2.Slow", b"x", cntl=cntl)
+            with lock:
+                if c.error_code == int(Errno.ELIMIT):
+                    hits["limit"] += 1
+                elif not c.failed:
+                    hits["ok"] += 1
+
+        threads = [threading.Thread(target=one) for _ in range(6)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(10.0)
+        assert hits["ok"] >= 2
+        assert hits["limit"] >= 1
+    finally:
+        srv.stop()
+
+
+def test_client_survives_server_restart():
+    from brpc_tpu.transport.socket_map import global_socket_map
+    global_socket_map()._hc = 0.05       # fast health check for the test
+    srv = Server()
+    srv.add_service(EchoService(), name="Restartable")
+    assert srv.start("127.0.0.1:0") == 0
+    port = srv.listen_endpoint.port
+    ch = Channel()
+    ch.init(f"127.0.0.1:{port}")
+    assert ch.call("Restartable.Echo", b"one") == b"one"
+    srv.stop()
+    # connection is dead: calls fail until the server returns
+    c = ch.call_method("Restartable.Echo", b"two")
+    assert c.failed
+    srv2 = Server()
+    srv2.add_service(EchoService(), name="Restartable")
+    assert srv2.start(f"127.0.0.1:{port}") == 0
+    try:
+        deadline = time.time() + 5.0
+        ok = False
+        while time.time() < deadline:
+            c = ch.call_method("Restartable.Echo", b"three")
+            if not c.failed:
+                ok = True
+                break
+            time.sleep(0.05)
+        assert ok, f"never recovered: {c.error_text}"
+        assert c.response == b"three"
+    finally:
+        srv2.stop()
+        global_socket_map()._hc = 3.0
+
+
+def test_method_stats_recorded(server, channel):
+    entry = server.find_method("EchoService", "Echo")
+    before = entry.status.latency.count()
+    channel.call("EchoService.Echo", b"statcheck")
+    assert entry.status.latency.count() > before
