@@ -1,0 +1,92 @@
+"""Driver benchmark — prints ONE JSON line.
+
+Round-1 metric: large-payload echo throughput through the full RPC stack
+(framed tpu_std protocol, zero-copy attachments, keep-write socket path)
+over loopback — the reference's headline config ("Echo throughput,
+pooled/single connections, large payloads", BASELINE.md: 2.3 GB/s pooled
+on a 24-core E5-2620). vs_baseline is against that 2.3 GB/s.
+
+Later rounds move this metric onto the device path (ICI transfer via the
+mesh transport), per BASELINE.json's north star.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+PAYLOAD = 1 << 20          # 1 MB, the rdma_performance headline size
+WARMUP_S = 1.0
+MEASURE_S = 4.0
+N_THREADS = 4
+BASELINE_GBPS = 2.3
+
+
+def main() -> None:
+    from brpc_tpu.butil.iobuf import IOBuf
+    from brpc_tpu.client import Channel, Controller
+    from brpc_tpu.server import Server, Service
+
+    class Echo(Service):
+        def Echo(self, cntl, request):
+            # echo the attachment back without copying its bytes
+            cntl.response_attachment.append_iobuf(cntl.request_attachment)
+            return b"ok"
+
+    srv = Server()
+    srv.add_service(Echo(), name="Bench")
+    assert srv.start("127.0.0.1:0") == 0
+    addr = str(srv.listen_endpoint)
+
+    stop_at = [0.0]
+    counters = []
+    attachment = bytes(PAYLOAD)
+
+    def worker(idx: int, counter: list) -> None:
+        ch = Channel()
+        ch.init(addr)
+        while time.perf_counter() < stop_at[0]:
+            cntl = Controller()
+            cntl.timeout_ms = 10_000
+            cntl.request_attachment = IOBuf(attachment)
+            c = ch.call_method("Bench.Echo", b"", cntl=cntl)
+            if not c.failed and len(c.response_attachment) == PAYLOAD:
+                counter[0] += 1
+
+    # warmup
+    stop_at[0] = time.perf_counter() + WARMUP_S
+    w = [0]
+    worker(0, w)
+
+    stop_at[0] = time.perf_counter() + MEASURE_S
+    threads = []
+    for i in range(N_THREADS):
+        c = [0]
+        counters.append(c)
+        t = threading.Thread(target=worker, args=(i, c))
+        t.start()
+        threads.append(t)
+    t0 = time.perf_counter()
+    for t in threads:
+        t.join()
+    elapsed = time.perf_counter() - t0
+
+    total_reqs = sum(c[0] for c in counters)
+    # payload moves twice per call (request + response attachment)
+    gbps = total_reqs * PAYLOAD * 2 / elapsed / 1e9
+    srv.stop()
+    print(json.dumps({
+        "metric": "echo_1mb_attachment_throughput",
+        "value": round(gbps, 3),
+        "unit": "GB/s",
+        "vs_baseline": round(gbps / BASELINE_GBPS, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -57,7 +57,11 @@ class Channel:
             return 0
         text = str(addr)
         if "://" in text:
-            from .load_balancer_with_naming import LoadBalancerWithNaming
+            try:
+                from .load_balancer_with_naming import LoadBalancerWithNaming
+            except ImportError:
+                LOG.error("cluster channels not available in this build")
+                return -1
             lb = LoadBalancerWithNaming()
             if lb.init(text, lb_name or "rr") != 0:
                 LOG.error("failed to init naming/LB for %s", text)
@@ -80,9 +84,7 @@ class Channel:
         """
         c = cntl or Controller()
         if not self._initialized:
-            c.set_failed(2001, "channel not initialized")
-            if done:
-                done(c)
+            c._fail_before_launch(2001, "channel not initialized", done)
             return c
         if attachment is not None:
             from ..butil.iobuf import IOBuf
@@ -93,9 +95,7 @@ class Channel:
         try:
             payload = serialize_payload(request)
         except TypeError as e:
-            c.set_failed(1003, str(e))
-            if done:
-                done(c)
+            c._fail_before_launch(1003, str(e), done)
             return c
         c._launch(self, method_full, payload, response_type, done)
         if done is None:

@@ -59,6 +59,7 @@ class Controller:
         "_live_versions", "_done", "_response_type", "_request_payload",
         "_method_full", "_remote", "_begin_us", "_ended",
         "_timeout_timer", "_backup_timer", "_sending_sid",
+        "_attempt_sids",
         "_channel", "_lb_ctx", "trace_id", "span_id",
     )
 
@@ -91,6 +92,7 @@ class Controller:
         self._timeout_timer = 0
         self._backup_timer = 0
         self._sending_sid = 0
+        self._attempt_sids = []          # pooled/short sids per attempt
         self._channel = None
         self._lb_ctx = None
         self.trace_id = 0
@@ -122,6 +124,18 @@ class Controller:
     def join(self, timeout: Optional[float] = None) -> bool:
         return _idp.join(self._cid_base, timeout) if self._cid_base \
             else self._ended.wait(timeout)
+
+    def _fail_before_launch(self, code: int, text: str,
+                            done: Optional[Callable]) -> None:
+        """Failure before a correlation id exists: set results and end so
+        join() returns instead of hanging."""
+        self.set_failed(code, text)
+        self._ended.set()
+        if done is not None:
+            try:
+                done(self)
+            except Exception:
+                LOG.exception("rpc done callback raised")
 
     # -- launch (called by Channel) ---------------------------------------
 
@@ -179,8 +193,10 @@ class Controller:
         ctype = self.connection_type or "single"
         if ctype == "pooled":
             sid, rc = pooled_socket(remote)
+            self._attempt_sids.append(sid)
         elif ctype == "short":
             sid, rc = short_socket(remote)
+            self._attempt_sids.append(sid)
         else:
             sid, rc = global_socket_map().get_socket(remote)
         self._sending_sid = sid
@@ -213,6 +229,19 @@ class Controller:
 
     # -- asynchronous events (timers / socket failures / cancel) ----------
 
+    def _retry_locked(self, failed_version: int, code: int) -> bool:
+        """Common retry decision+launch, run with the id locked: discard
+        the failed attempt, consult the policy, issue attempt n+1.
+        Returns True if a retry was issued."""
+        self._live_versions.discard(failed_version)
+        if self.retry_policy(self, code) and self._nretry < self.max_retry:
+            self._nretry += 1
+            self.retried_count = self._nretry
+            self._live_versions.add(self._nretry)
+            self._issue_rpc()
+            return True
+        return False
+
     @staticmethod
     def _on_id_error(call_id: int, cntl: "Controller", code: int,
                      text: str) -> None:
@@ -234,12 +263,7 @@ class Controller:
             return
         # socket-level failure of some attempt
         version = (call_id - cntl._cid_base) & ((1 << 36) - 1)
-        cntl._live_versions.discard(version)
-        if cntl.retry_policy(cntl, code) and cntl._nretry < cntl.max_retry:
-            cntl._nretry += 1
-            cntl.retried_count = cntl._nretry
-            cntl._live_versions.add(cntl._nretry)
-            cntl._issue_rpc()
+        if cntl._retry_locked(version, code):
             _idp.unlock(cntl._cid_base)
             return
         if cntl._live_versions:
@@ -259,13 +283,7 @@ class Controller:
             return
         code = msg.meta.error_code
         if code != 0:
-            self._live_versions.discard(version)
-            if self.retry_policy(self, code) \
-                    and self._nretry < self.max_retry:
-                self._nretry += 1
-                self.retried_count = self._nretry
-                self._live_versions.add(self._nretry)
-                self._issue_rpc()
+            if self._retry_locked(version, code):
                 _idp.unlock(self._cid_base)
                 return
             self._finish_locked(code, msg.meta.error_text)
@@ -299,11 +317,15 @@ class Controller:
             global_timer_thread().unschedule(self._timeout_timer)
         if self._backup_timer:
             global_timer_thread().unschedule(self._backup_timer)
-        if self.connection_type == "pooled" and self._sending_sid \
-                and code == 0:
-            return_pooled_socket(self._sending_sid)
-        elif self.connection_type == "short" and self._sending_sid:
-            s = Socket.address(self._sending_sid)
+        # per-attempt connections: the successful final pooled socket goes
+        # back to the pool; every other attempt's socket is released (it
+        # may carry an unconsumed in-flight response — not reusable)
+        for sid in self._attempt_sids:
+            if (sid == self._sending_sid and code == 0
+                    and self.connection_type == "pooled"):
+                return_pooled_socket(sid)
+                continue
+            s = Socket.address(sid)
             if s is not None:
                 s.release()
         ch = self._channel

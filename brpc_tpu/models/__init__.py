@@ -1,0 +1,13 @@
+"""Model families served by the framework.
+
+The reference is an RPC framework, not an ML system — its "models" are
+services (echo, redis, media). The TPU build's north star
+(BASELINE.md) is parameter-server / embedding-lookup services running
+inside a pod, so the flagship model family is a sharded embedding table +
+dense tower, exposed both as jittable train/serve steps and as an RPC
+service moving tensors in attachments.
+"""
+
+from .embedding_ps import PSConfig, EmbeddingPS
+
+__all__ = ["PSConfig", "EmbeddingPS"]

@@ -1,0 +1,150 @@
+"""EmbeddingPS — the flagship workload: sharded embedding parameter
+server with a dense scoring tower.
+
+TPU-first design (SURVEY.md §7 step 6): the embedding table is
+vocab-partitioned across the mesh's model axis (the PartitionChannel idea
+— key-space sharding — expressed as a NamedSharding instead of N
+sockets); batches are data-parallel; the dense tower is tensor-parallel.
+XLA inserts the ICI collectives for the sharded gather and the gradient
+psum — no hand-written scatter/gather RPCs in the hot path.
+
+Mesh axes:
+- ``dp``: data parallel (batch dim)
+- ``tp``: model parallel (vocab rows of the table = embedding/expert
+  parallelism; hidden dim of the tower = tensor parallelism)
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional, Tuple
+
+
+@dataclasses.dataclass(frozen=True)
+class PSConfig:
+    vocab: int = 65536
+    dim: int = 128
+    slots: int = 16           # lookup ids per example
+    hidden: int = 512
+    classes: int = 16
+    lr: float = 0.05
+
+
+def init_params(rng, cfg: PSConfig) -> Dict[str, Any]:
+    import jax
+    import jax.numpy as jnp
+
+    k_emb, k1, k2 = jax.random.split(rng, 3)
+    scale = 1.0 / (cfg.dim ** 0.5)
+    return {
+        "emb": jax.random.normal(k_emb, (cfg.vocab, cfg.dim),
+                                 jnp.float32) * scale,
+        "w1": jax.random.normal(k1, (cfg.dim, cfg.hidden),
+                                jnp.float32) * (1.0 / cfg.dim ** 0.5),
+        "b1": jnp.zeros((cfg.hidden,), jnp.float32),
+        "w2": jax.random.normal(k2, (cfg.hidden, cfg.classes),
+                                jnp.float32) * (1.0 / cfg.hidden ** 0.5),
+        "b2": jnp.zeros((cfg.classes,), jnp.float32),
+    }
+
+
+def forward(params: Dict[str, Any], ids):
+    """ids (batch, slots) int32 → logits (batch, classes). bf16 matmuls
+    feed the MXU; f32 master weights."""
+    import jax.numpy as jnp
+
+    emb = jnp.take(params["emb"], ids, axis=0)       # sharded gather
+    x = emb.mean(axis=1)
+    xb = x.astype(jnp.bfloat16)
+    h = jnp.maximum(
+        (xb @ params["w1"].astype(jnp.bfloat16)).astype(jnp.float32)
+        + params["b1"], 0.0)
+    logits = (h.astype(jnp.bfloat16)
+              @ params["w2"].astype(jnp.bfloat16)).astype(jnp.float32) \
+        + params["b2"]
+    return logits
+
+
+def loss_fn(params, ids, labels):
+    import jax
+    import jax.numpy as jnp
+
+    logits = forward(params, ids)
+    logp = jax.nn.log_softmax(logits)
+    return -jnp.take_along_axis(logp, labels[:, None], axis=1).mean()
+
+
+def sgd_train_step(params, ids, labels, lr: float):
+    """One SGD step. Pure + jittable; under a mesh, gradient psum over dp
+    is inserted by XLA from the shardings."""
+    import jax
+
+    loss, grads = jax.value_and_grad(loss_fn)(params, ids, labels)
+    new_params = jax.tree_util.tree_map(
+        lambda p, g: p - lr * g, params, grads)
+    return new_params, loss
+
+
+def param_specs(cfg: PSConfig):
+    """PartitionSpecs for the ('dp','tp') mesh."""
+    from jax.sharding import PartitionSpec as P
+
+    return {
+        "emb": P("tp", None),     # vocab-partitioned (ep-style)
+        "w1": P(None, "tp"),      # tower tensor-parallel
+        "b1": P("tp"),
+        "w2": P("tp", None),
+        "b2": P(),
+    }
+
+
+def batch_specs():
+    from jax.sharding import PartitionSpec as P
+
+    return P("dp", None), P("dp")
+
+
+class EmbeddingPS:
+    """Convenience wrapper binding config + params (+ optional mesh)."""
+
+    def __init__(self, cfg: Optional[PSConfig] = None, mesh=None,
+                 seed: int = 0):
+        import jax
+
+        self.cfg = cfg or PSConfig()
+        self.mesh = mesh
+        self.params = init_params(jax.random.PRNGKey(seed), self.cfg)
+        if mesh is not None:
+            from jax.sharding import NamedSharding
+
+            shardings = {k: NamedSharding(mesh, s)
+                         for k, s in param_specs(self.cfg).items()}
+            self.params = {k: jax.device_put(v, shardings[k])
+                           for k, v in self.params.items()}
+        self._fwd = jax.jit(forward)
+        self._step = jax.jit(sgd_train_step, static_argnames=("lr",),
+                             donate_argnums=(0,))
+
+    def lookup(self, ids):
+        """Serve path: embedding-bag only (the PS read RPC)."""
+        from ..ops.device_ops import embedding_bag
+
+        return embedding_bag(self.params["emb"], ids)
+
+    def predict(self, ids):
+        return self._fwd(self.params, ids)
+
+    def train_step(self, ids, labels) -> float:
+        self.params, loss = self._step(self.params, ids, labels,
+                                       lr=self.cfg.lr)
+        return float(loss)
+
+    def shard_batch(self, ids, labels):
+        if self.mesh is None:
+            return ids, labels
+        import jax
+        from jax.sharding import NamedSharding
+
+        ids_spec, lbl_spec = batch_specs()
+        return (jax.device_put(ids, NamedSharding(self.mesh, ids_spec)),
+                jax.device_put(labels, NamedSharding(self.mesh, lbl_spec)))

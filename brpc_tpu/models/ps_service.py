@@ -1,0 +1,89 @@
+"""Parameter-server RPC service — the framework's two halves meeting.
+
+An RPC Service (brpc-capability side) exposing the EmbeddingPS model
+(device side): ids ride the request payload, tensors ride the zero-copy
+attachment (never through a serializer — the lesson of baidu_std's
+attachment, /root/reference/src/brpc/policy/baidu_rpc_protocol.cpp:58).
+
+Methods:
+- ``Lookup``  ids → pooled embeddings (attachment: f32 tensor bytes)
+- ``Predict`` ids → logits
+- ``Train``   (ids, labels) → loss; applies one SGD step server-side
+- ``Stat``    → model/table shape info (JSON)
+"""
+
+from __future__ import annotations
+
+import json
+import struct
+from typing import Optional
+
+import numpy as np
+
+from ..butil.iobuf import IOBuf
+from ..butil.status import Errno
+from ..ops.device_ops import bytes_to_tensor, tensor_bytes
+from ..server.service import Service
+from .embedding_ps import EmbeddingPS, PSConfig
+
+
+def pack_ids(ids: np.ndarray) -> bytes:
+    """(batch, slots) int32 → wire payload."""
+    ids = np.ascontiguousarray(ids, dtype=np.int32)
+    return struct.pack("<II", *ids.shape) + ids.tobytes()
+
+
+def unpack_ids(data: bytes) -> np.ndarray:
+    b, s = struct.unpack_from("<II", data)
+    return np.frombuffer(data, dtype=np.int32,
+                         offset=8).reshape(b, s)
+
+
+class PSService(Service):
+    def __init__(self, model: Optional[EmbeddingPS] = None):
+        self.model = model or EmbeddingPS(PSConfig(vocab=4096, dim=64,
+                                                   hidden=128, classes=8))
+
+    def Lookup(self, cntl, request):
+        try:
+            ids = unpack_ids(request)
+        except (struct.error, ValueError) as e:
+            cntl.set_failed(Errno.EREQUEST, f"bad ids payload: {e}")
+            return None
+        pooled = self.model.lookup(ids)
+        data, dtype, shape = tensor_bytes(pooled)
+        cntl.response_attachment.append(data)
+        return json.dumps({"dtype": dtype, "shape": shape}).encode()
+
+    def Predict(self, cntl, request):
+        try:
+            ids = unpack_ids(request)
+        except (struct.error, ValueError) as e:
+            cntl.set_failed(Errno.EREQUEST, f"bad ids payload: {e}")
+            return None
+        logits = self.model.predict(ids)
+        data, dtype, shape = tensor_bytes(logits)
+        cntl.response_attachment.append(data)
+        return json.dumps({"dtype": dtype, "shape": shape}).encode()
+
+    def Train(self, cntl, request):
+        try:
+            ids = unpack_ids(request)
+            labels = np.frombuffer(cntl.request_attachment.to_bytes(),
+                                   dtype=np.int32)
+        except (struct.error, ValueError) as e:
+            cntl.set_failed(Errno.EREQUEST, f"bad train payload: {e}")
+            return None
+        if labels.shape[0] != ids.shape[0]:
+            cntl.set_failed(Errno.EREQUEST, "labels/ids batch mismatch")
+            return None
+        loss = self.model.train_step(ids, labels)
+        return json.dumps({"loss": loss}).encode()
+
+    def Stat(self, cntl, request):
+        cfg = self.model.cfg
+        return json.dumps({
+            "vocab": cfg.vocab, "dim": cfg.dim, "hidden": cfg.hidden,
+            "classes": cfg.classes,
+            "sharded": self.model.mesh is not None,
+        }).encode()

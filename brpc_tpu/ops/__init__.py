@@ -1,0 +1,11 @@
+"""TPU kernels (pallas) + device-side utility ops.
+
+The hot ops of the transport/data path, written as pallas TPU kernels
+with jnp fallbacks (interpret mode on CPU): payload checksums for
+integrity of device-resident frames, fused embedding-bag lookup, and the
+block-copy primitive behind the HBM payload pool.
+"""
+
+from .device_ops import checksum_u32, embedding_bag, tensor_bytes
+
+__all__ = ["checksum_u32", "embedding_bag", "tensor_bytes"]

@@ -1,0 +1,19 @@
+"""Mesh collectives layer — the ICI-native transport.
+
+Where the reference moves bytes with epoll+TCP/ibverbs RDMA
+(/root/reference/src/brpc/rdma/rdma_endpoint.h), a TPU pod moves tensors
+over ICI via XLA collectives. This package is the transport those
+capabilities map onto:
+
+- fan-out (ParallelChannel)      → broadcast / all_gather over a mesh axis
+- sharding (PartitionChannel)    → device_put with NamedSharding + all_to_all
+- streaming windows              → ring ppermute schedules
+- request/response over peers    → collective_permute pairs
+
+Everything is jitted shard_map programs over a jax.sharding.Mesh — XLA
+inserts the ICI DMA; we choose the schedule.
+"""
+
+from .mesh_transport import MeshTransport, default_mesh
+
+__all__ = ["MeshTransport", "default_mesh"]

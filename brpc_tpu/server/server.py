@@ -31,18 +31,19 @@ class ServerOptions:
 
     __slots__ = ("num_workers", "max_concurrency", "method_max_concurrency",
                  "auth", "interceptor", "idle_timeout_s",
-                 "internal_port", "server_info_name", "limiter_factory")
+                 "internal_port", "server_info_name")
 
     def __init__(self):
         self.num_workers = 0            # 0 = leave fiber runtime defaults
         self.max_concurrency = 0        # server-wide in-flight cap (0 = off)
+        # "Service.Method" -> int cap, "auto", "constant:N", or a
+        # ConcurrencyLimiter instance
         self.method_max_concurrency: Dict[str, Any] = {}
         self.auth: Optional[Any] = None          # .verify(auth_data, cntl)
         self.interceptor: Optional[Callable] = None  # (cntl) -> (ok, code, text)
         self.idle_timeout_s = -1
         self.internal_port = -1
         self.server_info_name = ""
-        self.limiter_factory: Optional[Callable] = None
 
 
 class _MethodEntry:
@@ -88,12 +89,15 @@ class Server:
             LOG.error("service %s has no public methods", sname)
             return -1
         self._services[sname] = service
-        from ..policy.concurrency_limiter import make_limiter
+        from ..policy.concurrency_limiter import (ConcurrencyLimiter,
+                                                  make_limiter)
         for mname, fn in methods.items():
             full = f"{sname}.{mname}"
             mc = self.options.method_max_concurrency.get(full, 0)
             limiter = None
-            if isinstance(mc, str):
+            if isinstance(mc, ConcurrencyLimiter):
+                limiter, mc = mc, 0
+            elif isinstance(mc, str):
                 limiter = make_limiter(mc)
                 mc = 0
             status = MethodStatus(full, max_concurrency=mc, limiter=limiter)

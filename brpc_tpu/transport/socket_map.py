@@ -119,7 +119,10 @@ class SocketPool:
 
     def put(self, sid: int) -> None:
         s = Socket.address(sid)
-        if s is None or s.failed:
+        if s is None:
+            return
+        if s.failed:
+            s.release()      # free the slot; do not pool dead conns
             return
         with self._lock:
             if len(self._free) < self._max:

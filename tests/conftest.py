@@ -16,4 +16,14 @@ if "xla_force_host_platform_device_count" not in _flags:
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 
+# The axon sitecustomize registers the TPU PJRT plugin at interpreter boot
+# and overrides JAX_PLATFORMS from the env; the config update below wins
+# as long as no backend has initialized yet (true at conftest time).
+try:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+except ImportError:
+    pass
+
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
