@@ -9,5 +9,9 @@ through the id lock, never a global table.
 
 from .channel import Channel, ChannelOptions
 from .controller import Controller, start_cancel
+from .parallel_channel import SKIP, ParallelChannel, SelectiveChannel
+from .partition_channel import PartitionChannel
 
-__all__ = ["Channel", "ChannelOptions", "Controller", "start_cancel"]
+__all__ = ["Channel", "ChannelOptions", "Controller", "start_cancel",
+           "ParallelChannel", "SelectiveChannel", "PartitionChannel",
+           "SKIP"]

@@ -51,6 +51,7 @@ class Controller:
         "timeout_ms", "max_retry", "backup_request_ms",
         "request_attachment", "response_attachment",
         "request_compress_type", "connection_type", "retry_policy",
+        "request_code", "excluded_servers",
         # results
         "response", "latency_us", "remote_side", "retried_count",
         "has_backup_request",
@@ -59,7 +60,7 @@ class Controller:
         "_live_versions", "_done", "_response_type", "_request_payload",
         "_method_full", "_remote", "_begin_us", "_ended",
         "_timeout_timer", "_backup_timer", "_sending_sid",
-        "_attempt_sids",
+        "_attempt_sids", "attempt_remotes",
         "_channel", "_lb_ctx", "trace_id", "span_id",
     )
 
@@ -72,6 +73,8 @@ class Controller:
         self.request_compress_type = CompressType.NONE
         self.connection_type: Optional[str] = None
         self.retry_policy: Callable = default_retry_policy
+        self.request_code = 0            # consistent-hashing key
+        self.excluded_servers: Set = set()   # retries avoid these
         self.response: Any = None
         self.latency_us = 0
         self.remote_side = None
@@ -93,6 +96,7 @@ class Controller:
         self._backup_timer = 0
         self._sending_sid = 0
         self._attempt_sids = []          # pooled/short sids per attempt
+        self.attempt_remotes = {}        # attempt version -> EndPoint
         self._channel = None
         self._lb_ctx = None
         self.trace_id = 0
@@ -189,6 +193,7 @@ class Controller:
                                        "no server available", locked=False)
             return
         self.remote_side = remote
+        self.attempt_remotes[self._nretry] = remote
         attempt_id = self._cid_base + self._nretry
         ctype = self.connection_type or "single"
         if ctype == "pooled":
@@ -234,6 +239,12 @@ class Controller:
         the failed attempt, consult the policy, issue attempt n+1.
         Returns True if a retry was issued."""
         self._live_versions.discard(failed_version)
+        # exclude the server of the attempt that actually failed — with a
+        # backup in flight, remote_side already points at the newer
+        # attempt's server (≈ excluded_servers.h)
+        failed_remote = self.attempt_remotes.get(failed_version)
+        if failed_remote is not None:
+            self.excluded_servers.add(failed_remote)
         if self.retry_policy(self, code) and self._nretry < self.max_retry:
             self._nretry += 1
             self.retried_count = self._nretry

@@ -1,0 +1,151 @@
+"""Naming services — who are my servers?
+
+≈ /root/reference/src/brpc/naming_service.h:36-61 +
+periodic_naming_service.cpp: a NamingService pushes full server lists to
+NamingServiceActions; most implementations poll a source periodically and
+push on change. A watcher (the LB) applies deltas through
+DoublyBufferedData so selection never takes the update lock.
+
+Server entries may carry a tag (``host:port tag``) — PartitionChannel
+reads partition tags like ``2/4`` from it
+(/root/reference/src/brpc/partition_channel.h:46).
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Callable, List, Optional, Sequence
+
+from ..butil.endpoint import EndPoint, parse_endpoint
+from ..butil.extension import extension
+from ..butil.logging_util import LOG
+from ..fiber.timer_thread import global_timer_thread
+
+DEFAULT_REFRESH_S = 5.0
+
+
+@dataclass(frozen=True)
+class ServerNode:
+    endpoint: EndPoint
+    tag: str = ""
+
+    def __str__(self) -> str:
+        return f"{self.endpoint} {self.tag}".strip()
+
+
+def parse_server_line(line: str) -> Optional[ServerNode]:
+    line = line.strip()
+    if not line or line.startswith("#"):
+        return None
+    parts = line.split(None, 1)
+    try:
+        ep = parse_endpoint(parts[0])
+    except (ValueError, IndexError):
+        return None
+    return ServerNode(ep, parts[1].strip() if len(parts) > 1 else "")
+
+
+class NamingService:
+    """Implementations override :meth:`fetch_servers` (pull model) or run
+    their own push loop calling ``self.push(nodes)``."""
+
+    def __init__(self):
+        self._watchers: List[Callable[[List[ServerNode]], None]] = []
+        self._watch_lock = threading.Lock()
+        # serializes deliveries so a watcher never sees an older list
+        # after a newer one (watch()'s initial snapshot vs a racing push)
+        self._deliver_lock = threading.Lock()
+        self._last: Optional[List[ServerNode]] = None
+        self._timer_id = 0
+        self._stopped = False
+        self.refresh_interval_s = DEFAULT_REFRESH_S
+
+    # -- override points ---------------------------------------------------
+
+    def fetch_servers(self) -> Optional[Sequence[ServerNode]]:
+        """Return the full current list, or None on transient failure
+        (watchers keep the previous list — the reference's degrade
+        behavior)."""
+        raise NotImplementedError
+
+    def run_once(self) -> None:
+        nodes = None
+        try:
+            nodes = self.fetch_servers()
+        except Exception as e:
+            LOG.warning("naming fetch failed: %s", e)
+        if nodes is not None:
+            self.push(list(nodes))
+
+    # -- machinery ---------------------------------------------------------
+
+    def start(self, url_path: str) -> int:
+        """Parse/validate the source; begin periodic refresh."""
+        self.run_once()
+        self._schedule()
+        return 0
+
+    def _schedule(self) -> None:
+        if self._stopped or self.refresh_interval_s <= 0:
+            return
+        self._timer_id = global_timer_thread().schedule(
+            self._tick, self.refresh_interval_s)
+
+    def _tick(self) -> None:
+        if self._stopped:
+            return
+        self.run_once()
+        self._schedule()
+
+    def push(self, nodes: List[ServerNode]) -> None:
+        """≈ NamingServiceActions::ResetServers: full-list semantics."""
+        with self._deliver_lock:
+            with self._watch_lock:
+                if self._last is not None and nodes == self._last:
+                    return
+                self._last = list(nodes)
+                watchers = list(self._watchers)
+            for w in watchers:
+                try:
+                    w(list(nodes))
+                except Exception:
+                    LOG.exception("naming watcher raised")
+
+    def watch(self, fn: Callable[[List[ServerNode]], None]) -> None:
+        with self._deliver_lock:
+            with self._watch_lock:
+                self._watchers.append(fn)
+                last = list(self._last) if self._last is not None else None
+            if last is not None:
+                fn(last)
+
+    def stop(self) -> None:
+        self._stopped = True
+        if self._timer_id:
+            global_timer_thread().unschedule(self._timer_id)
+
+    @property
+    def current(self) -> List[ServerNode]:
+        with self._watch_lock:
+            return list(self._last or [])
+
+
+def naming_registry():
+    return extension("naming_service")
+
+
+def create_naming_service(url: str) -> Optional[NamingService]:
+    """``scheme://rest`` → a STARTED NamingService instance."""
+    if "://" not in url:
+        return None
+    scheme, rest = url.split("://", 1)
+    factory = naming_registry().find(scheme)
+    if factory is None:
+        LOG.error("unknown naming scheme %r (known: %s)", scheme,
+                  naming_registry().list())
+        return None
+    ns = factory()
+    if ns.start(rest) != 0:
+        return None
+    return ns

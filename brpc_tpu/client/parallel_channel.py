@@ -1,0 +1,209 @@
+"""ParallelChannel & SelectiveChannel — channel combinators.
+
+≈ /root/reference/src/brpc/parallel_channel.h:94,127,168 and
+selective_channel.h:52,69:
+
+- **ParallelChannel** fans one call out to every sub-channel
+  concurrently; a ``call_mapper(index, sub_channel, request)`` shapes the
+  per-branch request (return ``SKIP`` to drop a branch), a
+  ``response_merger(responses)`` folds branch responses; the call fails
+  once more than ``fail_limit`` branches fail.
+- **SelectiveChannel** load-balances whole calls over heterogeneous
+  sub-channels with independent retry: a failed branch moves to another
+  sub-channel (the failed one is excluded for that call).
+
+On an ICI mesh, the fan-out data path is the mesh transport's
+scatter/all_gather (see brpc_tpu.parallel) — these classes are the
+host-side control plane with identical semantics over sockets.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, List, Optional, Sequence
+
+from ..butil.status import Errno
+from .channel import Channel
+from .controller import Controller
+
+SKIP = object()          # call_mapper return: skip this sub-channel
+
+
+def default_call_mapper(index: int, sub_channel, request):
+    return request
+
+
+def default_response_merger(responses: List[Any]):
+    return responses
+
+
+class ParallelChannel:
+    def __init__(self, fail_limit: int = -1):
+        self._subs: List[tuple] = []
+        self.fail_limit = fail_limit
+
+    def add_channel(self, channel,
+                    call_mapper: Optional[Callable] = None) -> None:
+        """The fan-out merger is per-call (call_method's ``merger=``),
+        not per-channel as in the reference — one merger over the ordered
+        branch responses covers the same use cases."""
+        self._subs.append((channel, call_mapper or default_call_mapper))
+
+    @property
+    def channel_count(self) -> int:
+        return len(self._subs)
+
+    def call_method(self, method_full: str, request: Any,
+                    response_type: Any = None,
+                    done: Optional[Callable] = None,
+                    cntl: Optional[Controller] = None,
+                    merger: Optional[Callable] = None) -> Controller:
+        c = cntl or Controller()
+        merger = merger or default_response_merger
+        branches: List[tuple] = []       # (index, sub, mapped_request)
+        for i, (sub, mapper) in enumerate(self._subs):
+            mapped = mapper(i, sub, request)
+            if mapped is SKIP:
+                continue
+            branches.append((i, sub, mapped))
+        if not branches:
+            c._fail_before_launch(Errno.EPCHANFINISH, "all branches skipped",
+                                  done)
+            return c
+
+        n = len(branches)
+        fail_limit = self.fail_limit if self.fail_limit >= 0 else n
+        state = {
+            "remaining": n, "failed": 0,
+            "responses": [None] * n,
+            "sub_cntls": [None] * n,
+            "finished": False,
+        }
+        lock = threading.Lock()
+        finished_evt = threading.Event()
+
+        def finish() -> None:
+            failed = state["failed"]
+            if failed > 0 and (failed >= fail_limit or failed == n):
+                codes = [sc.error_code for sc in state["sub_cntls"]
+                         if sc is not None and sc.failed]
+                texts = [sc.error_text for sc in state["sub_cntls"]
+                         if sc is not None and sc.failed]
+                c.set_failed(Errno.ETOOMANYFAILS,
+                             f"{failed}/{n} branches failed "
+                             f"(codes={codes[:4]}, first={texts[:1]})")
+            else:
+                try:
+                    c.response = merger(list(state["responses"]))
+                except Exception as e:
+                    c.set_failed(Errno.EINTERNAL, f"merger raised: {e}")
+            c._ended.set()
+            finished_evt.set()
+            if done is not None:
+                done(c)
+
+        def on_branch_done(slot: int):
+            def cb(sub_cntl: Controller) -> None:
+                with lock:
+                    if state["finished"]:
+                        return
+                    state["sub_cntls"][slot] = sub_cntl
+                    if sub_cntl.failed:
+                        state["failed"] += 1
+                    else:
+                        state["responses"][slot] = sub_cntl.response
+                    state["remaining"] -= 1
+                    fails_exceeded = (state["failed"] >= fail_limit
+                                      and fail_limit > 0)
+                    if state["remaining"] == 0 or fails_exceeded:
+                        state["finished"] = True
+                    else:
+                        return
+                finish()
+            return cb
+
+        for slot, (i, sub, mapped) in enumerate(branches):
+            sub_cntl = Controller()
+            sub_cntl.timeout_ms = c.timeout_ms
+            sub_cntl.max_retry = c.max_retry
+            sub.call_method(method_full, mapped, response_type,
+                            done=on_branch_done(slot), cntl=sub_cntl)
+        if done is None:
+            finished_evt.wait()
+        return c
+
+
+class SelectiveChannel:
+    """Round-robin over sub-channels; each call picks one, failures move
+    the call to another sub-channel (independent retry across channels).
+    Sub-channels are typically cluster channels with their own LB, so
+    channel-level selection stays simple by design."""
+
+    def __init__(self, max_retry: int = 3):
+        self._subs: List[Channel] = []
+        self.max_retry = max_retry
+        self._counter_lock = threading.Lock()
+        self._rr = 0
+
+    def add_channel(self, channel) -> int:
+        self._subs.append(channel)
+        return len(self._subs) - 1
+
+    def _pick(self, excluded: set) -> Optional[int]:
+        n = len(self._subs)
+        with self._counter_lock:
+            for _ in range(n):
+                idx = self._rr % n
+                self._rr += 1
+                if idx not in excluded:
+                    return idx
+        return None
+
+    def call_method(self, method_full: str, request: Any,
+                    response_type: Any = None,
+                    done: Optional[Callable] = None,
+                    cntl: Optional[Controller] = None) -> Controller:
+        c = cntl or Controller()
+        if not self._subs:
+            c._fail_before_launch(Errno.EINTERNAL, "no sub channels", done)
+            return c
+        excluded: set = set()
+        attempts = min(self.max_retry + 1, len(self._subs))
+
+        def attempt(k: int) -> None:
+            idx = self._pick(excluded)
+            if idx is None:
+                c.set_failed(Errno.ETOOMANYFAILS, "all sub channels failed")
+                c._ended.set()
+                if done is not None:
+                    done(c)
+                return
+            sub_cntl = Controller()
+            sub_cntl.timeout_ms = c.timeout_ms
+
+            def cb(sc: Controller) -> None:
+                if not sc.failed:
+                    c.response = sc.response
+                    c.response_attachment = sc.response_attachment
+                    c.remote_side = sc.remote_side
+                    c._ended.set()
+                    if done is not None:
+                        done(c)
+                    return
+                excluded.add(idx)
+                if k + 1 < attempts:
+                    attempt(k + 1)
+                else:
+                    c.set_failed(sc.error_code, sc.error_text)
+                    c._ended.set()
+                    if done is not None:
+                        done(c)
+
+            self._subs[idx].call_method(method_full, request,
+                                        response_type, done=cb,
+                                        cntl=sub_cntl)
+
+        attempt(0)
+        if done is None:
+            c._ended.wait()
+        return c

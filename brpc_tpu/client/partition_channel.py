@@ -1,0 +1,160 @@
+"""PartitionChannel — key-space sharding over a tagged cluster.
+
+≈ /root/reference/src/brpc/partition_channel.h:46,75,136: servers publish
+partition tags ``i/N`` through the naming service; the channel builds one
+sub-channel per partition (each load-balancing over that partition's
+replicas) and fans a call out to all partitions, merging responses.
+DynamicPartitionChannel's scheme mixing (``:136``) is approximated by
+re-reading tags on every naming push, so a cluster can migrate N→M
+partitions live.
+
+On a TPU pod, ``mesh://`` naming tags each chip ``i/N`` — a
+PartitionChannel over it is the control-plane twin of
+MeshTransport.scatter/all_gather (the data plane).
+"""
+
+from __future__ import annotations
+
+import re
+import threading
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from ..butil.logging_util import LOG
+from ..butil.status import Errno
+from .channel import Channel, ChannelOptions
+from .controller import Controller
+from .load_balancer import create_load_balancer
+from .naming_service import ServerNode, create_naming_service
+from .parallel_channel import SKIP, ParallelChannel, default_response_merger
+
+_TAG_RE = re.compile(r"^(\d+)/(\d+)$")
+
+
+def parse_partition_tag(tag: str) -> Optional[Tuple[int, int]]:
+    """First ``i/N`` token of the tag → (index, count)."""
+    for token in tag.split():
+        m = _TAG_RE.match(token)
+        if m:
+            return int(m.group(1)), int(m.group(2))
+    return None
+
+
+class _PartitionLB:
+    """A fixed-partition view over the shared server list."""
+
+    def __init__(self, lb_name: str, index: int):
+        self.lb = create_load_balancer(lb_name)
+        self.index = index
+
+    def select_server(self, cntl):
+        return self.lb.select_server(cntl)
+
+    def feedback(self, cntl):
+        self.lb.feedback(cntl)
+
+
+class _PartitionSubChannel(Channel):
+    """Channel whose 'cluster' is one partition's replicas."""
+
+    def __init__(self, lb: _PartitionLB,
+                 options: Optional[ChannelOptions] = None):
+        super().__init__(options)
+        self.load_balancer = lb
+        self._initialized = True
+
+
+class PartitionChannel:
+    def __init__(self, partition_count: int = 0,
+                 options: Optional[ChannelOptions] = None,
+                 fail_limit: int = -1):
+        self.partition_count = partition_count    # 0 = learn from tags
+        self.options = options or ChannelOptions()
+        self.fail_limit = fail_limit
+        self._ns = None
+        self._lb_name = "rr"
+        self._lock = threading.Lock()
+        self._partitions: Dict[int, _PartitionLB] = {}
+
+    def init(self, naming_url: str, lb_name: str = "rr") -> int:
+        from ..policy import load_balancers  # noqa: F401
+        from ..policy import naming          # noqa: F401
+
+        self._lb_name = lb_name
+        self._ns = create_naming_service(naming_url)
+        if self._ns is None:
+            return -1
+        self._ns.watch(self._on_servers)
+        with self._lock:
+            ok = bool(self._partitions)
+        if not ok:
+            LOG.error("no partition-tagged servers at %s", naming_url)
+            self._ns.stop()
+            self._ns = None
+            return -1
+        return 0
+
+    def _on_servers(self, nodes: List[ServerNode]) -> None:
+        # group by scheme (the N in "i/N"): mixing schemes would shard
+        # one key space two ways at once during an N→M migration
+        schemes: Dict[int, Dict[int, List[ServerNode]]] = {}
+        for n in nodes:
+            parsed = parse_partition_tag(n.tag)
+            if parsed is None:
+                continue
+            idx, total = parsed
+            if self.partition_count and total != self.partition_count:
+                continue                  # foreign partition scheme
+            if 0 <= idx < total:
+                schemes.setdefault(total, {}).setdefault(
+                    idx, []).append(n)
+        # adopt the largest scheme with COMPLETE coverage (every
+        # partition has at least one replica); else the most complete one
+        # (≈ DynamicPartitionChannel's capacity rule, simplified)
+        chosen: Dict[int, List[ServerNode]] = {}
+        best_key = (-1.0, 0)
+        for total, by_part in schemes.items():
+            coverage = len(by_part) / total
+            if (coverage, total) > best_key:
+                best_key = (coverage, total)
+                chosen = by_part
+        with self._lock:
+            stale = set(self._partitions) - set(chosen)
+            for idx in stale:
+                del self._partitions[idx]
+            for idx, members in chosen.items():
+                plb = self._partitions.get(idx)
+                if plb is None:
+                    plb = self._partitions[idx] = _PartitionLB(
+                        self._lb_name, idx)
+                plb.lb.reset_servers(members)
+
+    @property
+    def partitions(self) -> List[int]:
+        with self._lock:
+            return sorted(self._partitions)
+
+    def call_method(self, method_full: str, request: Any,
+                    response_type: Any = None,
+                    done: Optional[Callable] = None,
+                    cntl: Optional[Controller] = None,
+                    call_mapper: Optional[Callable] = None,
+                    merger: Optional[Callable] = None) -> Controller:
+        """Fan out to every partition (call_mapper(index, None, request)
+        shapes per-partition requests, e.g. splitting a key batch)."""
+        with self._lock:
+            parts = sorted(self._partitions.items())
+        pc = ParallelChannel(fail_limit=self.fail_limit)
+        for idx, plb in parts:
+            sub = _PartitionSubChannel(plb, self.options)
+            if call_mapper is not None:
+                def mk(i):
+                    return lambda _i, _sub, req: call_mapper(i, _sub, req)
+                pc.add_channel(sub, call_mapper=mk(idx))
+            else:
+                pc.add_channel(sub)
+        return pc.call_method(method_full, request, response_type,
+                              done=done, cntl=cntl, merger=merger)
+
+    def stop(self) -> None:
+        if self._ns is not None:
+            self._ns.stop()

@@ -1,0 +1,205 @@
+"""Builtin load-balancing policies, registered on import
+(≈ /root/reference/src/brpc/global.cpp:368-376):
+
+- ``rr`` / ``wrr``           round robin (+weighted by tag "w=N")
+- ``random`` / ``wr``        (weighted) random
+- ``c_murmurhash`` / ``c_md5``  consistent hashing (ketama ring,
+  /root/reference/src/brpc/policy/consistent_hashing_load_balancer.cpp)
+- ``la``                     locality-aware: lowest expected latency with
+  inflight punishment (policy/locality_aware_load_balancer.h:41-80,
+  docs/cn/lalb.md — algorithm shape, fresh implementation)
+"""
+
+from __future__ import annotations
+
+import bisect
+import hashlib
+import itertools
+import threading
+import time
+from typing import Dict, List, Optional
+
+from ..butil.endpoint import EndPoint
+from ..butil.fast_rand import fast_rand
+from ..client.load_balancer import LoadBalancer, lb_registry
+from ..client.naming_service import ServerNode
+
+
+def _weight_of(node: ServerNode) -> int:
+    for part in node.tag.split():
+        if part.startswith("w="):
+            try:
+                return max(1, int(part[2:]))
+            except ValueError:
+                return 1
+    return 1
+
+
+class RoundRobinLB(LoadBalancer):
+    def __init__(self):
+        super().__init__()
+        self._counter = itertools.count()
+
+    def select(self, nodes, cntl):
+        return nodes[next(self._counter) % len(nodes)]
+
+
+class WeightedRoundRobinLB(LoadBalancer):
+    def __init__(self):
+        super().__init__()
+        self._counter = itertools.count()
+        self._cache_lock = threading.Lock()
+        self._cache_src: Optional[tuple] = None
+        self._cycle: List[ServerNode] = []
+
+    def _expanded(self, nodes) -> List[ServerNode]:
+        key = tuple(id(n) for n in nodes)
+        with self._cache_lock:
+            if key != self._cache_src:
+                cycle: List[ServerNode] = []
+                for n in nodes:
+                    cycle.extend([n] * _weight_of(n))
+                self._cache_src = key
+                self._cycle = cycle
+            return self._cycle
+
+    def select(self, nodes, cntl):
+        cycle = self._expanded(nodes)
+        return cycle[next(self._counter) % len(cycle)]
+
+
+class RandomLB(LoadBalancer):
+    def select(self, nodes, cntl):
+        return nodes[fast_rand() % len(nodes)]
+
+
+class WeightedRandomLB(LoadBalancer):
+    def select(self, nodes, cntl):
+        weights = [_weight_of(n) for n in nodes]
+        total = sum(weights)
+        pick = fast_rand() % total
+        for n, w in zip(nodes, weights):
+            if pick < w:
+                return n
+            pick -= w
+        return nodes[-1]
+
+
+class ConsistentHashLB(LoadBalancer):
+    """Ketama ring with virtual replicas; the key is the call's
+    ``request_code`` (set by the user, ≈ cntl.set_request_code)."""
+
+    REPLICAS = 100
+
+    def __init__(self, hasher: str = "murmurhash"):
+        super().__init__()
+        self._hasher = hasher
+        self._ring_lock = threading.Lock()
+        self._ring_src: Optional[tuple] = None
+        self._ring: List[int] = []
+        self._ring_nodes: List[ServerNode] = []
+
+    def _hash(self, data: bytes) -> int:
+        if self._hasher == "md5":
+            return int.from_bytes(hashlib.md5(data).digest()[:8], "little")
+        # murmur-shaped 64-bit mix (fresh implementation)
+        h = 0xC6A4A7935BD1E995
+        for b in data:
+            h = ((h ^ b) * 0x100000001B3) & 0xFFFFFFFFFFFFFFFF
+            h ^= h >> 29
+        return h
+
+    def _build_ring(self, nodes):
+        key = tuple(str(n) for n in nodes)
+        with self._ring_lock:
+            if key == self._ring_src:
+                return self._ring, self._ring_nodes
+            points: List[tuple] = []
+            for n in nodes:
+                base = str(n.endpoint).encode()
+                for r in range(self.REPLICAS * _weight_of(n)):
+                    points.append((self._hash(base + b"#%d" % r), n))
+            points.sort(key=lambda p: p[0])
+            self._ring = [p[0] for p in points]
+            self._ring_nodes = [p[1] for p in points]
+            self._ring_src = key
+            return self._ring, self._ring_nodes
+
+    def select(self, nodes, cntl):
+        ring, ring_nodes = self._build_ring(nodes)
+        if not ring:
+            return None
+        code = getattr(cntl, "request_code", 0) or 0
+        h = self._hash(int(code).to_bytes(8, "little"))
+        idx = bisect.bisect_left(ring, h) % len(ring)
+        return ring_nodes[idx]
+
+
+class LocalityAwareLB(LoadBalancer):
+    """Pick the server with the best expected latency, punishing inflight
+    depth: weight = 1 / (ema_latency_us * (1 + inflight * punish)).
+    The reference's iterative lowest-expected-latency idea
+    (locality_aware_load_balancer.h) without its tree structure."""
+
+    PUNISH = 0.5
+    ALPHA = 0.2
+    DEFAULT_LATENCY_US = 50_000.0
+
+    def __init__(self):
+        super().__init__()
+        self._stat_lock = threading.Lock()
+        self._lat: Dict[EndPoint, float] = {}
+        self._inflight: Dict[EndPoint, int] = {}
+
+    def select(self, nodes, cntl):
+        best, best_score = None, float("inf")
+        with self._stat_lock:
+            untried = [n for n in nodes if n.endpoint not in self._lat]
+            if untried:
+                # explore before exploiting — otherwise the first server
+                # to report a latency wins all traffic forever
+                best = untried[fast_rand() % len(untried)]
+                self._inflight[best.endpoint] = \
+                    self._inflight.get(best.endpoint, 0) + 1
+                return best
+            for n in nodes:
+                lat = self._lat.get(n.endpoint, self.DEFAULT_LATENCY_US)
+                inflight = self._inflight.get(n.endpoint, 0)
+                score = lat * (1.0 + inflight * self.PUNISH)
+                # small dither so equal servers share load
+                score *= 1.0 + (fast_rand() % 128) / 1024.0
+                if score < best_score:
+                    best, best_score = n, score
+            if best is not None:
+                self._inflight[best.endpoint] = \
+                    self._inflight.get(best.endpoint, 0) + 1
+        return best
+
+    def on_feedback(self, cntl):
+        ep = cntl.remote_side
+        # every attempt's select() incremented inflight; decrement them
+        # all (retried calls touched several servers)
+        attempts = list(getattr(cntl, "attempt_remotes", {}).values()) \
+            or [ep]
+        with self._stat_lock:
+            for aep in attempts:
+                n = self._inflight.get(aep, 0)
+                if n > 0:
+                    self._inflight[aep] = n - 1
+            if cntl.error_code == 0:
+                prev = self._lat.get(ep, self.DEFAULT_LATENCY_US)
+                self._lat[ep] = prev + (cntl.latency_us - prev) * self.ALPHA
+            else:
+                # failures look slow: steer away without a hard ban
+                # (the breaker handles hard isolation)
+                prev = self._lat.get(ep, self.DEFAULT_LATENCY_US)
+                self._lat[ep] = prev * 1.5
+
+
+lb_registry().register("rr", RoundRobinLB)
+lb_registry().register("wrr", WeightedRoundRobinLB)
+lb_registry().register("random", RandomLB)
+lb_registry().register("wr", WeightedRandomLB)
+lb_registry().register("c_murmurhash", ConsistentHashLB)
+lb_registry().register("c_md5", lambda: ConsistentHashLB("md5"))
+lb_registry().register("la", LocalityAwareLB)

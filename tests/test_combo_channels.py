@@ -1,0 +1,158 @@
+"""Combo channel tests: Parallel fan-out with partial failure,
+Selective failover, Partition sharding by naming tags
+(≈ /root/reference/example/parallel_echo_c++, partition_echo_c++ as
+integration shapes)."""
+
+import threading
+import time
+
+import pytest
+
+from brpc_tpu.client import (SKIP, Channel, Controller, ParallelChannel,
+                             PartitionChannel, SelectiveChannel)
+from brpc_tpu.client.circuit_breaker import global_circuit_breaker_map
+from brpc_tpu.butil.status import Errno
+from brpc_tpu.server import Server, Service
+
+
+class Tagged(Service):
+    def __init__(self, who):
+        self.who = who
+
+    def Who(self, cntl, request):
+        return f"{self.who}:{request.decode()}".encode()
+
+
+def _server(who):
+    srv = Server()
+    srv.add_service(Tagged(who), name="T")
+    assert srv.start("127.0.0.1:0") == 0
+    return srv
+
+
+@pytest.fixture(autouse=True)
+def _clean_breakers():
+    global_circuit_breaker_map().reset()
+    yield
+    global_circuit_breaker_map().reset()
+
+
+def test_parallel_channel_fanout_and_merge():
+    servers = [_server(w) for w in "abc"]
+    try:
+        pc = ParallelChannel()
+        for s in servers:
+            ch = Channel()
+            ch.init(str(s.listen_endpoint))
+            pc.add_channel(ch)
+        c = pc.call_method("T.Who", b"x",
+                           merger=lambda rs: b",".join(rs))
+        assert not c.failed, c.error_text
+        assert c.response == b"a:x,b:x,c:x"
+    finally:
+        for s in servers:
+            s.stop()
+
+
+def test_parallel_channel_call_mapper_skip():
+    servers = [_server(w) for w in "ab"]
+    try:
+        pc = ParallelChannel()
+        for i, s in enumerate(servers):
+            ch = Channel()
+            ch.init(str(s.listen_endpoint))
+            pc.add_channel(ch, call_mapper=lambda i, sub, req, _i=i:
+                           SKIP if _i == 1 else req + b"!")
+        c = pc.call_method("T.Who", b"q")
+        assert not c.failed
+        assert c.response == [b"a:q!"]
+    finally:
+        for s in servers:
+            s.stop()
+
+
+def test_parallel_channel_fail_limit():
+    s1 = _server("a")
+    try:
+        pc = ParallelChannel(fail_limit=1)
+        ok = Channel()
+        ok.init(str(s1.listen_endpoint))
+        dead = Channel()
+        dead.init("127.0.0.1:1")        # nothing listens
+        pc.add_channel(ok)
+        pc.add_channel(dead)
+        cntl = Controller()
+        cntl.timeout_ms = 2000
+        c = pc.call_method("T.Who", b"x", cntl=cntl)
+        assert c.failed
+        assert c.error_code == int(Errno.ETOOMANYFAILS)
+    finally:
+        s1.stop()
+
+
+def test_parallel_channel_tolerates_failures_under_limit():
+    s1 = _server("a")
+    try:
+        pc = ParallelChannel(fail_limit=2)
+        ok = Channel()
+        ok.init(str(s1.listen_endpoint))
+        dead = Channel()
+        dead.init("127.0.0.1:1")
+        pc.add_channel(ok)
+        pc.add_channel(dead)
+        cntl = Controller()
+        cntl.timeout_ms = 2000
+        c = pc.call_method("T.Who", b"x", cntl=cntl)
+        assert not c.failed, c.error_text
+        assert c.response == [b"a:x", None]
+    finally:
+        s1.stop()
+
+
+def test_selective_channel_failover():
+    s1 = _server("alive")
+    try:
+        sc = SelectiveChannel()
+        dead = Channel()
+        dead.init("127.0.0.1:1")
+        ok = Channel()
+        ok.init(str(s1.listen_endpoint))
+        sc.add_channel(dead)
+        sc.add_channel(ok)
+        for _ in range(4):
+            cntl = Controller()
+            cntl.timeout_ms = 2000
+            c = sc.call_method("T.Who", b"z", cntl=cntl)
+            assert not c.failed, c.error_text
+            assert c.response == b"alive:z"
+    finally:
+        s1.stop()
+
+
+def test_partition_channel_shards_by_tag():
+    # 2 partitions × 2 replicas
+    servers = {w: _server(w) for w in ("p0a", "p0b", "p1a", "p1b")}
+    try:
+        url = ("list://"
+               f"{servers['p0a'].listen_endpoint} 0/2,"
+               f"{servers['p0b'].listen_endpoint} 0/2,"
+               f"{servers['p1a'].listen_endpoint} 1/2,"
+               f"{servers['p1b'].listen_endpoint} 1/2")
+        pch = PartitionChannel()
+        assert pch.init(url, "rr") == 0
+        assert pch.partitions == [0, 1]
+
+        # per-partition request shaping: partition k gets its own slice
+        c = pch.call_method(
+            "T.Who", b"k0|k1",
+            call_mapper=lambda i, sub, req: req.split(b"|")[i])
+        assert not c.failed, c.error_text
+        assert len(c.response) == 2
+        assert c.response[0].endswith(b":k0")
+        assert c.response[0][:2] == b"p0"
+        assert c.response[1].endswith(b":k1")
+        assert c.response[1][:2] == b"p1"
+        pch.stop()
+    finally:
+        for s in servers.values():
+            s.stop()
