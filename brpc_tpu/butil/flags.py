@@ -1,0 +1,93 @@
+"""Flag system — process-wide named config with live reload.
+
+≈ gflags + BRPC_VALIDATE_GFLAG (/root/reference/src/brpc/reloadable_flags.h
+:37,58 and builtin/flags_service.cpp:107-156): flags declare a default +
+help; a flag is *reloadable* iff it registered a validator; the HTTP
+portal's /flags page can read all and set reloadable ones; every flag is
+also visible to the metrics layer.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Dict, List, Optional
+
+
+class Flag:
+    __slots__ = ("name", "value", "default", "help", "validator", "type")
+
+    def __init__(self, name: str, default: Any, help_text: str,
+                 validator: Optional[Callable[[Any], bool]]):
+        self.name = name
+        self.value = default
+        self.default = default
+        self.help = help_text
+        self.validator = validator
+        self.type = type(default)
+
+    @property
+    def reloadable(self) -> bool:
+        return self.validator is not None
+
+
+_lock = threading.Lock()
+_flags: Dict[str, Flag] = {}
+
+
+def define_flag(name: str, default: Any, help_text: str = "",
+                validator: Optional[Callable[[Any], bool]] = None) -> Flag:
+    with _lock:
+        if name in _flags:
+            raise ValueError(f"flag {name!r} already defined")
+        f = Flag(name, default, help_text, validator)
+        _flags[name] = f
+        return f
+
+
+def get_flag(name: str, default: Any = None) -> Any:
+    f = _flags.get(name)
+    return f.value if f is not None else default
+
+
+def set_flag(name: str, value: Any) -> bool:
+    """Live-set; only reloadable flags accept writes, and the validator
+    must pass (≈ flags_service.cpp:135)."""
+    f = _flags.get(name)
+    if f is None or not f.reloadable:
+        return False
+    try:
+        if f.type is bool and isinstance(value, str):
+            typed = value.lower() in ("1", "true", "yes", "on")
+        else:
+            typed = f.type(value)
+    except (TypeError, ValueError):
+        return False
+    if not f.validator(typed):
+        return False
+    f.value = typed
+    return True
+
+
+def list_flags() -> List[Flag]:
+    with _lock:
+        return sorted(_flags.values(), key=lambda f: f.name)
+
+
+def positive(v) -> bool:
+    return v > 0
+
+
+def non_negative(v) -> bool:
+    return v >= 0
+
+
+def any_value(v) -> bool:
+    return True
+
+
+# core flags mirroring reference defaults (SURVEY.md appendix A); each
+# must have a live consumer — a settable flag nothing reads is a lie
+define_flag("max_body_size", 64 * 1024 * 1024,
+            "largest acceptable frame body", positive)
+define_flag("health_check_interval_s", 3.0,
+            "failed-socket reconnect period", positive)

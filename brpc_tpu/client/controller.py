@@ -159,6 +159,9 @@ class Controller:
             self.backup_request_ms = opts.backup_request_ms
         if self.connection_type is None:
             self.connection_type = opts.connection_type
+        if opts.protocol == "http" and self.connection_type == "single":
+            # http/1 cannot multiplex a shared connection
+            self.connection_type = "pooled"
         self._begin_us = monotonic_us()
         self._cid_base = _idp.create_ranged(
             self, Controller._on_id_error, self.max_retry + 2)
@@ -212,9 +215,24 @@ class Controller:
             _idp.error(attempt_id, int(Errno.EFAILEDSOCKET),
                        f"connect to {remote} failed")
             return
+        svc, mth = self._method_full.rsplit(".", 1)
+        wire = self._channel.options.protocol if self._channel else "tpu_std"
+        if wire == "http":
+            # HTTP/1 has no multiplexing: the in-flight call rides the
+            # connection itself (correlation_id on the socket), so the
+            # connection must be exclusive — pooled or short
+            from ..protocol.http import build_request
+            att = self.request_attachment.to_bytes()
+            body = self._request_payload.to_bytes() + att
+            headers = [("x-rpc-attachment-size", str(len(att)))] \
+                if att else None
+            frame = build_request("POST", f"/{svc}/{mth}", body=body,
+                                  host=str(remote), headers=headers)
+            sock.correlation_id = attempt_id
+            sock.write(frame, id_wait=attempt_id)
+            return
         meta = RpcMeta()
         meta.correlation_id = attempt_id
-        svc, mth = self._method_full.rsplit(".", 1)
         meta.service_name = svc
         meta.method_name = mth
         meta.trace_id = self.trace_id
@@ -332,11 +350,16 @@ class Controller:
         # back to the pool; every other attempt's socket is released (it
         # may carry an unconsumed in-flight response — not reusable)
         for sid in self._attempt_sids:
+            s = Socket.address(sid)
             if (sid == self._sending_sid and code == 0
-                    and self.connection_type == "pooled"):
+                    and self.connection_type == "pooled"
+                    and s is not None and not s.correlation_id):
+                # correlation_id != 0 marks an HTTP request still
+                # unanswered on this connection (a losing backup
+                # attempt): pooling it would deliver the late response
+                # to the next unrelated call
                 return_pooled_socket(sid)
                 continue
-            s = Socket.address(sid)
             if s is not None:
                 s.release()
         ch = self._channel
@@ -369,6 +392,41 @@ def process_rpc_response(msg: RpcMessage, sock: Socket) -> None:
             _idp.unlock(cid)
         return                          # late response of a finished call
     cntl._on_response(msg)
+
+
+def process_http_response(msg, sock: Socket) -> None:
+    """Client side of the HTTP protocol: the in-flight call is identified
+    by the connection (no multiplexing)."""
+    cid = sock.correlation_id
+    if not cid:
+        return
+    sock.correlation_id = 0
+    ok, cntl = _idp.lock(cid)
+    if not ok or cntl is None:
+        if ok:
+            _idp.unlock(cid)
+        return
+    if msg.status_code != 200:
+        rpc_code = msg.headers.get("x-rpc-error-code")
+        code = int(rpc_code) if rpc_code and rpc_code.isdigit() \
+            else int(Errno.EHTTP)
+        cntl._finish_locked(code,
+                            f"HTTP {msg.status_code}: "
+                            f"{msg.body[:200].decode('latin1', 'replace')}")
+        return
+    body = msg.body
+    att_size = msg.headers.get("x-rpc-attachment-size")
+    if att_size and att_size.isdigit():
+        n = int(att_size)
+        if 0 < n <= len(body):
+            cntl.response_attachment = IOBuf(body[len(body) - n:])
+            body = body[:len(body) - n]
+    try:
+        cntl.response = parse_payload(body, cntl._response_type)
+    except Exception as e:
+        cntl._finish_locked(Errno.ERESPONSE, f"response parse failed: {e}")
+        return
+    cntl._finish_locked(0, "")
 
 
 def start_cancel(call_id: int) -> None:

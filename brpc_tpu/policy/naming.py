@@ -84,7 +84,10 @@ class DnsNamingService(NamingService):
         if not host:
             return -1
         self._host = host
-        self._port = int(port) if port else 80
+        try:
+            self._port = int(port) if port else 80
+        except ValueError:
+            return -1
         return super().start(url_path)
 
     def fetch_servers(self) -> Optional[Sequence[ServerNode]]:

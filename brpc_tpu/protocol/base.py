@@ -84,9 +84,14 @@ class ParseResult:
         return ParseResult(ParseError.TOO_BIG_DATA)
 
 
-# 64 MB, mirroring the reference default (src/brpc/protocol.cpp:44);
-# live-tunable through the flags service once the portal is up.
+# 64 MB default, mirroring the reference (src/brpc/protocol.cpp:44).
 MAX_BODY_SIZE = 64 * 1024 * 1024
+
+
+def max_body_size() -> int:
+    """Current frame-size cap — live-tunable via /flags/max_body_size."""
+    from ..butil.flags import get_flag
+    return get_flag("max_body_size", MAX_BODY_SIZE)
 
 
 class Protocol:

@@ -22,8 +22,8 @@ import struct
 from typing import Any, Optional
 
 from ..butil.iobuf import IOBuf
-from .base import (MAX_BODY_SIZE, ParseResult, Protocol, ProtocolType,
-                   register_protocol)
+from .base import (ParseResult, Protocol, ProtocolType,
+                   max_body_size, register_protocol)
 from .meta import RpcMeta
 
 MAGIC = b"TRPC"
@@ -66,8 +66,9 @@ def parse(source: IOBuf, sock, read_eof: bool, arg) -> ParseResult:
     if header[:4] != MAGIC:
         return ParseResult.try_others()
     body_size, meta_size = struct.unpack_from("<II", header, 4)
-    if body_size > MAX_BODY_SIZE:
-        return ParseResult.too_big(MAX_BODY_SIZE)
+    limit = max_body_size()
+    if body_size > limit:
+        return ParseResult.too_big(limit)
     if meta_size > body_size:
         return ParseResult.absolutely_wrong()
     if avail < HEADER_SIZE + body_size:

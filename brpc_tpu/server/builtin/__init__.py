@@ -1,0 +1,174 @@
+"""Builtin observability portal — HTTP pages on the serving port.
+
+≈ /root/reference/src/brpc/builtin/ (25 services, server.cpp:464-559):
+status, vars, flags (live-set with validator gate), health, connections,
+version, prometheus metrics, runtime introspection (sockets/fibers/ids),
+and the service index. Handlers return
+(status, content_type, body, extra_headers).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+from ...butil import flags as flags_mod
+from ...bvar.prometheus import render_prometheus
+from ...bvar.variable import dump_exposed, find_exposed, list_exposed
+from ...protocol.http import HttpMessage
+
+Handler = Callable[[object, HttpMessage, List[str]], Tuple]
+
+_routes: Dict[str, Handler] = {}
+
+_START_TIME = time.time()
+
+
+def register_builtin(prefix: str, handler: Handler) -> None:
+    _routes[prefix] = handler
+
+
+def route_builtin(server, msg: HttpMessage):
+    parts = [p for p in msg.path.split("/") if p]
+    head = parts[0] if parts else ""
+    handler = _routes.get(head)
+    if handler is None:
+        return 404, "text/plain", f"no such page: {msg.path}\n".encode(), []
+    out = handler(server, msg, parts[1:])
+    if len(out) == 3:
+        status, ctype, body = out
+        extra: List = []
+    else:
+        status, ctype, body, extra = out
+    if isinstance(body, str):
+        body = body.encode()
+    return status, ctype, body, extra
+
+
+# ---- pages ---------------------------------------------------------------
+
+def _index(server, msg, rest):
+    lines = ["tpu-rpc server", "=" * 40, "", "services:"]
+    for (svc, mth), entry in sorted(server.methods.items()):
+        lines.append(f"  /{svc}/{mth}")
+    lines += ["", "builtin pages:"]
+    for p in sorted(_routes):
+        if p:
+            lines.append(f"  /{p}")
+    return 200, "text/plain", "\n".join(lines) + "\n"
+
+
+def _health(server, msg, rest):
+    return 200, "text/plain", "OK\n"
+
+
+def _version(server, msg, rest):
+    from ... import __version__
+    return 200, "text/plain", f"tpu-rpc/{__version__} {server.version}\n"
+
+
+def _status(server, msg, rest):
+    from ...fiber.runtime import global_runtime
+
+    rt = global_runtime()
+    out = {
+        "uptime_s": round(time.time() - _START_TIME, 1),
+        "listen": str(server.listen_endpoint),
+        "connections": server.connection_count(),
+        "inflight_requests": server.inflight,
+        "fiber_workers": rt.worker_count,
+        "fiber_pending": rt.pending_count,
+        "services": {},
+    }
+    for (svc, mth), entry in sorted(server.methods.items()):
+        st = entry.status
+        out["services"][f"{svc}.{mth}"] = {
+            "count": st.latency.count(),
+            "qps": round(st.latency.qps(), 1),
+            "latency_us_p50": round(st.latency.p50(), 1),
+            "latency_us_p99": round(st.latency.p99(), 1),
+            "errors": st.errors.get_value(),
+            "inflight": st.inflight,
+        }
+    return 200, "application/json", json.dumps(out, indent=1)
+
+
+def _vars(server, msg, rest):
+    if rest:
+        v = find_exposed(rest[0])
+        if v is None:
+            return 404, "text/plain", f"no var {rest[0]}\n"
+        return 200, "text/plain", f"{rest[0]} : {v.describe()}\n"
+    filt = msg.query().get("filter", "")
+    dump = dump_exposed(filt)
+    body = "".join(f"{k} : {v}\n" for k, v in sorted(dump.items()))
+    return 200, "text/plain", body
+
+
+def _metrics(server, msg, rest):
+    return 200, "text/plain; version=0.0.4", render_prometheus()
+
+
+def _flags(server, msg, rest):
+    q = msg.query()
+    if rest:
+        f = next((x for x in flags_mod.list_flags() if x.name == rest[0]),
+                 None)
+        if f is None:
+            return 404, "text/plain", f"no flag {rest[0]}\n"
+        if "setvalue" in q:
+            if not flags_mod.set_flag(f.name, q["setvalue"]):
+                return 403, "text/plain", \
+                    f"flag {f.name} is not settable to {q['setvalue']!r}\n"
+            return 200, "text/plain", \
+                f"{f.name} set to {f.value!r}\n"
+        return 200, "text/plain", _flag_line(f)
+    body = "".join(_flag_line(f) for f in flags_mod.list_flags())
+    return 200, "text/plain", body
+
+
+def _flag_line(f) -> str:
+    mark = " (R)" if f.reloadable else ""
+    return f"{f.name}={f.value!r} default={f.default!r}{mark}  # {f.help}\n"
+
+
+def _connections(server, msg, rest):
+    from ...transport.socket import socket_pool
+
+    out = {
+        "server_connections": server.connection_count(),
+        "socket_slots": len(socket_pool()),
+    }
+    return 200, "application/json", json.dumps(out, indent=1)
+
+
+def _fibers(server, msg, rest):
+    from ...fiber.runtime import global_runtime
+
+    rt = global_runtime()
+    return 200, "application/json", json.dumps({
+        "workers": rt.worker_count,
+        "pending": rt.pending_count,
+        "concurrency": rt.concurrency,
+    }, indent=1)
+
+
+def _list_vars(server, msg, rest):
+    return 200, "application/json", json.dumps(list_exposed())
+
+
+register_builtin("", _index)
+register_builtin("index", _index)
+register_builtin("health", _health)
+register_builtin("version", _version)
+register_builtin("status", _status)
+register_builtin("vars", _vars)
+register_builtin("list_vars", _list_vars)
+register_builtin("brpc_metrics", _metrics)
+register_builtin("metrics", _metrics)
+register_builtin("flags", _flags)
+register_builtin("connections", _connections)
+register_builtin("fibers", _fibers)

@@ -1,0 +1,137 @@
+"""HTTP request routing: RPC bridge + builtin portal.
+
+≈ the reference's http protocol dispatch (`/ServiceName/MethodName` →
+service, everything else → builtin services on the same port,
+/root/reference/src/brpc/policy/http_rpc_protocol.cpp + server.cpp:464).
+JSON bridge: a dict/list return value is serialized as JSON; a JSON body
+arrives as bytes for the method to parse (json2pb's role without
+protobuf codegen in the way).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Optional, Tuple
+
+from ..butil.iobuf import IOBuf
+from ..butil.logging_util import LOG
+from ..butil.status import Errno
+from ..butil.time_utils import monotonic_us
+from ..protocol.http import HttpMessage, build_response
+from ..protocol.meta import RpcMeta
+from ..transport.socket import Socket
+from .controller import ServerController
+
+
+def handle_http_request(msg: HttpMessage, sock, server) -> None:
+    path = msg.path.rstrip("/") or "/"
+    parts = [p for p in path.split("/") if p]
+    # RPC bridge: /Service/Method (also /Service.Method for symmetry)
+    entry = None
+    if len(parts) == 2:
+        entry = server.find_method(parts[0], parts[1])
+        svc, mth = parts[0], parts[1]
+    elif len(parts) == 1 and "." in parts[0]:
+        svc, _, mth = parts[0].partition(".")
+        entry = server.find_method(svc, mth)
+    if entry is not None:
+        _bridge_rpc(msg, sock, server, svc, mth, entry)
+        return
+    from .builtin import route_builtin
+    try:
+        status, ctype, body, extra = route_builtin(server, msg)
+    except Exception as e:
+        LOG.exception("builtin page %s raised", msg.path)
+        status, ctype, body, extra = 500, "text/plain", \
+            f"internal error: {e}\n".encode(), []
+    sock.write(build_response(status, body, ctype, headers=extra,
+                              keep_alive=msg.keep_alive))
+
+
+def _bridge_rpc(msg: HttpMessage, sock, server, svc: str,
+                mth: str, entry) -> None:
+    if not server.on_request_in():
+        sock.write(build_response(503, b"server max_concurrency",
+                                  keep_alive=msg.keep_alive))
+        return
+    if not entry.status.on_requested():
+        server.on_request_out()
+        sock.write(build_response(503, b"method max_concurrency",
+                                  keep_alive=msg.keep_alive))
+        return
+
+    meta = RpcMeta()
+    meta.service_name = svc
+    meta.method_name = mth
+
+    def send(cntl: ServerController, response: Any) -> None:
+        latency_us = monotonic_us() - cntl.begin_time_us
+        entry.status.on_responded(cntl.error_code, latency_us)
+        server.on_request_out()
+        s = Socket.address(cntl.socket_id)
+        if s is None:
+            return
+        if cntl.failed:
+            code = 400 if cntl.error_code in (int(Errno.EREQUEST),) else 500
+            s.write(build_response(
+                code, cntl.error_text.encode(),
+                headers=[("x-rpc-error-code", str(cntl.error_code))],
+                keep_alive=msg.keep_alive))
+            return
+        body, ctype = _encode_http_body(response)
+        extra = None
+        att = cntl.response_attachment.to_bytes() \
+            if len(cntl.response_attachment) else b""
+        if att:
+            # attachment rides after the body; the size header lets the
+            # peer split (HTTP has no native side channel)
+            body += att
+            extra = [("x-rpc-attachment-size", str(len(att)))]
+        s.write(build_response(200, body, ctype, headers=extra,
+                               keep_alive=msg.keep_alive))
+
+    cntl = ServerController(meta, sock.remote_side, sock.id, send)
+    cntl.server = server
+    if msg.method in ("GET", "HEAD") and msg.query_string:
+        request: Any = json.dumps(msg.query()).encode()
+    else:
+        request = msg.body
+        att_size = msg.headers.get("x-rpc-attachment-size")
+        if att_size and att_size.isdigit():
+            n = int(att_size)
+            if 0 < n <= len(request):
+                cntl.request_attachment = IOBuf(request[len(request) - n:])
+                request = request[:len(request) - n]
+    try:
+        from ..protocol.tpu_std import parse_payload
+        request = parse_payload(request, entry.request_type)
+    except Exception as e:
+        cntl.set_failed(Errno.EREQUEST, f"request parse failed: {e}")
+        cntl.finish(None)
+        return
+    try:
+        response = entry.fn(cntl, request)
+    except Exception as e:
+        LOG.exception("http method %s raised", entry.status.full_name)
+        cntl.set_failed(Errno.EINTERNAL, f"{type(e).__name__}: {e}")
+        cntl.finish(None)
+        return
+    if cntl.is_async:
+        return
+    cntl.finish(response)
+
+
+def _encode_http_body(response: Any) -> Tuple[bytes, str]:
+    if response is None:
+        return b"", "text/plain"
+    if isinstance(response, (dict, list)):
+        return json.dumps(response).encode(), "application/json"
+    if isinstance(response, str):
+        return response.encode(), "text/plain"
+    if isinstance(response, IOBuf):
+        return response.to_bytes(), "application/octet-stream"
+    if isinstance(response, (bytes, bytearray, memoryview)):
+        return bytes(response), "application/octet-stream"
+    if hasattr(response, "SerializeToString"):
+        return response.SerializeToString(), "application/x-protobuf"
+    return str(response).encode(), "text/plain"

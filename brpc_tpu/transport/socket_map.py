@@ -50,11 +50,18 @@ def _new_connection(remote: EndPoint,
 class SocketMap:
     """Peer → shared "single" connection dedup map (socket_map.cpp)."""
 
-    def __init__(self, health_check_interval_s: float =
-                 DEFAULT_HEALTH_CHECK_INTERVAL_S):
+    def __init__(self, health_check_interval_s: Optional[float] = None):
         self._lock = threading.Lock()
         self._map: Dict[EndPoint, int] = {}
+        # None = follow the live flag at connection time
         self._hc = health_check_interval_s
+
+    def _hc_interval(self) -> float:
+        if self._hc is not None:
+            return self._hc
+        from ..butil.flags import get_flag
+        return get_flag("health_check_interval_s",
+                        DEFAULT_HEALTH_CHECK_INTERVAL_S)
 
     def get_socket(self, remote: EndPoint) -> Tuple[int, int]:
         """Return (socket_id, 0) for the shared connection to ``remote``,
@@ -67,7 +74,7 @@ class SocketMap:
                 s = Socket.address(sid)
                 if s is not None:
                     return sid, 0
-            sid, rc = _new_connection(remote, self._hc)
+            sid, rc = _new_connection(remote, self._hc_interval())
             if rc == 0 or Socket.address(sid) is not None:
                 self._map[remote] = sid
             return sid, rc

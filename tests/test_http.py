@@ -1,0 +1,180 @@
+"""HTTP protocol + builtin portal tests: stdlib http.client as the interop
+peer (a real HTTP implementation we didn't write), RPC bridge, JSON
+responses, flags live-set, multi-protocol port sharing
+(≈ /root/reference/test/brpc_http_rpc_protocol_unittest.cpp shapes)."""
+
+import http.client
+import json
+
+import pytest
+
+from brpc_tpu.butil import flags as flags_mod
+from brpc_tpu.client import Channel, ChannelOptions, Controller
+from brpc_tpu.server import Server, Service
+
+
+class Calc(Service):
+    def Add(self, cntl, request):
+        data = json.loads(request or b"{}")
+        return {"sum": int(data.get("a", 0)) + int(data.get("b", 0))}
+
+    def Echo(self, cntl, request):
+        return request
+
+    def Fail(self, cntl, request):
+        cntl.set_failed(1003, "bad calc")
+        return None
+
+
+@pytest.fixture(scope="module")
+def server():
+    srv = Server()
+    srv.add_service(Calc())
+    assert srv.start("127.0.0.1:0") == 0
+    yield srv
+    srv.stop()
+
+
+def _conn(server):
+    ep = server.listen_endpoint
+    return http.client.HTTPConnection(ep.host, ep.port, timeout=5)
+
+
+def _get(server, path):
+    c = _conn(server)
+    c.request("GET", path)
+    r = c.getresponse()
+    body = r.read()
+    c.close()
+    return r.status, body
+
+
+def test_index_and_health(server):
+    status, body = _get(server, "/")
+    assert status == 200
+    assert b"/Calc/Add" in body
+    status, body = _get(server, "/health")
+    assert status == 200 and body == b"OK\n"
+
+
+def test_status_json(server):
+    status, body = _get(server, "/status")
+    assert status == 200
+    data = json.loads(body)
+    assert "Calc.Add" in data["services"]
+
+
+def test_vars_and_metrics(server):
+    from brpc_tpu.bvar.reducer import Adder
+
+    probe = Adder("http_test_probe_var")
+    probe << 7
+    status, body = _get(server, "/vars")
+    assert status == 200
+    assert b"http_test_probe_var" in body
+    status, body = _get(server, "/vars/http_test_probe_var")
+    assert status == 200 and b"7" in body
+    status, body = _get(server, "/brpc_metrics")
+    assert status == 200
+    probe.hide()
+
+
+def test_flags_get_and_live_set(server):
+    status, body = _get(server, "/flags")
+    assert status == 200 and b"max_body_size" in body
+    # reloadable flag set through the portal
+    status, body = _get(server, "/flags/health_check_interval_s?setvalue=7.5")
+    assert status == 200, body
+    assert flags_mod.get_flag("health_check_interval_s") == 7.5
+    # invalid value rejected by validator
+    status, body = _get(server, "/flags/health_check_interval_s?setvalue=-1")
+    assert status == 403
+    flags_mod.set_flag("health_check_interval_s", 3.0)
+
+
+def test_max_body_size_flag_is_effective():
+    import struct
+
+    from brpc_tpu.butil.iobuf import IOBuf
+    from brpc_tpu.protocol.base import ParseError
+    from brpc_tpu.protocol.tpu_std import MAGIC, parse
+
+    assert flags_mod.set_flag("max_body_size", 16)
+    try:
+        buf = IOBuf(MAGIC + struct.pack("<II", 100, 0) + b"x" * 100)
+        r = parse(buf, None, False, None)
+        assert r.error == ParseError.TOO_BIG_DATA
+    finally:
+        flags_mod.set_flag("max_body_size", 64 * 1024 * 1024)
+
+
+def test_http_attachment_roundtrip(server):
+    opts = ChannelOptions()
+    opts.protocol = "http"
+    ch = Channel(opts)
+    assert ch.init(str(server.listen_endpoint)) == 0
+    cntl = Controller()
+    cntl.request_attachment.append(b"ATTACH" * 10)
+    c = ch.call_method("Calc.Echo", b"body-only", cntl=cntl)
+    assert not c.failed, c.error_text
+    # server saw payload and attachment separately
+    assert c.response == b"body-only"
+
+
+def test_404(server):
+    status, body = _get(server, "/nope")
+    assert status == 404
+
+
+def test_rpc_bridge_post_json(server):
+    c = _conn(server)
+    c.request("POST", "/Calc/Add", body=json.dumps({"a": 20, "b": 22}),
+              headers={"Content-Type": "application/json"})
+    r = c.getresponse()
+    assert r.status == 200
+    assert json.loads(r.read()) == {"sum": 42}
+    # keep-alive: same connection again
+    c.request("POST", "/Calc/Echo", body=b"raw-bytes")
+    r = c.getresponse()
+    assert r.status == 200
+    assert r.read() == b"raw-bytes"
+    c.close()
+
+
+def test_rpc_bridge_get_query(server):
+    status, body = _get(server, "/Calc/Add?a=1&b=2")
+    assert status == 200
+    assert json.loads(body) == {"sum": 3}
+
+
+def test_rpc_bridge_error_mapping(server):
+    c = _conn(server)
+    c.request("POST", "/Calc/Fail", body=b"")
+    r = c.getresponse()
+    assert r.status == 400
+    assert r.getheader("x-rpc-error-code") == "1003"
+    assert b"bad calc" in r.read()
+    c.close()
+
+
+def test_http_client_channel(server):
+    opts = ChannelOptions()
+    opts.protocol = "http"
+    ch = Channel(opts)
+    assert ch.init(str(server.listen_endpoint)) == 0
+    c = ch.call_method("Calc.Echo", b"over-http")
+    assert not c.failed, c.error_text
+    assert c.response == b"over-http"
+    # error propagation carries the rpc code through the http header
+    c = ch.call_method("Calc.Fail", b"")
+    assert c.failed
+    assert c.error_code == 1003
+
+
+def test_same_port_serves_both_protocols(server):
+    # tpu_std client and HTTP client hit the SAME port
+    ch = Channel()
+    assert ch.init(str(server.listen_endpoint)) == 0
+    assert ch.call("Calc.Echo", b"native") == b"native"
+    status, body = _get(server, "/health")
+    assert status == 200
