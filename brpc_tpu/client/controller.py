@@ -60,7 +60,7 @@ class Controller:
         "_live_versions", "_done", "_response_type", "_request_payload",
         "_method_full", "_remote", "_begin_us", "_ended",
         "_timeout_timer", "_backup_timer", "_sending_sid",
-        "_attempt_sids", "attempt_remotes",
+        "_attempt_sids", "attempt_remotes", "_stream_to_create",
         "_channel", "_lb_ctx", "trace_id", "span_id",
     )
 
@@ -97,6 +97,7 @@ class Controller:
         self._sending_sid = 0
         self._attempt_sids = []          # pooled/short sids per attempt
         self.attempt_remotes = {}        # attempt version -> EndPoint
+        self._stream_to_create = None    # set by streaming.stream_create
         self._channel = None
         self._lb_ctx = None
         self.trace_id = 0
@@ -162,6 +163,12 @@ class Controller:
         if opts.protocol == "http" and self.connection_type == "single":
             # http/1 cannot multiplex a shared connection
             self.connection_type = "pooled"
+        if self._stream_to_create is not None:
+            # a stream must bind to exactly one server connection: a
+            # retried/backup attempt could be accepted by a second server
+            # and interleave frames into the same stream
+            self.max_retry = 0
+            self.backup_request_ms = -1
         self._begin_us = monotonic_us()
         self._cid_base = _idp.create_ranged(
             self, Controller._on_id_error, self.max_retry + 2)
@@ -237,6 +244,10 @@ class Controller:
         meta.method_name = mth
         meta.trace_id = self.trace_id
         meta.span_id = self.span_id
+        if self._stream_to_create is not None:
+            meta.stream_id = self._stream_to_create.id
+            meta.stream_window = \
+                self._stream_to_create.options.max_buf_size
         if self.timeout_ms and self.timeout_ms > 0:
             elapsed_ms = (monotonic_us() - self._begin_us) // 1000
             meta.timeout_ms = max(1, int(self.timeout_ms - elapsed_ms))
@@ -317,6 +328,12 @@ class Controller:
                 return
             self._finish_locked(code, msg.meta.error_text)
             return
+        if self._stream_to_create is not None and msg.meta.stream_id:
+            # the accepted stream rides the connection that answered
+            self._stream_to_create._bind(
+                msg.socket_id or self._sending_sid,
+                msg.meta.stream_id,
+                peer_window=msg.meta.stream_window)
         attachment = msg.split_attachment()
         raw = msg.payload.to_bytes()
         if msg.meta.compress_type:
@@ -342,6 +359,12 @@ class Controller:
         self._error_code = int(code)
         self._error_text = text
         self.latency_us = monotonic_us() - self._begin_us
+        if self._stream_to_create is not None and (
+                code != 0
+                or not self._stream_to_create._established.is_set()):
+            # establishment failed — or succeeded without the server
+            # accepting the stream: the pending stream dies with it
+            self._stream_to_create._close_local(notify_peer=False)
         if self._timeout_timer:
             global_timer_thread().unschedule(self._timeout_timer)
         if self._backup_timer:

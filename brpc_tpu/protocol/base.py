@@ -116,7 +116,8 @@ class Protocol:
 
     __slots__ = ("type", "name", "parse", "serialize_request",
                  "pack_request", "process_request", "process_response",
-                 "verify", "support_client", "support_server")
+                 "verify", "support_client", "support_server",
+                 "process_inline")
 
     def __init__(self, type: ProtocolType, name: str,
                  parse: Callable,
@@ -124,7 +125,8 @@ class Protocol:
                  process_response: Optional[Callable] = None,
                  serialize_request: Optional[Callable] = None,
                  pack_request: Optional[Callable] = None,
-                 verify: Optional[Callable] = None):
+                 verify: Optional[Callable] = None,
+                 process_inline: bool = False):
         self.type = type
         self.name = name
         self.parse = parse
@@ -135,6 +137,10 @@ class Protocol:
         self.verify = verify
         self.support_client = process_response is not None
         self.support_server = process_request is not None
+        # True = the messenger must process messages on the reading task
+        # in arrival order (protocols with ordered semantics — streams);
+        # processing must then be cheap/non-blocking
+        self.process_inline = process_inline
 
 
 _registry_lock = threading.Lock()

@@ -30,6 +30,7 @@ _T_SPAN_ID = 10         # u64
 _T_PARENT_SPAN = 11     # u64
 _T_STREAM_ID = 12       # u64 (streaming rpc settlement)
 _T_TIMEOUT_MS = 13      # u32 remaining-deadline propagation
+_T_STREAM_WINDOW = 14   # u32 receiver buffer size (stream handshake)
 
 
 class CompressType:
@@ -43,7 +44,7 @@ class RpcMeta:
     __slots__ = ("correlation_id", "compress_type", "attachment_size",
                  "service_name", "method_name", "error_code", "error_text",
                  "auth_data", "trace_id", "span_id", "parent_span_id",
-                 "stream_id", "timeout_ms")
+                 "stream_id", "timeout_ms", "stream_window")
 
     def __init__(self):
         self.correlation_id = 0
@@ -59,6 +60,7 @@ class RpcMeta:
         self.parent_span_id = 0
         self.stream_id = 0
         self.timeout_ms = 0
+        self.stream_window = 0
 
     @property
     def is_request(self) -> bool:
@@ -100,6 +102,8 @@ class RpcMeta:
             put(_T_STREAM_ID, struct.pack("<Q", self.stream_id))
         if self.timeout_ms:
             put(_T_TIMEOUT_MS, struct.pack("<I", self.timeout_ms))
+        if self.stream_window:
+            put(_T_STREAM_WINDOW, struct.pack("<I", self.stream_window))
         return bytes(out)
 
     @staticmethod
@@ -141,6 +145,8 @@ class RpcMeta:
                     (m.stream_id,) = struct.unpack("<Q", field)
                 elif tag == _T_TIMEOUT_MS:
                     (m.timeout_ms,) = struct.unpack("<I", field)
+                elif tag == _T_STREAM_WINDOW:
+                    (m.stream_window,) = struct.unpack("<I", field)
                 # unknown tags are skipped: forward compatibility
         except (struct.error, IndexError, UnicodeDecodeError):
             return None

@@ -1,0 +1,71 @@
+"""Stream frame protocol — parse + dispatch to Stream objects.
+
+≈ /root/reference/src/brpc/policy/streaming_rpc_protocol.cpp:42-148:
+frames ride the same connection as the RPC that established the stream;
+dispatch is by destination stream id, symmetric on both sides.
+"""
+
+from __future__ import annotations
+
+import struct
+
+from ..butil.iobuf import IOBuf
+from .base import (ParseResult, Protocol, ProtocolType, max_body_size,
+                   register_protocol)
+
+MAGIC = b"TSTR"
+HEADER = 17
+
+
+def parse(source: IOBuf, sock, read_eof: bool, arg) -> ParseResult:
+    avail = len(source)
+    if avail < HEADER:
+        got = source.fetch(min(4, avail))
+        if MAGIC.startswith(got):
+            return ParseResult.not_enough_data()
+        return ParseResult.try_others()
+    head = source.fetch(HEADER)
+    if head[:4] != MAGIC:
+        return ParseResult.try_others()
+    flags, dest, ln = struct.unpack_from("<BQI", head, 4)
+    if ln > max_body_size():
+        return ParseResult.too_big()
+    if avail < HEADER + ln:
+        return ParseResult.not_enough_data()
+    source.pop_front(HEADER)
+    payload = source.fetch(ln)
+    source.pop_front(ln)
+    return ParseResult.make_message((flags, dest, payload))
+
+
+def _dispatch(msg, sock) -> None:
+    from ..streaming import find_stream
+
+    flags, dest, payload = msg
+    stream = find_stream(dest)
+    if stream is None:
+        return                      # stream already closed; drop
+    stream.on_frame(flags, payload)
+
+
+def _process_request(msg, sock, server) -> None:
+    _dispatch(msg, sock)
+
+
+def _process_response(msg, sock) -> None:
+    _dispatch(msg, sock)
+
+
+STREAMING = Protocol(
+    ProtocolType.STREAMING_RPC, "streaming_rpc", parse,
+    process_request=_process_request,
+    process_response=_process_response,
+    # frames are ordered within a stream: dispatch on the reading task
+    # (cheap — a push into the stream's ExecutionQueue)
+    process_inline=True,
+)
+register_protocol(STREAMING)
+
+from ..transport.input_messenger import client_messenger  # noqa: E402
+
+client_messenger().add_handler(STREAMING)

@@ -1,0 +1,138 @@
+"""rpcz — per-RPC span tracing.
+
+≈ /root/reference/src/brpc/span.h:47-84 + builtin/rpcz_service.cpp:
+spans are rate-limited samples (bvar Collector, collector.h:57-72) so
+tracing can stay always-on; trace context (trace_id/span_id/parent) rides
+the tpu_std meta; storage is an in-memory bounded store browsable at
+/rpcz (the reference uses leveldb — deliberately simpler here, same
+capability surface: recent spans by id/time, annotations).
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+from collections import deque
+from typing import Deque, Dict, List, Optional
+
+from .butil.fast_rand import fast_rand
+from .butil.flags import define_flag, get_flag, any_value
+from .bvar.collector import Collected, Collector
+
+define_flag("enable_rpcz", True, "collect per-RPC spans", any_value)
+define_flag("rpcz_keep_spans", 2048, "max spans kept in memory",
+            lambda v: v > 0)
+
+_span_seq = itertools.count(1)
+
+
+class Span(Collected):
+    __slots__ = ("trace_id", "span_id", "parent_span_id", "full_method",
+                 "remote_side", "received_us", "start_us", "end_us",
+                 "error_code", "request_size", "response_size",
+                 "annotations", "is_server")
+
+    def __init__(self, full_method: str, trace_id: int = 0,
+                 parent_span_id: int = 0, is_server: bool = True):
+        self.trace_id = trace_id or fast_rand()
+        self.span_id = next(_span_seq)
+        self.parent_span_id = parent_span_id
+        self.full_method = full_method
+        self.remote_side = ""
+        self.received_us = int(time.time() * 1e6)
+        self.start_us = self.received_us
+        self.end_us = 0
+        self.error_code = 0
+        self.request_size = 0
+        self.response_size = 0
+        self.annotations: List[tuple] = []
+        self.is_server = is_server
+
+    def annotate(self, text: str) -> None:
+        """≈ TRACEPRINTF (src/brpc/traceprintf.h)."""
+        self.annotations.append((int(time.time() * 1e6), text))
+
+    def finish(self, error_code: int = 0) -> None:
+        self.end_us = int(time.time() * 1e6)
+        self.error_code = error_code
+        global_span_store().add(self)
+
+    @property
+    def latency_us(self) -> int:
+        return (self.end_us or int(time.time() * 1e6)) - self.received_us
+
+    def describe(self) -> Dict:
+        return {
+            "trace_id": f"{self.trace_id:x}",
+            "span_id": self.span_id,
+            "parent_span_id": self.parent_span_id,
+            "method": self.full_method,
+            "remote": self.remote_side,
+            "received_us": self.received_us,
+            "latency_us": self.latency_us,
+            "error_code": self.error_code,
+            "request_size": self.request_size,
+            "response_size": self.response_size,
+            "side": "server" if self.is_server else "client",
+            "annotations": [
+                {"us": ts, "text": txt} for ts, txt in self.annotations],
+        }
+
+
+class SpanStore:
+    """Bounded recent-span store, indexed by trace id."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._spans: Deque[Span] = deque()
+        # rate limiter: at most ~1000 spans/s retained (collector.h role)
+        self._collector = Collector()
+
+    def add(self, span: Span) -> None:
+        if not self._collector.submit(span):
+            return                        # over the rate budget: sampled out
+        self._collector.drain()           # used purely as a rate limiter
+        keep = get_flag("rpcz_keep_spans", 2048)
+        with self._lock:
+            self._spans.append(span)
+            while len(self._spans) > keep:
+                self._spans.popleft()
+
+    def recent(self, limit: int = 100) -> List[Span]:
+        with self._lock:
+            return list(self._spans)[-limit:]
+
+    def by_trace(self, trace_id: int) -> List[Span]:
+        with self._lock:
+            return [s for s in self._spans if s.trace_id == trace_id]
+
+    def clear(self) -> None:
+        with self._lock:
+            self._spans.clear()
+
+
+_store: Optional[SpanStore] = None
+_store_lock = threading.Lock()
+
+
+def global_span_store() -> SpanStore:
+    global _store
+    with _store_lock:
+        if _store is None:
+            _store = SpanStore()
+        return _store
+
+
+def rpcz_enabled() -> bool:
+    return bool(get_flag("enable_rpcz", True))
+
+
+def start_server_span(full_method: str, meta, remote_side) -> Optional[Span]:
+    """Called by the dispatch layer per request (None when disabled)."""
+    if not rpcz_enabled():
+        return None
+    span = Span(full_method, trace_id=meta.trace_id,
+                parent_span_id=meta.span_id, is_server=True)
+    span.remote_side = str(remote_side or "")
+    return span

@@ -160,6 +160,29 @@ def _list_vars(server, msg, rest):
     return 200, "application/json", json.dumps(list_exposed())
 
 
+def _rpcz(server, msg, rest):
+    from ...rpcz import global_span_store, rpcz_enabled
+
+    store = global_span_store()
+    q = msg.query()
+    if "trace_id" in q:
+        try:
+            tid = int(q["trace_id"], 16)
+        except ValueError:
+            return 400, "text/plain", "bad trace_id (hex)\n"
+        spans = store.by_trace(tid)
+    else:
+        try:
+            limit = max(1, int(q.get("limit", "100")))
+        except ValueError:
+            return 400, "text/plain", "bad limit (integer)\n"
+        spans = store.recent(limit)
+    return 200, "application/json", json.dumps({
+        "enabled": rpcz_enabled(),
+        "spans": [s.describe() for s in reversed(spans)],
+    }, indent=1)
+
+
 register_builtin("", _index)
 register_builtin("index", _index)
 register_builtin("health", _health)
@@ -172,3 +195,4 @@ register_builtin("metrics", _metrics)
 register_builtin("flags", _flags)
 register_builtin("connections", _connections)
 register_builtin("fibers", _fibers)
+register_builtin("rpcz", _rpcz)

@@ -27,6 +27,8 @@ class ServerController:
         "_async", "_finished", "_finish_lock", "_send_response",
         "begin_time_us", "trace_id", "span_id",
         "auth_context", "server",
+        "_remote_stream_id", "_accepted_stream_id",
+        "_accepted_stream_window", "span",
     )
 
     def __init__(self, request_meta: RpcMeta,
@@ -50,6 +52,10 @@ class ServerController:
         self.span_id = request_meta.span_id
         self.auth_context: Any = None
         self.server: Any = None
+        self._remote_stream_id = request_meta.stream_id
+        self._accepted_stream_id = 0
+        self._accepted_stream_window = 0
+        self.span = None                 # rpcz Span when tracing is on
 
     # -- error reporting ---------------------------------------------------
 
@@ -93,6 +99,12 @@ class ServerController:
                 return
             self._finished = True
         self._send_response(self, response)
+
+    def annotate(self, text: str) -> None:
+        """Add a note to the request's rpcz span (no-op when tracing is
+        off) — ≈ TRACEPRINTF into the current span."""
+        if self.span is not None:
+            self.span.annotate(text)
 
     def _mark_finished_if_first(self) -> bool:
         with self._finish_lock:

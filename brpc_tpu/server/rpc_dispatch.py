@@ -36,10 +36,22 @@ def _send_response(server, entry, cntl: ServerController,
     latency_us = monotonic_us() - cntl.begin_time_us
     entry.status.on_responded(cntl.error_code, latency_us)
     server.on_request_out()
+    if cntl.span is not None:
+        cntl.span.finish(cntl.error_code)
+    if cntl._accepted_stream_id and (cntl.failed or sock is None):
+        # the client will never bind: close the orphaned accepted stream
+        from ..streaming import find_stream
+        s = find_stream(cntl._accepted_stream_id)
+        if s is not None:
+            s._close_local(notify_peer=False)
+        cntl._accepted_stream_id = 0
     if sock is None:
         return      # connection died; response dropped like the reference
     meta = RpcMeta()
     meta.correlation_id = cntl.request_meta.correlation_id
+    if cntl._accepted_stream_id:
+        meta.stream_id = cntl._accepted_stream_id
+        meta.stream_window = cntl._accepted_stream_window
     if cntl.failed:
         meta.error_code = cntl.error_code
         meta.error_text = cntl.error_text
@@ -90,6 +102,12 @@ def process_rpc_request(msg: RpcMessage, sock: Socket, server) -> None:
         send_response=lambda c, r: _send_response(server, entry, c, r))
     cntl.server = server
     cntl.request_attachment = msg.split_attachment()
+    from ..rpcz import start_server_span
+    cntl.span = start_server_span(entry.status.full_name, meta,
+                                  sock.remote_side)
+    if cntl.span is not None:
+        cntl.span.request_size = len(msg.payload) \
+            + len(cntl.request_attachment)
 
     # auth on first message of the connection (≈ Protocol::verify)
     auth = server.options.auth

@@ -175,6 +175,7 @@ class Server:
         # (≈ Server::BuildAcceptor collecting protocols, server.cpp:572);
         # importing the modules registers the builtins
         from ..protocol import http as _http      # noqa: F401
+        from ..protocol import streaming as _str  # noqa: F401
         from ..protocol import tpu_std as _tpu    # noqa: F401
         handlers = [p for p in list_protocols() if p.support_server]
         self._messenger = InputMessenger(handlers, arg=self)

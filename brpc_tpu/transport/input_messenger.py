@@ -74,13 +74,18 @@ class InputMessenger:
             pending.append((proto, result.message))
         if not pending:
             return
-        # All but the last message get their own task; the last runs
-        # inline (input_messenger.cpp:377-394 batching).
-        for proto, msg in pending[:-1]:
-            fiber_runtime.spawn(self._process, proto, msg, sock,
-                                name=f"process_{proto.name}")
-        proto, msg = pending[-1]
-        self._process(proto, msg, sock)
+        # Ordered protocols (streams) process inline on the reading task
+        # in arrival order. Non-inline messages get their own task —
+        # except the final message of the gulp, which runs inline to save
+        # a context switch (input_messenger.cpp:377-394 batching). A
+        # non-inline message is NEVER run inline when messages follow it:
+        # a blocking RPC handler must not delay its own stream's frames.
+        for i, (proto, msg) in enumerate(pending):
+            if proto.process_inline or i == len(pending) - 1:
+                self._process(proto, msg, sock)
+            else:
+                fiber_runtime.spawn(self._process, proto, msg, sock,
+                                    name=f"process_{proto.name}")
 
     def _cut_one(self, sock: Socket):
         """Try last-used protocol, then all handlers. Returns

@@ -209,6 +209,14 @@ class Socket:
                 idp.error(id_wait, int(code), text)
         if self.correlation_id:
             idp.error(self.correlation_id, int(code), text)
+        with self._stream_lock:
+            broken_streams = list(self.stream_map.values())
+            self.stream_map.clear()
+        for stream in broken_streams:
+            # receive-only streams would otherwise never learn the
+            # connection died; off-thread, user on_closed may block
+            fiber_runtime.spawn(stream._on_conn_broken,
+                                name="stream_conn_broken")
         if self.health_check_interval_s > 0:
             from .health_check import start_health_check
             start_health_check(self.id, self.health_check_interval_s)

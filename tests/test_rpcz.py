@@ -1,0 +1,73 @@
+"""rpcz span tests: collection on the server path, trace propagation from
+client meta, annotations, the /rpcz page, and the enable flag."""
+
+import http.client
+import json
+
+import pytest
+
+from brpc_tpu.butil import flags as flags_mod
+from brpc_tpu.client import Channel, Controller
+from brpc_tpu.rpcz import global_span_store
+from brpc_tpu.server import Server, Service
+
+
+class Traced(Service):
+    def Work(self, cntl, request):
+        cntl.annotate("step-one")
+        cntl.annotate("step-two")
+        return b"done"
+
+
+@pytest.fixture()
+def server():
+    global_span_store().clear()
+    srv = Server()
+    srv.add_service(Traced())
+    assert srv.start("127.0.0.1:0") == 0
+    yield srv
+    srv.stop()
+    global_span_store().clear()
+
+
+def test_span_collected_with_annotations(server):
+    ch = Channel()
+    ch.init(str(server.listen_endpoint))
+    cntl = Controller()
+    cntl.trace_id = 0xABCDEF
+    c = ch.call_method("Traced.Work", b"payload", cntl=cntl)
+    assert not c.failed
+    spans = global_span_store().by_trace(0xABCDEF)
+    assert len(spans) == 1
+    s = spans[0]
+    assert s.full_method == "Traced.Work"
+    assert s.request_size == len(b"payload")
+    assert s.latency_us > 0
+    assert [t for _, t in s.annotations] == ["step-one", "step-two"]
+
+
+def test_rpcz_page(server):
+    ch = Channel()
+    ch.init(str(server.listen_endpoint))
+    ch.call("Traced.Work", b"x")
+    ep = server.listen_endpoint
+    conn = http.client.HTTPConnection(ep.host, ep.port, timeout=5)
+    conn.request("GET", "/rpcz")
+    r = conn.getresponse()
+    assert r.status == 200
+    data = json.loads(r.read())
+    assert data["enabled"] is True
+    assert any(s["method"] == "Traced.Work" for s in data["spans"])
+    conn.close()
+
+
+def test_rpcz_disable_flag(server):
+    assert flags_mod.set_flag("enable_rpcz", "false")
+    try:
+        global_span_store().clear()
+        ch = Channel()
+        ch.init(str(server.listen_endpoint))
+        ch.call("Traced.Work", b"x")
+        assert global_span_store().recent() == []
+    finally:
+        flags_mod.set_flag("enable_rpcz", "true")

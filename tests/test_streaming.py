@@ -1,0 +1,128 @@
+"""Streaming RPC tests: establishment over an RPC, ordered bidi data,
+credit-window flow control, graceful close
+(≈ /root/reference/test/brpc_streaming_rpc_unittest.cpp shapes +
+example/streaming_echo_c++)."""
+
+import threading
+import time
+
+import pytest
+
+from brpc_tpu.client import Channel, Controller
+from brpc_tpu.server import Server, Service
+from brpc_tpu.streaming import (Stream, StreamOptions, stream_accept,
+                                stream_create)
+
+
+class StreamEcho(Service):
+    """Accepts a stream and echoes every message back upper-cased."""
+
+    def __init__(self):
+        self.server_streams = []
+
+    def Start(self, cntl, request):
+        def on_received(stream, msgs):
+            for m in msgs:
+                stream.write(m.upper())
+
+        s = stream_accept(cntl, StreamOptions(on_received=on_received))
+        assert s is not None
+        self.server_streams.append(s)
+        return b"stream accepted"
+
+    def NoStream(self, cntl, request):
+        return b"plain"
+
+
+@pytest.fixture()
+def server():
+    srv = Server()
+    srv.add_service(StreamEcho(), name="SE")
+    assert srv.start("127.0.0.1:0") == 0
+    yield srv
+    srv.stop()
+
+
+def _collect(received, closed=None):
+    def on_received(stream, msgs):
+        received.extend(msgs)
+    return StreamOptions(on_received=on_received,
+                         on_closed=closed)
+
+
+def test_stream_echo_roundtrip(server):
+    ch = Channel()
+    ch.init(str(server.listen_endpoint))
+    received = []
+    cntl = Controller()
+    stream = stream_create(cntl, _collect(received))
+    c = ch.call_method("SE.Start", b"hi", cntl=cntl)
+    assert not c.failed, c.error_text
+    assert c.response == b"stream accepted"
+    assert stream.wait_established(5.0)
+
+    for i in range(20):
+        assert stream.write(f"msg{i}".encode()) == 0
+    deadline = time.time() + 5.0
+    while len(received) < 20 and time.time() < deadline:
+        time.sleep(0.01)
+    assert received == [f"MSG{i}".encode() for i in range(20)]
+    stream.close()
+
+
+def test_stream_flow_control_blocks_and_resumes(server):
+    ch = Channel()
+    ch.init(str(server.listen_endpoint))
+    received = []
+    cntl = Controller()
+    # tiny window: 4KB; messages of 1KB
+    opts = _collect(received)
+    opts.max_buf_size = 4096
+    opts.write_timeout_s = 5.0
+    stream = stream_create(cntl, opts)
+    c = ch.call_method("SE.Start", b"", cntl=cntl)
+    assert not c.failed
+    assert stream.wait_established(5.0)
+    payload = b"x" * 1024
+    t0 = time.time()
+    for _ in range(32):                 # 32KB >> 4KB window
+        assert stream.write(payload) == 0
+    # all data eventually delivered (acks advanced the window)
+    deadline = time.time() + 10.0
+    while len(received) < 32 and time.time() < deadline:
+        time.sleep(0.01)
+    assert len(received) == 32
+    stream.close()
+
+
+def test_stream_close_notifies_peer(server):
+    svc = server.services["SE"]
+    ch = Channel()
+    ch.init(str(server.listen_endpoint))
+    closed_evt = threading.Event()
+    cntl = Controller()
+    stream = stream_create(cntl, _collect([], lambda s: closed_evt.set()))
+    c = ch.call_method("SE.Start", b"", cntl=cntl)
+    assert not c.failed
+    assert stream.wait_established(5.0)
+    peer = svc.server_streams[-1]
+    peer.close()                        # server closes → client notified
+    assert closed_evt.wait(5.0)
+    assert stream.closed
+
+
+def test_no_stream_method_unaffected(server):
+    ch = Channel()
+    ch.init(str(server.listen_endpoint))
+    assert ch.call("SE.NoStream", b"") == b"plain"
+
+
+def test_failed_establishment_closes_stream():
+    ch = Channel()
+    ch.init("127.0.0.1:1")          # nothing listens
+    cntl = Controller()
+    cntl.timeout_ms = 1500
+    stream = stream_create(cntl, StreamOptions())
+    c = ch.call_method("SE.Start", b"", cntl=cntl)
+    assert c.failed
+    assert stream.closed
