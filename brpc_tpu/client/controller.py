@@ -164,11 +164,13 @@ class Controller:
             # http/1 cannot multiplex a shared connection
             self.connection_type = "pooled"
         if self._stream_to_create is not None:
-            # a stream must bind to exactly one server connection: a
-            # retried/backup attempt could be accepted by a second server
-            # and interleave frames into the same stream
+            # a stream must bind to exactly one long-lived server
+            # connection: retry/backup could get a second server to
+            # accept, and short/pooled connections are released or
+            # recycled at RPC completion under the live stream
             self.max_retry = 0
             self.backup_request_ms = -1
+            self.connection_type = "single"
         self._begin_us = monotonic_us()
         self._cid_base = _idp.create_ranged(
             self, Controller._on_id_error, self.max_retry + 2)

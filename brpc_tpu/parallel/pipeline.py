@@ -1,0 +1,75 @@
+"""Pipeline parallelism over a mesh axis.
+
+The reference's "pipeline" is network-sense (pipelined connections,
+SURVEY.md §2.9.5); model-stage pipelining is new TPU-first design: a
+GPipe-style microbatch schedule expressed as one shard_map program —
+stage parameters live stacked on the ``pp`` axis, activations hop to the
+next stage via ppermute each tick, and the loop runs
+``n_micro + n_stages - 1`` ticks (bubble included). XLA overlaps the
+ppermute with the next tick's compute where the schedule allows.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+from .mesh_transport import _shard_map
+
+
+def make_pipeline(mesh, stage_fn: Callable, axis: str = "pp"):
+    """Build f(stacked_params, microbatches) -> outputs.
+
+    - ``stacked_params``: pytree whose leaves have leading dim
+      ``n_stages`` (sharded over ``axis``) — stage i's slice feeds
+      ``stage_fn`` on device i.
+    - ``microbatches``: (n_micro, mb, ...) replicated; outputs
+      (n_micro, mb, ...) replicated (read off the last stage).
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    n = mesh.shape[axis]
+    fwd = [(i, (i + 1) % n) for i in range(n)]
+
+    def local(params, xs):
+        # params leaves: (1, ...) per device; xs: (n_micro, mb, ...)
+        my_params = jax.tree_util.tree_map(lambda p: p[0], params)
+        idx = jax.lax.axis_index(axis)
+        n_micro = xs.shape[0]
+        ticks = n_micro + n - 1
+        state = jnp.zeros_like(xs[0])
+        outputs = jnp.zeros_like(xs)
+
+        def body(t, carry):
+            state, outputs = carry
+            # stage 0 injects microbatch t (when one remains); others use
+            # the activation that just arrived from the previous stage
+            inject = xs[jnp.minimum(t, n_micro - 1)]
+            inp = jnp.where(idx == 0, inject, state)
+            out = stage_fn(my_params, inp)
+            # ship to the next stage; the last stage's ppermute output to
+            # stage 0 is ignored (overwritten by injection)
+            state_next = jax.lax.ppermute(out, axis, fwd)
+            # last stage emits the finished microbatch t-(n-1)
+            done_idx = t - (n - 1)
+            outputs = jax.lax.cond(
+                jnp.logical_and(idx == n - 1, done_idx >= 0),
+                lambda o: jax.lax.dynamic_update_slice(
+                    o, out[None], (jnp.maximum(done_idx, 0),) +
+                    (0,) * (o.ndim - 1)),
+                lambda o: o,
+                outputs)
+            return (state_next, outputs)
+
+        _, outputs = jax.lax.fori_loop(0, ticks, body, (state, outputs))
+        # only the last stage holds real outputs: broadcast to all
+        outputs = jax.lax.psum(
+            jnp.where(idx == n - 1, outputs, jnp.zeros_like(outputs)),
+            axis)
+        return outputs
+
+    return jax.jit(_shard_map(jax)(
+        local, mesh=mesh,
+        in_specs=(P(axis), P()),
+        out_specs=P()))

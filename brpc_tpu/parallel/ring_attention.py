@@ -1,0 +1,150 @@
+"""Sequence parallelism for long context: ring attention + Ulysses.
+
+No counterpart exists in the reference (SURVEY.md §5.7 — brpc's answer
+to big payloads is partition + streaming); this is new TPU-first design
+on the collective transport, as the survey prescribes:
+
+- **ring_attention**: Q stays put; K/V blocks rotate around the ``sp``
+  ring via ppermute while a flash-style online softmax accumulates
+  (running max / denominator), so attention over sequence length S runs
+  with S/n residency per chip and compute/communication overlap left to
+  XLA's schedule. Blockwise-parallel/ring formulation (public technique;
+  fresh implementation).
+- **ulysses_attention**: all_to_all re-shards sequence↔heads so each
+  chip runs FULL-sequence attention for a head subset — cheaper at
+  moderate S when heads divide the mesh.
+
+Both are jittable shard_map programs over one mesh axis; causal masking
+uses global positions derived from the device's ring index.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+from .mesh_transport import _shard_map
+
+
+def _attention_block(q, k_blk, v_blk, scale, mask):
+    """One (Q-local × K-block) flash step: returns (scores_max, exp
+    scores, weighted values) pieces for the online softmax."""
+    import jax.numpy as jnp
+
+    # (b, sq, h, d) x (b, sk, h, d) -> (b, h, sq, sk) on the MXU
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k_blk,
+                   preferred_element_type=jnp.float32) * scale
+    if mask is not None:
+        s = jnp.where(mask, s, -1e30)
+    return s
+
+
+def make_ring_attention(mesh, axis: str = "sp", causal: bool = False):
+    """Build the jitted ring attention fn for ``mesh``/``axis``.
+
+    Global shapes: q, k, v — (batch, seq, heads, dim), sharded on seq.
+    Returns f(q, k, v) -> (batch, seq, heads, dim), same sharding.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    n = mesh.shape[axis]
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def local(q, k, v):
+        # per-device: (b, s_local, h, d)
+        b, sl, h, d = q.shape
+        scale = 1.0 / (d ** 0.5)
+        idx = jax.lax.axis_index(axis)
+        q_pos = idx * sl + jnp.arange(sl)              # global positions
+
+        m0 = jnp.full((b, h, sl), -1e30, jnp.float32)  # running max
+        l0 = jnp.zeros((b, h, sl), jnp.float32)        # running denom
+        acc0 = jnp.zeros((b, sl, h, d), jnp.float32)
+
+        def body(step, carry):
+            k_blk, v_blk, m, l, acc = carry
+            # block we currently hold started at device (idx - step) % n
+            src = (idx - step) % n
+            mask = None
+            if causal:
+                k_pos = src * sl + jnp.arange(sl)
+                mask = q_pos[:, None] >= k_pos[None, :]   # (sq, sk)
+                mask = mask[None, None]                   # (1,1,sq,sk)
+            s = _attention_block(q, k_blk, v_blk, scale, mask)
+            m_new = jnp.maximum(m, s.max(axis=-1))
+            corr = jnp.exp(m - m_new)
+            p = jnp.exp(s - m_new[..., None])             # (b,h,sq,sk)
+            l = l * corr + p.sum(axis=-1)
+            pv = jnp.einsum("bhqk,bkhd->bqhd", p, v_blk,
+                            preferred_element_type=jnp.float32)
+            acc = acc * corr.transpose(0, 2, 1)[..., None] + pv
+            k_blk = jax.lax.ppermute(k_blk, axis, perm)
+            v_blk = jax.lax.ppermute(v_blk, axis, perm)
+            return (k_blk, v_blk, m_new, l, acc)
+
+        _, _, m, l, acc = jax.lax.fori_loop(
+            0, n, body, (k, v, m0, l0, acc0))
+        out = acc / jnp.maximum(l, 1e-30).transpose(0, 2, 1)[..., None]
+        return out.astype(q.dtype)
+
+    spec = P(None, axis, None, None)
+    return jax.jit(_shard_map(jax)(local, mesh=mesh,
+                                   in_specs=(spec, spec, spec),
+                                   out_specs=spec))
+
+
+def make_ulysses_attention(mesh, axis: str = "sp", causal: bool = False):
+    """Sequence↔head all_to_all, full local attention, exchange back.
+    Heads must be divisible by the mesh axis size."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    n = mesh.shape[axis]
+
+    def local(q, k, v):
+        # in: (b, s/n, h, d) → a2a → (b, s, h/n, d)
+        def seq_to_head(x):
+            return jax.lax.all_to_all(x, axis, split_axis=2,
+                                      concat_axis=1, tiled=True)
+
+        def head_to_seq(x):
+            return jax.lax.all_to_all(x, axis, split_axis=1,
+                                      concat_axis=2, tiled=True)
+
+        qf, kf, vf = seq_to_head(q), seq_to_head(k), seq_to_head(v)
+        b, s, hh, d = qf.shape
+        scale = 1.0 / (d ** 0.5)
+        s_mat = jnp.einsum("bqhd,bkhd->bhqk", qf, kf,
+                           preferred_element_type=jnp.float32) * scale
+        if causal:
+            pos = jnp.arange(s)
+            mask = (pos[:, None] >= pos[None, :])[None, None]
+            s_mat = jnp.where(mask, s_mat, -1e30)
+        p = jax.nn.softmax(s_mat, axis=-1)
+        out = jnp.einsum("bhqk,bkhd->bqhd", p, vf,
+                         preferred_element_type=jnp.float32)
+        return head_to_seq(out.astype(q.dtype))
+
+    spec = P(None, axis, None, None)
+    return jax.jit(_shard_map(jax)(local, mesh=mesh,
+                                   in_specs=(spec, spec, spec),
+                                   out_specs=spec))
+
+
+def reference_attention(q, k, v, causal: bool = False):
+    """Dense single-device attention — the correctness oracle for tests."""
+    import jax
+    import jax.numpy as jnp
+
+    d = q.shape[-1]
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) / (d ** 0.5)
+    if causal:
+        n = q.shape[1]
+        pos = jnp.arange(n)
+        mask = (pos[:, None] >= pos[None, :])[None, None]
+        s = jnp.where(mask, s, -1e30)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, v).astype(q.dtype)

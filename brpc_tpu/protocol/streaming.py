@@ -14,7 +14,12 @@ from .base import (ParseResult, Protocol, ProtocolType, max_body_size,
                    register_protocol)
 
 MAGIC = b"TSTR"
-HEADER = 17
+HEADER = 17            # 4 magic + 1 flags + 8 dest id + 4 len
+
+F_DATA = 0
+F_FEEDBACK = 1
+F_CLOSE = 2            # graceful FIN
+F_RST = 3              # abortive
 
 
 def parse(source: IOBuf, sock, read_eof: bool, arg) -> ParseResult:

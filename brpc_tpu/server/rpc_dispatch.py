@@ -70,6 +70,9 @@ def _send_response(server, entry, cntl: ServerController,
         if compressed is not None:
             meta.compress_type = cntl.response_compress_type
             payload = IOBuf(compressed)
+    if cntl.span is not None:
+        cntl.span.response_size = len(payload) \
+            + len(cntl.response_attachment)
     sock.write(pack_frame(meta, payload,
                           attachment=cntl.response_attachment))
 

@@ -27,18 +27,14 @@ from .butil.iobuf import IOBuf
 from .butil.logging_util import LOG
 from .butil.status import Errno
 from .fiber.execution_queue import ExecutionQueue
-from .protocol import streaming as _frame_proto  # noqa: F401 (registers)
 from .transport.socket import Socket
 
-MAGIC = b"TSTR"
-HEADER = 17            # 4 + 1 + 8 + 4
-
-F_DATA = 0
-F_FEEDBACK = 1
-F_CLOSE = 2            # graceful FIN
-F_RST = 3              # abortive
+# wire constants live with the parser — one definition for both sides
+from .protocol.streaming import (F_CLOSE, F_DATA, F_FEEDBACK, F_RST,
+                                 MAGIC)
 
 DEFAULT_WINDOW = 2 * 1024 * 1024
+_CLOSE_SENTINEL = object()     # ordered close marker in the deliver queue
 
 
 class StreamOptions:
@@ -83,7 +79,9 @@ class Stream:
         self._close_lock = threading.Lock()
         # writer-side credit window = the PEER's advertised receive
         # buffer (set at bind; own buf size is only a pre-bind fallback)
-        self._cond = threading.Condition()
+        # RLock: _send_frame failure inside write() re-enters via
+        # _close_local's notify
+        self._cond = threading.Condition(threading.RLock())
         self._write_window = self.options.max_buf_size
         self._produced = 0
         self._remote_consumed = 0
@@ -104,10 +102,19 @@ class Stream:
         if peer_window > 0:
             self._write_window = peer_window
         sock = Socket.address(socket_id)
-        if sock is not None:
+        if sock is not None and not sock.failed:
             with sock._stream_lock:
                 sock.stream_map[self.id] = self
+            if sock.failed:
+                # raced set_failed's sweep: self-remove and treat as dead
+                with sock._stream_lock:
+                    sock.stream_map.pop(self.id, None)
+                sock = None
+        elif sock is not None:
+            sock = None
         self._established.set()
+        if sock is None:
+            self._on_conn_broken()
 
     def wait_established(self, timeout: float = 10.0) -> bool:
         return self._established.wait(timeout)
@@ -143,7 +150,9 @@ class Stream:
             if self._closed:
                 return int(Errno.EEOF)
             self._produced += len(data)
-        return self._send_frame(F_DATA, data)
+            # send while still holding _cond: two writers woken together
+            # must hit the socket in credit-reservation order
+            return self._send_frame(F_DATA, data)
 
     def _send_frame(self, flags: int, payload: bytes = b"") -> int:
         sock = Socket.address(self.socket_id)
@@ -169,25 +178,37 @@ class Stream:
                 if consumed > self._remote_consumed:
                     self._remote_consumed = consumed
                     self._cond.notify_all()
-        elif flags in (F_CLOSE, F_RST):
+        elif flags == F_RST:
             self._close_local(notify_peer=False)
+        elif flags == F_CLOSE:
+            # ordered close: runs through the deliver queue so data cut
+            # before the FIN is handed to on_received first
+            self._deliver.execute(_CLOSE_SENTINEL)
 
     def _deliver_batch(self, it) -> None:
         msgs = list(it)
-        if not msgs:
-            return
-        if self.options.on_received is not None:
-            try:
-                self.options.on_received(self, msgs)
-            except Exception:
-                LOG.exception("stream on_received raised")
-        # ack AFTER delivery at half-window granularity (stream.cpp:307
-        # SetRemoteConsumed): a slow handler throttles the writer
-        self._consumed += sum(len(m) for m in msgs)
-        if (self._consumed - self._acked
-                >= self.options.max_buf_size // 2) and not self._closed:
-            self._acked = self._consumed
-            self._send_frame(F_FEEDBACK, struct.pack("<Q", self._consumed))
+        close_after = _CLOSE_SENTINEL in msgs
+        msgs = [m for m in msgs if m is not _CLOSE_SENTINEL]
+        if msgs:
+            # consumption = dequeued for processing: ack BEFORE the
+            # handler (the reference advances remote_consumed on pop,
+            # stream.cpp:307 — an on_received that writes back and blocks
+            # on peer credit must not stall its own acks). on_received
+            # should still not block forever; offload long work.
+            self._consumed += sum(len(m) for m in msgs)
+            if (self._consumed - self._acked
+                    >= self.options.max_buf_size // 2) \
+                    and not self._closed:
+                self._acked = self._consumed
+                self._send_frame(F_FEEDBACK,
+                                 struct.pack("<Q", self._consumed))
+            if self.options.on_received is not None:
+                try:
+                    self.options.on_received(self, msgs)
+                except Exception:
+                    LOG.exception("stream on_received raised")
+        if close_after:
+            self._close_local(notify_peer=False)
 
     # -- teardown ----------------------------------------------------------
 

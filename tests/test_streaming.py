@@ -30,6 +30,21 @@ class StreamEcho(Service):
         self.server_streams.append(s)
         return b"stream accepted"
 
+    def StartTinyWindow(self, cntl, request):
+        """Accepts with a 4KB receive buffer: the CLIENT's writes must
+        obey this negotiated window."""
+        received = []
+
+        def on_received(stream, msgs):
+            time.sleep(0.002)            # slow-ish consumer
+            received.extend(msgs)
+
+        s = stream_accept(cntl, StreamOptions(on_received=on_received,
+                                              max_buf_size=4096))
+        s.test_received = received       # type: ignore[attr-defined]
+        self.server_streams.append(s)
+        return b"ok"
+
     def NoStream(self, cntl, request):
         return b"plain"
 
@@ -71,27 +86,30 @@ def test_stream_echo_roundtrip(server):
 
 
 def test_stream_flow_control_blocks_and_resumes(server):
+    svc = server.services["SE"]
     ch = Channel()
     ch.init(str(server.listen_endpoint))
-    received = []
     cntl = Controller()
-    # tiny window: 4KB; messages of 1KB
-    opts = _collect(received)
-    opts.max_buf_size = 4096
-    opts.write_timeout_s = 5.0
+    opts = StreamOptions(write_timeout_s=10.0)
     stream = stream_create(cntl, opts)
-    c = ch.call_method("SE.Start", b"", cntl=cntl)
-    assert not c.failed
+    c = ch.call_method("SE.StartTinyWindow", b"", cntl=cntl)
+    assert not c.failed, c.error_text
     assert stream.wait_established(5.0)
+    # the SERVER advertised 4096: negotiation must have set our window
+    assert stream._write_window == 4096
     payload = b"x" * 1024
-    t0 = time.time()
+    max_outstanding = 0
     for _ in range(32):                 # 32KB >> 4KB window
         assert stream.write(payload) == 0
-    # all data eventually delivered (acks advanced the window)
+        max_outstanding = max(max_outstanding,
+                              stream._produced - stream._remote_consumed)
+    # credit accounting really constrained the writer
+    assert max_outstanding <= 4096 + len(payload)
+    peer = svc.server_streams[-1]
     deadline = time.time() + 10.0
-    while len(received) < 32 and time.time() < deadline:
+    while len(peer.test_received) < 32 and time.time() < deadline:
         time.sleep(0.01)
-    assert len(received) == 32
+    assert len(peer.test_received) == 32
     stream.close()
 
 
