@@ -190,6 +190,14 @@ class IOBuf:
         self._size = 0
 
     def append(self, data: Union[BytesLike, "IOBuf"]) -> None:
+        if isinstance(data, bytes) and len(data) > DEFAULT_BLOCK_SIZE:
+            # large immutable payloads attach zero-copy instead of being
+            # chopped into pool blocks (bytes can never mutate under us)
+            self.append_user_data(data)
+            return
+        self._append_copy(data)
+
+    def _append_copy(self, data: Union[BytesLike, "IOBuf"]) -> None:
         if isinstance(data, IOBuf):
             self.append_iobuf(data)
             return
@@ -387,8 +395,16 @@ class IOPortal(IOBuf):
 
     def append_from_socket(self, sock, max_bytes: int = 65536) -> int:
         """recv_into a fresh/open tail region. Returns bytes read
-        (0 = EOF, raises BlockingIOError if nonblocking and empty)."""
-        blk = self._write_block(min_space=512)
+        (0 = EOF, raises BlockingIOError if nonblocking and empty).
+
+        Large reads get a dedicated block of ``max_bytes`` so a 512KB
+        gulp is ONE recv into one slab, not 64 pool-block nibbles — the
+        syscall-amortization the reference gets from readv into an
+        IOPortal's block chain (src/butil/iobuf.cpp read path)."""
+        if max_bytes > DEFAULT_BLOCK_SIZE:
+            blk = (self._pool or default_block_pool()).allocate(max_bytes)
+        else:
+            blk = self._write_block(min_space=512)
         space = min(blk.left_space, max_bytes)
         nread = sock.recv_into(blk.view(blk.size, space), space)
         if nread > 0:

@@ -40,9 +40,8 @@ class EventDispatcher:
         self._sel.register(self._wakeup_r, selectors.EVENT_READ, ("wakeup",))
         self._thread: Optional[threading.Thread] = None
         self._stopped = False
-        # fd -> (read_cb or None, one-shot write_cb or None)
-        self._interest: Dict[int, Tuple[Optional[Callable],
-                                        Optional[Callable]]] = {}
+        # fd -> [read_cb or None, one-shot write_cb or None, read_armed]
+        self._interest: Dict[int, list] = {}
 
     # -- public API --------------------------------------------------------
 
@@ -64,9 +63,17 @@ class EventDispatcher:
     def add_consumer(self, sock: _socket.socket,
                      on_readable: Callable) -> None:
         """≈ EventDispatcher::AddConsumer (event_dispatcher_epoll.cpp:157):
-        persistent read interest; ``on_readable()`` must not block the
-        dispatcher (it only wakes a task)."""
+        one-shot-armed read interest; ``on_readable()`` must not block
+        the dispatcher (it only wakes a task). Read interest is
+        suspended when an event fires and re-armed by ``rearm_read``
+        once the consumer drains to EAGAIN — otherwise the level-
+        triggered poller spins while the consumer task works."""
         self._submit(("add_read", sock.fileno(), on_readable))
+
+    def rearm_read(self, fd: int) -> None:
+        """Consumer finished (hit EAGAIN): re-enable read interest.
+        Pending kernel data re-fires immediately (level-triggered)."""
+        self._submit(("rearm_read", fd))
 
     def remove_consumer(self, sock: _socket.socket) -> None:
         self._submit(("remove", sock.fileno()))
@@ -101,13 +108,20 @@ class EventDispatcher:
             try:
                 if kind == "add_read":
                     _, fd, cb = op
-                    read_cb, write_cb = self._interest.get(fd, (None, None))
-                    self._interest[fd] = (cb, write_cb)
+                    ent = self._interest.setdefault(fd, [None, None, True])
+                    ent[0] = cb
+                    ent[2] = True
                     self._reregister(fd)
+                elif kind == "rearm_read":
+                    fd = op[1]
+                    ent = self._interest.get(fd)
+                    if ent is not None and ent[0] is not None:
+                        ent[2] = True
+                        self._reregister(fd)
                 elif kind == "add_write":
                     _, fd, cb = op
-                    read_cb, _ = self._interest.get(fd, (None, None))
-                    self._interest[fd] = (read_cb, cb)
+                    ent = self._interest.setdefault(fd, [None, None, True])
+                    ent[1] = cb
                     self._reregister(fd)
                 elif kind == "remove":
                     fd = op[1]
@@ -120,14 +134,16 @@ class EventDispatcher:
                 LOG.warning("dispatcher op %s failed: %s", kind, e)
 
     def _reregister(self, fd: int) -> None:
-        read_cb, write_cb = self._interest.get(fd, (None, None))
+        read_cb, write_cb, armed = self._interest.get(
+            fd, (None, None, False))
         events = 0
-        if read_cb is not None:
+        if read_cb is not None and armed:
             events |= selectors.EVENT_READ
         if write_cb is not None:
             events |= selectors.EVENT_WRITE
         if events == 0:
-            self._interest.pop(fd, None)
+            if read_cb is None and write_cb is None:
+                self._interest.pop(fd, None)
             try:
                 self._sel.unregister(fd)
             except (KeyError, ValueError, OSError):
@@ -162,10 +178,13 @@ class EventDispatcher:
                         pass
                     continue
                 fd = key.fd
-                read_cb, write_cb = self._interest.get(fd, (None, None))
+                ent = self._interest.get(fd)
+                if ent is None:
+                    continue
+                read_cb, write_cb = ent[0], ent[1]
                 if mask & selectors.EVENT_WRITE and write_cb is not None:
                     # one-shot: clear write interest before firing
-                    self._interest[fd] = (read_cb, None)
+                    ent[1] = None
                     try:
                         self._reregister(fd)
                     except (KeyError, ValueError, OSError):
@@ -175,6 +194,13 @@ class EventDispatcher:
                     except Exception:
                         LOG.exception("epollout callback failed")
                 if mask & selectors.EVENT_READ and read_cb is not None:
+                    # suspend read interest until the consumer drains to
+                    # EAGAIN and rearms (one-shot semantics)
+                    ent[2] = False
+                    try:
+                        self._reregister(fd)
+                    except (KeyError, ValueError, OSError):
+                        pass
                     try:
                         read_cb()
                     except Exception:

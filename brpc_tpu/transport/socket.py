@@ -392,8 +392,16 @@ class Socket:
                 # consume every event observed while we ran
                 if self._nevent <= 1 or self._failed:
                     self._nevent = 0
-                    return
+                    break
                 self._nevent = 1
+        # drained to EAGAIN: re-enable read interest (one-shot arming —
+        # the poller must not spin while this task was working)
+        if not self._failed and self.fd is not None \
+                and self._dispatcher is not None:
+            try:
+                self._dispatcher.rearm_read(self.fd.fileno())
+            except (OSError, ValueError):
+                pass
 
     def read_into_portal(self, suggested: int = 0) -> int:
         """≈ Socket::DoRead (socket.cpp:1994): one readv-ish gulp into the
