@@ -400,9 +400,21 @@ class IOPortal(IOBuf):
         Large reads get a dedicated block of ``max_bytes`` so a 512KB
         gulp is ONE recv into one slab, not 64 pool-block nibbles — the
         syscall-amortization the reference gets from readv into an
-        IOPortal's block chain (src/butil/iobuf.cpp read path)."""
+        IOPortal's block chain (src/butil/iobuf.cpp read path).  The
+        current tail block is reused while it still has meaningful room,
+        so trickling traffic on a connection with a large avg-msg-size
+        EMA doesn't churn a fresh large slab per recv."""
         if max_bytes > DEFAULT_BLOCK_SIZE:
-            blk = (self._pool or default_block_pool()).allocate(max_bytes)
+            # Only a DEDICATED large slab (capacity > pool block size) may
+            # be reused: a pool-sized tail could be the thread-local shared
+            # block, which another thread's appends write into concurrently.
+            tail = self._refs[-1][0] if self._refs else None
+            if tail is not None and tail.pool is not None \
+                    and tail.capacity > DEFAULT_BLOCK_SIZE \
+                    and tail.left_space >= max_bytes // 4:
+                blk = tail
+            else:
+                blk = (self._pool or default_block_pool()).allocate(max_bytes)
         else:
             blk = self._write_block(min_space=512)
         space = min(blk.left_space, max_bytes)

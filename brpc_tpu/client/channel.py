@@ -63,7 +63,8 @@ class Channel:
                 LOG.error("cluster channels not available in this build")
                 return -1
             lb = LoadBalancerWithNaming()
-            if lb.init(text, lb_name or "rr") != 0:
+            if lb.init(text, lb_name or "rr",
+                       self.options.enable_circuit_breaker) != 0:
                 LOG.error("failed to init naming/LB for %s", text)
                 return -1
             self.load_balancer = lb

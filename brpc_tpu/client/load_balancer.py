@@ -29,6 +29,10 @@ class LoadBalancer:
         self._servers: DoublyBufferedData[List[ServerNode]] = \
             DoublyBufferedData([])
         self._breakers = global_circuit_breaker_map()
+        # Gated by ChannelOptions.enable_circuit_breaker (off by default,
+        # like the reference channel.h:49-77): when False, no node is
+        # filtered by breaker state and calls don't feed it.
+        self.use_circuit_breaker = False
 
     # -- membership (≈ AddServer/RemoveServer batched) --------------------
 
@@ -58,9 +62,10 @@ class LoadBalancer:
     def candidates(self, cntl) -> List[ServerNode]:
         nodes = self._servers.read()
         excluded = getattr(cntl, "excluded_servers", None) or ()
+        breakers = self._breakers if self.use_circuit_breaker else None
         out = [n for n in nodes
                if n.endpoint not in excluded
-               and not self._breakers.isolated(n.endpoint)]
+               and (breakers is None or not breakers.isolated(n.endpoint))]
         if not out and nodes:
             # every node excluded/isolated: fall back to the full list
             # rather than failing the call outright (cluster recover
@@ -84,8 +89,9 @@ class LoadBalancer:
         """Called on RPC completion with the final controller state."""
         if cntl.remote_side is None:
             return
-        self._breakers.on_call(cntl.remote_side, cntl.error_code,
-                               cntl.latency_us)
+        if self.use_circuit_breaker:
+            self._breakers.on_call(cntl.remote_side, cntl.error_code,
+                                   cntl.latency_us)
         self.on_feedback(cntl)
 
     def on_feedback(self, cntl) -> None:

@@ -17,7 +17,8 @@ class LoadBalancerWithNaming:
         self._ns: Optional[NamingService] = None
         self._lb: Optional[LoadBalancer] = None
 
-    def init(self, naming_url: str, lb_name: str) -> int:
+    def init(self, naming_url: str, lb_name: str,
+             enable_circuit_breaker: bool = False) -> int:
         # builtin policies register on import
         from ..policy import load_balancers as _lbs  # noqa: F401
         from ..policy import naming as _naming       # noqa: F401
@@ -26,6 +27,7 @@ class LoadBalancerWithNaming:
         if self._lb is None:
             LOG.error("unknown load balancer %r", lb_name)
             return -1
+        self._lb.use_circuit_breaker = enable_circuit_breaker
         self._ns = create_naming_service(naming_url)
         if self._ns is None:
             return -1

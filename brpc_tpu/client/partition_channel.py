@@ -42,8 +42,10 @@ def parse_partition_tag(tag: str) -> Optional[Tuple[int, int]]:
 class _PartitionLB:
     """A fixed-partition view over the shared server list."""
 
-    def __init__(self, lb_name: str, index: int):
+    def __init__(self, lb_name: str, index: int,
+                 enable_circuit_breaker: bool = False):
         self.lb = create_load_balancer(lb_name)
+        self.lb.use_circuit_breaker = enable_circuit_breaker
         self.index = index
 
     def select_server(self, cntl):
@@ -125,7 +127,8 @@ class PartitionChannel:
                 plb = self._partitions.get(idx)
                 if plb is None:
                     plb = self._partitions[idx] = _PartitionLB(
-                        self._lb_name, idx)
+                        self._lb_name, idx,
+                        self.options.enable_circuit_breaker)
                 plb.lb.reset_servers(members)
 
     @property

@@ -14,9 +14,10 @@ start.  Design differences, deliberate:
   So: a dynamic pool with a shared run queue, LIFO slot for urgent starts,
   and on-demand worker growth up to ``max_workers`` when all workers are
   busy/blocked.
-- The native C++ engine (native/) provides true M:N fibers with
-  work-stealing deques for the transport hot path; this Python runtime is
-  the control-plane engine and the semantic model both share.
+- This Python runtime is the control-plane engine; the transport hot
+  path (syscalls + framing) is handled by the optional native C++ IO
+  engine under ``brpc_tpu/native`` when built, which releases the GIL
+  around its epoll/read/write loops.
 """
 
 from __future__ import annotations

@@ -50,6 +50,13 @@ def _dispatch(msg, sock) -> None:
     stream = find_stream(dest)
     if stream is None:
         return                      # stream already closed; drop
+    # A stream is bound to exactly one connection; frames for it arriving
+    # on any OTHER socket are forged/misrouted (a peer guessing ids) and
+    # must be dropped — the reference gets this for free because its
+    # StreamIds are versioned SocketIds (src/brpc/stream.cpp).
+    if stream.socket_id and sock is not None \
+            and getattr(sock, "id", stream.socket_id) != stream.socket_id:
+        return
     stream.on_frame(flags, payload)
 
 

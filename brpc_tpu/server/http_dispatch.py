@@ -37,6 +37,16 @@ def handle_http_request(msg: HttpMessage, sock, server) -> None:
     if entry is not None:
         _bridge_rpc(msg, sock, server, svc, mth, entry)
         return
+    # With an internal port configured, operator pages are reachable only
+    # through it (≈ reference's internal-port-only builtin services);
+    # liveness probes stay public.
+    if server.options.internal_port >= 0 \
+            and getattr(sock, "tag", None) != "internal" \
+            and (not parts or parts[0] not in ("health", "version")):
+        sock.write(build_response(
+            403, b"builtin services are restricted to the internal port\n",
+            keep_alive=msg.keep_alive))
+        return
     from .builtin import route_builtin
     try:
         status, ctype, body, extra = route_builtin(server, msg)

@@ -64,6 +64,8 @@ class Server:
         self._methods: Dict[Tuple[str, str], _MethodEntry] = {}
         self._listener: Optional[_socket.socket] = None
         self._acceptor: Optional[Acceptor] = None
+        self._internal_acceptor: Optional[Acceptor] = None
+        self._internal_endpoint: Optional[EndPoint] = None
         self._messenger: Optional[InputMessenger] = None
         self._listen_endpoint: Optional[EndPoint] = None
         self._started = False
@@ -181,6 +183,31 @@ class Server:
         self._messenger = InputMessenger(handlers, arg=self)
         self._acceptor = Acceptor(self._messenger)
         self._acceptor.start_accept(lst)
+
+        # Optional second, operator-only port: builtin portal pages (flag
+        # mutation, rpcz, profilers …) are served ONLY to connections
+        # accepted here when set (≈ server.cpp:1079-1086).
+        if self.options.internal_port >= 0:
+            ilst = _socket.socket(_socket.AF_INET, _socket.SOCK_STREAM)
+            ilst.setsockopt(_socket.SOL_SOCKET, _socket.SO_REUSEADDR, 1)
+            try:
+                ilst.bind((host, self.options.internal_port))
+            except OSError as e:
+                LOG.error("bind internal port %d: %s",
+                          self.options.internal_port, e)
+                ilst.close()
+                self._acceptor.stop_accept()
+                self._acceptor = None
+                self._messenger = None
+                self._listener = None
+                self._listen_endpoint = None
+                return -1
+            ilst.listen(128)
+            self._internal_endpoint = EndPoint(host=host,
+                                               port=ilst.getsockname()[1])
+            self._internal_acceptor = Acceptor(self._messenger,
+                                               tag="internal")
+            self._internal_acceptor.start_accept(ilst)
         self._started = True
         self._stopped_event.clear()
         LOG.info("Server started at %s (%d services, %d methods)",
@@ -191,6 +218,10 @@ class Server:
     @property
     def listen_endpoint(self) -> Optional[EndPoint]:
         return self._listen_endpoint
+
+    @property
+    def internal_endpoint(self) -> Optional[EndPoint]:
+        return self._internal_endpoint
 
     @property
     def running(self) -> bool:
@@ -206,6 +237,8 @@ class Server:
         self._started = False
         if self._acceptor is not None:
             self._acceptor.stop_accept()
+        if self._internal_acceptor is not None:
+            self._internal_acceptor.stop_accept()
         self._listener = None
         self._stopped_event.set()
         return 0

@@ -19,6 +19,7 @@ Wire format (same port, detected like every protocol):
 from __future__ import annotations
 
 import itertools
+import os
 import struct
 import threading
 from typing import Callable, Dict, List, Optional
@@ -54,7 +55,11 @@ class StreamOptions:
 
 _streams_lock = threading.Lock()
 _streams: Dict[int, "Stream"] = {}
-_next_id = itertools.count(1)
+# ids start at a random 48-bit offset so they are not enumerable from a
+# fresh connection (the reference's StreamIds are versioned SocketIds and
+# equally non-guessable); forged frames are additionally rejected by the
+# socket-binding check in protocol/streaming._dispatch.
+_next_id = itertools.count(int.from_bytes(os.urandom(6), "little") | 1)
 
 
 def _register(stream: "Stream") -> int:

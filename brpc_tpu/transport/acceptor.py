@@ -22,9 +22,11 @@ from .socket import Socket, SocketOptions
 
 class Acceptor:
     def __init__(self, messenger: InputMessenger,
-                 dispatcher: Optional[EventDispatcher] = None):
+                 dispatcher: Optional[EventDispatcher] = None,
+                 tag: Optional[str] = None):
         self._messenger = messenger
         self._dispatcher = dispatcher or global_dispatcher()
+        self._tag = tag                  # stamped on accepted sockets
         self._listen_sid = 0
         self._conn_lock = threading.Lock()
         self._connections: Dict[int, int] = {}   # sid -> sid (set)
@@ -60,6 +62,7 @@ class Acceptor:
                 fd=conn, remote_side=remote,
                 on_edge_triggered_events=self._messenger.on_new_messages))
             s = Socket.address(sid)
+            s.tag = self._tag
             s.attach_dispatcher(self._dispatcher)
             with self._conn_lock:
                 self._connections[sid] = sid

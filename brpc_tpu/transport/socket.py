@@ -88,7 +88,7 @@ class Socket:
         "_read_portal", "_avg_msg_size", "_last_protocol",
         "health_check_interval_s", "connect_timeout_s",
         "_pooled_home", "correlation_id",
-        "stream_map", "_stream_lock",
+        "stream_map", "_stream_lock", "tag",
     )
 
     # -- lifecycle ---------------------------------------------------------
@@ -121,6 +121,7 @@ class Socket:
         self.correlation_id = 0           # single-connection id_wait hint
         self.stream_map = {}              # stream_id -> Stream (streaming RPC)
         self._stream_lock = threading.Lock()
+        self.tag = None                   # acceptor tag ("internal" port etc.)
 
     @staticmethod
     def create(options: SocketOptions) -> int:

@@ -178,3 +178,39 @@ def test_same_port_serves_both_protocols(server):
     assert ch.call("Calc.Echo", b"native") == b"native"
     status, body = _get(server, "/health")
     assert status == 200
+
+
+def test_internal_port_gates_builtin_pages():
+    """With internal_port set, operator pages 403 on the public port and
+    serve on the internal one; /health stays public (≈ the reference's
+    internal-port-only builtin services, server.cpp:1079-1086)."""
+    from brpc_tpu.server import ServerOptions
+
+    opts = ServerOptions()
+    opts.internal_port = 0          # ephemeral internal port
+    srv = Server(opts)
+    srv.add_service(Calc())
+    assert srv.start("127.0.0.1:0") == 0
+    try:
+        assert srv.internal_endpoint is not None
+        assert srv.internal_endpoint.port != srv.listen_endpoint.port
+        status, _ = _get(srv, "/flags")
+        assert status == 403
+        status, body = _get(srv, "/health")
+        assert status == 200 and body == b"OK\n"
+        # RPC bridge still works on the public port
+        c = _conn(srv)
+        c.request("POST", "/Calc/Echo", body=b"ping")
+        r = c.getresponse()
+        assert r.status == 200 and r.read() == b"ping"
+        c.close()
+        # internal port serves everything
+        iep = srv.internal_endpoint
+        ic = http.client.HTTPConnection(iep.host, iep.port, timeout=5)
+        ic.request("GET", "/flags")
+        r = ic.getresponse()
+        assert r.status == 200
+        r.read()
+        ic.close()
+    finally:
+        srv.stop()

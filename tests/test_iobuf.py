@@ -29,13 +29,29 @@ def test_append_and_materialize():
     assert b == b"hello world"
 
 
-def test_append_spanning_blocks():
+def test_append_large_bytes_attaches_zero_copy():
+    """Large immutable ``bytes`` attach as ONE user block (zero-copy fast
+    path) instead of being chopped into pool blocks."""
     b = IOBuf()
     payload = os.urandom(3 * DEFAULT_BLOCK_SIZE + 123)
     b.append(payload)
     assert len(b) == len(payload)
     assert bytes(b) == payload
+    assert b.backing_block_count == 1
+    assert b.backing_views()[0].obj is payload
+
+
+def test_append_spanning_blocks():
+    """Mutable buffers must be copied into pool blocks (they can change
+    under us), so a large bytearray spans multiple blocks."""
+    b = IOBuf()
+    payload = bytearray(os.urandom(3 * DEFAULT_BLOCK_SIZE + 123))
+    b.append(payload)
+    assert len(b) == len(payload)
+    assert bytes(b) == bytes(payload)
     assert b.backing_block_count >= 3
+    payload[:] = b"\x00" * len(payload)   # mutation must not leak through
+    assert bytes(b) != bytes(payload)
 
 
 def test_small_appends_pack_into_shared_block():

@@ -144,3 +144,40 @@ def test_failed_establishment_closes_stream():
     c = ch.call_method("SE.Start", b"", cntl=cntl)
     assert c.failed
     assert stream.closed
+
+
+def test_forged_frames_from_other_connections_dropped():
+    """Frames carrying a valid stream id but arriving on a DIFFERENT
+    connection than the stream is bound to must be dropped (spoof guard;
+    the reference's versioned-SocketId stream ids give this implicitly)."""
+    from brpc_tpu.protocol.streaming import F_DATA, _dispatch
+
+    got = []
+    s = Stream(StreamOptions(on_received=lambda st, msgs: got.extend(msgs)))
+    try:
+        s.socket_id = 424242          # bound connection (no real socket)
+
+        class FakeSock:
+            def __init__(self, sid):
+                self.id = sid
+
+        _dispatch((F_DATA, s.id, b"forged"), FakeSock(999999))
+        time.sleep(0.1)
+        assert got == []              # dropped
+        _dispatch((F_DATA, s.id, b"legit"), FakeSock(424242))
+        deadline = time.time() + 2
+        while not got and time.time() < deadline:
+            time.sleep(0.01)
+        assert got == [b"legit"]
+    finally:
+        s._close_local(notify_peer=False)
+
+
+def test_stream_ids_not_enumerable():
+    """Ids start at a random offset, not 1 — a fresh peer can't guess
+    live stream ids by counting."""
+    s = Stream()
+    try:
+        assert s.id > 1000
+    finally:
+        s._close_local(notify_peer=False)
