@@ -50,6 +50,7 @@ class Controller:
     __slots__ = (
         "timeout_ms", "max_retry", "backup_request_ms",
         "request_attachment", "response_attachment",
+        "request_device_attachment", "response_device_attachment",
         "request_compress_type", "connection_type", "retry_policy",
         "request_code", "excluded_servers",
         # results
@@ -70,6 +71,10 @@ class Controller:
         self.backup_request_ms: Optional[int] = None
         self.request_attachment = IOBuf()
         self.response_attachment = IOBuf()
+        # device tensors (ici/): out = a jax array to ship
+        # device-resident; in = DeviceAttachment handle (.tensor())
+        self.request_device_attachment = None
+        self.response_device_attachment = None
         self.request_compress_type = CompressType.NONE
         self.connection_type: Optional[str] = None
         self.retry_policy: Callable = default_retry_policy
@@ -260,7 +265,33 @@ class Controller:
             if data is not None:
                 meta.compress_type = self.request_compress_type
                 payload = IOBuf(data)
-        frame = pack_frame(meta, payload, attachment=self.request_attachment)
+        attachment = self.request_attachment
+        from ..ici.endpoint import ici_enabled, local_domain_id, prepare_send
+        if ici_enabled():
+            # advertise our fabric domain on every frame (one-roundtrip
+            # handshake, ≈ RdmaEndpoint's TCP-then-QP bring-up)
+            meta.ici_domain = local_domain_id()
+        if self.request_device_attachment is not None:
+            # with ici disabled prepare_send degrades to host-staged
+            # bytes itself — the attachment must never be dropped
+            post_timeout = 30.0
+            if self.timeout_ms and self.timeout_ms > 0:
+                elapsed_ms = (monotonic_us() - self._begin_us) // 1000
+                post_timeout = min(
+                    30.0, max(0.001, (self.timeout_ms - elapsed_ms) / 1e3))
+            try:
+                tail = prepare_send(sock, meta,
+                                    self.request_device_attachment,
+                                    timeout_s=post_timeout)
+            except RuntimeError as e:
+                _idp.error(attempt_id, int(Errno.EOVERCROWDED), str(e))
+                return
+            if tail is not None:
+                combined = IOBuf()
+                combined.append_iobuf(attachment)
+                combined.append_iobuf(tail)
+                attachment = combined
+        frame = pack_frame(meta, payload, attachment=attachment)
         sock.write(frame, id_wait=attempt_id)
 
     # -- asynchronous events (timers / socket failures / cancel) ----------
@@ -321,6 +352,11 @@ class Controller:
         """Runs with the id LOCKED. ≈ OnVersionedRPCReturned."""
         version = msg.meta.correlation_id - self._cid_base
         if version not in self._live_versions:
+            if msg.meta.ici_desc:
+                # discarding a response carrying a posted descriptor:
+                # return the peer's window credit
+                from ..ici.endpoint import ack_unused
+                ack_unused(msg.meta, msg.socket_id)
             _idp.unlock(self._cid_base)      # stale attempt's response
             return
         code = msg.meta.error_code
@@ -337,6 +373,15 @@ class Controller:
                 msg.meta.stream_id,
                 peer_window=msg.meta.stream_window)
         attachment = msg.split_attachment()
+        if msg.meta.ici_domain:
+            s = Socket.address(msg.socket_id or self._sending_sid)
+            if s is not None:
+                s.ici_peer_domain = msg.meta.ici_domain
+        if msg.meta.ici_desc:
+            from ..ici.endpoint import split_device_attachment
+            attachment, self.response_device_attachment = \
+                split_device_attachment(msg.meta, attachment,
+                                        msg.socket_id or self._sending_sid)
         raw = msg.payload.to_bytes()
         if msg.meta.compress_type:
             raw = compress_mod.decompress(raw, msg.meta.compress_type)
@@ -415,6 +460,9 @@ def process_rpc_response(msg: RpcMessage, sock: Socket) -> None:
     if not ok or cntl is None:
         if ok:
             _idp.unlock(cid)
+        if msg.meta.ici_desc:
+            from ..ici.endpoint import ack_unused
+            ack_unused(msg.meta, getattr(sock, "id", 0))
         return                          # late response of a finished call
     cntl._on_response(msg)
 

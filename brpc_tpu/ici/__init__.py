@@ -1,0 +1,28 @@
+"""ICI data plane — device-resident RPC payloads.
+
+The TPU-native equivalent of the reference's RDMA stack
+(/root/reference/src/brpc/rdma/): tensors stay in HBM end to end; the
+TCP connection carries only *descriptors* (and acks), the way an RDMA
+wire message carries rkeys instead of payload bytes.
+
+Layers (mirroring rdma_endpoint.h / block_pool.cpp roles):
+
+- :mod:`fabric`    — how posted tensors move between peers
+  (in-process registry → ``jax.device_put`` over ICI; optional
+  ``jax.experimental.transfer`` pull server for cross-host).
+- :mod:`block_pool`— bounded, recycled HBM landing buffers for the
+  host-staged fallback path (registered-memory analogue).
+- :mod:`endpoint`  — per-connection window+ack flow control, descriptor
+  lifecycle, the "TICI" ack frame protocol.
+- :mod:`attachment`— the user-facing DeviceAttachment object.
+"""
+
+from .attachment import DeviceAttachment
+from .block_pool import DeviceBlockPool, default_device_pool
+from .endpoint import IciEndpoint, ici_enabled
+from .fabric import local_domain_id
+
+__all__ = [
+    "DeviceAttachment", "DeviceBlockPool", "default_device_pool",
+    "IciEndpoint", "ici_enabled", "local_domain_id",
+]

@@ -1,0 +1,109 @@
+"""DeviceAttachment — a tensor riding an RPC without leaving the device.
+
+The user-facing object on both ends:
+
+- sender: ``cntl.request_device_attachment = jax_array`` (client) or
+  ``cntl.response_device_attachment = jax_array`` (server);
+- receiver: ``cntl.request_device_attachment.tensor(device=...)``.
+
+On the wire it is either a *descriptor* (peer reachable through a
+fabric — payload stays in HBM, ≈ the RDMA rkey the reference sends in
+rdma_endpoint.cpp) or raw bytes in the regular attachment (fallback,
+≈ ``use_rdma=false``).  The descriptor codec lives here; the transfer +
+flow control live in endpoint.py.
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Any, Optional, Tuple
+
+# descriptor kinds
+KIND_INLINE = 0          # payload rides the byte attachment (fallback)
+KIND_INPROC = 1          # redeem from this process's registry
+KIND_TRANSFER = 2        # pull from peer's jax transfer server
+
+
+def encode_descriptor(kind: int, desc_id: int, nbytes: int, dtype: str,
+                      shape: Tuple[int, ...], extra: bytes = b"") -> bytes:
+    d = dtype.encode()
+    out = struct.pack("<BQI", kind, desc_id, nbytes)
+    out += bytes([len(d)]) + d
+    out += bytes([len(shape)]) + b"".join(
+        struct.pack("<Q", s) for s in shape)
+    out += struct.pack("<H", len(extra)) + extra
+    return out
+
+
+def decode_descriptor(data: bytes):
+    kind, desc_id, nbytes = struct.unpack_from("<BQI", data)
+    off = 13
+    dlen = data[off]; off += 1
+    dtype = data[off:off + dlen].decode(); off += dlen
+    ndim = data[off]; off += 1
+    shape = tuple(struct.unpack_from("<Q", data, off + 8 * i)[0]
+                  for i in range(ndim))
+    off += 8 * ndim
+    (elen,) = struct.unpack_from("<H", data, off); off += 2
+    extra = data[off:off + elen]
+    return kind, desc_id, nbytes, dtype, shape, extra
+
+
+class DeviceAttachment:
+    """Received tensor handle: redeems lazily, at most once, and acks
+    the sender on redemption (the ack returns window credit,
+    endpoint.py)."""
+
+    __slots__ = ("kind", "desc_id", "nbytes", "dtype", "shape",
+                 "_array", "_host_bytes", "_socket_id", "_redeemed",
+                 "_extra")
+
+    def __init__(self, kind: int, desc_id: int, nbytes: int, dtype: str,
+                 shape: Tuple[int, ...], socket_id: int = 0,
+                 host_bytes: Optional[bytes] = None, extra: bytes = b""):
+        self.kind = kind
+        self.desc_id = desc_id
+        self.nbytes = nbytes
+        self.dtype = dtype
+        self.shape = shape
+        self._array = None
+        self._host_bytes = host_bytes
+        self._socket_id = socket_id
+        self._redeemed = False
+        self._extra = extra
+
+    def __len__(self) -> int:
+        return self.nbytes
+
+    @property
+    def device_resident(self) -> bool:
+        return self.kind != KIND_INLINE
+
+    def tensor(self, device: Any = None):
+        """The attached tensor, landed on ``device`` (None: wherever the
+        fabric left it / the default device for the fallback path)."""
+        if self._array is not None:
+            if device is not None:
+                import jax
+                return jax.device_put(self._array, device)
+            return self._array
+        from .endpoint import redeem_attachment
+        self._array = redeem_attachment(self, device)
+        self._redeemed = True
+        return self._array
+
+    def numpy(self):
+        """Host copy (explicit D2H — debugging / host consumers)."""
+        import numpy as np
+        return np.asarray(self.tensor())
+
+    def __del__(self):
+        # dropped without redemption (user ignored the attachment):
+        # return the poster's window credit instead of pinning it until
+        # the TTL sweep
+        if self.kind == KIND_INPROC and not self._redeemed:
+            try:
+                from .endpoint import _send_ack
+                _send_ack(self._socket_id, (self.desc_id,))
+            except Exception:
+                pass                     # interpreter teardown etc.

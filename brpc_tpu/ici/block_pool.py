@@ -1,0 +1,176 @@
+"""Device block pool — bounded, recycled HBM landing buffers.
+
+Role parity with /root/reference/src/brpc/rdma/block_pool.cpp: RDMA
+needs payload memory drawn from a *registered*, bounded region so the
+NIC can DMA into it without per-transfer registration.  The JAX
+equivalent of "registered memory" is a live device buffer the runtime
+already owns; the equivalent of block recycling is **buffer donation** —
+a donated input's HBM is reused for the output, so landing a host
+payload into a pooled block writes the *same* HBM pages every time
+instead of churning the allocator.
+
+Used by the host-staged fallback path (peer outside every fabric's
+reach, ≈ ``FLAGS_use_rdma=false``): wire bytes → one H2D DMA → a pooled
+HBM block.  The pure ICI path never lands bytes at all (descriptors are
+redeemed device-side, endpoint.py).
+
+Why byte-granular HBM slicing is *not* re-expressed here: XLA owns HBM
+through its BFC allocator and device arrays are immutable; what the
+block pool can honestly guarantee on TPU is (a) a bounded data-plane
+footprint and (b) page-stable recycling via donation — both are what
+rdma/block_pool exists for.  The chain/ref mechanics stay in IOBuf.
+"""
+
+from __future__ import annotations
+
+import functools
+import threading
+from collections import defaultdict, deque
+from typing import Any, Deque, Dict, Optional, Tuple
+
+from ..butil.iobuf import Block, BlockPool
+from ..butil.logging_util import LOG
+
+DEFAULT_POOL_BYTES = 256 * 1024 * 1024      # data-plane HBM cap
+
+
+class DeviceBlock(Block):
+    """A Block whose storage is a device (HBM) array of raw bytes.
+
+    ``array`` is a flat uint8 jax.Array of ``capacity`` bytes.  IOBuf
+    can chain refs to it like any block; byte access (``view``) stages
+    to the host explicitly and lazily — the data plane never calls it.
+    """
+
+    __slots__ = ("array",)
+
+    def __init__(self, array: Any, nbytes: int,
+                 pool: Optional["DeviceBlockPool"] = None):
+        # Block.data must be len()-able; the host mirror is created only
+        # if someone byte-reads the block (portal/debug paths).
+        self.array = array
+        super().__init__(_LazyHostMirror(self, nbytes), nbytes, pool)
+
+    def view(self, offset: int, length: int):
+        return memoryview(self.data.materialize())[offset:offset + length]
+
+
+class _LazyHostMirror:
+    """len()-able placeholder that stages device bytes to host on first
+    real access (explicit D2H, never implicit)."""
+
+    __slots__ = ("_block", "_host", "_nbytes")
+
+    def __init__(self, block: DeviceBlock, nbytes: int):
+        self._block = block
+        self._host = None
+        self._nbytes = nbytes
+
+    def __len__(self) -> int:
+        return self._nbytes
+
+    def materialize(self) -> bytes:
+        if self._host is None:
+            import numpy as np
+            self._host = np.asarray(self._block.array).tobytes()
+        return self._host
+
+
+@functools.lru_cache(maxsize=64)
+def _land_fn(nbytes: int):
+    """jit'd landing kernel: donated dst ⇒ XLA writes src's bytes into
+    dst's existing HBM pages (input-output aliasing)."""
+    import jax
+
+    def land(dst, src):
+        return jax.lax.dynamic_update_slice(dst, src, (0,))
+
+    return jax.jit(land, donate_argnums=(0,))
+
+
+class DeviceBlockPool(BlockPool):
+    """Free-listed HBM byte-buffer pool with donation-based recycling.
+
+    ``land(host_view)`` → uint8 device array of exactly ``len(view)``
+    bytes, drawn from (and returned to) per-size free lists.  Repeated
+    same-size landings reuse the same HBM pages — assert-able via
+    ``unsafe_buffer_pointer()`` stability, the test's proof of
+    recycling.
+    """
+
+    def __init__(self, max_bytes: int = DEFAULT_POOL_BYTES,
+                 device: Any = None):
+        self.max_bytes = max_bytes
+        self.device = device
+        self._lock = threading.Lock()
+        self._free: Dict[int, Deque[Any]] = defaultdict(deque)
+        self.pooled_bytes = 0          # held in free lists
+        self.landed = 0                # stats
+        self.recycled = 0
+
+    # -- BlockPool interface ----------------------------------------------
+
+    def allocate(self, capacity: int = 0) -> DeviceBlock:
+        """Fresh zeroed device block (IOBuf interface compliance; the
+        data plane uses :meth:`land` / :meth:`adopt`)."""
+        import jax.numpy as jnp
+        capacity = capacity or 8192
+        arr = self._take(capacity)
+        if arr is None:
+            arr = jnp.zeros((capacity,), jnp.uint8)
+            if self.device is not None:
+                import jax
+                arr = jax.device_put(arr, self.device)
+        return DeviceBlock(arr, capacity, self)
+
+    # -- data plane --------------------------------------------------------
+
+    def land(self, host_view) -> Any:
+        """One H2D DMA of ``host_view`` into a pooled (donated) buffer;
+        returns a flat uint8 device array owning recycled HBM."""
+        import jax
+        import numpy as np
+
+        src = np.frombuffer(host_view, dtype=np.uint8)
+        n = src.nbytes
+        self.landed += 1
+        dst = self._take(n)
+        if dst is None:
+            import jax.numpy as jnp
+            dst = jnp.zeros((n,), jnp.uint8)
+            if self.device is not None:
+                dst = jax.device_put(dst, self.device)
+        else:
+            self.recycled += 1
+        return _land_fn(n)(dst, src)
+
+    def recycle(self, array: Any) -> None:
+        """Return a landed uint8 buffer for reuse (caller guarantees no
+        live views; donation on next land makes aliasing impossible to
+        observe anyway — the old array object is consumed)."""
+        n = int(array.size)
+        with self._lock:
+            if self.pooled_bytes + n > self.max_bytes:
+                return                    # over cap: let XLA free it
+            self._free[n].append(array)
+            self.pooled_bytes += n
+
+    def _take(self, nbytes: int) -> Optional[Any]:
+        with self._lock:
+            lst = self._free.get(nbytes)
+            if lst:
+                self.pooled_bytes -= nbytes
+                return lst.popleft()
+        return None
+
+
+_default_lock = threading.Lock()
+_default_pool: Optional[DeviceBlockPool] = None
+
+
+def default_device_pool() -> DeviceBlockPool:
+    global _default_pool
+    with _default_lock:
+        if _default_pool is None:
+            _default_pool = DeviceBlockPool()
+        return _default_pool

@@ -1,0 +1,340 @@
+"""IciEndpoint — per-connection device data plane with window+ack flow
+control.
+
+Role parity with /root/reference/src/brpc/rdma/rdma_endpoint.h:55-180:
+
+- ``RdmaEndpoint`` rides an established TCP Socket and moves payloads
+  out-of-band (verbs) while the socket carries control frames; the
+  IciEndpoint rides a Socket and moves tensors out-of-band (fabric:
+  in-process registry / jax transfer server) while the socket carries
+  descriptors and acks.
+- sliding window + explicit ack (``rdma_endpoint.cpp`` window/ack
+  machinery): posting counts against ``ici_window_bytes``; the
+  receiver's redemption sends a "TICI" ack frame; the ack returns
+  credit and releases the posted tensor.
+- completion notification through the event dispatcher
+  (``rdma_endpoint.h:145-159`` comp_channel→epoll): acks arrive as
+  normal epoll-driven frames on the connection.
+
+Send-path decision (mirrors ``Socket::_rdma_state``): if the peer's
+domain (learned from RpcMeta on the first exchange) is reachable by a
+fabric ⇒ descriptor send, zero host copies; else ⇒ host-staged bytes in
+the regular attachment (the ``use_rdma=false`` TCP fallback).
+"""
+
+from __future__ import annotations
+
+import struct
+import threading
+import weakref
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..butil.flags import define_flag, get_flag
+from ..butil.iobuf import IOBuf
+from ..butil.logging_util import LOG
+from ..butil.status import Errno
+from ..protocol.base import (ParseResult, Protocol, ProtocolType,
+                             register_protocol)
+from .attachment import (KIND_INLINE, KIND_INPROC, DeviceAttachment,
+                         decode_descriptor, encode_descriptor)
+from .block_pool import default_device_pool
+from .fabric import in_process_fabric, local_domain_id
+
+define_flag("ici_enabled", True,
+            "exchange ICI domains and send device attachments "
+            "device-resident when peers share a fabric",
+            validator=lambda v: True)       # reloadable on/off switch
+define_flag("ici_window_bytes", 256 * 1024 * 1024,
+            "max posted-but-unacked device payload bytes per connection",
+            validator=lambda v: int(v) > 0)
+define_flag("ici_desc_ttl_s", 120,
+            "reclaim posted descriptors never redeemed after this many "
+            "seconds", validator=lambda v: int(v) > 0)
+define_flag("ici_use_landing_pool", False,
+            "land host-staged device payloads through the recycled "
+            "DeviceBlockPool instead of direct device_put (stable HBM "
+            "footprint at the cost of one extra device kernel)")
+
+
+def ici_enabled() -> bool:
+    return bool(get_flag("ici_enabled", True))
+
+
+class IciEndpoint:
+    """Sender-side window accounting for one connection.
+
+    One per Socket, created lazily on the first device-attachment send
+    (≈ RdmaEndpoint construction on handshake)."""
+
+    __slots__ = ("socket_id", "_lock", "_cond", "outstanding_bytes",
+                 "posted_count", "acked_count", "__weakref__")
+
+    def __init__(self, socket_id: int):
+        self.socket_id = socket_id
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self.outstanding_bytes = 0
+        self.posted_count = 0
+        self.acked_count = 0
+
+    def post(self, array: Any, nbytes: int,
+             timeout_s: float = 30.0) -> Optional[int]:
+        """Reserve window credit and post to the fabric. Returns the
+        descriptor id, or None if the window stayed full (the
+        EOVERCROWDED analogue of a stuffed RDMA send queue)."""
+        window = int(get_flag("ici_window_bytes", 256 * 1024 * 1024))
+        with self._cond:
+            ok = self._cond.wait_for(
+                lambda: self.outstanding_bytes + nbytes <= window
+                or self.outstanding_bytes == 0,    # oversized payload:
+                timeout=timeout_s)                 # admit alone
+            if not ok:
+                return None
+            self.outstanding_bytes += nbytes
+            self.posted_count += 1
+        return in_process_fabric().post(array, nbytes, self._on_release,
+                                        socket_id=self.socket_id)
+
+    def _on_release(self, nbytes: int) -> None:
+        with self._cond:
+            self.outstanding_bytes -= nbytes
+            self.acked_count += 1
+            self._cond.notify_all()
+
+
+_endpoints: "weakref.WeakSet[IciEndpoint]" = weakref.WeakSet()
+
+
+def endpoint_of(sock) -> IciEndpoint:
+    ep = sock.ici_endpoint
+    if ep is None:
+        ep = sock.ici_endpoint = IciEndpoint(sock.id)
+        _endpoints.add(ep)
+    return ep
+
+
+def live_endpoints() -> List[IciEndpoint]:
+    """All endpoints that ever posted (introspection: /vars, tests)."""
+    return list(_endpoints)
+
+
+# -- send path -------------------------------------------------------------
+
+def _tensor_meta(array) -> Tuple[int, str, Tuple[int, ...]]:
+    dtype = str(array.dtype)
+    shape = tuple(int(s) for s in array.shape)
+    nbytes = int(array.size) * array.dtype.itemsize
+    return nbytes, dtype, shape
+
+
+_LOOPBACK_HOSTS = ("127.0.0.1", "::1", "localhost")
+
+
+def _is_local_peer(sock) -> bool:
+    """In-process reach additionally requires a loopback peer address —
+    a remote peer replaying our own domain token must not be able to
+    steer us onto descriptors it can never redeem."""
+    ep = sock.remote_side
+    return ep is not None and str(getattr(ep, "host", "")) \
+        in _LOOPBACK_HOSTS
+
+
+def prepare_send(sock, meta, array,
+                 timeout_s: float = 30.0) -> Optional[IOBuf]:
+    """Route a device attachment for sending: descriptor (device stays
+    put) or host-staged bytes (fallback — also taken when ici is
+    disabled by flag).  Returns the byte tail to append to the frame
+    attachment (None for the descriptor path); sets ``meta.ici_desc``.
+    Raises RuntimeError if the window is full past ``timeout_s``."""
+    import jax
+
+    if not isinstance(array, jax.Array):
+        array = jax.numpy.asarray(array)
+    nbytes, dtype, shape = _tensor_meta(array)
+    peer = sock.ici_peer_domain
+    if ici_enabled() and peer is not None \
+            and in_process_fabric().can_reach(peer) \
+            and _is_local_peer(sock):
+        desc_id = endpoint_of(sock).post(array, nbytes,
+                                         timeout_s=timeout_s)
+        if desc_id is None:
+            raise RuntimeError(
+                "ICI window full: posted device payloads awaiting ack "
+                f"exceed ici_window_bytes on socket {sock.id}")
+        meta.ici_desc = encode_descriptor(KIND_INPROC, desc_id, nbytes,
+                                          dtype, shape)
+        return None
+    # fallback: one explicit D2H, bytes ride the regular attachment
+    import numpy as np
+    host = np.asarray(array)
+    meta.ici_desc = encode_descriptor(KIND_INLINE, 0, nbytes, dtype, shape)
+    tail = IOBuf()
+    tail.append_user_data(host.tobytes())
+    return tail
+
+
+def split_device_attachment(meta, attachment: IOBuf, socket_id: int
+                            ) -> Tuple[IOBuf, Optional[DeviceAttachment]]:
+    """Receiver side: if the frame carries a device attachment, split
+    its byte tail (inline fallback) off ``attachment``.  Returns
+    ``(user_attachment, device_attachment_or_None)`` — the user byte
+    attachment keeps its own semantics."""
+    if not meta.ici_desc:
+        return attachment, None
+    try:
+        kind, desc_id, nbytes, dtype, shape, extra = \
+            decode_descriptor(meta.ici_desc)
+    except (struct.error, IndexError):
+        return attachment, None          # malformed wire field: drop
+    host_bytes: Optional[bytes] = None
+    if kind == KIND_INLINE:
+        if nbytes > len(attachment):
+            return attachment, None      # malformed; drop the handle
+        keep = len(attachment) - nbytes
+        user_part = attachment.cutn(keep)    # device tail stays behind
+        host_bytes = attachment.to_bytes()
+        attachment = user_part
+    return attachment, DeviceAttachment(
+        kind, desc_id, nbytes, dtype, shape, socket_id=socket_id,
+        host_bytes=host_bytes, extra=extra)
+
+
+# -- redeem path -----------------------------------------------------------
+
+def redeem_attachment(att: DeviceAttachment, device: Any = None):
+    """Land the attachment as a device tensor; acks the poster for
+    descriptor kinds (credit return rides the connection, arriving at
+    the poster through the normal dispatcher — the comp_channel→epoll
+    shape)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    if att.kind == KIND_INPROC:
+        arr = in_process_fabric().redeem(att.desc_id, device)
+        if arr is None:
+            raise RuntimeError(
+                f"ICI descriptor {att.desc_id} expired or already "
+                "redeemed (sender reclaimed after ttl?)")
+        _send_ack(att._socket_id, (att.desc_id,))
+        return arr
+    # inline fallback: host bytes → device
+    np_dtype = np.dtype(att.dtype)
+    host = np.frombuffer(att._host_bytes, dtype=np_dtype).reshape(att.shape)
+    if get_flag("ici_use_landing_pool", False):
+        u8 = default_device_pool().land(att._host_bytes)
+        itemsize = np_dtype.itemsize
+        arr = jax.lax.bitcast_convert_type(
+            u8.reshape(-1, itemsize) if itemsize > 1 else u8,
+            jnp.dtype(att.dtype)).reshape(att.shape)
+        if device is not None:
+            arr = jax.device_put(arr, device)
+        return arr
+    return jax.device_put(host, device) if device is not None \
+        else jnp.asarray(host)
+
+
+# -- "TICI" ack frames -----------------------------------------------------
+#
+#    [ "TICI" ][ u32 count ][ count × u64 desc_id ]
+
+_ACK_MAGIC = b"TICI"
+_ACK_HEADER = 8
+
+
+def _send_ack(socket_id: int, desc_ids) -> None:
+    from ..transport.socket import Socket
+    sock = Socket.address(socket_id)
+    ids = list(desc_ids)
+    if sock is None or sock.failed:
+        return                      # poster's TTL sweep will reclaim
+    frame = IOBuf(_ACK_MAGIC + struct.pack("<I", len(ids))
+                  + b"".join(struct.pack("<Q", i) for i in ids))
+    sock.write(frame)
+
+
+def _parse_ack(source: IOBuf, sock, read_eof: bool, arg) -> ParseResult:
+    avail = len(source)
+    if avail < _ACK_HEADER:
+        got = source.fetch(min(4, avail))
+        if _ACK_MAGIC.startswith(got):
+            return ParseResult.not_enough_data()
+        return ParseResult.try_others()
+    head = source.fetch(_ACK_HEADER)
+    if head[:4] != _ACK_MAGIC:
+        return ParseResult.try_others()
+    (count,) = struct.unpack_from("<I", head, 4)
+    if count > 1 << 20:
+        return ParseResult.absolutely_wrong()
+    total = _ACK_HEADER + 8 * count
+    if avail < total:
+        return ParseResult.not_enough_data()
+    source.pop_front(_ACK_HEADER)
+    payload = source.fetch(8 * count)
+    source.pop_front(8 * count)
+    ids = struct.unpack(f"<{count}Q", payload)
+    return ParseResult.make_message(ids)
+
+
+def ack_unused(meta, socket_id: int) -> None:
+    """Return window credit for a descriptor the receiver is DISCARDING
+    without redeeming (stale retry response, admission reject, dropped
+    late response) — otherwise the credit stays pinned until the TTL
+    sweep."""
+    if not meta.ici_desc:
+        return
+    try:
+        kind, desc_id = decode_descriptor(meta.ici_desc)[:2]
+    except (struct.error, IndexError):
+        return
+    if kind == KIND_INPROC:
+        _send_ack(socket_id, (desc_id,))
+
+
+def _process_ack(msg, sock, server=None) -> None:
+    fabric = in_process_fabric()
+    sid = getattr(sock, "id", None)
+    for desc_id in msg:
+        # bound to the posting connection: forged acks naming another
+        # connection's descriptors are dropped
+        fabric.release(desc_id, only_socket=sid)
+
+
+ICI_ACK = Protocol(
+    ProtocolType.ICI_ACK, "ici_ack", _parse_ack,
+    process_request=lambda m, s, srv: _process_ack(m, s, srv),
+    process_response=lambda m, s: _process_ack(m, s),
+    process_inline=True,           # a few dict ops; never blocks
+)
+register_protocol(ICI_ACK)
+
+from ..transport.input_messenger import client_messenger  # noqa: E402
+
+client_messenger().add_handler(ICI_ACK)
+
+
+# -- descriptor TTL sweep --------------------------------------------------
+
+_sweep_started = False
+_sweep_lock = threading.Lock()
+
+
+def _ensure_sweeper() -> None:
+    global _sweep_started
+    with _sweep_lock:
+        if _sweep_started:
+            return
+        _sweep_started = True
+    from ..fiber.timer_thread import global_timer_thread
+
+    def sweep():
+        ttl = float(get_flag("ici_desc_ttl_s", 120))
+        n = in_process_fabric().sweep_expired(ttl)
+        if n:
+            LOG.warning("ICI ttl sweep reclaimed %d descriptors", n)
+        global_timer_thread().schedule(sweep, max(ttl / 4, 5.0))
+
+    global_timer_thread().schedule(sweep, 30.0)
+
+
+_ensure_sweeper()

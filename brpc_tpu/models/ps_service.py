@@ -20,9 +20,7 @@ from typing import Optional
 
 import numpy as np
 
-from ..butil.iobuf import IOBuf
 from ..butil.status import Errno
-from ..ops.device_ops import bytes_to_tensor, tensor_bytes
 from ..server.service import Service
 from .embedding_ps import EmbeddingPS, PSConfig
 
@@ -51,8 +49,10 @@ class PSService(Service):
             cntl.set_failed(Errno.EREQUEST, f"bad ids payload: {e}")
             return None
         pooled = self.model.lookup(ids)
-        data, dtype, shape = tensor_bytes(pooled)
-        cntl.response_attachment.append(data)
+        # result rides the ICI data plane: device-resident to same-fabric
+        # peers (zero host copies), auto host-staged otherwise (ici/)
+        cntl.response_device_attachment = pooled
+        dtype, shape = str(pooled.dtype), tuple(int(s) for s in pooled.shape)
         return json.dumps({"dtype": dtype, "shape": shape}).encode()
 
     def Predict(self, cntl, request):
@@ -62,15 +62,31 @@ class PSService(Service):
             cntl.set_failed(Errno.EREQUEST, f"bad ids payload: {e}")
             return None
         logits = self.model.predict(ids)
-        data, dtype, shape = tensor_bytes(logits)
-        cntl.response_attachment.append(data)
+        cntl.response_device_attachment = logits
+        dtype, shape = str(logits.dtype), tuple(int(s) for s in logits.shape)
         return json.dumps({"dtype": dtype, "shape": shape}).encode()
+
+    def EchoTensor(self, cntl, request):
+        """Device-tensor echo — the rdma_performance-equivalent method
+        (≈ /root/reference/example/rdma_performance/server.cpp): the
+        request's device attachment comes back as the response's,
+        never leaving the device fabric."""
+        att = cntl.request_device_attachment
+        if att is None:
+            cntl.set_failed(Errno.EREQUEST, "no device attachment")
+            return None
+        cntl.response_device_attachment = att.tensor()
+        return b"ok"
 
     def Train(self, cntl, request):
         try:
             ids = unpack_ids(request)
-            labels = np.frombuffer(cntl.request_attachment.to_bytes(),
-                                   dtype=np.int32)
+            if cntl.request_device_attachment is not None:
+                labels = np.asarray(
+                    cntl.request_device_attachment.tensor()).astype(np.int32)
+            else:
+                labels = np.frombuffer(cntl.request_attachment.to_bytes(),
+                                       dtype=np.int32)
         except (struct.error, ValueError) as e:
             cntl.set_failed(Errno.EREQUEST, f"bad train payload: {e}")
             return None

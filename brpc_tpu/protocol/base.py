@@ -36,6 +36,7 @@ class ProtocolType(enum.IntEnum):
     ESP = 8
     NSHEAD = 9
     MESH = 10            # device-mesh collective transport frames
+    ICI_ACK = 11         # device-attachment redemption acks (ici/)
 
 
 class ParseError(enum.IntEnum):

@@ -31,6 +31,8 @@ _T_PARENT_SPAN = 11     # u64
 _T_STREAM_ID = 12       # u64 (streaming rpc settlement)
 _T_TIMEOUT_MS = 13      # u32 remaining-deadline propagation
 _T_STREAM_WINDOW = 14   # u32 receiver buffer size (stream handshake)
+_T_ICI_DOMAIN = 15      # bytes: sender's device-fabric domain id
+_T_ICI_DESC = 16        # bytes: device attachment descriptor (ici/)
 
 
 class CompressType:
@@ -44,7 +46,8 @@ class RpcMeta:
     __slots__ = ("correlation_id", "compress_type", "attachment_size",
                  "service_name", "method_name", "error_code", "error_text",
                  "auth_data", "trace_id", "span_id", "parent_span_id",
-                 "stream_id", "timeout_ms", "stream_window")
+                 "stream_id", "timeout_ms", "stream_window",
+                 "ici_domain", "ici_desc")
 
     def __init__(self):
         self.correlation_id = 0
@@ -61,6 +64,8 @@ class RpcMeta:
         self.stream_id = 0
         self.timeout_ms = 0
         self.stream_window = 0
+        self.ici_domain = b""
+        self.ici_desc = b""
 
     @property
     def is_request(self) -> bool:
@@ -104,6 +109,10 @@ class RpcMeta:
             put(_T_TIMEOUT_MS, struct.pack("<I", self.timeout_ms))
         if self.stream_window:
             put(_T_STREAM_WINDOW, struct.pack("<I", self.stream_window))
+        if self.ici_domain:
+            put(_T_ICI_DOMAIN, self.ici_domain)
+        if self.ici_desc:
+            put(_T_ICI_DESC, self.ici_desc)
         return bytes(out)
 
     @staticmethod
@@ -147,6 +156,10 @@ class RpcMeta:
                     (m.timeout_ms,) = struct.unpack("<I", field)
                 elif tag == _T_STREAM_WINDOW:
                     (m.stream_window,) = struct.unpack("<I", field)
+                elif tag == _T_ICI_DOMAIN:
+                    m.ici_domain = field
+                elif tag == _T_ICI_DESC:
+                    m.ici_desc = field
                 # unknown tags are skipped: forward compatibility
         except (struct.error, IndexError, UnicodeDecodeError):
             return None

@@ -22,6 +22,7 @@ class ServerController:
     __slots__ = (
         "request_meta", "remote_side", "socket_id",
         "request_attachment", "response_attachment",
+        "request_device_attachment", "response_device_attachment",
         "response_compress_type",
         "_error_code", "_error_text",
         "_async", "_finished", "_finish_lock", "_send_response",
@@ -40,6 +41,10 @@ class ServerController:
         self.socket_id = socket_id
         self.request_attachment = IOBuf()
         self.response_attachment = IOBuf()
+        # device tensors: in = DeviceAttachment handle (redeem with
+        # .tensor()), out = a jax array to ship device-resident (ici/)
+        self.request_device_attachment = None
+        self.response_device_attachment = None
         self.response_compress_type = CompressType.NONE
         self._error_code = 0
         self._error_text = ""

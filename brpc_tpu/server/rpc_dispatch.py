@@ -22,7 +22,12 @@ from .controller import ServerController
 
 
 def _send_error(sock: Socket, correlation_id: int, code: int,
-                text: str) -> None:
+                text: str, request_meta: RpcMeta = None) -> None:
+    if request_meta is not None and request_meta.ici_desc:
+        # rejected before the device attachment was split: return the
+        # client's posted window credit
+        from ..ici.endpoint import ack_unused
+        ack_unused(request_meta, sock.id)
     meta = RpcMeta()
     meta.correlation_id = correlation_id
     meta.error_code = int(code)
@@ -49,6 +54,11 @@ def _send_response(server, entry, cntl: ServerController,
         return      # connection died; response dropped like the reference
     meta = RpcMeta()
     meta.correlation_id = cntl.request_meta.correlation_id
+    if cntl.request_meta.ici_domain:
+        # answer the domain exchange so the client can go device-resident
+        from ..ici.endpoint import ici_enabled, local_domain_id
+        if ici_enabled():
+            meta.ici_domain = local_domain_id()
     if cntl._accepted_stream_id:
         meta.stream_id = cntl._accepted_stream_id
         meta.stream_window = cntl._accepted_stream_window
@@ -70,11 +80,27 @@ def _send_response(server, entry, cntl: ServerController,
         if compressed is not None:
             meta.compress_type = cntl.response_compress_type
             payload = IOBuf(compressed)
+    attachment = cntl.response_attachment
+    if cntl.response_device_attachment is not None:
+        from ..ici.endpoint import ici_enabled, local_domain_id, prepare_send
+        if ici_enabled():
+            meta.ici_domain = local_domain_id()
+        try:
+            tail = prepare_send(sock, meta, cntl.response_device_attachment,
+                                timeout_s=5.0)
+        except RuntimeError as e:
+            meta.error_code = int(Errno.EOVERCROWDED)
+            meta.error_text = str(e)
+            sock.write(pack_frame(meta, IOBuf()))
+            return
+        if tail is not None:
+            combined = IOBuf()
+            combined.append_iobuf(attachment)
+            combined.append_iobuf(tail)
+            attachment = combined
     if cntl.span is not None:
-        cntl.span.response_size = len(payload) \
-            + len(cntl.response_attachment)
-    sock.write(pack_frame(meta, payload,
-                          attachment=cntl.response_attachment))
+        cntl.span.response_size = len(payload) + len(attachment)
+    sock.write(pack_frame(meta, payload, attachment=attachment))
 
 
 def process_rpc_request(msg: RpcMessage, sock: Socket, server) -> None:
@@ -86,18 +112,22 @@ def process_rpc_request(msg: RpcMessage, sock: Socket, server) -> None:
         known = meta.service_name in server.services
         _send_error(sock, cid,
                     Errno.ENOMETHOD if known else Errno.ENOSERVICE,
-                    f"unknown {meta.service_name}.{meta.method_name}")
+                    f"unknown {meta.service_name}.{meta.method_name}",
+                    request_meta=meta)
         return
     if not server.running:
-        _send_error(sock, cid, Errno.ELOGOFF, "server is stopping")
+        _send_error(sock, cid, Errno.ELOGOFF, "server is stopping",
+                    request_meta=meta)
         return
     if not server.on_request_in():
-        _send_error(sock, cid, Errno.ELIMIT, "server max_concurrency")
+        _send_error(sock, cid, Errno.ELIMIT, "server max_concurrency",
+                    request_meta=meta)
         return
     if not entry.status.on_requested():
         server.on_request_out()
         _send_error(sock, cid, Errno.ELIMIT,
-                    f"{entry.status.full_name} max_concurrency")
+                    f"{entry.status.full_name} max_concurrency",
+                    request_meta=meta)
         return
 
     cntl = ServerController(
@@ -105,6 +135,14 @@ def process_rpc_request(msg: RpcMessage, sock: Socket, server) -> None:
         send_response=lambda c, r: _send_response(server, entry, c, r))
     cntl.server = server
     cntl.request_attachment = msg.split_attachment()
+    if meta.ici_domain:
+        # learn the peer's device-fabric domain (enables device-resident
+        # response attachments from the very first exchange)
+        sock.ici_peer_domain = meta.ici_domain
+    if meta.ici_desc:
+        from ..ici.endpoint import split_device_attachment
+        cntl.request_attachment, cntl.request_device_attachment = \
+            split_device_attachment(meta, cntl.request_attachment, sock.id)
     from ..rpcz import start_server_span
     cntl.span = start_server_span(entry.status.full_name, meta,
                                   sock.remote_side)

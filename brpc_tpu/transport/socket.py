@@ -89,6 +89,7 @@ class Socket:
         "health_check_interval_s", "connect_timeout_s",
         "_pooled_home", "correlation_id",
         "stream_map", "_stream_lock", "tag",
+        "ici_endpoint", "ici_peer_domain",
     )
 
     # -- lifecycle ---------------------------------------------------------
@@ -122,6 +123,8 @@ class Socket:
         self.stream_map = {}              # stream_id -> Stream (streaming RPC)
         self._stream_lock = threading.Lock()
         self.tag = None                   # acceptor tag ("internal" port etc.)
+        self.ici_endpoint = None          # lazy IciEndpoint (device payloads)
+        self.ici_peer_domain = None       # peer's fabric domain (from meta)
 
     @staticmethod
     def create(options: SocketOptions) -> int:
@@ -218,6 +221,11 @@ class Socket:
             # connection died; off-thread, user on_closed may block
             fiber_runtime.spawn(stream._on_conn_broken,
                                 name="stream_conn_broken")
+        if self.ici_endpoint is not None:
+            # reclaim device payloads posted on this connection (≈ QP
+            # teardown reclaiming posted work requests)
+            from ..ici.fabric import in_process_fabric
+            in_process_fabric().release_socket(self.id)
         if self.health_check_interval_s > 0:
             from .health_check import start_health_check
             start_health_check(self.id, self.health_check_interval_s)

@@ -147,8 +147,11 @@ def test_ps_service_over_rpc():
         c = ch.call_method("PS.Lookup", pack_ids(ids), cntl=cntl)
         assert not c.failed, c.error_text
         info = json.loads(c.response)
-        pooled = bytes_to_tensor(c.response_attachment.to_bytes(),
-                                 info["dtype"], tuple(info["shape"]))
+        att = c.response_device_attachment
+        assert att is not None
+        assert (att.dtype, tuple(att.shape)) == \
+            (info["dtype"], tuple(info["shape"]))
+        pooled = np.asarray(att.tensor())
         assert pooled.shape == (2, cfg.dim)
         want = np.asarray(svc.model.lookup(ids))
         np.testing.assert_allclose(pooled, want, rtol=1e-6)
