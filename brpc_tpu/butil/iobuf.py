@@ -79,6 +79,12 @@ class HostBlockPool(BlockPool):
     def __init__(self, block_size: int = DEFAULT_BLOCK_SIZE, max_cached: int = 64):
         self.block_size = block_size
         self._free: deque = deque()
+        # large read slabs (adaptive socket reads) recycle through
+        # size-class free lists — a fresh bytearray(512KB) is a 512KB
+        # memset per recv otherwise, the top cost in the echo profile
+        self._free_large: dict = {}
+        self._large_cached = 0
+        self._max_large_cached_bytes = 64 << 20
         self._lock = threading.Lock()
         self._max_cached = max_cached
         self.allocated = 0  # stats
@@ -92,18 +98,30 @@ class HostBlockPool(BlockPool):
                 if self._free:
                     data = self._free.popleft()
                     self.reused += 1
+        elif capacity > self.block_size:
+            with self._lock:
+                lst = self._free_large.get(capacity)
+                if lst:
+                    data = lst.pop()
+                    self._large_cached -= capacity
+                    self.reused += 1
         if data is None:
             self.allocated += 1
             data = bytearray(capacity)
         blk = Block(data, 0, self)
-        if capacity == self.block_size:
+        if capacity >= self.block_size:
             weakref.finalize(blk, self._recycle, data)
         return blk
 
     def _recycle(self, data: bytearray) -> None:
+        n = len(data)
         with self._lock:
-            if len(self._free) < self._max_cached:
-                self._free.append(data)
+            if n == self.block_size:
+                if len(self._free) < self._max_cached:
+                    self._free.append(data)
+            elif self._large_cached + n <= self._max_large_cached_bytes:
+                self._free_large.setdefault(n, []).append(data)
+                self._large_cached += n
 
 
 _default_pool = HostBlockPool()

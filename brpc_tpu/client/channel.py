@@ -100,7 +100,7 @@ class Channel:
             return c
         c._launch(self, method_full, payload, response_type, done)
         if done is None:
-            c.join()
+            c._sync_wait()
         return c
 
     # sugar: channel.call("Echo.Hi", b"x") -> response bytes or raises

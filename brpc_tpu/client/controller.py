@@ -62,7 +62,7 @@ class Controller:
         "_method_full", "_remote", "_begin_us", "_ended",
         "_timeout_timer", "_backup_timer", "_sending_sid",
         "_attempt_sids", "attempt_remotes", "_stream_to_create",
-        "_channel", "_lb_ctx", "trace_id", "span_id",
+        "_channel", "_lb_ctx", "trace_id", "span_id", "_direct_ok",
     )
 
     def __init__(self):
@@ -103,6 +103,7 @@ class Controller:
         self._attempt_sids = []          # pooled/short sids per attempt
         self.attempt_remotes = {}        # attempt version -> EndPoint
         self._stream_to_create = None    # set by streaming.stream_create
+        self._direct_ok = False
         self._channel = None
         self._lb_ctx = None
         self.trace_id = 0
@@ -134,6 +135,54 @@ class Controller:
     def join(self, timeout: Optional[float] = None) -> bool:
         return _idp.join(self._cid_base, timeout) if self._cid_base \
             else self._ended.wait(timeout)
+
+    def _sync_wait(self) -> None:
+        """Block until completion.  Fast path: on an exclusive
+        (pooled/short) connection the caller reads+processes its own
+        response inline — the whole round trip costs zero cross-thread
+        wakeups.  Falls back to the id join whenever the attempt's
+        socket is unavailable/converted (retries re-enter the loop)."""
+        if not self._direct_ok:
+            self.join()
+            return
+        import select as _select
+
+        from ..transport.input_messenger import client_messenger
+        messenger = client_messenger()
+        deadline = None
+        if self.timeout_ms and self.timeout_ms > 0:
+            deadline = self._begin_us / 1e6 + self.timeout_ms / 1e3
+        while not self._ended.is_set():
+            if deadline is not None:
+                left = deadline - monotonic_us() / 1e6
+                if left <= 0:
+                    _idp.error(self._cid_base, int(Errno.ERPCTIMEDOUT),
+                               f"deadline {self.timeout_ms}ms exceeded")
+                    self._ended.wait(1.0)
+                    return
+            else:
+                left = 0.1
+            sock = Socket.address(self._sending_sid)
+            if sock is None or sock.failed or not sock.direct_read \
+                    or sock.fd is None:
+                # the id machinery owns this phase (connect error, retry
+                # in flight, converted socket): poll-join briefly
+                self._ended.wait(0.01)
+                continue
+            try:
+                r, _, _ = _select.select([sock.fd], [], [],
+                                         min(left or 0.1, 0.1))
+            except (OSError, ValueError):
+                self._ended.wait(0.005)       # fd closed under us
+                continue
+            if not r or self._ended.is_set():
+                continue
+            nread = sock.read_into_portal()
+            if nread == 0:
+                if not sock.failed:
+                    sock.set_failed(Errno.EEOF, "remote closed connection")
+            elif nread > 0:
+                messenger._cut_and_process(sock)
 
     def _fail_before_launch(self, code: int, text: str,
                             done: Optional[Callable]) -> None:
@@ -177,10 +226,20 @@ class Controller:
             self.backup_request_ms = -1
             self.connection_type = "single"
         self._begin_us = monotonic_us()
+        # sync fast path eligibility: the caller thread reads responses
+        # directly off an exclusive (pooled/short) connection — no
+        # dispatcher wake, no fiber spawn, no butex wake per call
+        self._direct_ok = (done is None
+                           and self.connection_type in ("pooled", "short")
+                           and (not self.backup_request_ms
+                                or self.backup_request_ms <= 0)
+                           and self._stream_to_create is None)
         self._cid_base = _idp.create_ranged(
             self, Controller._on_id_error, self.max_retry + 2)
         self._live_versions = {0}
-        if self.timeout_ms and self.timeout_ms > 0:
+        if self.timeout_ms and self.timeout_ms > 0 and not self._direct_ok:
+            # direct sync calls enforce the deadline inline in
+            # _sync_wait — no timer-thread round trip per call
             self._timeout_timer = global_timer_thread().schedule(
                 _idp.error, self.timeout_ms / 1e3, None,
                 self._cid_base, int(Errno.ERPCTIMEDOUT),
@@ -223,6 +282,10 @@ class Controller:
             sid, rc = global_socket_map().get_socket(remote)
         self._sending_sid = sid
         sock = Socket.address(sid)
+        if sock is not None and sock.direct_read and not self._direct_ok:
+            # async/backup/stream call on a fast-path connection: hand
+            # its reads to the dispatcher permanently
+            sock.ensure_dispatched()
         if sock is None or (rc != 0 and sock.failed):
             # connection failed synchronously: deliver through the id so
             # the retry path is uniform

@@ -9,10 +9,14 @@ a donated input's HBM is reused for the output, so landing a host
 payload into a pooled block writes the *same* HBM pages every time
 instead of churning the allocator.
 
-Used by the host-staged fallback path (peer outside every fabric's
-reach, ≈ ``FLAGS_use_rdma=false``): wire bytes → one H2D DMA → a pooled
-HBM block.  The pure ICI path never lands bytes at all (descriptors are
-redeemed device-side, endpoint.py).
+Lifecycle is EXPLICIT, like RDMA registered buffers: the consumer calls
+:meth:`DeviceBlockPool.recycle` when a landed buffer's contents are no
+longer referenced — applications with repeated same-shape transfers
+(parameter servers pushing fixed-shape shards) get page-stable reuse
+this way.  The RPC fallback path itself uses plain ``device_put`` (no
+recycling opportunity: the receiver owns the tensor indefinitely); the
+pure ICI path never lands bytes at all (descriptors are redeemed
+device-side, endpoint.py).
 
 Why byte-granular HBM slicing is *not* re-expressed here: XLA owns HBM
 through its BFC allocator and device arrays are immutable; what the

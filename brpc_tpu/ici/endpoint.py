@@ -37,7 +37,6 @@ from ..protocol.base import (ParseResult, Protocol, ProtocolType,
                              register_protocol)
 from .attachment import (KIND_INLINE, KIND_INPROC, DeviceAttachment,
                          decode_descriptor, encode_descriptor)
-from .block_pool import default_device_pool
 from .fabric import in_process_fabric, local_domain_id
 
 define_flag("ici_enabled", True,
@@ -50,10 +49,6 @@ define_flag("ici_window_bytes", 256 * 1024 * 1024,
 define_flag("ici_desc_ttl_s", 120,
             "reclaim posted descriptors never redeemed after this many "
             "seconds", validator=lambda v: int(v) > 0)
-define_flag("ici_use_landing_pool", False,
-            "land host-staged device payloads through the recycled "
-            "DeviceBlockPool instead of direct device_put (stable HBM "
-            "footprint at the cost of one extra device kernel)")
 
 
 def ici_enabled() -> bool:
@@ -77,8 +72,8 @@ class IciEndpoint:
         self.posted_count = 0
         self.acked_count = 0
 
-    def post(self, array: Any, nbytes: int,
-             timeout_s: float = 30.0) -> Optional[int]:
+    def post(self, array: Any, nbytes: int, timeout_s: float = 30.0,
+             conn_key=None) -> Optional[int]:
         """Reserve window credit and post to the fabric. Returns the
         descriptor id, or None if the window stayed full (the
         EOVERCROWDED analogue of a stuffed RDMA send queue)."""
@@ -93,7 +88,8 @@ class IciEndpoint:
             self.outstanding_bytes += nbytes
             self.posted_count += 1
         return in_process_fabric().post(array, nbytes, self._on_release,
-                                        socket_id=self.socket_id)
+                                        socket_id=self.socket_id,
+                                        conn_key=conn_key)
 
     def _on_release(self, nbytes: int) -> None:
         with self._cond:
@@ -139,6 +135,34 @@ def _is_local_peer(sock) -> bool:
         in _LOOPBACK_HOSTS
 
 
+def conn_key_of(sock):
+    """Connection identity both ends compute identically: the unordered
+    (local, remote) address pair.  Binds a descriptor to the exact TCP
+    connection it was posted for — a peer on another connection forging
+    ids cannot redeem them (fabric.redeem enforces equality)."""
+    local = sock.local_side
+    if local is None and sock.fd is not None:
+        try:
+            name = sock.fd.getsockname()
+            from ..butil.endpoint import EndPoint
+            local = EndPoint(host=name[0], port=name[1])
+            sock.local_side = local
+        except (OSError, IndexError):
+            return None
+    remote = sock.remote_side
+    if local is None or remote is None:
+        return None
+
+    def norm(h: str) -> str:
+        # wildcard-bound listeners report 0.0.0.0/::; the in-process
+        # path is loopback-gated, so both ends agree on 127.0.0.1
+        return "127.0.0.1" if h in ("0.0.0.0", "::", "localhost") else h
+
+    a = (norm(str(local.host)), int(local.port))
+    b = (norm(str(remote.host)), int(remote.port))
+    return (a, b) if a <= b else (b, a)
+
+
 def prepare_send(sock, meta, array,
                  timeout_s: float = 30.0) -> Optional[IOBuf]:
     """Route a device attachment for sending: descriptor (device stays
@@ -151,12 +175,20 @@ def prepare_send(sock, meta, array,
     if not isinstance(array, jax.Array):
         array = jax.numpy.asarray(array)
     nbytes, dtype, shape = _tensor_meta(array)
+    if nbytes >= 1 << 32:
+        # the descriptor codec carries nbytes as u32; refuse before any
+        # window credit or D2H staging is spent
+        raise RuntimeError(
+            f"device attachment of {nbytes} bytes exceeds the 4GiB "
+            "frame limit — shard it or use streaming")
     peer = sock.ici_peer_domain
+    conn_key = conn_key_of(sock)
     if ici_enabled() and peer is not None \
             and in_process_fabric().can_reach(peer) \
-            and _is_local_peer(sock):
+            and _is_local_peer(sock) and conn_key is not None:
         desc_id = endpoint_of(sock).post(array, nbytes,
-                                         timeout_s=timeout_s)
+                                         timeout_s=timeout_s,
+                                         conn_key=conn_key)
         if desc_id is None:
             raise RuntimeError(
                 "ICI window full: posted device payloads awaiting ack "
@@ -165,11 +197,11 @@ def prepare_send(sock, meta, array,
                                           dtype, shape)
         return None
     # fallback: one explicit D2H, bytes ride the regular attachment
-    import numpy as np
-    host = np.asarray(array)
+    from ..ops.device_ops import tensor_bytes
+    data, dtype, shape = tensor_bytes(array)
     meta.ici_desc = encode_descriptor(KIND_INLINE, 0, nbytes, dtype, shape)
     tail = IOBuf()
-    tail.append_user_data(host.tobytes())
+    tail.append_user_data(data)
     return tail
 
 
@@ -186,6 +218,8 @@ def split_device_attachment(meta, attachment: IOBuf, socket_id: int
             decode_descriptor(meta.ici_desc)
     except (struct.error, IndexError):
         return attachment, None          # malformed wire field: drop
+    if kind not in (KIND_INLINE, KIND_INPROC):
+        return attachment, None          # unknown/unsupported kind: drop
     host_bytes: Optional[bytes] = None
     if kind == KIND_INLINE:
         if nbytes > len(attachment):
@@ -206,32 +240,24 @@ def redeem_attachment(att: DeviceAttachment, device: Any = None):
     descriptor kinds (credit return rides the connection, arriving at
     the poster through the normal dispatcher — the comp_channel→epoll
     shape)."""
-    import jax
     import jax.numpy as jnp
-    import numpy as np
 
     if att.kind == KIND_INPROC:
-        arr = in_process_fabric().redeem(att.desc_id, device)
+        from ..transport.socket import Socket
+        sock = Socket.address(att._socket_id)
+        key = conn_key_of(sock) if sock is not None else None
+        arr = in_process_fabric().redeem(att.desc_id, device, conn_key=key)
         if arr is None:
             raise RuntimeError(
-                f"ICI descriptor {att.desc_id} expired or already "
-                "redeemed (sender reclaimed after ttl?)")
+                f"ICI descriptor {att.desc_id} expired, already redeemed, "
+                "or bound to a different connection")
         _send_ack(att._socket_id, (att.desc_id,))
         return arr
-    # inline fallback: host bytes → device
-    np_dtype = np.dtype(att.dtype)
-    host = np.frombuffer(att._host_bytes, dtype=np_dtype).reshape(att.shape)
-    if get_flag("ici_use_landing_pool", False):
-        u8 = default_device_pool().land(att._host_bytes)
-        itemsize = np_dtype.itemsize
-        arr = jax.lax.bitcast_convert_type(
-            u8.reshape(-1, itemsize) if itemsize > 1 else u8,
-            jnp.dtype(att.dtype)).reshape(att.shape)
-        if device is not None:
-            arr = jax.device_put(arr, device)
-        return arr
-    return jax.device_put(host, device) if device is not None \
-        else jnp.asarray(host)
+    # inline fallback: host bytes → device (one H2D)
+    from ..ops.device_ops import bytes_to_tensor
+    arr = bytes_to_tensor(att._host_bytes, att.dtype, att.shape,
+                          device=device)
+    return arr if device is not None else jnp.asarray(arr)
 
 
 # -- "TICI" ack frames -----------------------------------------------------

@@ -26,10 +26,12 @@ the two domains.
 Trust model: the domain exchange is cooperative, like the reference's
 plaintext RDMA handshake (rdma_endpoint.cpp TCP bring-up) — it guards
 against *misconfiguration* (random 16-byte tokens can't collide by
-accident), not against a malicious peer.  The damage a forged domain can
-do is bounded: descriptors are bound to the posting connection (acks
-from other connections are rejected), all of a connection's descriptors
-are reclaimed when it dies, the in-process path additionally requires a
+accident), not against a malicious peer.  The damage a forged domain or
+descriptor can do is bounded: redemption requires the redeemer to sit
+on the SAME connection the descriptor was posted for (the mirrored
+address-pair key checked in :meth:`InProcessFabric.redeem`), acks from
+other connections are rejected, all of a connection's descriptors are
+reclaimed when it dies, the in-process path additionally requires a
 loopback peer address, and the TTL sweep is the backstop.  Authenticate
 peers with the regular auth layer if the network is hostile.
 """
@@ -54,15 +56,17 @@ def local_domain_id() -> bytes:
 
 
 class PostedEntry:
-    __slots__ = ("array", "nbytes", "posted_at", "on_release", "socket_id")
+    __slots__ = ("array", "nbytes", "posted_at", "on_release", "socket_id",
+                 "conn_key")
 
     def __init__(self, array: Any, nbytes: int, on_release=None,
-                 socket_id: int = 0):
+                 socket_id: int = 0, conn_key=None):
         self.array = array
         self.nbytes = nbytes
         self.posted_at = time.monotonic()
         self.on_release = on_release
-        self.socket_id = socket_id
+        self.socket_id = socket_id      # poster-local: binds acks
+        self.conn_key = conn_key        # connection pair: binds redemption
 
 
 class InProcessFabric:
@@ -84,22 +88,32 @@ class InProcessFabric:
         return peer_domain == _LOCAL_DOMAIN
 
     def post(self, array: Any, nbytes: int, on_release=None,
-             socket_id: int = 0) -> int:
+             socket_id: int = 0, conn_key=None) -> int:
         with self._lock:
             desc_id = self._next_id
             self._next_id += 1
             self._posted[desc_id] = PostedEntry(array, nbytes, on_release,
-                                                socket_id)
+                                                socket_id, conn_key)
             self.posted_bytes += nbytes
         return desc_id
 
-    def redeem(self, desc_id: int, device: Any = None) -> Optional[Any]:
+    def redeem(self, desc_id: int, device: Any = None,
+               conn_key=None) -> Optional[Any]:
         """Fetch a posted tensor, landing it on ``device`` (None = leave
         where posted).  Same-device redemption is zero-copy (device_put
-        is an alias); cross-device rides ICI on hardware."""
+        is an alias); cross-device rides ICI on hardware.
+
+        When the entry was posted with a connection key, the redeemer
+        must present the SAME key (both ends of one TCP connection see
+        the mirrored address pair) — a peer forging descriptor ids from
+        another connection gets None, never another client's tensor."""
         with self._lock:
             entry = self._posted.get(desc_id)
         if entry is None:
+            return None
+        if entry.conn_key is not None and conn_key != entry.conn_key:
+            LOG.warning("ICI redeem rejected: descriptor %d bound to a "
+                        "different connection", desc_id)
             return None
         arr = entry.array
         if device is not None:

@@ -1,0 +1,59 @@
+"""Native C++ IO engine — build-on-demand loader.
+
+The engine (src/engine.cpp) runs epoll loops, tpu_std frame cutting and
+vectored writes in C++ with the GIL released; Python is entered once per
+complete message.  This is the framework's native-performance data plane
+(SURVEY.md §2's "C++, not Python stand-ins" requirement); the pure-Python
+transport remains the fallback and the full multi-protocol path.
+
+``load()`` compiles ``_native.so`` with g++ on first use (cached by
+mtime) and returns the module, or None when no toolchain is available —
+callers must treat None as "use the Python transport".
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import threading
+from typing import Optional
+
+from ..butil.logging_util import LOG
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_lock = threading.Lock()
+_module = None
+_tried = False
+
+
+def load() -> Optional[object]:
+    """The compiled engine module, building it if needed (None if the
+    build fails — callers fall back to the Python transport)."""
+    global _module, _tried
+    with _lock:
+        if _module is not None or _tried:
+            return _module
+        _tried = True
+        so = os.path.join(_DIR, "_native.so")
+        src = os.path.join(_DIR, "src", "engine.cpp")
+        try:
+            if (not os.path.exists(so)
+                    or os.path.getmtime(so) < os.path.getmtime(src)):
+                LOG.info("building native engine (_native.so)...")
+                subprocess.run(["make", "-C", _DIR], check=True,
+                               capture_output=True, timeout=120)
+            import importlib.util
+            spec = importlib.util.spec_from_file_location(
+                "brpc_tpu.native._native", so)
+            mod = importlib.util.module_from_spec(spec)
+            spec.loader.exec_module(mod)
+            _module = mod
+        except Exception as e:
+            LOG.warning("native engine unavailable (%s); "
+                        "using the Python transport", e)
+            _module = None
+        return _module
+
+
+def available() -> bool:
+    return load() is not None

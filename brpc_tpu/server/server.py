@@ -31,7 +31,8 @@ class ServerOptions:
 
     __slots__ = ("num_workers", "max_concurrency", "method_max_concurrency",
                  "auth", "interceptor", "idle_timeout_s",
-                 "internal_port", "server_info_name")
+                 "internal_port", "server_info_name",
+                 "native", "native_loops")
 
     def __init__(self):
         self.num_workers = 0            # 0 = leave fiber runtime defaults
@@ -44,6 +45,11 @@ class ServerOptions:
         self.idle_timeout_s = -1
         self.internal_port = -1
         self.server_info_name = ""
+        # serve the main port through the native C++ IO engine (framed
+        # protocols only; pair with internal_port for the HTTP portal).
+        # Falls back to the Python transport if the engine can't build.
+        self.native = False
+        self.native_loops = 2
 
 
 class _MethodEntry:
@@ -64,6 +70,7 @@ class Server:
         self._methods: Dict[Tuple[str, str], _MethodEntry] = {}
         self._listener: Optional[_socket.socket] = None
         self._acceptor: Optional[Acceptor] = None
+        self._native_bridge = None
         self._internal_acceptor: Optional[Acceptor] = None
         self._internal_endpoint: Optional[EndPoint] = None
         self._messenger: Optional[InputMessenger] = None
@@ -182,8 +189,20 @@ class Server:
         from ..protocol import tpu_std as _tpu    # noqa: F401
         handlers = [p for p in list_protocols() if p.support_server]
         self._messenger = InputMessenger(handlers, arg=self)
-        self._acceptor = Acceptor(self._messenger)
-        self._acceptor.start_accept(lst)
+        if self.options.native:
+            from ..native import load as load_native
+            native_mod = load_native()
+            if native_mod is not None:
+                from ..transport.native_bridge import NativeBridge
+                self._native_bridge = NativeBridge(
+                    self, native_mod, loops=self.options.native_loops)
+                self._native_bridge.listen(lst)
+            else:
+                LOG.warning("native engine unavailable; serving %s through "
+                            "the Python transport", ep)
+        if self._native_bridge is None:
+            self._acceptor = Acceptor(self._messenger)
+            self._acceptor.start_accept(lst)
 
         # Optional second, operator-only port: builtin portal pages (flag
         # mutation, rpcz, profilers …) are served ONLY to connections
@@ -229,7 +248,12 @@ class Server:
         return self._started
 
     def connection_count(self) -> int:
-        return self._acceptor.connection_count() if self._acceptor else 0
+        n = self._acceptor.connection_count() if self._acceptor else 0
+        if self._native_bridge is not None:
+            n += self._native_bridge.connection_count()
+        if self._internal_acceptor is not None:
+            n += self._internal_acceptor.connection_count()
+        return n
 
     def stop(self) -> int:
         """≈ Server::Stop: stop accepting, fail live connections."""
@@ -238,6 +262,9 @@ class Server:
         self._started = False
         if self._acceptor is not None:
             self._acceptor.stop_accept()
+        if self._native_bridge is not None:
+            self._native_bridge.stop()
+            self._native_bridge = None
         if self._internal_acceptor is not None:
             self._internal_acceptor.stop_accept()
         self._listener = None

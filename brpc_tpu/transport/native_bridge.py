@@ -1,0 +1,177 @@
+"""Bridge between the native C++ IO engine and the Python RPC stack.
+
+The engine (brpc_tpu/native) owns connections, framing and writes; this
+module gives each native connection a :class:`NativeSocket` (a Socket
+living in the same versioned-id pool, so controllers/streams/ICI acks
+address it exactly like a Python-transport socket) and routes engine
+events into the existing dispatch layers:
+
+    EV_MESSAGE -> server.rpc_dispatch.process_rpc_request (on a fiber)
+    EV_ACK     -> ici fabric release (descriptor ownership enforced)
+    EV_STREAM  -> protocol.streaming dispatch (socket-binding checked)
+    EV_UNKNOWN -> connection failed (native ports speak the framed
+                  protocols; the full multi-protocol port — HTTP portal
+                  etc. — is the Python path / the internal port)
+
+Zero-copy discipline: a message's payload IOBuf wraps the engine's
+NativeBuf (buffer protocol) — no Python-side copy on ingest; responses
+hand the engine the IOBuf's backing views, which the engine pins
+(Py_buffer) until written.
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Any, Dict, Optional
+
+from ..butil.endpoint import EndPoint
+from ..butil.iobuf import IOBuf
+from ..butil.logging_util import LOG
+from ..butil.status import Errno
+from ..fiber import runtime as fiber_runtime
+from ..protocol.meta import RpcMeta
+from ..protocol.tpu_std import RpcMessage
+from .socket import Socket, SocketOptions, socket_pool
+
+
+class NativeSocket(Socket):
+    """Socket whose write path is the native engine (no fd on the
+    Python side).  Lives in the regular socket pool: Socket.address()
+    resolves it, streams bind to it, ICI endpoints hang off it."""
+
+    __slots__ = ("engine", "conn_id")
+
+    def __init__(self):
+        super().__init__()
+        self.engine = None
+        self.conn_id = 0
+
+    def write(self, buf: IOBuf, id_wait: int = 0) -> int:
+        if self._failed:
+            code = self._error_code or int(Errno.EFAILEDSOCKET)
+            if id_wait:
+                from ..fiber.versioned_id import global_id_pool
+                global_id_pool().error(id_wait, code, self._error_text)
+            return code
+        try:
+            self.engine.send(self.conn_id, tuple(buf.backing_views()))
+            return 0
+        except ConnectionError as e:
+            self.set_failed(Errno.EFAILEDSOCKET, str(e))
+            if id_wait:
+                from ..fiber.versioned_id import global_id_pool
+                global_id_pool().error(id_wait, int(Errno.EFAILEDSOCKET),
+                                       str(e))
+            return int(Errno.EFAILEDSOCKET)
+
+
+class NativeBridge:
+    def __init__(self, server, engine_module, loops: int = 2):
+        self._server = server
+        self._m = engine_module
+        self.engine = engine_module.Engine(self._dispatch, loops=loops)
+        self._conns: Dict[int, int] = {}      # engine conn_id -> socket id
+
+    def listen(self, listen_socket) -> None:
+        listen_socket.setblocking(False)
+        # the bridge owns the fd's lifetime alongside the engine
+        self._listen_socket = listen_socket
+        name = listen_socket.getsockname()
+        self._local_ep = EndPoint(host=name[0], port=name[1])
+        self.engine.listen(listen_socket.fileno())
+
+    def stop(self) -> None:
+        self.engine.stop()
+        for sid in list(self._conns.values()):
+            s = Socket.address(sid)
+            if s is not None:
+                s.release()
+        self._conns.clear()
+
+    def connection_count(self) -> int:
+        return self.engine.stats()["connections"]
+
+    # -- engine event entry (runs on engine loop threads, GIL held) -----
+
+    def _dispatch(self, event: int, conn_id: int, obj: Any,
+                  extra: int) -> None:
+        m = self._m
+        try:
+            if event == m.EV_MESSAGE:
+                self._on_message(conn_id, obj, extra)
+            elif event == m.EV_ACK:
+                self._on_ack(conn_id, obj, extra)
+            elif event == m.EV_STREAM:
+                self._on_stream(conn_id, obj)
+            elif event == m.EV_OPEN:
+                self._on_open(conn_id, obj, extra)
+            elif event == m.EV_CLOSE:
+                self._on_close(conn_id)
+            elif event == m.EV_UNKNOWN:
+                LOG.warning("non-framed bytes on native port from conn %d "
+                            "(%d bytes); closing — use the Python/internal "
+                            "port for HTTP", conn_id, len(obj))
+        except Exception:
+            LOG.exception("native dispatch raised (event=%d)", event)
+
+    def _on_open(self, conn_id: int, ip: str, port: int) -> None:
+        sid, s = socket_pool().acquire(NativeSocket())
+        s.id = sid
+        s.engine = self.engine
+        s.conn_id = conn_id
+        s.remote_side = EndPoint(host=str(ip), port=int(port))
+        s.local_side = self._local_ep    # conn-pair key for ICI binding
+        s.tag = None
+        self._conns[conn_id] = sid
+
+    def _on_close(self, conn_id: int) -> None:
+        sid = self._conns.pop(conn_id, None)
+        if sid is None:
+            return
+        s = Socket.address(sid)
+        if s is not None:
+            s.release()      # set_failed (streams/ici cleanup) + free slot
+
+    def _sock(self, conn_id: int) -> Optional[Socket]:
+        sid = self._conns.get(conn_id)
+        return Socket.address(sid) if sid is not None else None
+
+    def _on_message(self, conn_id: int, buf, meta_size: int) -> None:
+        sock = self._sock(conn_id)
+        if sock is None:
+            return
+        mv = memoryview(buf)
+        meta = RpcMeta.decode(bytes(mv[:meta_size]))
+        if meta is None:
+            self.engine.close_conn(conn_id)
+            return
+        payload = IOBuf()
+        if len(buf) > meta_size:
+            payload.append_user_data(mv[meta_size:])   # zero-copy ingest
+        msg = RpcMessage(meta, payload, sock.id)
+        # service code runs on the fiber pool, never on the IO loop
+        # (≈ InputMessenger starting a bthread per message batch)
+        from ..server.rpc_dispatch import process_rpc_request
+        fiber_runtime.spawn(process_rpc_request, msg, sock, self._server,
+                            name="native_rpc")
+
+    def _on_ack(self, conn_id: int, buf, count: int) -> None:
+        sock = self._sock(conn_id)
+        if sock is None:
+            return
+        from ..ici.fabric import in_process_fabric
+        fabric = in_process_fabric()
+        ids = struct.unpack(f"<{count}Q", bytes(buf))
+        for desc_id in ids:
+            fabric.release(desc_id, only_socket=sock.id)
+
+    def _on_stream(self, conn_id: int, buf) -> None:
+        sock = self._sock(conn_id)
+        if sock is None:
+            return
+        mv = memoryview(buf)
+        flags = mv[0]
+        (dest,) = struct.unpack_from("<Q", mv, 1)
+        payload = bytes(mv[13:])
+        from ..protocol.streaming import _dispatch as stream_dispatch
+        stream_dispatch((flags, dest, payload), sock)

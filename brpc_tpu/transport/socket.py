@@ -90,6 +90,7 @@ class Socket:
         "_pooled_home", "correlation_id",
         "stream_map", "_stream_lock", "tag",
         "ici_endpoint", "ici_peer_domain",
+        "direct_read", "_dispatch_lock",
     )
 
     # -- lifecycle ---------------------------------------------------------
@@ -125,6 +126,13 @@ class Socket:
         self.tag = None                   # acceptor tag ("internal" port etc.)
         self.ici_endpoint = None          # lazy IciEndpoint (device payloads)
         self.ici_peer_domain = None       # peer's fabric domain (from meta)
+        # direct-read: the socket is NOT registered with the dispatcher;
+        # the synchronous caller reads its responses itself (pooled/short
+        # sync fast path — saves a dispatcher wake + fiber spawn + butex
+        # wake per call).  ensure_dispatched() converts one-way to the
+        # dispatcher-driven mode for async use.
+        self.direct_read = False
+        self._dispatch_lock = threading.Lock()
 
     @staticmethod
     def create(options: SocketOptions) -> int:
@@ -376,6 +384,20 @@ class Socket:
     def attach_dispatcher(self, dispatcher) -> None:
         self._dispatcher = dispatcher
 
+    def ensure_dispatched(self) -> None:
+        """One-way conversion of a direct-read socket to dispatcher-driven
+        mode (an async/backup/stream call landed on a pooled connection
+        created for sync fast-path reads)."""
+        with self._dispatch_lock:
+            if not self.direct_read:
+                return
+            self.direct_read = False
+        if self.fd is not None and not self._failed:
+            from .event_dispatcher import global_dispatcher
+            disp = global_dispatcher()
+            self.attach_dispatcher(disp)
+            disp.add_consumer(self.fd, self.start_input_event)
+
     def start_input_event(self) -> None:
         """≈ Socket::StartInputEvent (socket.cpp:2111): first event spawns
         a consumer task; further events while it runs just bump a counter
@@ -439,7 +461,7 @@ class Socket:
         the reference's trick to amortize syscalls without hogging blocks
         (input_messenger.cpp:352-358)."""
         avg = self._avg_msg_size or 1024.0
-        return max(4096, min(int(avg * 16), 512 * 1024))
+        return max(4096, min(int(avg * 16), 1024 * 1024))
 
     def note_msg_size(self, n: int) -> None:
         # EMA with the same intent as the reference's running average

@@ -30,9 +30,14 @@ DEFAULT_HEALTH_CHECK_INTERVAL_S = 3.0   # reference socket_map.cpp:33
 
 
 def _new_connection(remote: EndPoint,
-                    health_check_interval_s: float = 0.0) -> Tuple[int, int]:
+                    health_check_interval_s: float = 0.0,
+                    direct_read: bool = False) -> Tuple[int, int]:
     """Create+connect a client Socket wired for responses.
-    Returns (socket_id, error_code)."""
+    Returns (socket_id, error_code).
+
+    ``direct_read`` skips dispatcher registration: the synchronous
+    caller reads responses itself (pooled/short fast path); an async
+    user later converts via ``ensure_dispatched()``."""
     sid = Socket.create(SocketOptions(
         remote_side=remote,
         on_edge_triggered_events=client_messenger().on_new_messages,
@@ -41,6 +46,9 @@ def _new_connection(remote: EndPoint,
     rc = s.connect_if_not()
     if rc != 0:
         return sid, rc
+    if direct_read:
+        s.direct_read = True
+        return sid, 0
     disp = global_dispatcher()
     s.attach_dispatcher(disp)
     disp.add_consumer(s.fd, s.start_input_event)
@@ -118,7 +126,9 @@ class SocketPool:
                 return sid, 0
             if s is not None:
                 s.release()      # failed pooled conn: free the slot
-        sid, rc = _new_connection(self._remote)
+        # pooled connections are born direct-read (sync fast path);
+        # async callers convert them via ensure_dispatched()
+        sid, rc = _new_connection(self._remote, direct_read=True)
         s = Socket.address(sid)
         if s is not None:
             s._pooled_home = self
@@ -167,4 +177,4 @@ def return_pooled_socket(sid: int) -> None:
 
 
 def short_socket(remote: EndPoint) -> Tuple[int, int]:
-    return _new_connection(remote)
+    return _new_connection(remote, direct_read=True)

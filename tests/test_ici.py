@@ -429,3 +429,48 @@ def test_malformed_descriptor_dropped_cleanly():
     out, dev = split_device_attachment(meta, att, 1)
     assert dev is None
     assert out.to_bytes() == b"payload"
+
+
+def test_redeem_bound_to_connection_pair():
+    """A descriptor posted for one connection cannot be redeemed through
+    another (cross-connection tensor disclosure guard)."""
+    f = InProcessFabric()
+    x = jnp.ones((16,), jnp.float32)
+    key = (("127.0.0.1", 1111), ("127.0.0.1", 2222))
+    did = f.post(x, 64, conn_key=key)
+    assert f.redeem(did, conn_key=(("127.0.0.1", 1111),
+                                   ("127.0.0.1", 3333))) is None
+    assert f.redeem(did, conn_key=None) is None
+    assert f.redeem(did, conn_key=key) is x
+    f.release(did)
+
+
+def test_oversized_attachment_fails_cleanly(server):
+    """>4GiB attachments are refused with an RPC error before any window
+    credit or staging is spent (descriptor nbytes is u32)."""
+    class Fake:
+        dtype = np.dtype("float32")
+        shape = (1 << 31,)
+        size = 1 << 31
+    from brpc_tpu.ici.endpoint import prepare_send
+
+    class SockStub:
+        id = 1
+        ici_peer_domain = None
+        remote_side = None
+        local_side = None
+        fd = None
+        ici_endpoint = None
+
+    import jax as _jax
+    real = _jax.Array
+    try:
+        _jax.Array = (Fake,)  # make isinstance pass for the stub
+    except TypeError:
+        pytest.skip("cannot stub jax.Array")
+    try:
+        from brpc_tpu.protocol.meta import RpcMeta
+        with pytest.raises(RuntimeError, match="4GiB"):
+            prepare_send(SockStub(), RpcMeta(), Fake())
+    finally:
+        _jax.Array = real
