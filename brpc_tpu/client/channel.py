@@ -91,6 +91,17 @@ class Channel:
             from ..butil.iobuf import IOBuf
             c.request_attachment = attachment if isinstance(attachment, IOBuf) \
                 else IOBuf(attachment)
+        if self.options.protocol == "grpc":
+            if done is not None:
+                # keep call_method's async contract: the blocking h2
+                # unary wait runs on a fiber, done fires on completion
+                from ..fiber import runtime as fiber_runtime
+                fiber_runtime.spawn(self._call_grpc, method_full, request,
+                                    response_type, done, c,
+                                    name="grpc_call")
+                return c
+            return self._call_grpc(method_full, request, response_type,
+                                   done, c)
         if c.request_compress_type == CompressType.NONE:
             c.request_compress_type = self.options.request_compress_type
         try:
@@ -101,6 +112,53 @@ class Channel:
         c._launch(self, method_full, payload, response_type, done)
         if done is None:
             c._sync_wait()
+        return c
+
+    def _call_grpc(self, method_full: str, request: Any,
+                   response_type: Any, done: Optional[Callable],
+                   c: Controller) -> Controller:
+        """gRPC unary over a multiplexed h2 connection
+        (protocol="grpc").  Single-server channels only; LB selection
+        picks a server per call for cluster channels."""
+        from ..butil.time_utils import monotonic_us
+        from ..protocol.h2_rpc import errno_of_grpc_status
+        from ..protocol.tpu_std import parse_payload
+        from .grpc_client import grpc_connection
+
+        remote = self.single_server
+        if remote is None and self.load_balancer is not None:
+            remote = self.load_balancer.select_server(c)
+        if remote is None:
+            c._fail_before_launch(2001, "no server available", done)
+            return c
+        c.remote_side = remote
+        try:
+            payload = serialize_payload(request).to_bytes()
+        except TypeError as e:
+            c._fail_before_launch(1003, str(e), done)
+            return c
+        svc, _, mth = method_full.rpartition(".")
+        timeout_s = (c.timeout_ms or self.options.timeout_ms or 30000) / 1e3
+        begin = monotonic_us()
+        status, message, body = grpc_connection(remote).unary_call(
+            f"/{svc}/{mth}", payload, timeout_s=timeout_s)
+        c.latency_us = monotonic_us() - begin
+        if status != 0:
+            c.set_failed(errno_of_grpc_status(status),
+                         f"grpc-status {status}: {message}")
+        else:
+            try:
+                c.response = parse_payload(body, response_type)
+            except Exception as e:
+                c.set_failed(1004, f"response parse failed: {e}")
+        if self.load_balancer is not None:
+            self.load_balancer.feedback(c)
+        c._ended.set()
+        if done is not None:
+            try:
+                done(c)
+            except Exception:
+                LOG.exception("rpc done callback raised")
         return c
 
     # sugar: channel.call("Echo.Hi", b"x") -> response bytes or raises

@@ -1,0 +1,237 @@
+"""gRPC client — h2 connection with multiplexed unary calls.
+
+The client half of the h2/gRPC interop story (≈ the client paths of
+/root/reference/src/brpc/policy/http2_rpc_protocol.cpp): one TCP
+connection per peer, streams multiplexed, a dedicated reader thread
+distributing frames to waiting callers (h2 responses are unordered
+across streams, so the tpu_std direct-read trick does not apply).
+
+Used by Channel when ``options.protocol == "grpc"``; also usable
+standalone against any gRPC server (oracle: grpcio in the tests).
+"""
+
+from __future__ import annotations
+
+import socket as _socket
+import struct
+import threading
+from typing import Dict, List, Optional, Tuple
+
+from ..butil.endpoint import EndPoint
+from ..butil.logging_util import LOG
+from ..protocol.h2_rpc import GRPC_CT, pack_grpc_message, unpack_grpc_messages
+from ..protocol.h2_session import H2Error, H2Session
+
+
+class _Call:
+    __slots__ = ("event", "headers", "trailers", "body", "rst_code")
+
+    def __init__(self):
+        self.event = threading.Event()
+        self.headers: List[Tuple[str, str]] = []
+        self.trailers: List[Tuple[str, str]] = []
+        self.body = bytearray()
+        self.rst_code: Optional[int] = None
+
+    def header(self, name: str, default: str = "") -> str:
+        for n, v in self.trailers:
+            if n == name:
+                return v
+        for n, v in self.headers:
+            if n == name:
+                return v
+        return default
+
+
+class GrpcConnection:
+    """One h2 connection; thread-safe; reconnects lazily after failure."""
+
+    def __init__(self, remote: EndPoint, connect_timeout_s: float = 2.0):
+        self._remote = remote
+        self._connect_timeout_s = connect_timeout_s
+        self._lock = threading.Lock()        # guards session + socket writes
+        self._sock: Optional[_socket.socket] = None
+        self._session: Optional[H2Session] = None
+        self._calls: Dict[int, _Call] = {}
+        self._reader: Optional[threading.Thread] = None
+        self._dead = True
+
+    # -- connection management --------------------------------------------
+
+    def _ensure_connected(self) -> None:
+        with self._lock:
+            if not self._dead and self._sock is not None:
+                return
+            sock = _socket.create_connection(
+                self._remote.to_sockaddr(),
+                timeout=self._connect_timeout_s)
+            sock.setsockopt(_socket.IPPROTO_TCP, _socket.TCP_NODELAY, 1)
+            sock.settimeout(None)
+            self._sock = sock
+            self._session = H2Session(is_server=False)
+            self._session.start()
+            self._flush_locked()
+            self._dead = False
+            self._reader = threading.Thread(target=self._read_loop,
+                                            name="grpc_reader", daemon=True)
+            self._reader.start()
+
+    def _flush_locked(self) -> None:
+        out = self._session.take_output()
+        if out and self._sock is not None:
+            self._sock.sendall(out)
+
+    def _fail_all(self, why: str) -> None:
+        with self._lock:
+            self._dead = True
+            calls = list(self._calls.values())
+            self._calls.clear()
+            try:
+                if self._sock is not None:
+                    self._sock.close()
+            except OSError:
+                pass
+            self._sock = None
+        for call in calls:
+            call.rst_code = -1
+            call.trailers = [("grpc-status", "14"),      # UNAVAILABLE
+                             ("grpc-message", why)]
+            call.event.set()
+
+    def _read_loop(self) -> None:
+        sock = self._sock
+        session = self._session
+        while True:
+            try:
+                data = sock.recv(256 * 1024)
+            except OSError as e:
+                self._fail_all(f"recv: {e}")
+                return
+            if not data:
+                self._fail_all("connection closed by server")
+                return
+            try:
+                with self._lock:
+                    if self._session is not session:
+                        return                   # superseded
+                    events = session.feed(data)
+                    self._flush_locked()
+            except H2Error as e:
+                self._fail_all(f"h2: {e}")
+                return
+            for ev in events:
+                self._on_event(ev)
+
+    def _on_event(self, ev: tuple) -> None:
+        kind = ev[0]
+        if kind == "headers":
+            _, sid, headers, end = ev
+            call = self._calls.get(sid)
+            if call is None:
+                return
+            if call.headers:
+                call.trailers = headers
+            else:
+                call.headers = headers
+            if end:
+                self._finish(sid)
+        elif kind == "data":
+            _, sid, body, end = ev
+            call = self._calls.get(sid)
+            if call is None:
+                return
+            call.body += body
+            if end:
+                self._finish(sid)
+        elif kind == "rst":
+            _, sid, code = ev
+            call = self._calls.get(sid)
+            if call is not None:
+                call.rst_code = code
+                self._finish(sid)
+        elif kind == "goaway":
+            self._fail_all(f"goaway code={ev[2]}")
+
+    def _finish(self, sid: int) -> None:
+        with self._lock:
+            call = self._calls.pop(sid, None)
+            if self._session is not None:
+                self._session.close_stream(sid)
+        if call is not None:
+            call.event.set()
+
+    # -- calls -------------------------------------------------------------
+
+    def unary_call(self, path: str, payload: bytes,
+                   timeout_s: float = 30.0,
+                   metadata: Optional[List[Tuple[str, str]]] = None
+                   ) -> Tuple[int, str, bytes]:
+        """Returns (grpc_status, message, response_bytes).  14/UNAVAILABLE
+        on transport failure, 4/DEADLINE_EXCEEDED on timeout."""
+        try:
+            self._ensure_connected()
+        except OSError as e:
+            return 14, f"connect to {self._remote}: {e}", b""
+        call = _Call()
+        with self._lock:
+            if self._dead:
+                return 14, "connection lost", b""
+            sid = self._session.next_stream_id()
+            self._calls[sid] = call
+            headers = [
+                (":method", "POST"),
+                (":scheme", "http"),
+                (":path", path),
+                (":authority", str(self._remote)),
+                ("content-type", GRPC_CT),
+                ("te", "trailers"),
+                ("grpc-timeout", f"{max(1, int(timeout_s * 1000))}m"),
+            ] + list(metadata or [])
+            try:
+                self._session.send_headers(sid, headers)
+                self._session.send_data(sid, pack_grpc_message(payload),
+                                        end_stream=True)
+                self._flush_locked()
+            except OSError as e:
+                self._calls.pop(sid, None)
+                self._fail_all(f"send: {e}")
+                return 14, f"send: {e}", b""
+        if not call.event.wait(timeout_s):
+            with self._lock:
+                self._calls.pop(sid, None)
+                if self._session is not None:
+                    try:
+                        self._session.send_rst(sid, 0x8)   # CANCEL
+                        self._flush_locked()
+                    except OSError:
+                        pass
+            return 4, f"deadline {timeout_s}s exceeded", b""
+        if call.rst_code not in (None, -1):
+            return 13, f"stream reset (h2 code {call.rst_code})", b""
+        status_s = call.header("grpc-status", "2")
+        status = int(status_s) if status_s.isdigit() else 2
+        message = call.header("grpc-message")
+        body = b""
+        if call.body:
+            buf = bytearray(call.body)
+            try:
+                msgs = unpack_grpc_messages(buf)
+                body = msgs[0] if msgs else b""
+            except H2Error as e:
+                return 13, f"bad response framing: {e}", b""
+        return status, message, body
+
+    def close(self) -> None:
+        self._fail_all("closed")
+
+
+_conns_lock = threading.Lock()
+_conns: Dict[EndPoint, GrpcConnection] = {}
+
+
+def grpc_connection(remote: EndPoint) -> GrpcConnection:
+    with _conns_lock:
+        conn = _conns.get(remote)
+        if conn is None:
+            conn = _conns[remote] = GrpcConnection(remote)
+        return conn

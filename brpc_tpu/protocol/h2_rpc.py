@@ -1,0 +1,349 @@
+"""HTTP/2 server protocol + gRPC semantics on the shared port.
+
+Capability parity with /root/reference/src/brpc/policy/http2_rpc_protocol.cpp
++ src/brpc/grpc.*: the same port that speaks tpu_std/HTTP/1/streaming
+also accepts h2 connections (detected by the client preface).  Requests
+with content-type ``application/grpc`` get full gRPC unary semantics
+(5-byte message framing, ``/package.Service/Method`` routing into the
+regular service registry, grpc-status/grpc-message trailers,
+grpc-timeout); other h2 requests are served the builtin portal pages —
+the JSON/RPC bridge stays on HTTP/1.
+
+The oracle for this implementation is the real ``grpcio`` package
+(tests/test_grpc_interop.py): a grpcio client calls this server and a
+grpcio server answers this framework's h2 client.
+"""
+
+from __future__ import annotations
+
+import struct
+import threading
+from typing import Dict, List, Optional, Tuple
+
+from ..butil.iobuf import IOBuf
+from ..butil.logging_util import LOG
+from ..butil.status import Errno
+from ..butil.time_utils import monotonic_us
+from .base import (ParseResult, Protocol, ProtocolType, max_body_size,
+                   register_protocol)
+from .h2_session import PREFACE, E_PROTOCOL, H2Error, H2Session
+
+GRPC_CT = "application/grpc"
+
+# Errno -> grpc-status (status.proto codes); default UNKNOWN(2)
+_ERRNO_TO_GRPC = {
+    0: 0,
+    int(Errno.ENOSERVICE): 12,      # UNIMPLEMENTED
+    int(Errno.ENOMETHOD): 12,
+    int(Errno.EREQUEST): 3,         # INVALID_ARGUMENT
+    int(Errno.ERPCAUTH): 16,        # UNAUTHENTICATED
+    int(Errno.ELIMIT): 8,           # RESOURCE_EXHAUSTED
+    int(Errno.EOVERCROWDED): 8,
+    int(Errno.ERPCTIMEDOUT): 4,     # DEADLINE_EXCEEDED
+    int(Errno.EINTERNAL): 13,       # INTERNAL
+}
+
+
+def grpc_status_of(errno_code: int) -> int:
+    return _ERRNO_TO_GRPC.get(int(errno_code), 2)
+
+
+_GRPC_TO_ERRNO = {
+    0: 0,
+    3: int(Errno.EREQUEST),
+    4: int(Errno.ERPCTIMEDOUT),
+    8: int(Errno.ELIMIT),
+    12: int(Errno.ENOMETHOD),
+    13: int(Errno.EINTERNAL),
+    14: int(Errno.EFAILEDSOCKET),
+    16: int(Errno.ERPCAUTH),
+}
+
+
+def errno_of_grpc_status(status: int) -> int:
+    return _GRPC_TO_ERRNO.get(int(status), int(Errno.EINTERNAL))
+
+
+def pack_grpc_message(payload: bytes) -> bytes:
+    return b"\x00" + struct.pack(">I", len(payload)) + payload
+
+
+def unpack_grpc_messages(buf: bytearray) -> List[bytes]:
+    """Cut complete length-prefixed messages off ``buf`` (mutates)."""
+    out = []
+    while len(buf) >= 5:
+        compressed = buf[0]
+        (ln,) = struct.unpack_from(">I", buf, 1)
+        if len(buf) < 5 + ln:
+            break
+        if compressed:
+            raise H2Error(E_PROTOCOL, "compressed grpc message "
+                                      "(no grpc-encoding negotiated)")
+        out.append(bytes(buf[5:5 + ln]))
+        del buf[:5 + ln]
+    return out
+
+
+class H2Request:
+    __slots__ = ("stream_id", "headers", "body", "conn")
+
+    def __init__(self, stream_id: int, headers: List[Tuple[str, str]],
+                 body: bytes, conn: "H2ServerConn"):
+        self.stream_id = stream_id
+        self.headers = headers
+        self.body = body
+        self.conn = conn
+
+    def header(self, name: str) -> str:
+        for n, v in self.headers:
+            if n == name:
+                return v
+        return ""
+
+
+class H2ServerConn:
+    """Per-connection server state: the session + request assembly."""
+
+    def __init__(self, sock):
+        self.session = H2Session(is_server=True)
+        self.sock_id = sock.id
+        self._assembling: Dict[int, dict] = {}
+        self.ready: List[H2Request] = []
+        self.lock = threading.Lock()
+
+    def feed(self, data: bytes) -> None:
+        with self.lock:
+            events = self.session.feed(data)
+            for ev in events:
+                kind = ev[0]
+                if kind == "headers":
+                    _, sid, headers, end = ev
+                    st = self._assembling.setdefault(
+                        sid, {"headers": [], "body": bytearray()})
+                    if st["headers"]:
+                        st["trailers"] = headers      # request trailers
+                    else:
+                        st["headers"] = headers
+                    if end:
+                        self._complete(sid)
+                elif kind == "data":
+                    _, sid, body, end = ev
+                    st = self._assembling.get(sid)
+                    if st is None:
+                        continue
+                    st["body"] += body
+                    if len(st["body"]) > max_body_size():
+                        self.session.send_rst(sid, E_PROTOCOL)
+                        del self._assembling[sid]
+                        continue
+                    if end:
+                        self._complete(sid)
+                elif kind == "rst":
+                    self._assembling.pop(ev[1], None)
+
+    def _complete(self, sid: int) -> None:
+        st = self._assembling.pop(sid, None)
+        if st is None:
+            return
+        self.ready.append(H2Request(sid, st["headers"],
+                                    bytes(st["body"]), self))
+
+    # -- response writers (serialized by self.lock) -----------------------
+
+    def flush(self, sock) -> None:
+        # take_output must be under the lock: two responses finishing
+        # concurrently could otherwise clear each other's queued frames
+        with self.lock:
+            out = self.session.take_output()
+        if out and not sock.failed:
+            sock.write(IOBuf(out))
+
+    def send_grpc_response(self, sock, sid: int, payload: Optional[bytes],
+                           status: int, message: str = "") -> None:
+        with self.lock:
+            if status == 0 and payload is not None:
+                self.session.send_headers(sid, [
+                    (":status", "200"), ("content-type", GRPC_CT)])
+                self.session.send_data(sid, pack_grpc_message(payload))
+                self.session.send_headers(
+                    sid, [("grpc-status", "0")], end_stream=True)
+            else:
+                self.session.send_headers(sid, [
+                    (":status", "200"), ("content-type", GRPC_CT),
+                    ("grpc-status", str(status)),
+                    ("grpc-message", message or "")], end_stream=True)
+            self.session.close_stream(sid)
+        self.flush(sock)
+
+    def send_http_response(self, sock, sid: int, status: int, body: bytes,
+                           ctype: str = "text/plain",
+                           extra: Optional[List[Tuple[str, str]]] = None
+                           ) -> None:
+        with self.lock:
+            headers = [(":status", str(status)), ("content-type", ctype),
+                       ("content-length", str(len(body)))]
+            headers += list(extra or [])
+            self.session.send_headers(sid, headers, end_stream=not body)
+            if body:
+                self.session.send_data(sid, body, end_stream=True)
+            self.session.close_stream(sid)
+        self.flush(sock)
+
+
+def parse(source: IOBuf, sock, read_eof: bool, arg) -> ParseResult:
+    conn: Optional[H2ServerConn] = getattr(sock, "h2_conn", None)
+    if conn is None:
+        avail = len(source)
+        probe = source.fetch(min(len(PREFACE), avail))
+        if not PREFACE.startswith(probe):
+            return ParseResult.try_others()
+        if avail < len(PREFACE):
+            return ParseResult.not_enough_data()
+        conn = H2ServerConn(sock)
+        sock.h2_conn = conn
+    data = source.to_bytes()
+    source.clear()
+    try:
+        if data:
+            conn.feed(data)
+    except H2Error as e:
+        LOG.warning("h2 connection error: %s", e)
+        with conn.lock:
+            conn.session.send_goaway(e.code)
+        conn.flush(sock)
+        return ParseResult.absolutely_wrong()
+    conn.flush(sock)                      # settings acks, window updates
+    if conn.ready:
+        first = conn.ready.pop(0)
+        # one gulp can complete SEVERAL multiplexed streams, but the
+        # messenger collects one message per parse and stops at an empty
+        # source — dispatch the extras ourselves, one fiber each
+        if conn.ready:
+            from ..fiber import runtime as fiber_runtime
+            extras, conn.ready = conn.ready, []
+            for req in extras:
+                fiber_runtime.spawn(_process_request, req, sock, arg,
+                                    name="h2_request")
+        return ParseResult.make_message(first)
+    return ParseResult.not_enough_data()
+
+
+def _process_request(req: H2Request, sock, server) -> None:
+    ct = req.header("content-type")
+    if ct.startswith(GRPC_CT):
+        _process_grpc(req, sock, server)
+        return
+    # generic h2: builtin portal pages (the HTTP/1 path keeps the full
+    # JSON bridge; internal-port gating applies identically)
+    from ..protocol.http import HttpMessage
+    from ..server.builtin import route_builtin
+
+    path = req.header(":path")
+    msg = HttpMessage()
+    msg.is_request = True
+    msg.method = req.header(":method") or "GET"
+    msg.path, _, msg.query_string = path.partition("?")
+    msg.body = req.body
+    from ..server.http_dispatch import portal_restricted
+    parts = [p for p in msg.path.split("/") if p]
+    if portal_restricted(server, sock, parts[0] if parts else ""):
+        req.conn.send_http_response(sock, req.stream_id, 403,
+                                    b"restricted to the internal port\n")
+        return
+    try:
+        status, ctype, body, extra = route_builtin(server, msg)
+    except Exception as e:
+        LOG.exception("builtin page %s raised (h2)", path)
+        status, ctype, body, extra = 500, "text/plain", \
+            f"internal error: {e}\n".encode(), []
+    req.conn.send_http_response(sock, req.stream_id, status, body,
+                                ctype, extra)
+
+
+def _process_grpc(req: H2Request, sock, server) -> None:
+    from ..server.controller import ServerController
+    from ..protocol.meta import RpcMeta
+    from ..protocol.tpu_std import parse_payload, serialize_payload
+
+    path = req.header(":path")
+    parts = [p for p in path.split("/") if p]
+    if len(parts) != 2:
+        req.conn.send_grpc_response(sock, req.stream_id, None, 12,
+                                    f"malformed path {path!r}")
+        return
+    svc_full, method = parts
+    entry = server.find_method(svc_full, method)
+    if entry is None and "." in svc_full:
+        # grpc clients address /package.Service/Method; our registry is
+        # keyed by bare service name
+        entry = server.find_method(svc_full.rsplit(".", 1)[-1], method)
+    if entry is None:
+        req.conn.send_grpc_response(sock, req.stream_id, None, 12,
+                                    f"unknown method {path}")
+        return
+    if not server.on_request_in():
+        req.conn.send_grpc_response(sock, req.stream_id, None, 8,
+                                    "server max_concurrency")
+        return
+    if not entry.status.on_requested():
+        server.on_request_out()
+        req.conn.send_grpc_response(sock, req.stream_id, None, 8,
+                                    "method max_concurrency")
+        return
+
+    buf = bytearray(req.body)
+    try:
+        messages = unpack_grpc_messages(buf)
+    except H2Error as e:
+        entry.status.on_responded(int(Errno.EREQUEST), 0)
+        server.on_request_out()
+        req.conn.send_grpc_response(sock, req.stream_id, None, 12, str(e))
+        return
+    payload = messages[0] if messages else b""
+
+    meta = RpcMeta()
+    meta.service_name = svc_full
+    meta.method_name = method
+
+    def send(cntl: ServerController, response) -> None:
+        latency_us = monotonic_us() - cntl.begin_time_us
+        entry.status.on_responded(cntl.error_code, latency_us)
+        server.on_request_out()
+        if cntl.failed:
+            req.conn.send_grpc_response(
+                sock, req.stream_id, None,
+                grpc_status_of(cntl.error_code), cntl.error_text)
+            return
+        try:
+            body = serialize_payload(response).to_bytes()
+        except TypeError as e:
+            req.conn.send_grpc_response(sock, req.stream_id, None, 13,
+                                        f"serialize: {e}")
+            return
+        req.conn.send_grpc_response(sock, req.stream_id, body, 0)
+
+    cntl = ServerController(meta, sock.remote_side, sock.id, send)
+    cntl.server = server
+    try:
+        request = parse_payload(payload, entry.request_type)
+    except Exception as e:
+        cntl.set_failed(Errno.EREQUEST, f"request parse failed: {e}")
+        cntl.finish(None)
+        return
+    try:
+        response = entry.fn(cntl, request)
+    except Exception as e:
+        LOG.exception("grpc method %s raised", entry.status.full_name)
+        cntl.set_failed(Errno.EINTERNAL, f"{type(e).__name__}: {e}")
+        cntl.finish(None)
+        return
+    if cntl.is_async:
+        return
+    cntl.finish(response)
+
+
+H2 = Protocol(
+    ProtocolType.H2, "h2", parse,
+    process_request=_process_request,
+)
+register_protocol(H2)

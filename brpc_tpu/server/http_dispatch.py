@@ -23,6 +23,18 @@ from ..transport.socket import Socket
 from .controller import ServerController
 
 
+PUBLIC_BUILTIN_PAGES = ("health", "version")
+
+
+def portal_restricted(server, sock, first_segment: str) -> bool:
+    """True when builtin pages must be refused on this connection: an
+    internal port is configured, this connection is not on it, and the
+    page is not in the public allowlist (shared by HTTP/1 and h2)."""
+    return (server.options.internal_port >= 0
+            and getattr(sock, "tag", None) != "internal"
+            and first_segment not in PUBLIC_BUILTIN_PAGES)
+
+
 def handle_http_request(msg: HttpMessage, sock, server) -> None:
     path = msg.path.rstrip("/") or "/"
     parts = [p for p in path.split("/") if p]
@@ -40,9 +52,7 @@ def handle_http_request(msg: HttpMessage, sock, server) -> None:
     # With an internal port configured, operator pages are reachable only
     # through it (≈ reference's internal-port-only builtin services);
     # liveness probes stay public.
-    if server.options.internal_port >= 0 \
-            and getattr(sock, "tag", None) != "internal" \
-            and (not parts or parts[0] not in ("health", "version")):
+    if portal_restricted(server, sock, parts[0] if parts else ""):
         sock.write(build_response(
             403, b"builtin services are restricted to the internal port\n",
             keep_alive=msg.keep_alive))

@@ -184,6 +184,7 @@ class Server:
         # (≈ Server::BuildAcceptor collecting protocols, server.cpp:572);
         # importing the modules registers the builtins
         from ..ici import endpoint as _ici        # noqa: F401
+        from ..protocol import h2_rpc as _h2      # noqa: F401
         from ..protocol import http as _http      # noqa: F401
         from ..protocol import streaming as _str  # noqa: F401
         from ..protocol import tpu_std as _tpu    # noqa: F401

@@ -90,7 +90,7 @@ class Socket:
         "_pooled_home", "correlation_id",
         "stream_map", "_stream_lock", "tag",
         "ici_endpoint", "ici_peer_domain",
-        "direct_read", "_dispatch_lock",
+        "direct_read", "_dispatch_lock", "h2_conn",
     )
 
     # -- lifecycle ---------------------------------------------------------
@@ -133,6 +133,7 @@ class Socket:
         # dispatcher-driven mode for async use.
         self.direct_read = False
         self._dispatch_lock = threading.Lock()
+        self.h2_conn = None               # server-side HTTP/2 session state
 
     @staticmethod
     def create(options: SocketOptions) -> int:

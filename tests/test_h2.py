@@ -1,0 +1,119 @@
+"""HPACK + h2 session unit tests (RFC 7541/7540 vectors + loopback).
+The heavyweight conformance check is tests/test_grpc_interop.py (real
+grpcio as the oracle); these pin the primitives."""
+
+import pytest
+
+from brpc_tpu.protocol.hpack import (Decoder, Encoder, HpackError,
+                                     decode_int, encode_int,
+                                     huffman_decode, huffman_encode)
+from brpc_tpu.protocol.h2_session import PREFACE, H2Session
+
+
+def test_hpack_integer_rfc_examples():
+    # RFC 7541 C.1: 10 in 5-bit prefix; 1337 in 5-bit prefix
+    assert encode_int(10, 5) == b"\x0a"
+    assert encode_int(1337, 5) == b"\x1f\x9a\x0a"
+    assert decode_int(b"\x0a", 0, 5) == (10, 1)
+    assert decode_int(b"\x1f\x9a\x0a", 0, 5) == (1337, 3)
+
+
+def test_huffman_rfc_vectors():
+    # RFC 7541 C.4.1-C.4.3
+    assert huffman_encode(b"www.example.com").hex() == \
+        "f1e3c2e5f23a6ba0ab90f4ff"
+    assert huffman_encode(b"no-cache").hex() == "a8eb10649cbf"
+    assert huffman_decode(bytes.fromhex("25a849e95ba97d7f")) == \
+        b"custom-key"
+    assert huffman_decode(bytes.fromhex("25a849e95bb8e8b4bf")) == \
+        b"custom-value"
+
+
+def test_huffman_roundtrip_all_bytes():
+    data = bytes(range(256)) * 3
+    assert huffman_decode(huffman_encode(data)) == data
+
+
+def test_huffman_bad_padding_rejected():
+    with pytest.raises(HpackError):
+        huffman_decode(b"\x00")      # '0' bits of padding are invalid
+
+
+def test_hpack_dynamic_table_shrinks_repeat_headers():
+    e, d = Encoder(), Decoder()
+    hs = [(":status", "200"), ("x-long-header-name", "v" * 64)]
+    w1 = e.encode(hs)
+    w2 = e.encode(hs)
+    assert d.decode(w1) == hs
+    assert d.decode(w2) == hs
+    assert len(w2) < len(w1) // 4        # fully indexed second time
+
+
+def test_hpack_sensitive_headers_never_indexed():
+    e, d = Encoder(), Decoder()
+    hs = [("authorization", "Bearer tok")]
+    w1 = e.encode(hs)
+    w2 = e.encode(hs)
+    assert len(w2) >= len(w1) - 1        # no dynamic-table win
+    assert d.decode(w1) == hs and d.decode(w2) == hs
+
+
+def test_h2_session_loopback_request_response():
+    client = H2Session(is_server=False)
+    server = H2Session(is_server=True)
+    client.start()
+
+    sid = client.next_stream_id()
+    client.send_headers(sid, [(":method", "POST"), (":path", "/x")])
+    client.send_data(sid, b"hello", end_stream=True)
+
+    events = server.feed(client.take_output())
+    kinds = [e[0] for e in events]
+    assert "headers" in kinds and "data" in kinds
+    hev = next(e for e in events if e[0] == "headers")
+    assert (":path", "/x") in hev[2]
+    dev = next(e for e in events if e[0] == "data")
+    assert dev[2] == b"hello" and dev[3] is True
+
+    server.send_headers(sid, [(":status", "200")])
+    server.send_data(sid, b"world", end_stream=True)
+    revents = client.feed(server.take_output())
+    assert any(e[0] == "data" and e[2] == b"world" for e in revents)
+
+
+def test_h2_flow_control_blocks_and_resumes():
+    import struct
+
+    from brpc_tpu.protocol import h2_session as h2
+
+    client = H2Session(is_server=False)
+    client.start()
+    # pretend the peer acked settings and left the default 64KB windows
+    sid = client.next_stream_id()
+    client.send_headers(sid, [(":method", "POST"), (":path", "/big")])
+    client.take_output()
+    big = bytes(200_000)                 # > 65535 default window
+    client.send_data(sid, big, end_stream=True)
+    sent1 = client.take_output()
+    assert 0 < len(sent1) < len(big) + 1000   # clipped at the window
+    # grant more connection+stream window: the rest flushes
+    upd = struct.pack(">I", 150_000)
+    client.feed(b"")                     # no-op
+    client._on_frame(h2.F_WINDOW_UPDATE, 0, 0, upd, [])
+    client._on_frame(h2.F_WINDOW_UPDATE, 0, sid, upd, [])
+    sent2 = client.take_output()
+    total_payload = sum(len(f) for f in (sent1, sent2))
+    assert total_payload > len(big)      # everything (plus frame headers)
+
+
+def test_h2_ping_is_acked():
+    server = H2Session(is_server=True)
+    events = server.feed(PREFACE)
+    server.take_output()
+    import struct
+    ping = struct.pack(">I", 8)[1:] + bytes([0x6, 0x0]) + \
+        struct.pack(">I", 0) + b"12345678"
+    events = server.feed(ping)
+    assert ("ping", b"12345678") in events
+    out = server.take_output()
+    assert b"12345678" in out            # PING ACK echoed
