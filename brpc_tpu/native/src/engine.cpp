@@ -442,17 +442,9 @@ static void accept_conns(EngineImpl* eng, Loop* lp) {
       std::lock_guard<std::mutex> g(eng->cmu);
       eng->by_id[c->id] = c;
     }
-    if (target == lp) {
-      lp->conns[c->id] = c;
-      struct epoll_event ev;
-      ev.events = EPOLLIN;
-      ev.data.u64 = c->id;
-      epoll_ctl(lp->epfd, EPOLL_CTL_ADD, fd, &ev);
-    } else {
-      std::lock_guard<std::mutex> g(target->mu);
-      target->pending_out.push_back(c->id | (1ull << 63));  // adopt marker
-      loop_wake(target);
-    }
+    // EV_OPEN MUST be dispatched before the fd reaches any epoll: once a
+    // loop can read the first frame, EV_MESSAGE may race ahead of the
+    // bridge learning the connection and the request would be dropped.
     {
       PyGILState_STATE gs = PyGILState_Ensure();
       flush_decrefs_locked_gil(lp);
@@ -465,6 +457,17 @@ static void accept_conns(EngineImpl* eng, Loop* lp) {
       else
         Py_DECREF(r);
       PyGILState_Release(gs);
+    }
+    if (target == lp) {
+      lp->conns[c->id] = c;
+      struct epoll_event ev;
+      ev.events = EPOLLIN;
+      ev.data.u64 = c->id;
+      epoll_ctl(lp->epfd, EPOLL_CTL_ADD, fd, &ev);
+    } else {
+      std::lock_guard<std::mutex> g(target->mu);
+      target->pending_out.push_back(c->id | (1ull << 63));  // adopt marker
+      loop_wake(target);
     }
   }
 }

@@ -27,3 +27,31 @@ except ImportError:
     pass
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import pytest  # noqa: E402
+
+
+def require_native():
+    """Skip the calling test when the native engine can't build."""
+    from brpc_tpu.native import available
+    if not available():
+        pytest.skip("native engine unavailable (no toolchain)")
+
+
+@pytest.fixture(scope="session", params=[False, True], ids=["py", "native"])
+def native_mode(request):
+    """Run server-backed suites over both transports: the pure-Python
+    path and the native C++ IO engine (built on demand; the reference
+    tests Socket/InputMessenger directly — brpc_socket_unittest.cpp)."""
+    if request.param:
+        require_native()
+    return request.param
+
+
+@pytest.fixture()
+def server_options(native_mode):
+    """ServerOptions pre-configured for the current transport param."""
+    from brpc_tpu.server import ServerOptions
+    opts = ServerOptions()
+    opts.native = native_mode
+    return opts

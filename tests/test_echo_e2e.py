@@ -47,8 +47,11 @@ class EchoService(Service):
 
 
 @pytest.fixture(scope="module")
-def server():
-    srv = Server()
+def server(native_mode):
+    # module-scoped: cannot use the function-scoped server_options fixture
+    opts = ServerOptions()
+    opts.native = native_mode
+    srv = Server(opts)
     assert srv.add_service(EchoService()) == 0
     assert srv.start("127.0.0.1:0") == 0
     yield srv

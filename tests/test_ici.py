@@ -39,8 +39,8 @@ class TensorEcho(Service):
 
 
 @pytest.fixture()
-def server():
-    srv = Server()
+def server(server_options):
+    srv = Server(server_options)
     srv.add_service(TensorEcho(), name="TE")
     assert srv.start("127.0.0.1:0") == 0
     yield srv

@@ -50,8 +50,8 @@ class StreamEcho(Service):
 
 
 @pytest.fixture()
-def server():
-    srv = Server()
+def server(server_options):
+    srv = Server(server_options)
     srv.add_service(StreamEcho(), name="SE")
     assert srv.start("127.0.0.1:0") == 0
     yield srv
