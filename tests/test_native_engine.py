@@ -235,10 +235,17 @@ def test_engine_stats_progress(nserver):
         _read_frame(s)
     finally:
         s.close()
-    after = eng.stats()
-    assert after["messages"] > before["messages"]
-    assert after["bytes_in"] > before["bytes_in"]
-    assert after["bytes_out"] > before["bytes_out"]
+    # the loop thread bumps bytes_out after writev returns — the client
+    # can observe the response bytes first, so poll briefly
+    deadline = time.monotonic() + 2.0
+    while time.monotonic() < deadline:
+        after = eng.stats()
+        if (after["messages"] > before["messages"]
+                and after["bytes_in"] > before["bytes_in"]
+                and after["bytes_out"] > before["bytes_out"]):
+            return
+        time.sleep(0.005)
+    raise AssertionError(f"stats did not progress: {before} -> {after}")
 
 
 def test_pipelined_burst(nserver):
