@@ -35,23 +35,29 @@ class Block:
     ``size`` is the filled prefix; only the filled prefix may be referenced.
     """
 
-    __slots__ = ("data", "size", "capacity", "pool", "_mv", "__weakref__")
+    __slots__ = ("data", "size", "capacity", "pool", "__weakref__")
 
     def __init__(self, data, size: int, pool: Optional["BlockPool"] = None):
         self.data = data
         self.size = size
         self.capacity = len(data)
         self.pool = pool
-        self._mv = None  # lazily created memoryview over data
 
     @property
     def left_space(self) -> int:
         return self.capacity - self.size
 
+    def __buffer__(self, flags: int) -> memoryview:
+        # PEP 688: the Block itself is the buffer exporter, so every view
+        # handed out keeps the BLOCK (not just its bytearray) alive — the
+        # recycling finalizer cannot fire while zero-copy views exist
+        # anywhere (write queues, the native engine's pinned Py_buffers).
+        return memoryview(self.data)
+
     def view(self, offset: int, length: int) -> memoryview:
-        if self._mv is None:
-            self._mv = memoryview(self.data)
-        return self._mv[offset : offset + length]
+        # no caching: a Block-held memoryview(self) would be a reference
+        # cycle, deferring recycling to the cycle collector
+        return memoryview(self)[offset : offset + length]
 
 
 class BlockPool:

@@ -314,6 +314,10 @@ class Controller:
         meta.method_name = mth
         meta.trace_id = self.trace_id
         meta.span_id = self.span_id
+        if self._channel is not None and self._channel.options.auth_data:
+            # credentials ride every frame; the server verifies on the
+            # connection's first message (≈ Protocol::verify)
+            meta.auth_data = self._channel.options.auth_data
         if self._stream_to_create is not None:
             meta.stream_id = self._stream_to_create.id
             meta.stream_window = \

@@ -1,0 +1,459 @@
+"""Fast unary call lane — the client latency hot path.
+
+≈ the reference's single-digit-µs per-call discipline
+(/root/reference/docs/cn/benchmark.md:57: 200-300ns handler cost, most of
+the round trip spent in the kernel).  The general Controller path costs a
+correlation-id rendezvous, IOBuf framing, protocol detection and several
+cross-thread wakeups per call; an echo-class unary RPC on an exclusive
+(pooled/short) connection needs none of that:
+
+- the frame is built as one flat ``bytes`` from cached method TLVs,
+- the request/response round trip runs inside the native engine's
+  ``sync_call`` (writev + read-one-frame with the GIL released); a pure
+  Python fallback keeps the lane working without the toolchain,
+- the response is decoded inline on the calling thread.
+
+Anything unusual (streams, device attachments, compression, backup
+requests, async ``done``, non-tpu_std wire) is rejected by
+:func:`eligible` and flows through the full Controller state machine.
+Retriable failures retry *inside* the lane with the same policy and
+excluded-servers bookkeeping as the slow path.
+"""
+
+from __future__ import annotations
+
+import select as _select
+import struct
+from typing import Any, Optional, Tuple
+
+from ..butil.iobuf import IOBuf
+from ..butil.status import Errno
+from ..butil.time_utils import monotonic_us
+from ..transport.socket import Socket
+from ..transport.socket_map import (pooled_socket, return_pooled_socket,
+                                    short_socket)
+
+from ..protocol.meta import (TAG_AUTH, TAG_ICI_DOMAIN, TAG_METHOD,
+                             TAG_SERVICE, TLV_ATTACHMENT, TLV_CORRELATION,
+                             TLV_SPAN, TLV_TIMEOUT, TLV_TRACE, encode_tlv)
+
+_MAGIC = b"TRPC"
+_CID_TAG = TLV_CORRELATION
+_ATT_TAG = TLV_ATTACHMENT
+_TMO_TAG = TLV_TIMEOUT
+
+_native_mod: Optional[object] = None
+_native_tried = False
+
+
+def _native():
+    global _native_mod, _native_tried
+    if not _native_tried:
+        _native_tried = True
+        try:
+            from ..native import load
+            _native_mod = load()
+        except Exception:
+            _native_mod = None
+    return _native_mod
+
+
+_fast_cid = 0x46_0000_0000            # distinct range from the IdPool's ids
+
+
+def _next_cid() -> int:
+    global _fast_cid
+    _fast_cid += 1
+    return _fast_cid
+
+
+def method_tlv(method_full: str) -> bytes:
+    """Pre-encoded service+method TLV bytes (cached on the Channel)."""
+    svc, _, mth = method_full.rpartition(".")
+    return (encode_tlv(TAG_SERVICE, svc.encode())
+            + encode_tlv(TAG_METHOD, mth.encode()))
+
+
+def eligible(channel, cntl) -> bool:
+    """Cheap static screen; runtime conditions re-checked in run()."""
+    opts = channel.options
+    ctype = cntl.connection_type or opts.connection_type
+    return (opts.protocol == "tpu_std"
+            and ctype in ("pooled", "short")
+            and not cntl.request_compress_type
+            and cntl.request_device_attachment is None
+            and cntl._stream_to_create is None
+            and (cntl.backup_request_ms is None
+                 or cntl.backup_request_ms <= 0)
+            and (opts.backup_request_ms is None
+                 or opts.backup_request_ms <= 0))
+
+
+def _py_sync_call(sock, frame: bytes,
+                  timeout_s: float) -> Tuple[memoryview, int]:
+    """Python fallback for native sync_call: same single-frame contract."""
+    import time as _time
+    deadline = _time.monotonic() + timeout_s if timeout_s >= 0 else None
+    fd = sock.fd
+    view = memoryview(frame)
+    while view:
+        try:
+            n = fd.send(view)
+            view = view[n:]
+        except BlockingIOError:
+            left = None if deadline is None else deadline - _time.monotonic()
+            if left is not None and left <= 0:
+                raise TimeoutError("rpc deadline exceeded")
+            _select.select([], [fd], [], left)
+    buf = bytearray()
+    need = 12
+    body = meta = 0
+    while True:
+        left = None if deadline is None else deadline - _time.monotonic()
+        if left is not None and left <= 0:
+            raise TimeoutError("rpc deadline exceeded")
+        r, _, _ = _select.select([fd], [], [], left)
+        if not r:
+            raise TimeoutError("rpc deadline exceeded")
+        try:
+            chunk = fd.recv(65536 if need <= 65536 else need)
+        except BlockingIOError:
+            continue
+        if not chunk:
+            raise ConnectionError("connection closed by peer")
+        buf += chunk
+        if body == 0 and len(buf) >= 12:
+            if buf[:4] != _MAGIC:
+                raise ValueError("unexpected magic on fast-path read")
+            body, meta = struct.unpack_from("<II", buf, 4)
+            if meta > body:
+                raise ValueError("bad frame sizes")
+            need = 12 + body
+        if body and len(buf) >= 12 + body:
+            return memoryview(buf)[12:12 + body], meta
+
+
+def run(channel, cntl, method_full: str, request: Any,
+        response_type: Any, method_tlvs: bytes) -> None:
+    """Complete the RPC on the calling thread.  Fills ``cntl`` exactly
+    like the Controller slow path (response, attachments, error state,
+    latency, LB feedback) and sets ``cntl._ended``.  Raises TypeError
+    for unserializable requests (caller maps it to EREQUEST)."""
+    opts = channel.options
+    if cntl.timeout_ms is None:
+        cntl.timeout_ms = opts.timeout_ms
+    if cntl.max_retry is None:
+        cntl.max_retry = opts.max_retry
+    if cntl.connection_type is None:
+        cntl.connection_type = opts.connection_type
+    begin = monotonic_us()
+    cntl._begin_us = begin
+    timeout_ms = cntl.timeout_ms
+    deadline_us = begin + timeout_ms * 1000 \
+        if timeout_ms and timeout_ms > 0 else None
+
+    if isinstance(request, (bytes, bytearray, memoryview)):
+        payload_b = request
+    else:
+        from ..protocol.tpu_std import serialize_payload
+        payload_b = serialize_payload(request).to_bytes()
+    att = cntl.request_attachment
+    att_parts: Tuple = ()
+    att_len = 0
+    if att is not None and len(att):
+        # large attachments ride as scatter-gather views — no flattening
+        att_parts = tuple(att.backing_views())
+        att_len = len(att)
+        if len(att_parts) > 56:
+            # sync_call caps the iovec count; a many-block attachment
+            # flattens rather than poisoning the socket with a ValueError
+            att_parts = (att.to_bytes(),)
+
+    from ..ici.endpoint import ici_enabled, local_domain_id
+    domain = local_domain_id() if ici_enabled() else b""
+    auth = opts.auth_data or b""
+
+    nat = _native()
+    pooled = cntl.connection_type == "pooled"
+    nretry = 0
+
+    while True:
+        # -- target selection (mirrors Controller._select_remote) --
+        if channel.load_balancer is not None:
+            remote = channel.load_balancer.select_server(cntl)
+        else:
+            remote = channel.single_server
+        if remote is None:
+            _finish(channel, cntl, Errno.EINTERNAL, "no server available")
+            return
+        cntl.remote_side = remote
+        cntl.attempt_remotes[nretry] = remote
+
+        sid, rc = pooled_socket(remote) if pooled else short_socket(remote)
+        sock = Socket.address(sid)
+        code, text = 0, ""
+        if sock is None or (rc != 0 and sock.failed):
+            code, text = int(Errno.EFAILEDSOCKET), f"connect to {remote} failed"
+        elif sock.fd is None and sock.connect_if_not() != 0:
+            code, text = int(Errno.EFAILEDSOCKET), f"connect to {remote} failed"
+        elif not sock.direct_read or not sock.read_portal.empty():
+            # converted to dispatcher-managed reads (an async call used
+            # it) or carrying buffered bytes: this lane cannot own the
+            # reads — route the call through the full state machine
+            if sock is not None:
+                if pooled:
+                    return_pooled_socket(sid)
+                else:
+                    sock.release()
+            _slow_path(channel, cntl, method_full, request, response_type)
+            return
+
+        if code == 0:
+            cid = _next_cid()
+            mb = bytearray(_CID_TAG)
+            mb += struct.pack("<Q", cid)
+            if att_len:
+                mb += _ATT_TAG + struct.pack("<I", att_len)
+            mb += method_tlvs
+            if auth and getattr(sock, "app_data", None) is None:
+                mb += encode_tlv(TAG_AUTH, auth)
+                sock.app_data = "authed"
+            if deadline_us is not None:
+                left_ms = max(1, int((deadline_us - monotonic_us()) // 1000))
+                mb += _TMO_TAG + struct.pack("<I", left_ms)
+            if domain:
+                mb += encode_tlv(TAG_ICI_DOMAIN, domain)
+            if cntl.trace_id:
+                mb += TLV_TRACE + struct.pack("<Q", cntl.trace_id)
+            if cntl.span_id:
+                mb += TLV_SPAN + struct.pack("<Q", cntl.span_id)
+            header = _MAGIC + struct.pack(
+                "<II", len(mb) + len(payload_b) + att_len, len(mb))
+            timeout_s = -1.0 if deadline_us is None \
+                else max(0.001, (deadline_us - monotonic_us()) / 1e6)
+            try:
+                if nat is not None:
+                    buf, meta_size = nat.sync_call(
+                        sock.fd.fileno(),
+                        (header, bytes(mb), payload_b) + att_parts,
+                        timeout_s)
+                else:
+                    buf, meta_size = _py_sync_call(
+                        sock,
+                        b"".join((header, bytes(mb), payload_b, *att_parts)),
+                        timeout_s)
+            except TimeoutError:
+                sock.set_failed(Errno.ERPCTIMEDOUT, "rpc timeout")
+                sock.release()
+                _finish(channel, cntl, Errno.ERPCTIMEDOUT,
+                        f"deadline {timeout_ms}ms exceeded")
+                return
+            except (ConnectionError, ValueError, OSError) as e:
+                sock.set_failed(Errno.EFAILEDSOCKET, str(e))
+                sock.release()
+                code, text = int(Errno.EFAILEDSOCKET), str(e)
+
+        if code == 0:
+            done, code, text = _handle_response(
+                channel, cntl, sock, sid, pooled, buf, meta_size, cid,
+                response_type)
+            if done:
+                return
+
+        # -- retriable failure: mirror Controller._retry_locked --
+        cntl.excluded_servers.add(remote)
+        if cntl.retry_policy(cntl, code) and nretry < cntl.max_retry:
+            nretry += 1
+            cntl.retried_count = nretry
+            if deadline_us is not None and monotonic_us() >= deadline_us:
+                _finish(channel, cntl, Errno.ERPCTIMEDOUT,
+                        f"deadline {timeout_ms}ms exceeded")
+                return
+            continue
+        _finish(channel, cntl, code, text)
+        return
+
+
+def _handle_response(channel, cntl, sock, sid: int, pooled: bool, buf,
+                     meta_size: int, cid: int,
+                     response_type: Any) -> Tuple[bool, int, str]:
+    """Decode one response frame.  Returns (done, code, text); done=False
+    means a retriable failure the caller's loop should handle."""
+    from ..protocol.meta import RpcMeta
+    mv = memoryview(buf)
+    meta = RpcMeta.decode(bytes(mv[:meta_size]))
+    if meta is None or meta.correlation_id != cid:
+        sock.set_failed(Errno.ERESPONSE, "undecodable response meta")
+        sock.release()
+        return False, int(Errno.EFAILEDSOCKET), "undecodable response"
+    if meta.ici_domain:
+        sock.ici_peer_domain = meta.ici_domain
+    if meta.error_code:
+        # full frame consumed — the connection itself is healthy
+        if pooled:
+            return_pooled_socket(sid)
+        else:
+            sock.release()
+        return False, meta.error_code, meta.error_text
+    body = mv[meta_size:]
+    attachment = IOBuf()
+    if meta.attachment_size:
+        n = meta.attachment_size
+        if 0 < n <= len(body):
+            # zero-copy: the attachment view keeps the frame buffer alive
+            attachment.append_user_data(body[len(body) - n:])
+            body = body[:len(body) - n]
+    if meta.ici_desc:
+        from ..ici.endpoint import split_device_attachment
+        attachment, cntl.response_device_attachment = \
+            split_device_attachment(meta, attachment, sid)
+    raw = bytes(body)
+    if meta.compress_type:
+        from ..protocol import compress as compress_mod
+        raw = compress_mod.decompress(raw, meta.compress_type)
+        if raw is None:
+            if pooled:
+                return_pooled_socket(sid)
+            else:
+                sock.release()
+            _finish(channel, cntl, Errno.ERESPONSE,
+                    "undecompressable response")
+            return True, 0, ""
+    from ..protocol.tpu_std import parse_payload
+    try:
+        cntl.response = parse_payload(raw, response_type)
+    except Exception as e:
+        if pooled:
+            return_pooled_socket(sid)
+        else:
+            sock.release()
+        _finish(channel, cntl, Errno.ERESPONSE,
+                f"response parse failed: {e}")
+        return True, 0, ""
+    cntl.response_attachment = attachment
+    if pooled:
+        return_pooled_socket(sid)
+    else:
+        sock.release()
+    _finish(channel, cntl, 0, "")
+    return True, 0, ""
+
+
+def _finish(channel, cntl, code, text: str) -> None:
+    if code:
+        cntl.set_failed(code, text)
+    cntl.latency_us = monotonic_us() - cntl._begin_us
+    if channel.load_balancer is not None:
+        channel.load_balancer.feedback(cntl)
+    cntl._ended.set()
+
+
+def _slow_path(channel, cntl, method_full, request, response_type) -> None:
+    """Escape hatch: run the full Controller machinery."""
+    from ..protocol.tpu_std import serialize_payload
+    payload = serialize_payload(request)
+    cntl._launch(channel, method_full, payload, response_type, None)
+    cntl._sync_wait()
+
+
+def run_batch(channel, method_full: str, requests, response_type: Any,
+              timeout_ms: Optional[int], method_tlvs: bytes):
+    """Pipelined batch of unary calls on ONE exclusive connection: all
+    frames written in one vectored send, responses matched by
+    correlation id (the server may answer out of order when user code
+    runs on fibers).  Raises RpcError on the first failed sub-call or on
+    transport failure — batch is the perf lane, not the resilience lane.
+    """
+    from ..protocol.meta import RpcMeta
+    from ..protocol.tpu_std import parse_payload, serialize_payload
+    from .channel import RpcError
+
+    if timeout_ms is None:
+        timeout_ms = channel.options.timeout_ms
+    remote = channel.single_server
+    if remote is None:
+        # cluster channel: batching across servers loses the single-
+        # connection pipelining anyway — fall back to per-call
+        return [channel.call(method_full, r, response_type,
+                             timeout_ms=timeout_ms) for r in requests]
+    sid, rc = pooled_socket(remote)
+    sock = Socket.address(sid)
+    if sock is None or (rc != 0 and sock.failed) \
+            or (sock.fd is None and sock.connect_if_not() != 0):
+        if sock is not None:
+            sock.release()
+        raise RpcError(int(Errno.EFAILEDSOCKET),
+                       f"connect to {remote} failed")
+    if not sock.direct_read or not sock.read_portal.empty():
+        return_pooled_socket(sid)
+        return [channel.call(method_full, r, response_type,
+                             timeout_ms=timeout_ms) for r in requests]
+
+    parts = []
+    cids = []
+    tmo_tlv = _TMO_TAG + struct.pack("<I", max(1, timeout_ms)) \
+        if timeout_ms and timeout_ms > 0 else b""
+    auth = channel.options.auth_data or b""
+    auth_tlv = b""
+    if auth and getattr(sock, "app_data", None) is None:
+        # credentials ride the connection's first message (server verifies
+        # once per connection)
+        auth_tlv = encode_tlv(TAG_AUTH, auth)
+        sock.app_data = "authed"
+    for req in requests:
+        if isinstance(req, (bytes, bytearray, memoryview)):
+            pb = req
+        else:
+            pb = serialize_payload(req).to_bytes()
+        cid = _next_cid()
+        cids.append(cid)
+        mb = _CID_TAG + struct.pack("<Q", cid) + method_tlvs \
+            + auth_tlv + tmo_tlv
+        auth_tlv = b""                       # first message only
+        parts.append(_MAGIC + struct.pack("<II", len(mb) + len(pb), len(mb))
+                     + mb)
+        parts.append(pb)
+    timeout_s = timeout_ms / 1e3 if timeout_ms and timeout_ms > 0 else -1.0
+    nat = _native()
+    try:
+        if nat is not None:
+            frames = nat.sync_call_many(sock.fd.fileno(), parts,
+                                        len(cids), timeout_s)
+        else:
+            frames = []
+            it = iter(range(len(cids)))
+            for i in it:
+                frames.append(_py_sync_call(
+                    sock, parts[2 * i] + parts[2 * i + 1], timeout_s))
+    except (TimeoutError, ConnectionError, ValueError, OSError) as e:
+        sock.set_failed(Errno.EFAILEDSOCKET, str(e))
+        sock.release()
+        code = Errno.ERPCTIMEDOUT if isinstance(e, TimeoutError) \
+            else Errno.EFAILEDSOCKET
+        raise RpcError(int(code), str(e)) from None
+
+    by_cid = {}
+    first_error = None
+    for buf, meta_size in frames:
+        mv = memoryview(buf)
+        meta = RpcMeta.decode(bytes(mv[:meta_size]))
+        if meta is None:
+            sock.set_failed(Errno.ERESPONSE, "undecodable batch response")
+            sock.release()
+            raise RpcError(int(Errno.ERESPONSE), "undecodable batch response")
+        if meta.error_code and first_error is None:
+            first_error = (meta.error_code, meta.error_text)
+        body = mv[meta_size:]
+        if meta.attachment_size and 0 < meta.attachment_size <= len(body):
+            body = body[:len(body) - meta.attachment_size]
+        by_cid[meta.correlation_id] = bytes(body)
+    return_pooled_socket(sid)
+    if first_error is not None:
+        raise RpcError(first_error[0], first_error[1])
+    out = []
+    for cid in cids:
+        if cid not in by_cid:
+            raise RpcError(int(Errno.ERESPONSE),
+                           "batch response missing a correlation id")
+        out.append(parse_payload(by_cid[cid], response_type))
+    return out

@@ -42,6 +42,24 @@ class CompressType:
     SNAPPY = 3
 
 
+def encode_tlv(tag: int, data: bytes) -> bytes:
+    """One TLV field as wire bytes (for pre-encoded fast paths)."""
+    return bytes([tag]) + struct.pack("<I", len(data)) + data
+
+
+# pre-encoded TLV prefixes for the latency fast paths (client fast_call,
+# server fast response) — single source of truth with the tag registry
+TLV_CORRELATION = b"\x01\x08\x00\x00\x00"   # _T_CORRELATION, u64 follows
+TLV_ATTACHMENT = b"\x03\x04\x00\x00\x00"    # _T_ATTACHMENT, u32 follows
+TLV_TIMEOUT = b"\x0d\x04\x00\x00\x00"       # _T_TIMEOUT_MS, u32 follows
+TLV_TRACE = b"\x09\x08\x00\x00\x00"         # _T_TRACE_ID, u64 follows
+TLV_SPAN = b"\x0a\x08\x00\x00\x00"          # _T_SPAN_ID, u64 follows
+TAG_SERVICE = _T_SERVICE
+TAG_METHOD = _T_METHOD
+TAG_AUTH = _T_AUTH
+TAG_ICI_DOMAIN = _T_ICI_DOMAIN
+
+
 class RpcMeta:
     __slots__ = ("correlation_id", "compress_type", "attachment_size",
                  "service_name", "method_name", "error_code", "error_text",

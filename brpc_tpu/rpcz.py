@@ -23,6 +23,9 @@ from .bvar.collector import Collected, Collector
 define_flag("enable_rpcz", True, "collect per-RPC spans", any_value)
 define_flag("rpcz_keep_spans", 2048, "max spans kept in memory",
             lambda v: v > 0)
+define_flag("rpcz_max_samples_per_second", 1000,
+            "rpcz sampling budget (traced calls always record)",
+            lambda v: int(v) >= 0)
 
 _span_seq = itertools.count(1)
 
@@ -128,10 +131,29 @@ def rpcz_enabled() -> bool:
     return bool(get_flag("enable_rpcz", True))
 
 
+_sample_window = [0.0, 0, 1000]    # window start (s), taken, budget
+
+
 def start_server_span(full_method: str, meta, remote_side) -> Optional[Span]:
-    """Called by the dispatch layer per request (None when disabled)."""
+    """Called by the dispatch layer per request (None when disabled or
+    over the sampling budget).  Like the reference's Collector-budgeted
+    rpcz sampling (/root/reference/src/bvar/collector.cpp), at most
+    ``rpcz_max_samples_per_second`` spans are recorded per second so
+    tracing never dominates the request path; traced calls (non-zero
+    trace_id) always record."""
     if not rpcz_enabled():
         return None
+    w = _sample_window
+    if not meta.trace_id:
+        import time as _time
+        now = _time.monotonic()
+        if now - w[0] >= 1.0:
+            w[0] = now
+            w[1] = 0
+            w[2] = int(get_flag("rpcz_max_samples_per_second", 1000))
+        if w[1] >= w[2]:
+            return None
+        w[1] += 1
     span = Span(full_method, trace_id=meta.trace_id,
                 parent_span_id=meta.span_id, is_server=True)
     span.remote_side = str(remote_side or "")

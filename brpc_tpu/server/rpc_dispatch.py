@@ -35,6 +35,29 @@ def _send_error(sock: Socket, correlation_id: int, code: int,
     sock.write(pack_frame(meta, IOBuf()))
 
 
+import struct as _struct
+
+from ..protocol.meta import (TAG_ICI_DOMAIN, TLV_ATTACHMENT,
+                             TLV_CORRELATION, encode_tlv)
+
+_CID_TAG = TLV_CORRELATION
+_ATT_TAG = TLV_ATTACHMENT
+_domain_tlv_cache = None
+
+
+def _domain_tlv() -> bytes:
+    """Pre-encoded T_ICI_DOMAIN TLV for this process (empty when ici is
+    off).  The domain id is fixed per process, so encode it once."""
+    global _domain_tlv_cache
+    if _domain_tlv_cache is None:
+        from ..ici.endpoint import ici_enabled, local_domain_id
+        if ici_enabled():
+            _domain_tlv_cache = encode_tlv(TAG_ICI_DOMAIN, local_domain_id())
+        else:
+            _domain_tlv_cache = b""
+    return _domain_tlv_cache
+
+
 def _send_response(server, entry, cntl: ServerController,
                    response: Any) -> None:
     sock = Socket.address(cntl.socket_id)
@@ -43,6 +66,28 @@ def _send_response(server, entry, cntl: ServerController,
     server.on_request_out()
     if cntl.span is not None:
         cntl.span.finish(cntl.error_code)
+    elif (not cntl.failed and sock is not None
+            and not cntl._accepted_stream_id
+            and not cntl.response_compress_type
+            and cntl.response_device_attachment is None
+            and isinstance(response, (bytes, bytearray, memoryview))):
+        # echo-class fast path: flat TLV meta, no IOBuf/RpcMeta churn
+        att = cntl.response_attachment
+        na = len(att) if att is not None else 0
+        mb = _CID_TAG + _struct.pack("<Q", cntl.request_meta.correlation_id)
+        if na:
+            mb += _ATT_TAG + _struct.pack("<I", na)
+        if cntl.request_meta.ici_domain:
+            # answer the device-fabric domain exchange (cached TLV)
+            mb += _domain_tlv()
+        head = (b"TRPC"
+                + _struct.pack("<II", len(mb) + len(response) + na, len(mb))
+                + mb)
+        if na:
+            sock.write_parts((head, response) + tuple(att.backing_views()))
+        else:
+            sock.write_parts((head, response))
+        return
     if cntl._accepted_stream_id and (cntl.failed or sock is None):
         # the client will never bind: close the orphaned accepted stream
         from ..streaming import find_stream

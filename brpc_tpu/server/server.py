@@ -32,7 +32,7 @@ class ServerOptions:
     __slots__ = ("num_workers", "max_concurrency", "method_max_concurrency",
                  "auth", "interceptor", "idle_timeout_s",
                  "internal_port", "server_info_name",
-                 "native", "native_loops")
+                 "native", "native_loops", "usercode_inline")
 
     def __init__(self):
         self.num_workers = 0            # 0 = leave fiber runtime defaults
@@ -50,6 +50,12 @@ class ServerOptions:
         # Falls back to the Python transport if the engine can't build.
         self.native = False
         self.native_loops = 2
+        # run user code directly on the native engine's IO thread instead
+        # of a fiber (≈ the reference's usercode_in_pthread,
+        # /root/reference/src/brpc/details/usercode_backup_pool.h): saves a
+        # thread handoff per request — the echo-class latency fast path.
+        # Only enable when handlers never block (or begin_async() early).
+        self.usercode_inline = False
 
 
 class _MethodEntry:

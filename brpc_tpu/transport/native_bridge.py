@@ -46,6 +46,24 @@ class NativeSocket(Socket):
         self.engine = None
         self.conn_id = 0
 
+    def write_parts(self, parts, id_wait: int = 0) -> int:
+        if self._failed:
+            code = self._error_code or int(Errno.EFAILEDSOCKET)
+            if id_wait:
+                from ..fiber.versioned_id import global_id_pool
+                global_id_pool().error(id_wait, code, self._error_text)
+            return code
+        try:
+            self.engine.send(self.conn_id, parts)
+            return 0
+        except ConnectionError as e:
+            self.set_failed(Errno.EFAILEDSOCKET, str(e))
+            if id_wait:
+                from ..fiber.versioned_id import global_id_pool
+                global_id_pool().error(id_wait, int(Errno.EFAILEDSOCKET),
+                                       str(e))
+            return int(Errno.EFAILEDSOCKET)
+
     def write(self, buf: IOBuf, id_wait: int = 0) -> int:
         if self._failed:
             code = self._error_code or int(Errno.EFAILEDSOCKET)
@@ -149,9 +167,15 @@ class NativeBridge:
         if len(buf) > meta_size:
             payload.append_user_data(mv[meta_size:])   # zero-copy ingest
         msg = RpcMessage(meta, payload, sock.id)
+        from ..server.rpc_dispatch import process_rpc_request
+        if self._server.options.usercode_inline:
+            # run user code on the IO loop thread: zero handoffs between
+            # frame cut and response write (the latency fast path; any
+            # blocking handler stalls this loop — that's the contract)
+            process_rpc_request(msg, sock, self._server)
+            return
         # service code runs on the fiber pool, never on the IO loop
         # (≈ InputMessenger starting a bthread per message batch)
-        from ..server.rpc_dispatch import process_rpc_request
         fiber_runtime.spawn(process_rpc_request, msg, sock, self._server,
                             name="native_rpc")
 

@@ -299,6 +299,16 @@ class Socket:
                                     name="keep_write")
         return 0
 
+    def write_parts(self, parts, id_wait: int = 0) -> int:
+        """Queue pre-framed byte parts for write (fast response path —
+        skips per-part IOBuf assembly on transports that can scatter-
+        gather natively; here it wraps the parts zero-copy)."""
+        buf = IOBuf()
+        for p in parts:
+            if len(p):
+                buf.append_user_data(p)
+        return self.write(buf, id_wait)
+
     def _drain_once(self, epoch: int) -> bool:
         """Try to flush the queue without blocking. Returns True when done
         with the drainer role (queue empty, socket failed, or the role was

@@ -38,7 +38,10 @@ def test_append_large_bytes_attaches_zero_copy():
     assert len(b) == len(payload)
     assert bytes(b) == payload
     assert b.backing_block_count == 1
-    assert b.backing_views()[0].obj is payload
+    # zero-copy: the block's storage IS the payload object (views now
+    # export via the Block so recycling can't outrun them — their .obj
+    # is the block wrapper, not the storage)
+    assert b._refs[0][0].data is payload
 
 
 def test_append_spanning_blocks():
@@ -80,8 +83,9 @@ def test_append_user_data_zero_copy():
     b.append_user_data(memoryview(payload))
     assert len(b) == 100000
     assert b.backing_block_count == 1
-    # underlying storage is the same object (zero-copy)
-    assert b.backing_views()[0].obj is payload
+    # zero-copy: mutating the user buffer is visible through the view
+    payload[0:1] = b"A"
+    assert bytes(b.backing_views()[0][:1]) == b"A"
 
 
 def test_cutn():
@@ -245,3 +249,19 @@ def test_multithreaded_append_isolation():
         t.join()
     for tid, data in results.items():
         assert data == bytes([tid]) * 3500
+
+
+def test_views_pin_blocks_against_recycling():
+    """Regression: zero-copy views must keep the BLOCK alive — pool
+    recycling must never hand a live view's storage to a new IOBuf
+    (this corrupted deferred native writes: all pipelined responses
+    became the last frame)."""
+    import gc
+    b = IOBuf(b"A" * 1000)
+    views = b.backing_views()
+    del b
+    gc.collect()
+    # churn the pool hard: any recycled storage would be overwritten
+    for i in range(64):
+        IOBuf(bytes([i]) * 1000)
+    assert bytes(views[0]) == b"A" * 1000
