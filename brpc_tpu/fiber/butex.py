@@ -45,9 +45,13 @@ class Butex:
                 return True
             g = self._gen
             with blocking():
-                return self._cond.wait_for(
+                from .. import profiling
+                waitfn = lambda: self._cond.wait_for(  # noqa: E731
                     lambda: self._gen != g or self._value != expected,
                     timeout)
+                if profiling.contention_active():
+                    return profiling.timed_wait("butex", waitfn)
+                return waitfn()
 
     def wake(self, n: int = 1) -> None:
         with self._cond:
@@ -87,8 +91,12 @@ class CountdownEvent:
     def wait(self, timeout: Optional[float] = None) -> bool:
         with self._butex._cond:
             with blocking():
-                return self._butex._cond.wait_for(
+                from .. import profiling
+                waitfn = lambda: self._butex._cond.wait_for(  # noqa: E731
                     lambda: self._butex._value <= 0, timeout)
+                if profiling.contention_active():
+                    return profiling.timed_wait("countdown", waitfn)
+                return waitfn()
 
     @property
     def count(self) -> int:

@@ -53,6 +53,10 @@ class TaskHandle:
 
     def join(self, timeout: Optional[float] = None) -> bool:
         with blocking():
+            from .. import profiling
+            if profiling.contention_active():
+                return profiling.timed_wait(
+                    "join", lambda: self._done.wait(timeout))
             return self._done.wait(timeout)
 
     @property

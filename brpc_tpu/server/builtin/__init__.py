@@ -183,6 +183,63 @@ def _rpcz(server, msg, rest):
     }, indent=1)
 
 
+def _hotspots(server, msg, rest):
+    """/hotspots/{cpu,contention,growth,heap,device} — profilers.
+    ≈ hotspots_service.cpp:35-40 (CPU/heap/growth/contention); device
+    traces are the TPU-native addition (jax.profiler capture)."""
+    from ... import profiling
+    from ...fiber.runtime import blocking
+
+    q = msg.query()
+    try:
+        seconds = min(120.0, max(0.1, float(q.get("seconds", "5"))))
+    except ValueError:
+        return 400, "text/plain", "bad seconds\n"
+    kind = rest[0] if rest else "cpu"
+    with blocking():
+        return _hotspots_run(server, q, kind, seconds)
+
+
+def _hotspots_run(server, q, kind, seconds):
+    """Profiler window bodies sleep for ``seconds`` — run under the
+    fiber runtime's blocking() mark so the pool compensates."""
+    from ... import profiling
+    if kind == "cpu":
+        try:
+            hz = min(999, max(1, int(q.get("hz", "99"))))
+        except ValueError:
+            return 400, "text/plain", "bad hz\n"
+        prof = profiling.sample_cpu(seconds=seconds, hz=hz)
+        view = q.get("view", "flame")
+        if view == "folded":
+            return 200, "text/plain", profiling.render_folded(prof.folded)
+        if view == "flat":
+            return 200, "text/plain", profiling.render_flat(prof.folded)
+        return 200, "text/html", profiling.render_flame_html(
+            prof.folded,
+            title=f"cpu profile — {seconds:.0f}s @ {hz}Hz "
+                  f"({prof.samples} samples)")
+    if kind == "contention":
+        return 200, "text/plain", profiling.collect_contention(seconds)
+    if kind == "growth":
+        return 200, "text/plain", profiling.collect_growth(seconds)
+    if kind == "heap":
+        return 200, "text/plain", profiling.collect_heap()
+    if kind == "device":
+        try:
+            data, name = profiling.collect_device_trace(seconds)
+        except Exception as e:
+            return 500, "text/plain", f"device trace failed: {e}\n"
+        return (200, "application/gzip", data,
+                [("content-disposition", f"attachment; filename={name}")])
+    return (404, "text/plain",
+            "hotspots profilers: /hotspots/cpu?seconds=5&hz=99"
+            "[&view=flame|flat|folded], /hotspots/contention?seconds=5, "
+            "/hotspots/growth?seconds=5, /hotspots/heap, "
+            "/hotspots/device?seconds=3\n")
+
+
+register_builtin("hotspots", _hotspots)
 register_builtin("", _index)
 register_builtin("index", _index)
 register_builtin("health", _health)

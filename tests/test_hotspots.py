@@ -1,0 +1,152 @@
+"""/hotspots profiler portal tests — the reference's hotspots_service
+capability (CPU/contention/growth/heap) plus device trace capture,
+exercised over live HTTP (≈ test strategy of
+/root/reference/test/brpc_builtin_service_unittest.cpp)."""
+
+import http.client
+import threading
+import time
+
+import pytest
+
+from brpc_tpu import profiling
+from brpc_tpu.server import Server, Service
+
+
+class Busy(Service):
+    def Spin(self, cntl, request):
+        t0 = time.monotonic()
+        x = 0
+        while time.monotonic() - t0 < 0.3:
+            x += sum(range(200))
+        return b"%d" % x
+
+
+@pytest.fixture(scope="module")
+def server():
+    srv = Server()
+    srv.add_service(Busy(), name="B")
+    assert srv.start("127.0.0.1:0") == 0
+    yield srv
+    srv.stop()
+
+
+def _get(server, path, timeout=30):
+    ep = server.listen_endpoint
+    c = http.client.HTTPConnection(ep.host, ep.port, timeout=timeout)
+    c.request("GET", path)
+    r = c.getresponse()
+    body = r.read()
+    headers = dict(r.getheaders())
+    c.close()
+    return r.status, body, headers
+
+
+def test_cpu_profile_names_hot_function(server):
+    # drive load from a thread while the profile window is open
+    from brpc_tpu.client import Channel
+    ch = Channel()
+    ch.init(str(server.listen_endpoint))
+    stop = [False]
+
+    def load():
+        while not stop[0]:
+            ch.call("B.Spin", b"", timeout_ms=10_000)
+
+    t = threading.Thread(target=load, daemon=True)
+    t.start()
+    try:
+        status, body, _ = _get(server, "/hotspots/cpu?seconds=1&view=flat")
+        assert status == 200
+        assert b"Spin" in body or b"test_hotspots" in body, body[:800]
+        status, body, _ = _get(server,
+                               "/hotspots/cpu?seconds=0.5&view=folded")
+        assert status == 200
+        assert b";" in body           # folded stacks present
+        status, body, _ = _get(server, "/hotspots/cpu?seconds=0.5")
+        assert status == 200 and body.startswith(b"<!doctype html>")
+        assert b'class="f"' in body   # flame boxes rendered
+    finally:
+        stop[0] = True
+        t.join(timeout=10)
+
+
+def test_contention_reports_wait_sites(server):
+    from brpc_tpu.fiber.butex import Butex
+    bx = Butex(0)
+
+    def waiter():
+        bx.wait(0, timeout=1.0)
+
+    threads = [threading.Thread(target=waiter) for _ in range(2)]
+
+    def kick():
+        time.sleep(0.05)
+        for t in threads:
+            t.start()
+        time.sleep(0.4)
+        bx.add_and_wake(1)
+
+    k = threading.Thread(target=kick)
+    k.start()
+    status, body, _ = _get(server, "/hotspots/contention?seconds=1")
+    k.join()
+    for t in threads:
+        t.join()
+    assert status == 200
+    assert b"butex" in body, body[:800]
+    assert b"test_hotspots" in body   # the wait site is named
+
+
+def test_growth_names_allocation_site(server):
+    hoard = []
+
+    def alloc():
+        time.sleep(0.2)
+        for _ in range(200):
+            hoard.append(bytearray(10_000))
+
+    t = threading.Thread(target=alloc)
+    t.start()
+    status, body, _ = _get(server, "/hotspots/growth?seconds=1")
+    t.join()
+    assert status == 200
+    assert b"test_hotspots" in body, body[:800]
+    hoard.clear()
+
+
+def test_heap_endpoint(server):
+    status, body, _ = _get(server, "/hotspots/heap")
+    assert status == 200     # either a report or the "not tracing" hint
+    assert b"allocation site" in body or b"tracemalloc" in body
+
+
+def test_device_trace_tarball(server):
+    status, body, headers = _get(server, "/hotspots/device?seconds=0.3",
+                                 timeout=60)
+    assert status == 200, body[:300]
+    assert body[:2] == b"\x1f\x8b"          # gzip magic
+    assert "attachment" in headers.get("content-disposition", "")
+
+
+def test_hotspots_index(server):
+    status, body, _ = _get(server, "/hotspots/nope")
+    assert status == 404
+    assert b"/hotspots/cpu" in body
+
+
+def test_sampler_direct():
+    stop = [False]
+
+    def busy():
+        while not stop[0]:
+            sum(range(500))
+
+    t = threading.Thread(target=busy, daemon=True)
+    t.start()
+    prof = profiling.sample_cpu(seconds=0.4, hz=200)
+    stop[0] = True
+    t.join()
+    assert prof.samples > 10
+    flat = profiling.render_flat(prof.folded)
+    assert "busy" in flat or "test_hotspots" in flat
