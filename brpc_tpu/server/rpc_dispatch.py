@@ -152,6 +152,12 @@ def process_rpc_request(msg: RpcMessage, sock: Socket, server) -> None:
     meta = msg.meta
     cid = meta.correlation_id
 
+    from ..tools import rpc_dump
+    if rpc_dump.dump_enabled():
+        # sampled wire capture for rpc_replay (payload still carries the
+        # attachment tail here — the dump is the original frame body)
+        rpc_dump.maybe_dump_request(meta, msg.payload.to_bytes())
+
     entry = server.find_method(meta.service_name, meta.method_name)
     if entry is None:
         known = meta.service_name in server.services

@@ -1,0 +1,27 @@
+"""Operational tools — load generation, traffic capture/replay, portal
+viewing.
+
+≈ /root/reference/tools/ (rpc_press, rpc_replay, rpc_view) and
+src/brpc/rpc_dump.h — re-designed for this framework: the press drives
+the client fast lane, dumps are raw tpu_std frames (replayable bytes,
+no intermediate format), and the viewer reads the builtin portal.
+
+Submodules import lazily (PEP 562): the server's dump hook must not pull
+the whole client stack at dispatch time.
+"""
+
+_EXPORTS = {
+    "Press": "rpc_press", "PressOptions": "rpc_press",
+    "DumpReader": "rpc_dump", "dump_enabled": "rpc_dump",
+    "maybe_dump_request": "rpc_dump", "close_dump": "rpc_dump",
+    "Replayer": "rpc_replay", "ReplayOptions": "rpc_replay",
+    "fetch": "rpc_view",
+}
+
+
+def __getattr__(name):
+    mod = _EXPORTS.get(name)
+    if mod is None:
+        raise AttributeError(name)
+    import importlib
+    return getattr(importlib.import_module(f".{mod}", __name__), name)

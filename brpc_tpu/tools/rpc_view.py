@@ -1,0 +1,39 @@
+"""rpc_view — read a remote server's builtin portal from the terminal.
+
+≈ /root/reference/tools/rpc_view/rpc_view.cpp: fetch any builtin page
+(status, vars, flags, connections, rpcz, hotspots, ...) over HTTP and
+print it.  `python -m brpc_tpu.tools.rpc_view host:port [page]`.
+"""
+
+from __future__ import annotations
+
+import http.client
+from typing import List, Optional
+
+
+def fetch(server: str, page: str = "status", timeout: float = 10.0) -> str:
+    host, _, port = server.partition(":")
+    conn = http.client.HTTPConnection(host, int(port or 80), timeout=timeout)
+    try:
+        conn.request("GET", "/" + page.lstrip("/"))
+        resp = conn.getresponse()
+        body = resp.read()
+        if resp.status != 200:
+            raise RuntimeError(f"HTTP {resp.status}: {body[:200]!r}")
+        return body.decode("utf-8", "replace")
+    finally:
+        conn.close()
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    import argparse
+    ap = argparse.ArgumentParser(description="view a tpu-rpc server portal")
+    ap.add_argument("server", help="host:port")
+    ap.add_argument("page", nargs="?", default="status")
+    args = ap.parse_args(argv)
+    print(fetch(args.server, args.page), end="")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
