@@ -176,6 +176,20 @@ class Channel:
                 LOG.exception("rpc done callback raised")
         return c
 
+    def grpc_stream(self, method_full: str,
+                    timeout_ms: Optional[int] = None,
+                    metadata=None):
+        """Open a full-duplex gRPC stream to a single-server channel
+        (protocol='grpc'): returns a GrpcStreamCall with write()/read()/
+        done_writing()/status()."""
+        from .grpc_client import grpc_connection
+        if self.single_server is None:
+            raise RpcError(2001, "grpc_stream needs a single-server channel")
+        svc, _, mth = method_full.rpartition(".")
+        timeout_s = (timeout_ms or self.options.timeout_ms or 30000) / 1e3
+        return grpc_connection(self.single_server).streaming_call(
+            f"/{svc}/{mth}", timeout_s, metadata)
+
     # sugar: channel.call("Echo.Hi", b"x") -> response bytes or raises
     def call(self, method_full: str, request: Any,
              response_type: Any = None, **kw) -> Any:

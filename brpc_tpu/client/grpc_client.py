@@ -24,14 +24,19 @@ from ..protocol.h2_session import H2Error, H2Session
 
 
 class _Call:
-    __slots__ = ("event", "headers", "trailers", "body", "rst_code")
+    __slots__ = ("event", "headers", "trailers", "body", "rst_code",
+                 "streaming", "msgs", "cond", "ended")
 
-    def __init__(self):
+    def __init__(self, streaming: bool = False):
         self.event = threading.Event()
         self.headers: List[Tuple[str, str]] = []
         self.trailers: List[Tuple[str, str]] = []
         self.body = bytearray()
         self.rst_code: Optional[int] = None
+        self.streaming = streaming
+        self.msgs: List[bytes] = []        # streaming: decoded messages
+        self.cond = threading.Condition()
+        self.ended = False
 
     def header(self, name: str, default: str = "") -> str:
         for n, v in self.trailers:
@@ -96,6 +101,9 @@ class GrpcConnection:
             call.rst_code = -1
             call.trailers = [("grpc-status", "14"),      # UNAVAILABLE
                              ("grpc-message", why)]
+            with call.cond:
+                call.ended = True
+                call.cond.notify_all()
             call.event.set()
 
     def _read_loop(self) -> None:
@@ -141,6 +149,15 @@ class GrpcConnection:
             if call is None:
                 return
             call.body += body
+            if call.streaming:
+                with call.cond:
+                    try:
+                        call.msgs.extend(unpack_grpc_messages(call.body))
+                    except H2Error:
+                        call.rst_code = -2
+                        self._finish(sid)
+                        return
+                    call.cond.notify_all()
             if end:
                 self._finish(sid)
         elif kind == "rst":
@@ -158,9 +175,24 @@ class GrpcConnection:
             if self._session is not None:
                 self._session.close_stream(sid)
         if call is not None:
+            with call.cond:
+                call.ended = True
+                call.cond.notify_all()
             call.event.set()
 
     # -- calls -------------------------------------------------------------
+
+    def _request_headers(self, path: str, timeout_s: float,
+                         metadata) -> List[Tuple[str, str]]:
+        return [
+            (":method", "POST"),
+            (":scheme", "http"),
+            (":path", path),
+            (":authority", str(self._remote)),
+            ("content-type", GRPC_CT),
+            ("te", "trailers"),
+            ("grpc-timeout", f"{max(1, int(timeout_s * 1000))}m"),
+        ] + list(metadata or [])
 
     def unary_call(self, path: str, payload: bytes,
                    timeout_s: float = 30.0,
@@ -178,15 +210,7 @@ class GrpcConnection:
                 return 14, "connection lost", b""
             sid = self._session.next_stream_id()
             self._calls[sid] = call
-            headers = [
-                (":method", "POST"),
-                (":scheme", "http"),
-                (":path", path),
-                (":authority", str(self._remote)),
-                ("content-type", GRPC_CT),
-                ("te", "trailers"),
-                ("grpc-timeout", f"{max(1, int(timeout_s * 1000))}m"),
-            ] + list(metadata or [])
+            headers = self._request_headers(path, timeout_s, metadata)
             try:
                 self._session.send_headers(sid, headers)
                 self._session.send_data(sid, pack_grpc_message(payload),
@@ -221,8 +245,117 @@ class GrpcConnection:
                 return 13, f"bad response framing: {e}", b""
         return status, message, body
 
+    def streaming_call(self, path: str, timeout_s: float = 30.0,
+                       metadata: Optional[List[Tuple[str, str]]] = None
+                       ) -> "GrpcStreamCall":
+        """Open a full-duplex gRPC stream (covers server-streaming,
+        client-streaming and bidi): write() request messages, read()
+        response messages, done_writing() to half-close, status()/
+        message() after the response stream ends."""
+        self._ensure_connected()
+        call = _Call(streaming=True)
+        with self._lock:
+            if self._dead:
+                raise ConnectionError("connection lost")
+            sid = self._session.next_stream_id()
+            self._calls[sid] = call
+            self._session.send_headers(
+                sid, self._request_headers(path, timeout_s, metadata))
+            self._flush_locked()
+        return GrpcStreamCall(self, sid, call, timeout_s)
+
     def close(self) -> None:
         self._fail_all("closed")
+
+
+class GrpcStreamCall:
+    """Client end of one gRPC stream."""
+
+    def __init__(self, conn: GrpcConnection, sid: int, call: _Call,
+                 timeout_s: float):
+        self._conn = conn
+        self._sid = sid
+        self._call = call
+        self._timeout_s = timeout_s
+        self._half_closed = False
+
+    # -- sending -----------------------------------------------------------
+
+    def write(self, payload: bytes) -> None:
+        if self._half_closed:
+            raise RuntimeError("write after done_writing")
+        if self._call.ended:
+            # the server already finished: framing DATA on a closed h2
+            # stream is a connection error that would kill every call
+            # multiplexed on this connection
+            raise ConnectionError(
+                f"stream finished (grpc-status {self.status()})")
+        with self._conn._lock:
+            if self._conn._dead:
+                raise ConnectionError("connection lost")
+            self._conn._session.send_data(self._sid,
+                                          pack_grpc_message(payload))
+            self._conn._flush_locked()
+
+    def done_writing(self) -> None:
+        """Half-close: no more request messages."""
+        if self._half_closed:
+            return
+        self._half_closed = True
+        if self._call.ended:
+            return
+        with self._conn._lock:
+            if self._conn._dead:
+                return
+            self._conn._session.send_data(self._sid, b"", end_stream=True)
+            self._conn._flush_locked()
+
+    # -- receiving ---------------------------------------------------------
+
+    def read(self, timeout_s: Optional[float] = None) -> Optional[bytes]:
+        """Next response message; None when the server finished."""
+        call = self._call
+        deadline = timeout_s if timeout_s is not None else self._timeout_s
+        with call.cond:
+            ok = call.cond.wait_for(lambda: call.msgs or call.ended,
+                                    deadline)
+            if call.msgs:
+                return call.msgs.pop(0)
+            if not ok:
+                raise TimeoutError("grpc stream read timed out")
+            return None
+
+    def __iter__(self):
+        return self
+
+    def __next__(self) -> bytes:
+        msg = self.read()
+        if msg is None:
+            raise StopIteration
+        return msg
+
+    def cancel(self) -> None:
+        with self._conn._lock:
+            if not self._conn._dead and self._conn._session is not None:
+                try:
+                    self._conn._session.send_rst(self._sid, 0x8)  # CANCEL
+                    self._conn._flush_locked()
+                except OSError:
+                    pass
+        self._conn._finish(self._sid)
+
+    # -- completion --------------------------------------------------------
+
+    def status(self) -> int:
+        s = self._call.header("grpc-status", "2")
+        return int(s) if s.isdigit() else 2
+
+    def message(self) -> str:
+        return self._call.header("grpc-message")
+
+    def wait(self, timeout_s: Optional[float] = None) -> bool:
+        return self._call.event.wait(
+            timeout_s if timeout_s is not None else self._timeout_s)
 
 
 _conns_lock = threading.Lock()

@@ -84,6 +84,19 @@ def unpack_grpc_messages(buf: bytearray) -> List[bytes]:
     return out
 
 
+def resolve_grpc_entry(server, path: str):
+    """``/package.Service/Method`` → method entry (the registry is keyed
+    by bare service name; package-qualified paths fall back)."""
+    parts = [p for p in path.split("/") if p]
+    if len(parts) != 2:
+        return None
+    svc_full, method = parts
+    entry = server.find_method(svc_full, method)
+    if entry is None and "." in svc_full:
+        entry = server.find_method(svc_full.rsplit(".", 1)[-1], method)
+    return entry
+
+
 class H2Request:
     __slots__ = ("stream_id", "headers", "body", "conn")
 
@@ -101,23 +114,167 @@ class H2Request:
         return ""
 
 
-class H2ServerConn:
-    """Per-connection server state: the session + request assembly."""
+class GrpcServerStream:
+    """Live full-duplex gRPC stream on the server: the handler reads
+    request messages by iterating, pushes responses with write(), and
+    the dispatcher sends trailers when the handler returns.
+    ≈ the reference's full-duplex h2 streams (grpc.h + the streaming
+    paths of policy/http2_rpc_protocol.cpp)."""
 
-    def __init__(self, sock):
+    def __init__(self, conn: "H2ServerConn", sock, sid: int):
+        self.conn = conn
+        self.sock = sock
+        self.sid = sid
+        self._recv = bytearray()            # un-cut grpc message bytes
+        self._msgs: List[bytes] = []
+        self._buffered = 0                  # unread bytes (bounded)
+        self._cond = threading.Condition()
+        self._closed_remote = False
+        self.cancelled = False              # peer RST: send nothing back
+        self.framing_error = False          # bad message framing: status 12
+        self._headers_sent = False
+
+    # -- fed by the connection (under conn.lock) ---------------------------
+
+    def _on_data(self, body: bytes, end: bool) -> None:
+        with self._cond:
+            self._recv += body
+            self._buffered += len(body)
+            if self._buffered > max_body_size():
+                # same defense as the unary assembly path: a writer
+                # outpacing the handler must not buffer unboundedly.
+                # RST goes out now, so nothing more may be sent later.
+                self.cancelled = True
+                self._closed_remote = True
+                self.conn.session.send_rst(self.sid, E_PROTOCOL)
+                self._cond.notify_all()
+                return
+            try:
+                self._msgs.extend(unpack_grpc_messages(self._recv))
+            except H2Error:
+                self.framing_error = True
+                self._closed_remote = True
+            if end:
+                self._closed_remote = True
+            self._cond.notify_all()
+
+    def _on_rst(self) -> None:
+        with self._cond:
+            self.cancelled = True
+            self._closed_remote = True
+            self._cond.notify_all()
+
+    # -- handler side ------------------------------------------------------
+
+    def __iter__(self):
+        return self
+
+    def __next__(self) -> bytes:
+        msg = self.read()
+        if msg is None:
+            raise StopIteration
+        return msg
+
+    def read(self, timeout: Optional[float] = None) -> Optional[bytes]:
+        """Next request message, or None when the client half-closed
+        (or the stream was cancelled).  Raises TimeoutError on timeout —
+        None strictly means end-of-stream."""
+        from ..fiber.runtime import blocking
+        with self._cond:
+            with blocking():
+                ok = self._cond.wait_for(
+                    lambda: self._msgs or self._closed_remote
+                    or self.cancelled, timeout)
+            if self._msgs:
+                msg = self._msgs.pop(0)
+                self._buffered -= len(msg)
+                return msg
+            if not ok:
+                raise TimeoutError("grpc stream read timed out")
+            return None
+
+    def write(self, payload: bytes) -> None:
+        """Push one response message."""
+        if self.cancelled or self.framing_error:
+            return
+        with self.conn.lock:
+            self._send_headers_locked()
+            self.conn.session.send_data(self.sid, pack_grpc_message(payload))
+        self.conn.flush(self.sock)
+
+    def _send_headers_locked(self) -> None:
+        if not self._headers_sent:
+            self._headers_sent = True
+            self.conn.session.send_headers(self.sid, [
+                (":status", "200"), ("content-type", GRPC_CT)])
+
+    def _finish(self, status: int, message: str = "",
+                final_payload: Optional[bytes] = None) -> None:
+        if self.cancelled:
+            # peer reset the stream: nothing may be sent on it
+            with self.conn.lock:
+                self.conn.live.pop(self.sid, None)
+            return
+        if self.framing_error and status == 0:
+            status, message = 12, "malformed grpc message framing"
+            final_payload = None
+        with self.conn.lock:
+            if status == 0:
+                self._send_headers_locked()
+                if final_payload is not None:
+                    self.conn.session.send_data(
+                        self.sid, pack_grpc_message(final_payload))
+                self.conn.session.send_headers(
+                    self.sid, [("grpc-status", "0")]
+                    + ([("grpc-message", message)] if message else []),
+                    end_stream=True)
+            elif self._headers_sent:
+                self.conn.session.send_headers(
+                    self.sid, [("grpc-status", str(status)),
+                               ("grpc-message", message or "")],
+                    end_stream=True)
+            else:
+                self.conn.session.send_headers(self.sid, [
+                    (":status", "200"), ("content-type", GRPC_CT),
+                    ("grpc-status", str(status)),
+                    ("grpc-message", message or "")], end_stream=True)
+            self.conn.session.close_stream(self.sid)
+            self.conn.live.pop(self.sid, None)
+        self.conn.flush(self.sock)
+
+
+class H2ServerConn:
+    """Per-connection server state: the session + request assembly (and
+    live streaming dispatch for @grpc_streaming methods)."""
+
+    def __init__(self, sock, server=None):
         self.session = H2Session(is_server=True)
         self.sock_id = sock.id
+        self.server = server
+        self._sock = sock
         self._assembling: Dict[int, dict] = {}
+        self.live: Dict[int, GrpcServerStream] = {}
         self.ready: List[H2Request] = []
         self.lock = threading.Lock()
 
     def feed(self, data: bytes) -> None:
+        spawn_live: List[Tuple[GrpcServerStream, object]] = []
         with self.lock:
             events = self.session.feed(data)
             for ev in events:
                 kind = ev[0]
                 if kind == "headers":
                     _, sid, headers, end = ev
+                    if sid in self.live:
+                        if end:                    # request trailers
+                            self.live[sid]._on_data(b"", True)
+                        continue
+                    entry = None if end else self._streaming_entry(headers)
+                    if entry is not None:
+                        stream = GrpcServerStream(self, self._sock, sid)
+                        self.live[sid] = stream
+                        spawn_live.append((stream, (entry, headers)))
+                        continue
                     st = self._assembling.setdefault(
                         sid, {"headers": [], "body": bytearray()})
                     if st["headers"]:
@@ -128,6 +285,10 @@ class H2ServerConn:
                         self._complete(sid)
                 elif kind == "data":
                     _, sid, body, end = ev
+                    live = self.live.get(sid)
+                    if live is not None:
+                        live._on_data(body, end)
+                        continue
                     st = self._assembling.get(sid)
                     if st is None:
                         continue
@@ -140,6 +301,25 @@ class H2ServerConn:
                         self._complete(sid)
                 elif kind == "rst":
                     self._assembling.pop(ev[1], None)
+                    live = self.live.pop(ev[1], None)
+                    if live is not None:
+                        live._on_rst()
+        for stream, ctx in spawn_live:
+            from ..fiber import runtime as fiber_runtime
+            fiber_runtime.spawn(_run_streaming_handler, stream, ctx[0],
+                                ctx[1], self._sock, self.server,
+                                name="grpc_stream")
+
+    def _streaming_entry(self, headers):
+        """The method entry IFF this request addresses a @grpc_streaming
+        method (dispatch must then start before END_STREAM)."""
+        if self.server is None:
+            return None
+        hmap = dict(headers)
+        if not hmap.get("content-type", "").startswith(GRPC_CT):
+            return None
+        entry = resolve_grpc_entry(self.server, hmap.get(":path", ""))
+        return entry if entry is not None and entry.grpc_streaming else None
 
     def _complete(self, sid: int) -> None:
         st = self._assembling.pop(sid, None)
@@ -199,7 +379,7 @@ def parse(source: IOBuf, sock, read_eof: bool, arg) -> ParseResult:
             return ParseResult.try_others()
         if avail < len(PREFACE):
             return ParseResult.not_enough_data()
-        conn = H2ServerConn(sock)
+        conn = H2ServerConn(sock, server=arg)
         sock.h2_conn = conn
     data = source.to_bytes()
     source.clear()
@@ -226,6 +406,51 @@ def parse(source: IOBuf, sock, read_eof: bool, arg) -> ParseResult:
                                     name="h2_request")
         return ParseResult.make_message(first)
     return ParseResult.not_enough_data()
+
+
+def _run_streaming_handler(stream: GrpcServerStream, entry, headers,
+                           sock, server) -> None:
+    """Fiber body for a @grpc_streaming method: admission, handler,
+    trailers.  The handler sees (cntl, stream)."""
+    from ..server.controller import ServerController
+    from ..protocol.meta import RpcMeta
+    from ..protocol.tpu_std import serialize_payload
+
+    if not server.on_request_in():
+        stream._finish(8, "server max_concurrency")
+        return
+    if not entry.status.on_requested():
+        server.on_request_out()
+        stream._finish(8, "method max_concurrency")
+        return
+    meta = RpcMeta()
+    meta.service_name = entry.status.full_name.rsplit(".", 1)[0]
+    meta.method_name = entry.method_name
+    begin = monotonic_us()
+    cntl = ServerController(meta, sock.remote_side, sock.id,
+                            send_response=lambda c, r: None)
+    cntl.server = server
+    cntl.grpc_stream = stream
+    try:
+        ret = entry.fn(cntl, stream)
+    except Exception as e:
+        LOG.exception("grpc streaming method %s raised",
+                      entry.status.full_name)
+        cntl.set_failed(Errno.EINTERNAL, f"{type(e).__name__}: {e}")
+        ret = None
+    entry.status.on_responded(cntl.error_code, monotonic_us() - begin)
+    server.on_request_out()
+    if cntl.failed:
+        stream._finish(grpc_status_of(cntl.error_code), cntl.error_text)
+        return
+    final = None
+    if ret is not None:
+        try:
+            final = serialize_payload(ret).to_bytes()
+        except TypeError as e:
+            stream._finish(13, f"serialize: {e}")
+            return
+    stream._finish(0, final_payload=final)
 
 
 def _process_request(req: H2Request, sock, server) -> None:
@@ -266,20 +491,20 @@ def _process_grpc(req: H2Request, sock, server) -> None:
     from ..protocol.tpu_std import parse_payload, serialize_payload
 
     path = req.header(":path")
-    parts = [p for p in path.split("/") if p]
-    if len(parts) != 2:
-        req.conn.send_grpc_response(sock, req.stream_id, None, 12,
-                                    f"malformed path {path!r}")
-        return
-    svc_full, method = parts
-    entry = server.find_method(svc_full, method)
-    if entry is None and "." in svc_full:
-        # grpc clients address /package.Service/Method; our registry is
-        # keyed by bare service name
-        entry = server.find_method(svc_full.rsplit(".", 1)[-1], method)
+    entry = resolve_grpc_entry(server, path)
     if entry is None:
         req.conn.send_grpc_response(sock, req.stream_id, None, 12,
                                     f"unknown method {path}")
+        return
+    if entry.grpc_streaming:
+        # fully-assembled request on a streaming method (client sent
+        # END_STREAM with HEADERS or in one gulp): run the handler with
+        # a pre-closed stream carrying the buffered messages
+        stream = GrpcServerStream(req.conn, sock, req.stream_id)
+        with req.conn.lock:
+            req.conn.live[req.stream_id] = stream
+        stream._on_data(req.body, True)
+        _run_streaming_handler(stream, entry, req.headers, sock, server)
         return
     if not server.on_request_in():
         req.conn.send_grpc_response(sock, req.stream_id, None, 8,
@@ -302,8 +527,8 @@ def _process_grpc(req: H2Request, sock, server) -> None:
     payload = messages[0] if messages else b""
 
     meta = RpcMeta()
-    meta.service_name = svc_full
-    meta.method_name = method
+    meta.service_name = entry.status.full_name.rsplit(".", 1)[0]
+    meta.method_name = entry.method_name
 
     def send(cntl: ServerController, response) -> None:
         latency_us = monotonic_us() - cntl.begin_time_us

@@ -8,8 +8,8 @@ same port via the multi-protocol messenger.
 """
 
 from .server import Server, ServerOptions
-from .service import Service, method
+from .service import Service, grpc_streaming, method
 from .controller import ServerController
 
 __all__ = ["Server", "ServerOptions", "Service", "ServerController",
-           "method"]
+           "method", "grpc_streaming"]

@@ -29,7 +29,7 @@ class ServerController:
         "begin_time_us", "trace_id", "span_id",
         "auth_context", "server",
         "_remote_stream_id", "_accepted_stream_id",
-        "_accepted_stream_window", "span",
+        "_accepted_stream_window", "span", "grpc_stream",
     )
 
     def __init__(self, request_meta: RpcMeta,
@@ -61,6 +61,7 @@ class ServerController:
         self._accepted_stream_id = 0
         self._accepted_stream_window = 0
         self.span = None                 # rpcz Span when tracing is on
+        self.grpc_stream = None          # GrpcServerStream on @grpc_streaming
 
     # -- error reporting ---------------------------------------------------
 

@@ -59,11 +59,14 @@ class ServerOptions:
 
 
 class _MethodEntry:
-    __slots__ = ("fn", "request_type", "status", "service", "method_name")
+    __slots__ = ("fn", "request_type", "status", "service", "method_name",
+                 "grpc_streaming")
 
-    def __init__(self, fn, request_type, status, service, method_name):
+    def __init__(self, fn, request_type, status, service, method_name,
+                 grpc_streaming=False):
         self.fn = fn
         self.request_type = request_type
+        self.grpc_streaming = grpc_streaming
         self.status = status
         self.service = service
         self.method_name = method_name
@@ -122,6 +125,7 @@ class Server:
                 status=status,
                 service=service,
                 method_name=mname,
+                grpc_streaming=getattr(fn, "_grpc_streaming", False),
             )
             self._methods[(sname, mname)] = entry
         return 0

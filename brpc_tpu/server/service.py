@@ -31,6 +31,28 @@ def method(request_type: Any = None, response_compress: int = 0):
     return mark
 
 
+def grpc_streaming(fn: Callable) -> Callable:
+    """Declare a gRPC STREAMING method (server/client/bidi — the wire
+    doesn't distinguish; the handler shape does):
+
+        class Chat(Service):
+            @grpc_streaming
+            def Talk(self, cntl, msgs):       # msgs: iterator of requests
+                for m in msgs:                # client/bidi streaming
+                    cntl.grpc_stream.write(m) # server pushes
+                return None                   # or a final response message
+
+    The handler runs as soon as request HEADERS arrive; request messages
+    stream in through ``msgs`` (ends when the client half-closes); every
+    ``cntl.grpc_stream.write(bytes)`` pushes one response message; a
+    non-None return value is sent as a final message before trailers.
+    ≈ the reference's full-duplex h2 streams
+    (/root/reference/src/brpc/policy/http2_rpc_protocol.cpp + grpc.h).
+    """
+    fn._grpc_streaming = True
+    return fn
+
+
 class Service:
     """Optional base class; any duck-typed object works via
     :func:`extract_methods`."""

@@ -188,3 +188,209 @@ def test_channel_grpc_against_our_server(server):
     c = ch.call_method("EchoSvc.Echo", b"self-grpc")
     assert not c.failed, c.error_text
     assert c.response == b"self-grpc"
+
+
+# -- streaming: grpcio client -> brpc_tpu server ----------------------------
+
+from brpc_tpu.server import grpc_streaming  # noqa: E402
+
+
+class StreamSvc(Service):
+    @grpc_streaming
+    def Countdown(self, cntl, msgs):
+        # server-streaming: one request message, N pushed responses
+        first = msgs.read()
+        for i in range(int(first or b"0"), 0, -1):
+            cntl.grpc_stream.write(b"%d" % i)
+        return None
+
+    @grpc_streaming
+    def Sum(self, cntl, msgs):
+        # client-streaming: consume all, single response via return
+        return b"%d" % sum(int(m) for m in msgs)
+
+    @grpc_streaming
+    def Chat(self, cntl, msgs):
+        # bidi: answer each message as it arrives
+        for m in msgs:
+            cntl.grpc_stream.write(m.upper())
+        return None
+
+    @grpc_streaming
+    def FailMid(self, cntl, msgs):
+        cntl.grpc_stream.write(b"one")
+        cntl.set_failed(1003, "stream failed midway")
+        return None
+
+
+@pytest.fixture(scope="module")
+def stream_server():
+    srv = Server()
+    srv.add_service(StreamSvc(), name="S")
+    assert srv.start("127.0.0.1:0") == 0
+    yield srv
+    srv.stop()
+
+
+def _grpc_channel(server):
+    ep = server.listen_endpoint
+    return grpc.insecure_channel(f"{ep.host}:{ep.port}")
+
+
+def test_grpcio_server_streaming(stream_server):
+    with _grpc_channel(stream_server) as ch:
+        fn = ch.unary_stream("/S/Countdown", request_serializer=_ident,
+                             response_deserializer=_ident)
+        got = list(fn(b"4", timeout=10))
+    assert got == [b"4", b"3", b"2", b"1"]
+
+
+def test_grpcio_client_streaming(stream_server):
+    with _grpc_channel(stream_server) as ch:
+        fn = ch.stream_unary("/S/Sum", request_serializer=_ident,
+                             response_deserializer=_ident)
+        got = fn(iter([b"1", b"2", b"3", b"4"]), timeout=10)
+    assert got == b"10"
+
+
+def test_grpcio_bidi_streaming(stream_server):
+    with _grpc_channel(stream_server) as ch:
+        fn = ch.stream_stream("/S/Chat", request_serializer=_ident,
+                              response_deserializer=_ident)
+        got = list(fn(iter([b"alpha", b"beta", b"gamma"]), timeout=10))
+    assert got == [b"ALPHA", b"BETA", b"GAMMA"]
+
+
+def test_grpcio_streaming_error_propagates(stream_server):
+    with _grpc_channel(stream_server) as ch:
+        fn = ch.unary_stream("/S/FailMid", request_serializer=_ident,
+                             response_deserializer=_ident)
+        it = fn(b"", timeout=10)
+        assert next(it) == b"one"
+        with pytest.raises(grpc.RpcError) as ei:
+            list(it)
+        assert ei.value.code() == grpc.StatusCode.INVALID_ARGUMENT
+
+
+def test_grpcio_large_server_stream(stream_server):
+    """Many pushed messages > initial window: flow control on streams."""
+    with _grpc_channel(stream_server) as ch:
+        fn = ch.stream_stream("/S/Chat", request_serializer=_ident,
+                              response_deserializer=_ident)
+        reqs = [bytes([65 + (i % 26)]) * 8000 for i in range(40)]  # ~320KB
+        got = list(fn(iter(reqs), timeout=30))
+    assert got == [r.upper() for r in reqs]
+
+
+# -- streaming: brpc_tpu client -> grpcio server ----------------------------
+
+class _GrpcioStreams(grpc.GenericRpcHandler):
+    def service(self, handler_call_details):
+        m = handler_call_details.method
+        if m == "/oracle.S/Count":
+            def count(req, ctx):
+                for i in range(int(req or b"0")):
+                    yield b"tick%d" % i
+            return grpc.unary_stream_rpc_method_handler(
+                count, request_deserializer=_ident,
+                response_serializer=_ident)
+        if m == "/oracle.S/Join":
+            def join(req_iter, ctx):
+                return b",".join(req_iter)
+            return grpc.stream_unary_rpc_method_handler(
+                join, request_deserializer=_ident,
+                response_serializer=_ident)
+        if m == "/oracle.S/Rev":
+            def rev(req_iter, ctx):
+                for r in req_iter:
+                    yield r[::-1]
+            return grpc.stream_stream_rpc_method_handler(
+                rev, request_deserializer=_ident,
+                response_serializer=_ident)
+        return None
+
+
+@pytest.fixture(scope="module")
+def grpcio_stream_server():
+    from concurrent import futures
+    srv = grpc.server(futures.ThreadPoolExecutor(max_workers=4))
+    srv.add_generic_rpc_handlers((_GrpcioStreams(),))
+    port = srv.add_insecure_port("127.0.0.1:0")
+    srv.start()
+    yield port
+    srv.stop(0)
+
+
+def _our_conn(port):
+    from brpc_tpu.butil.endpoint import parse_endpoint
+    from brpc_tpu.client.grpc_client import GrpcConnection
+    return GrpcConnection(parse_endpoint(f"127.0.0.1:{port}"))
+
+
+def test_our_client_server_streaming(grpcio_stream_server):
+    conn = _our_conn(grpcio_stream_server)
+    try:
+        call = conn.streaming_call("/oracle.S/Count", 10.0)
+        call.write(b"3")
+        call.done_writing()
+        assert list(call) == [b"tick0", b"tick1", b"tick2"]
+        assert call.status() == 0, call.message()
+    finally:
+        conn.close()
+
+
+def test_our_client_client_streaming(grpcio_stream_server):
+    conn = _our_conn(grpcio_stream_server)
+    try:
+        call = conn.streaming_call("/oracle.S/Join", 10.0)
+        for part in (b"a", b"b", b"c"):
+            call.write(part)
+        call.done_writing()
+        assert list(call) == [b"a,b,c"]
+        assert call.status() == 0, call.message()
+    finally:
+        conn.close()
+
+
+def test_our_client_bidi(grpcio_stream_server):
+    conn = _our_conn(grpcio_stream_server)
+    try:
+        call = conn.streaming_call("/oracle.S/Rev", 10.0)
+        call.write(b"abc")
+        assert call.read() == b"cba"
+        call.write(b"hello")
+        assert call.read() == b"olleh"
+        call.done_writing()
+        assert call.read() is None
+        assert call.status() == 0, call.message()
+    finally:
+        conn.close()
+
+
+def test_our_client_streaming_against_our_server(stream_server):
+    """Full circle: our streaming client against our streaming server."""
+    from brpc_tpu.client.grpc_client import GrpcConnection
+    from brpc_tpu.butil.endpoint import parse_endpoint
+    ep = stream_server.listen_endpoint
+    conn = GrpcConnection(parse_endpoint(f"{ep.host}:{ep.port}"))
+    try:
+        call = conn.streaming_call("/S/Chat", 10.0)
+        call.write(b"xyz")
+        assert call.read() == b"XYZ"
+        call.write(b"q")
+        assert call.read() == b"Q"
+        call.done_writing()
+        assert call.read() is None
+        assert call.status() == 0, call.message()
+        # client-streaming shape through Channel sugar
+        opts = ChannelOptions()
+        opts.protocol = "grpc"
+        ch2 = Channel(opts)
+        assert ch2.init(f"{ep.host}:{ep.port}") == 0
+        call = ch2.grpc_stream("S.Sum")
+        for i in (b"5", b"6"):
+            call.write(i)
+        call.done_writing()
+        assert list(call) == [b"11"]
+    finally:
+        conn.close()
