@@ -21,9 +21,12 @@ Fresh design notes (not a port):
 
 from __future__ import annotations
 
+import errno as _errno_mod
 import threading
 import weakref
 from collections import deque
+
+_errno_EAGAIN = _errno_mod.EAGAIN
 from typing import Iterable, List, Optional, Tuple, Union
 
 DEFAULT_BLOCK_SIZE = 8192
@@ -406,7 +409,16 @@ class IOBuf:
             views = clipped
         if not views:
             return 0
-        sent = sock.sendmsg(views)
+        try:
+            sent = sock.sendmsg(views)
+        except NotImplementedError:
+            # TLS sockets have no scatter-gather send; SSLWantWrite maps
+            # to the EAGAIN contract the write path already understands
+            import ssl as _ssl
+            try:
+                sent = sock.send(views[0])
+            except (_ssl.SSLWantWriteError, _ssl.SSLWantReadError):
+                raise BlockingIOError(_errno_EAGAIN, "ssl wants io")
         self.pop_front(sent)
         return sent
 

@@ -26,7 +26,8 @@ class ChannelOptions:
     __slots__ = ("timeout_ms", "connect_timeout_ms", "max_retry",
                  "backup_request_ms", "connection_type", "protocol",
                  "request_compress_type", "auth_data",
-                 "enable_circuit_breaker")
+                 "enable_circuit_breaker",
+                 "ssl", "ssl_context", "ssl_ca", "ssl_verify")
 
     def __init__(self):
         self.timeout_ms = 500
@@ -38,6 +39,15 @@ class ChannelOptions:
         self.request_compress_type = CompressType.NONE
         self.auth_data = b""
         self.enable_circuit_breaker = False
+        # TLS (≈ ChannelSSLOptions, /root/reference/src/brpc/ssl_options.h):
+        # ssl=True wraps every connection; ssl_context overrides the
+        # default client context; ssl_ca pins a CA file; ssl_verify
+        # enables cert verification (off by default — self-signed dev
+        # certs work out of the box, like the reference default)
+        self.ssl = False
+        self.ssl_context = None
+        self.ssl_ca = None
+        self.ssl_verify = False
 
 
 class Channel:
@@ -47,6 +57,25 @@ class Channel:
         self.load_balancer = None
         self._initialized = False
         self._method_tlvs = {}      # method_full -> pre-encoded meta TLVs
+        self._ssl_ctx_cache = None
+
+    def ssl_ctx(self):
+        """The channel's client TLS context (None when TLS is off)."""
+        opts = self.options
+        if opts.ssl_context is not None:
+            return opts.ssl_context
+        if not opts.ssl:
+            return None
+        if self._ssl_ctx_cache is None:
+            import ssl as _ssl
+            ctx = _ssl.create_default_context(
+                cafile=opts.ssl_ca) if opts.ssl_ca \
+                else _ssl.create_default_context()
+            if not opts.ssl_verify:
+                ctx.check_hostname = False
+                ctx.verify_mode = _ssl.CERT_NONE
+            self._ssl_ctx_cache = ctx
+        return self._ssl_ctx_cache
 
     def init(self, addr: Any, lb_name: str = "") -> int:
         """``addr``: "ip:port" / EndPoint for a single server, or a
@@ -218,7 +247,7 @@ class Channel:
                 fast_call.method_tlv(method_full)
         if not self._initialized:
             raise RpcError(2001, "channel not initialized")
-        if self.options.protocol != "tpu_std":
+        if self.options.protocol != "tpu_std" or self.ssl_ctx() is not None:
             return [self.call(method_full, r, response_type,
                               timeout_ms=timeout_ms) for r in requests]
         return fast_call.run_batch(self, method_full, list(requests),

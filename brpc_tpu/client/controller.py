@@ -233,7 +233,13 @@ class Controller:
                            and self.connection_type in ("pooled", "short")
                            and (not self.backup_request_ms
                                 or self.backup_request_ms <= 0)
-                           and self._stream_to_create is None)
+                           and self._stream_to_create is None
+                           # TLS buffers decrypted bytes inside the SSL
+                           # layer: a select()-driven direct reader could
+                           # stall on data that will never hit the fd —
+                           # dispatcher-managed reads drain correctly
+                           and (channel is None
+                                or channel.ssl_ctx() is None))
         self._cid_base = _idp.create_ranged(
             self, Controller._on_id_error, self.max_retry + 2)
         self._live_versions = {0}
@@ -272,14 +278,16 @@ class Controller:
         self.attempt_remotes[self._nretry] = remote
         attempt_id = self._cid_base + self._nretry
         ctype = self.connection_type or "single"
+        ssl_ctx = self._channel.ssl_ctx() if self._channel else None
         if ctype == "pooled":
-            sid, rc = pooled_socket(remote)
+            sid, rc = pooled_socket(remote, ssl_context=ssl_ctx)
             self._attempt_sids.append(sid)
         elif ctype == "short":
-            sid, rc = short_socket(remote)
+            sid, rc = short_socket(remote, ssl_context=ssl_ctx)
             self._attempt_sids.append(sid)
         else:
-            sid, rc = global_socket_map().get_socket(remote)
+            sid, rc = global_socket_map().get_socket(remote,
+                                                     ssl_context=ssl_ctx)
         self._sending_sid = sid
         sock = Socket.address(sid)
         if sock is not None and sock.direct_read and not self._direct_ok:

@@ -79,6 +79,7 @@ def eligible(channel, cntl) -> bool:
     opts = channel.options
     ctype = cntl.connection_type or opts.connection_type
     return (opts.protocol == "tpu_std"
+            and not opts.ssl and opts.ssl_context is None
             and ctype in ("pooled", "short")
             and not cntl.request_compress_type
             and cntl.request_device_attachment is None

@@ -32,7 +32,8 @@ class ServerOptions:
     __slots__ = ("num_workers", "max_concurrency", "method_max_concurrency",
                  "auth", "interceptor", "idle_timeout_s",
                  "internal_port", "server_info_name",
-                 "native", "native_loops", "usercode_inline")
+                 "native", "native_loops", "usercode_inline",
+                 "ssl_cert", "ssl_key", "ssl_context")
 
     def __init__(self):
         self.num_workers = 0            # 0 = leave fiber runtime defaults
@@ -56,6 +57,13 @@ class ServerOptions:
         # thread handoff per request — the echo-class latency fast path.
         # Only enable when handlers never block (or begin_async() early).
         self.usercode_inline = False
+        # TLS on the serving port (≈ ServerSSLOptions,
+        # /root/reference/src/brpc/ssl_options.h:83): set cert+key paths,
+        # or a ready ssl.SSLContext.  TLS serves through the Python
+        # transport (the native engine speaks cleartext framed protocols).
+        self.ssl_cert = ""
+        self.ssl_key = ""
+        self.ssl_context = None
 
 
 class _MethodEntry:
@@ -161,6 +169,17 @@ class Server:
     def inflight(self) -> int:
         return self._inflight
 
+    def _server_ssl_context(self):
+        opts = self.options
+        if opts.ssl_context is not None:
+            return opts.ssl_context
+        if not opts.ssl_cert:
+            return None
+        import ssl as _ssl
+        ctx = _ssl.SSLContext(_ssl.PROTOCOL_TLS_SERVER)
+        ctx.load_cert_chain(opts.ssl_cert, opts.ssl_key or None)
+        return ctx
+
     # -- lifecycle ---------------------------------------------------------
 
     def start(self, addr: Any = "127.0.0.1:0") -> int:
@@ -200,7 +219,8 @@ class Server:
         from ..protocol import tpu_std as _tpu    # noqa: F401
         handlers = [p for p in list_protocols() if p.support_server]
         self._messenger = InputMessenger(handlers, arg=self)
-        if self.options.native:
+        ssl_ctx = self._server_ssl_context()
+        if self.options.native and ssl_ctx is None:
             from ..native import load as load_native
             native_mod = load_native()
             if native_mod is not None:
@@ -211,8 +231,11 @@ class Server:
             else:
                 LOG.warning("native engine unavailable; serving %s through "
                             "the Python transport", ep)
+        elif self.options.native and ssl_ctx is not None:
+            LOG.warning("TLS serving uses the Python transport; "
+                        "native engine disabled for %s", ep)
         if self._native_bridge is None:
-            self._acceptor = Acceptor(self._messenger)
+            self._acceptor = Acceptor(self._messenger, ssl_context=ssl_ctx)
             self._acceptor.start_accept(lst)
 
         # Optional second, operator-only port: builtin portal pages (flag

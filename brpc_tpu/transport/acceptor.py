@@ -23,10 +23,12 @@ from .socket import Socket, SocketOptions
 class Acceptor:
     def __init__(self, messenger: InputMessenger,
                  dispatcher: Optional[EventDispatcher] = None,
-                 tag: Optional[str] = None):
+                 tag: Optional[str] = None,
+                 ssl_context=None):
         self._messenger = messenger
         self._dispatcher = dispatcher or global_dispatcher()
         self._tag = tag                  # stamped on accepted sockets
+        self._ssl_context = ssl_context  # TLS: wrap accepted connections
         self._listen_sid = 0
         self._conn_lock = threading.Lock()
         self._connections: Dict[int, int] = {}   # sid -> sid (set)
@@ -51,22 +53,46 @@ class Acceptor:
                 conn, addr = listen_sock.fd.accept()
             except (BlockingIOError, OSError):
                 return
-            conn.setblocking(False)
             try:
                 conn.setsockopt(_socket.IPPROTO_TCP, _socket.TCP_NODELAY, 1)
             except OSError:
                 pass
             remote = EndPoint(host=addr[0], port=addr[1]) \
                 if isinstance(addr, tuple) else EndPoint(host=str(addr), port=0)
-            sid = Socket.create(SocketOptions(
-                fd=conn, remote_side=remote,
-                on_edge_triggered_events=self._messenger.on_new_messages))
-            s = Socket.address(sid)
-            s.tag = self._tag
-            s.attach_dispatcher(self._dispatcher)
-            with self._conn_lock:
-                self._connections[sid] = sid
-            self._dispatcher.add_consumer(conn, s.start_input_event)
+            if self._ssl_context is not None:
+                # bounded blocking handshake on its own fiber so the
+                # accept loop never stalls behind a slow TLS peer
+                from ..fiber import runtime as fiber_runtime
+                fiber_runtime.spawn(self._tls_accept, conn, remote,
+                                    name="tls_accept")
+                continue
+            conn.setblocking(False)
+            self._register(conn, remote)
+
+    def _tls_accept(self, conn: _socket.socket, remote: EndPoint) -> None:
+        try:
+            conn.settimeout(5.0)
+            tls = self._ssl_context.wrap_socket(conn, server_side=True)
+            tls.setblocking(False)
+        except (OSError, ValueError) as e:
+            LOG.warning("TLS handshake with %s failed: %s", remote, e)
+            try:
+                conn.close()
+            except OSError:
+                pass
+            return
+        self._register(tls, remote)
+
+    def _register(self, conn: _socket.socket, remote: EndPoint) -> None:
+        sid = Socket.create(SocketOptions(
+            fd=conn, remote_side=remote,
+            on_edge_triggered_events=self._messenger.on_new_messages))
+        s = Socket.address(sid)
+        s.tag = self._tag
+        s.attach_dispatcher(self._dispatcher)
+        with self._conn_lock:
+            self._connections[sid] = sid
+        self._dispatcher.add_consumer(conn, s.start_input_event)
 
     def connection_count(self) -> int:
         self._gc()

@@ -49,14 +49,16 @@ _sockets_created = Adder("socket_count")
 
 class SocketOptions:
     __slots__ = ("fd", "remote_side", "on_edge_triggered_events", "user",
-                 "health_check_interval_s", "connect_timeout_s", "app_data")
+                 "health_check_interval_s", "connect_timeout_s", "app_data",
+                 "ssl_context")
 
     def __init__(self, fd: Optional[_socket.socket] = None,
                  remote_side: Optional[EndPoint] = None,
                  on_edge_triggered_events: Optional[Callable] = None,
                  user: Any = None,
                  health_check_interval_s: float = 0.0,
-                 connect_timeout_s: float = 1.0):
+                 connect_timeout_s: float = 1.0,
+                 ssl_context: Any = None):
         self.fd = fd
         self.remote_side = remote_side
         self.on_edge_triggered_events = on_edge_triggered_events
@@ -64,6 +66,7 @@ class SocketOptions:
         self.health_check_interval_s = health_check_interval_s
         self.connect_timeout_s = connect_timeout_s
         self.app_data = None
+        self.ssl_context = ssl_context   # client: wrap on connect (TLS)
 
 
 _pool: ResourcePool["Socket"] = ResourcePool()
@@ -90,7 +93,7 @@ class Socket:
         "_pooled_home", "correlation_id",
         "stream_map", "_stream_lock", "tag",
         "ici_endpoint", "ici_peer_domain",
-        "direct_read", "_dispatch_lock", "h2_conn",
+        "direct_read", "_dispatch_lock", "h2_conn", "ssl_context",
     )
 
     # -- lifecycle ---------------------------------------------------------
@@ -134,6 +137,7 @@ class Socket:
         self.direct_read = False
         self._dispatch_lock = threading.Lock()
         self.h2_conn = None               # server-side HTTP/2 session state
+        self.ssl_context = None           # TLS: wrap on connect
 
     @staticmethod
     def create(options: SocketOptions) -> int:
@@ -147,6 +151,7 @@ class Socket:
         s.app_data = options.app_data
         s.health_check_interval_s = options.health_check_interval_s
         s.connect_timeout_s = options.connect_timeout_s
+        s.ssl_context = options.ssl_context
         if s.fd is not None:
             s.fd.setblocking(False)
         _sockets_created << 1
@@ -177,8 +182,15 @@ class Socket:
             fd = _socket.create_connection(
                 self.remote_side.to_sockaddr(),
                 timeout=self.connect_timeout_s)
-            fd.setblocking(False)
             fd.setsockopt(_socket.IPPROTO_TCP, _socket.TCP_NODELAY, 1)
+            if self.ssl_context is not None:
+                # blocking bounded handshake, then the normal
+                # non-blocking event-driven life (≈ ssl_helper.cpp's
+                # SSL_do_handshake loop on the DCN path)
+                fd.settimeout(self.connect_timeout_s + 4.0)
+                fd = self.ssl_context.wrap_socket(
+                    fd, server_hostname=str(self.remote_side.host))
+            fd.setblocking(False)
             self.fd = fd
             return 0
         except OSError as e:
@@ -456,6 +468,9 @@ class Socket:
         except BlockingIOError:
             return -1
         except (ConnectionResetError, BrokenPipeError, OSError) as e:
+            import ssl as _ssl
+            if isinstance(e, (_ssl.SSLWantReadError, _ssl.SSLWantWriteError)):
+                return -1               # TLS needs more wire bytes first
             if isinstance(e, OSError) and e.errno in (_errno.EAGAIN,
                                                       _errno.EWOULDBLOCK):
                 return -1

@@ -31,7 +31,8 @@ DEFAULT_HEALTH_CHECK_INTERVAL_S = 3.0   # reference socket_map.cpp:33
 
 def _new_connection(remote: EndPoint,
                     health_check_interval_s: float = 0.0,
-                    direct_read: bool = False) -> Tuple[int, int]:
+                    direct_read: bool = False,
+                    ssl_context=None) -> Tuple[int, int]:
     """Create+connect a client Socket wired for responses.
     Returns (socket_id, error_code).
 
@@ -41,7 +42,8 @@ def _new_connection(remote: EndPoint,
     sid = Socket.create(SocketOptions(
         remote_side=remote,
         on_edge_triggered_events=client_messenger().on_new_messages,
-        health_check_interval_s=health_check_interval_s))
+        health_check_interval_s=health_check_interval_s,
+        ssl_context=ssl_context))
     s = Socket.address(sid)
     rc = s.connect_if_not()
     if rc != 0:
@@ -71,25 +73,29 @@ class SocketMap:
         return get_flag("health_check_interval_s",
                         DEFAULT_HEALTH_CHECK_INTERVAL_S)
 
-    def get_socket(self, remote: EndPoint) -> Tuple[int, int]:
+    def get_socket(self, remote: EndPoint,
+                   ssl_context=None) -> Tuple[int, int]:
         """Return (socket_id, 0) for the shared connection to ``remote``,
         creating it on first use. A failed socket stays in the map —
         health check revives it in place, exactly the reference behavior
         (callers see EFAILEDSOCKET meanwhile and may retry elsewhere)."""
+        key = (remote, ssl_context is not None)
         with self._lock:
-            sid = self._map.get(remote)
+            sid = self._map.get(key)
             if sid is not None:
                 s = Socket.address(sid)
                 if s is not None:
                     return sid, 0
-            sid, rc = _new_connection(remote, self._hc_interval())
+            sid, rc = _new_connection(remote, self._hc_interval(),
+                                      ssl_context=ssl_context)
             if rc == 0 or Socket.address(sid) is not None:
-                self._map[remote] = sid
+                self._map[key] = sid
             return sid, rc
 
     def remove(self, remote: EndPoint) -> None:
         with self._lock:
-            sid = self._map.pop(remote, None)
+            sid = self._map.pop((remote, False), None) \
+                or self._map.pop((remote, True), None)
         if sid is not None:
             s = Socket.address(sid)
             if s is not None:
@@ -109,11 +115,13 @@ class SocketPool:
     """Per-peer pooled connections (≈ Socket::GetPooledSocket,
     socket.cpp:2650)."""
 
-    def __init__(self, remote: EndPoint, max_pooled: int = 32):
+    def __init__(self, remote: EndPoint, max_pooled: int = 32,
+                 ssl_context=None):
         self._remote = remote
         self._lock = threading.Lock()
         self._free: Deque[int] = deque()
         self._max = max_pooled
+        self._ssl_context = ssl_context
 
     def get(self) -> Tuple[int, int]:
         while True:
@@ -128,7 +136,8 @@ class SocketPool:
                 s.release()      # failed pooled conn: free the slot
         # pooled connections are born direct-read (sync fast path);
         # async callers convert them via ensure_dispatched()
-        sid, rc = _new_connection(self._remote, direct_read=True)
+        sid, rc = _new_connection(self._remote, direct_read=True,
+                                  ssl_context=self._ssl_context)
         s = Socket.address(sid)
         if s is not None:
             s._pooled_home = self
@@ -162,11 +171,13 @@ def global_socket_map() -> SocketMap:
         return _global_map
 
 
-def pooled_socket(remote: EndPoint) -> Tuple[int, int]:
+def pooled_socket(remote: EndPoint, ssl_context=None) -> Tuple[int, int]:
+    key = (remote, ssl_context is not None)
     with _pools_lock:
-        pool = _pools.get(remote)
+        pool = _pools.get(key)
         if pool is None:
-            pool = _pools[remote] = SocketPool(remote)
+            pool = _pools[key] = SocketPool(remote,
+                                            ssl_context=ssl_context)
     return pool.get()
 
 
@@ -176,5 +187,6 @@ def return_pooled_socket(sid: int) -> None:
         s._pooled_home.put(sid)
 
 
-def short_socket(remote: EndPoint) -> Tuple[int, int]:
-    return _new_connection(remote, direct_read=True)
+def short_socket(remote: EndPoint, ssl_context=None) -> Tuple[int, int]:
+    return _new_connection(remote, direct_read=True,
+                           ssl_context=ssl_context)

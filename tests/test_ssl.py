@@ -1,0 +1,129 @@
+"""TLS transport tests (≈ /root/reference/src/brpc/details/ssl_helper.cpp
+capability: encrypted client/server channels on the DCN path).
+Self-signed certs are generated per-session with the openssl CLI."""
+
+import subprocess
+import time
+
+import pytest
+
+from brpc_tpu.butil.iobuf import IOBuf
+from brpc_tpu.client import Channel, ChannelOptions, Controller
+from brpc_tpu.server import Server, ServerOptions, Service
+
+
+@pytest.fixture(scope="module")
+def certs(tmp_path_factory):
+    d = tmp_path_factory.mktemp("certs")
+    cert, key = str(d / "cert.pem"), str(d / "key.pem")
+    subprocess.run(
+        ["openssl", "req", "-x509", "-newkey", "rsa:2048", "-nodes",
+         "-keyout", key, "-out", cert, "-days", "1",
+         "-subj", "/CN=localhost",
+         "-addext", "subjectAltName=IP:127.0.0.1,DNS:localhost"],
+        check=True, capture_output=True, timeout=60)
+    return cert, key
+
+
+class Echo(Service):
+    def Echo(self, cntl, request):
+        return request
+
+    def Att(self, cntl, request):
+        cntl.response_attachment.append_iobuf(cntl.request_attachment)
+        return b"ok"
+
+
+@pytest.fixture(scope="module")
+def tls_server(certs):
+    cert, key = certs
+    opts = ServerOptions()
+    opts.ssl_cert = cert
+    opts.ssl_key = key
+    srv = Server(opts)
+    srv.add_service(Echo(), name="E")
+    assert srv.start("127.0.0.1:0") == 0
+    yield srv
+    srv.stop()
+
+
+def _tls_channel(server, ctype="single", **kw):
+    co = ChannelOptions()
+    co.ssl = True
+    co.connection_type = ctype
+    co.timeout_ms = 5000
+    for k, v in kw.items():
+        setattr(co, k, v)
+    ch = Channel(co)
+    assert ch.init(str(server.listen_endpoint)) == 0
+    return ch
+
+
+def test_tls_echo_single(tls_server):
+    ch = _tls_channel(tls_server)
+    assert ch.call("E.Echo", b"secret-hello") == b"secret-hello"
+    for i in range(20):
+        assert ch.call("E.Echo", b"m%d" % i) == b"m%d" % i
+
+
+def test_tls_echo_pooled_and_short(tls_server):
+    for ctype in ("pooled", "short"):
+        ch = _tls_channel(tls_server, ctype=ctype)
+        assert ch.call("E.Echo", b"via-" + ctype.encode()) \
+            == b"via-" + ctype.encode()
+
+
+def test_tls_large_payload_and_attachment(tls_server):
+    ch = _tls_channel(tls_server)
+    big = bytes(range(256)) * 2048          # 512KB
+    cntl = Controller()
+    cntl.timeout_ms = 20_000
+    cntl.request_attachment = IOBuf(big)
+    c = ch.call_method("E.Att", b"", cntl=cntl)
+    assert not c.failed, c.error_text
+    assert c.response_attachment.to_bytes() == big
+
+
+def test_tls_verified_against_pinned_ca(tls_server, certs):
+    cert, _ = certs
+    ch = _tls_channel(tls_server, ssl_ca=cert, ssl_verify=True)
+    assert ch.call("E.Echo", b"verified") == b"verified"
+
+
+def test_plaintext_client_rejected_by_tls_server(tls_server):
+    co = ChannelOptions()
+    co.timeout_ms = 2000
+    co.max_retry = 0
+    ch = Channel(co)
+    assert ch.init(str(tls_server.listen_endpoint)) == 0
+    cntl = Controller()
+    ch.call_method("E.Echo", b"plaintext", cntl=cntl)
+    assert cntl.failed
+    # and the server still serves TLS clients afterwards
+    ch2 = _tls_channel(tls_server)
+    assert ch2.call("E.Echo", b"still-works") == b"still-works"
+
+
+def test_tls_client_against_plaintext_server_fails_cleanly():
+    srv = Server()
+    srv.add_service(Echo(), name="E")
+    assert srv.start("127.0.0.1:0") == 0
+    try:
+        co = ChannelOptions()
+        co.ssl = True
+        co.timeout_ms = 2000
+        co.max_retry = 0
+        ch = Channel(co)
+        assert ch.init(str(srv.listen_endpoint)) == 0
+        cntl = Controller()
+        ch.call_method("E.Echo", b"x", cntl=cntl)
+        assert cntl.failed
+    finally:
+        srv.stop()
+
+
+def test_tls_grpc_interop_skipped_note():
+    """gRPC-over-TLS rides the same ssl.SSLContext plumbing via the h2
+    client; covered implicitly once GrpcConnection gains TLS (tracked
+    in SURVEY §7) — this placeholder documents the boundary."""
+    assert True
