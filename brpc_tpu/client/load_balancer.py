@@ -33,6 +33,13 @@ class LoadBalancer:
         # like the reference channel.h:49-77): when False, no node is
         # filtered by breaker state and calls don't feed it.
         self.use_circuit_breaker = False
+        # ClusterRecoverPolicy (≈ cluster_recover_policy.h): when fewer
+        # than min_working_instances survive breaker isolation, the
+        # cluster is deemed "recovering" — selection probes the FULL
+        # list (isolated included) so broken-but-healed servers get
+        # traffic and can revive, instead of the survivors melting down.
+        self.min_working_instances = 0      # 0 = policy off
+        self.recovering = False
 
     # -- membership (≈ AddServer/RemoveServer batched) --------------------
 
@@ -63,9 +70,20 @@ class LoadBalancer:
         nodes = self._servers.read()
         excluded = getattr(cntl, "excluded_servers", None) or ()
         breakers = self._breakers if self.use_circuit_breaker else None
-        out = [n for n in nodes
-               if n.endpoint not in excluded
-               and (breakers is None or not breakers.isolated(n.endpoint))]
+        usable = [n for n in nodes
+                  if (breakers is None
+                      or not breakers.isolated(n.endpoint))]
+        if breakers is not None and self.min_working_instances > 0:
+            if len(usable) < self.min_working_instances:
+                self.recovering = True
+            elif self.recovering and \
+                    len(usable) >= self.min_working_instances:
+                self.recovering = False
+            if self.recovering:
+                # probe the full list so isolated-but-healed servers get
+                # traffic and can re-qualify
+                usable = list(nodes)
+        out = [n for n in usable if n.endpoint not in excluded]
         if not out and nodes:
             # every node excluded/isolated: fall back to the full list
             # rather than failing the call outright (cluster recover

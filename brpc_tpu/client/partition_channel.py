@@ -161,3 +161,92 @@ class PartitionChannel:
     def stop(self) -> None:
         if self._ns is not None:
             self._ns.stop()
+
+
+class DynamicPartitionChannel(PartitionChannel):
+    """≈ DynamicPartitionChannel (partition_channel.h:136): during an
+    N→M re-partitioning, servers of BOTH schemes coexist in naming; each
+    call picks one scheme, weighted by its capacity (replica count), so
+    traffic migrates proportionally as the new scheme fills in — instead
+    of the base class's single-scheme adoption cliff."""
+
+    def __init__(self, *args, **kw):
+        super().__init__(*args, **kw)
+        self._schemes: Dict[int, Dict[int, _PartitionLB]] = {}
+        self._scheme_sizes: Dict[int, int] = {}
+
+    def _on_servers(self, nodes: List[ServerNode]) -> None:
+        groups: Dict[int, Dict[int, List[ServerNode]]] = {}
+        for n in nodes:
+            parsed = parse_partition_tag(n.tag)
+            if parsed is None:
+                continue
+            idx, total = parsed
+            if 0 <= idx < total:
+                groups.setdefault(total, {}).setdefault(idx, []).append(n)
+        with self._lock:
+            # only COMPLETE schemes carry traffic (a scheme missing a
+            # partition would black-hole part of the key space)
+            complete = {t: g for t, g in groups.items() if len(g) == t}
+            stale = set(self._schemes) - set(complete)
+            for t in stale:
+                del self._schemes[t]
+                self._scheme_sizes.pop(t, None)
+            for t, by_part in complete.items():
+                scheme = self._schemes.setdefault(t, {})
+                for idx, members in by_part.items():
+                    plb = scheme.get(idx)
+                    if plb is None:
+                        plb = scheme[idx] = _PartitionLB(
+                            self._lb_name, idx,
+                            self.options.enable_circuit_breaker)
+                    plb.lb.reset_servers(members)
+                self._scheme_sizes[t] = sum(
+                    len(m) for m in by_part.values())
+            # keep the base-class view pointing at the largest scheme so
+            # .partitions introspection still answers
+            if complete:
+                biggest = max(complete)
+                self._partitions = dict(self._schemes[biggest])
+
+    @property
+    def scheme_weights(self) -> Dict[int, int]:
+        with self._lock:
+            return dict(self._scheme_sizes)
+
+    def call_method(self, method_full: str, request: Any,
+                    response_type: Any = None,
+                    done: Optional[Callable] = None,
+                    cntl: Optional[Controller] = None,
+                    call_mapper: Optional[Callable] = None,
+                    merger: Optional[Callable] = None) -> Controller:
+        from ..butil.fast_rand import fast_rand
+        with self._lock:
+            total_cap = sum(self._scheme_sizes.values())
+            if total_cap <= 0:
+                parts = []
+            else:
+                r = fast_rand() % total_cap
+                chosen = None
+                for t in sorted(self._schemes):
+                    r -= self._scheme_sizes[t]
+                    if r < 0:
+                        chosen = t
+                        break
+                parts = sorted(self._schemes[chosen].items())
+        if not parts:
+            c = cntl or Controller()
+            c._fail_before_launch(int(Errno.EINTERNAL),
+                                  "no complete partition scheme", done)
+            return c
+        pc = ParallelChannel(fail_limit=self.fail_limit)
+        for idx, plb in parts:
+            sub = _PartitionSubChannel(plb, self.options)
+            if call_mapper is not None:
+                def mk(i):
+                    return lambda _i, _sub, req: call_mapper(i, _sub, req)
+                pc.add_channel(sub, call_mapper=mk(idx))
+            else:
+                pc.add_channel(sub)
+        return pc.call_method(method_full, request, response_type,
+                              done=done, cntl=cntl, merger=merger)

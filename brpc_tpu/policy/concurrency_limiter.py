@@ -99,10 +99,44 @@ class AutoLimiter(ConcurrencyLimiter):
             self._win_lat_sum = 0.0
 
 
+class TimeoutLimiter(ConcurrencyLimiter):
+    """Timeout-driven limit
+    (≈ /root/reference/src/brpc/policy/timeout_concurrency_limiter.h):
+    admit only as many requests as can still finish inside the timeout
+    budget — max_concurrency = timeout / avg_latency.  A latency EMA
+    (failures counted at the full timeout) drives the bound, so a slow
+    backend sheds load it could never answer in time instead of queueing
+    doomed requests."""
+
+    def __init__(self, timeout_ms: float = 500.0,
+                 min_limit: int = 2, max_limit: int = 4096,
+                 alpha: float = 0.2):
+        self._timeout_us = max(1.0, timeout_ms * 1000.0)
+        self._min = min_limit
+        self._max = max_limit
+        self._alpha = alpha
+        self._lock = threading.Lock()
+        self._lat_ema: Optional[float] = None
+        self._limit = max_limit
+
+    def max_concurrency(self) -> int:
+        return self._limit
+
+    def on_responded(self, error_code: int, latency_us: float) -> None:
+        with self._lock:
+            sample = latency_us if error_code == 0 else self._timeout_us
+            if self._lat_ema is None:
+                self._lat_ema = float(sample)
+            else:
+                self._lat_ema += (sample - self._lat_ema) * self._alpha
+            self._limit = int(min(self._max, max(
+                self._min, self._timeout_us / max(1.0, self._lat_ema))))
+
+
 def make_limiter(spec) -> Optional[ConcurrencyLimiter]:
     """Parse an AdaptiveMaxConcurrency-style spec
     (≈ src/brpc/adaptive_max_concurrency.h): int / "constant:N" /
-    "auto" / "unlimited"."""
+    "auto" / "timeout[:ms]" / "unlimited"."""
     if spec is None:
         return None
     if isinstance(spec, int):
@@ -112,6 +146,10 @@ def make_limiter(spec) -> Optional[ConcurrencyLimiter]:
         return None
     if s == "auto":
         return AutoLimiter()
+    if s == "timeout":
+        return TimeoutLimiter()
+    if s.startswith("timeout:"):
+        return TimeoutLimiter(float(s.split(":", 1)[1]))
     if s.startswith("constant:"):
         return ConstantLimiter(int(s.split(":", 1)[1]))
     if s.isdigit():

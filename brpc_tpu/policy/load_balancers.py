@@ -17,7 +17,7 @@ import hashlib
 import itertools
 import threading
 import time
-from typing import Dict, List, Optional
+from typing import Any, Dict, List, Optional
 
 from ..butil.endpoint import EndPoint
 from ..butil.fast_rand import fast_rand
@@ -135,11 +135,71 @@ class ConsistentHashLB(LoadBalancer):
         return ring_nodes[idx]
 
 
+class WeightTree:
+    """Fenwick (binary-indexed) tree over node weights with O(log n)
+    update and O(log n) weighted-random pick — the reference's
+    locality-aware weight tree shape
+    (/root/reference/src/brpc/policy/locality_aware_load_balancer.h:41-80)
+    re-expressed: total() is the root sum, pick descends by prefix sums.
+    """
+
+    def __init__(self, n: int = 0):
+        self._n = 0
+        self._bit: List[float] = []
+        self._w: List[float] = []
+        if n:
+            self.resize(n)
+
+    def resize(self, n: int) -> None:
+        self._n = n
+        self._bit = [0.0] * (n + 1)
+        self._w = [0.0] * n
+
+    def update(self, i: int, w: float) -> None:
+        delta = w - self._w[i]
+        if delta == 0.0:
+            return
+        self._w[i] = w
+        j = i + 1
+        while j <= self._n:
+            self._bit[j] += delta
+            j += j & (-j)
+
+    def weight(self, i: int) -> float:
+        return self._w[i]
+
+    def total(self) -> float:
+        return self._prefix(self._n)
+
+    def _prefix(self, j: int) -> float:
+        s = 0.0
+        while j > 0:
+            s += self._bit[j]
+            j -= j & (-j)
+        return s
+
+    def pick(self, r: float) -> int:
+        """Index i such that prefix(i) <= r < prefix(i+1); O(log n)
+        Fenwick descent."""
+        pos = 0
+        mask = 1
+        while mask * 2 <= self._n:
+            mask *= 2
+        while mask:
+            nxt = pos + mask
+            if nxt <= self._n and self._bit[nxt] <= r:
+                pos = nxt
+                r -= self._bit[nxt]
+            mask //= 2
+        return min(pos, self._n - 1)
+
+
 class LocalityAwareLB(LoadBalancer):
-    """Pick the server with the best expected latency, punishing inflight
-    depth: weight = 1 / (ema_latency_us * (1 + inflight * punish)).
-    The reference's iterative lowest-expected-latency idea
-    (locality_aware_load_balancer.h) without its tree structure."""
+    """Weighted-random by expected goodness: weight =
+    1 / (ema_latency_us * (1 + inflight * punish)), maintained in a
+    Fenwick weight tree so select and feedback are O(log n) — the shape
+    that survives pod-scale server lists
+    (≈ locality_aware_load_balancer.h:41-80)."""
 
     PUNISH = 0.5
     ALPHA = 0.2
@@ -150,29 +210,53 @@ class LocalityAwareLB(LoadBalancer):
         self._stat_lock = threading.Lock()
         self._lat: Dict[EndPoint, float] = {}
         self._inflight: Dict[EndPoint, int] = {}
+        self._tree = WeightTree()
+        self._eps: List[EndPoint] = []
+        self._index: Dict[EndPoint, int] = {}
+        self._by_ep: Dict[EndPoint, Any] = {}
+
+    def _weight_of(self, ep: EndPoint) -> float:
+        lat = self._lat.get(ep, self.DEFAULT_LATENCY_US)
+        inflight = self._inflight.get(ep, 0)
+        return 1e9 / (lat * (1.0 + inflight * self.PUNISH))
+
+    def _rebuild_locked(self, nodes) -> None:
+        self._eps = [n.endpoint for n in nodes]
+        self._index = {ep: i for i, ep in enumerate(self._eps)}
+        self._by_ep = {n.endpoint: n for n in nodes}
+        self._tree.resize(len(self._eps))
+        for i, ep in enumerate(self._eps):
+            self._tree.update(i, self._weight_of(ep))
+
+    def _bump_locked(self, ep: EndPoint) -> None:
+        i = self._index.get(ep)
+        if i is not None:
+            self._tree.update(i, self._weight_of(ep))
 
     def select(self, nodes, cntl):
-        best, best_score = None, float("inf")
         with self._stat_lock:
-            untried = [n for n in nodes if n.endpoint not in self._lat]
-            if untried:
-                # explore before exploiting — otherwise the first server
-                # to report a latency wins all traffic forever
-                best = untried[fast_rand() % len(untried)]
-                self._inflight[best.endpoint] = \
-                    self._inflight.get(best.endpoint, 0) + 1
-                return best
-            for n in nodes:
-                lat = self._lat.get(n.endpoint, self.DEFAULT_LATENCY_US)
-                inflight = self._inflight.get(n.endpoint, 0)
-                score = lat * (1.0 + inflight * self.PUNISH)
-                # small dither so equal servers share load
-                score *= 1.0 + (fast_rand() % 128) / 1024.0
-                if score < best_score:
-                    best, best_score = n, score
-            if best is not None:
-                self._inflight[best.endpoint] = \
-                    self._inflight.get(best.endpoint, 0) + 1
+            if len(nodes) != len(self._eps) or any(
+                    n.endpoint not in self._index for n in nodes):
+                self._rebuild_locked(nodes)
+            total = self._tree._prefix(self._tree._n)
+            if total <= 0:
+                best = nodes[fast_rand() % len(nodes)]
+            else:
+                # a few weighted draws tolerate per-call exclusions
+                # without rebuilding the tree
+                excluded = getattr(cntl, "excluded_servers", None) or ()
+                best = None
+                for _ in range(4):
+                    r = (fast_rand() % (1 << 30)) / float(1 << 30) * total
+                    ep = self._eps[self._tree.pick(r)]
+                    if ep not in excluded:
+                        best = self._by_ep.get(ep)
+                        break
+                if best is None:
+                    best = nodes[fast_rand() % len(nodes)]
+            ep = best.endpoint
+            self._inflight[ep] = self._inflight.get(ep, 0) + 1
+            self._bump_locked(ep)
         return best
 
     def on_feedback(self, cntl):
@@ -186,6 +270,7 @@ class LocalityAwareLB(LoadBalancer):
                 n = self._inflight.get(aep, 0)
                 if n > 0:
                     self._inflight[aep] = n - 1
+                self._bump_locked(aep)
             if cntl.error_code == 0:
                 prev = self._lat.get(ep, self.DEFAULT_LATENCY_US)
                 self._lat[ep] = prev + (cntl.latency_us - prev) * self.ALPHA
@@ -194,6 +279,7 @@ class LocalityAwareLB(LoadBalancer):
                 # (the breaker handles hard isolation)
                 prev = self._lat.get(ep, self.DEFAULT_LATENCY_US)
                 self._lat[ep] = prev * 1.5
+            self._bump_locked(ep)
 
 
 lb_registry().register("rr", RoundRobinLB)
