@@ -105,6 +105,15 @@ class ResourcePool(Generic[T]):
     def __len__(self) -> int:
         return self.live_count
 
+    def live_items(self) -> List[Tuple[int, T]]:
+        """Snapshot of (id, obj) for live slots (introspection pages)."""
+        out: List[Tuple[int, T]] = []
+        with self._lock:
+            for slot, obj in enumerate(self._objs):
+                if obj is not None:
+                    out.append((make_id(self._versions[slot], slot), obj))
+        return out
+
 
 class ObjectPool(Generic[T]):
     """Simple recycling pool without ids (≈ butil::ObjectPool,

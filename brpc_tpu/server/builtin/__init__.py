@@ -239,6 +239,106 @@ def _hotspots_run(server, q, kind, seconds):
             "/hotspots/device?seconds=3\n")
 
 
+def _sockets(server, msg, rest):
+    """/sockets — live socket table (≈ builtin/sockets_service.cpp)."""
+    from ...transport.socket import socket_pool
+
+    lines = [f"{'id':>20} {'remote':<22} {'state':<8} "
+             f"{'direct':<7} {'tag':<10} pending_writes", "-" * 80]
+    for sid, s in socket_pool().live_items():
+        try:
+            state = "failed" if s.failed else "ok"
+            remote = str(s.remote_side or "-")
+            tag = str(getattr(s, "tag", None) or "-")
+            direct = "yes" if getattr(s, "direct_read", False) else "no"
+            pending = len(getattr(s, "_write_queue", ()) or ())
+            lines.append(f"{sid:>20} {remote:<22} {state:<8} "
+                         f"{direct:<7} {tag:<10} {pending}")
+        except Exception:
+            continue
+    lines.append(f"\n{len(socket_pool())} live sockets")
+    return 200, "text/plain", "\n".join(lines) + "\n"
+
+
+def _threads(server, msg, rest):
+    """/threads — all thread stacks (≈ builtin pstack via
+    threads_service.cpp; here sys._current_frames + traceback)."""
+    import threading as _threading
+    import traceback as _tb
+
+    names = {t.ident: t.name for t in _threading.enumerate()}
+    out = []
+    for tid, frame in sorted(sys._current_frames().items()):
+        out.append(f"--- thread {tid} ({names.get(tid, '?')}) ---")
+        out.extend(line.rstrip() for line in _tb.format_stack(frame))
+        out.append("")
+    return 200, "text/plain", "\n".join(out) + "\n"
+
+
+def _protobufs(server, msg, rest):
+    """/protobufs — service/method schema listing (the reference lists
+    registered pb descriptors; here the method registry + request types)."""
+    out = {}
+    for (svc, mth), entry in sorted(server.methods.items()):
+        rt = entry.request_type
+        out[f"{svc}.{mth}"] = {
+            "request_type": getattr(rt, "__name__", str(rt))
+            if rt is not None else "bytes",
+            "grpc_streaming": bool(getattr(entry, "grpc_streaming", False)),
+            "max_concurrency": entry.status.max_concurrency or 0,
+        }
+    return 200, "application/json", json.dumps(out, indent=1)
+
+
+def _vlog(server, msg, rest):
+    """/vlog — inspect/set the framework log level
+    (?setlevel=DEBUG|INFO|WARNING|ERROR)."""
+    import logging as _logging
+
+    from ...butil.logging_util import LOG as _LOG
+    q = msg.query()
+    if "setlevel" in q:
+        name = q["setlevel"].upper()
+        lvl = getattr(_logging, name, None)
+        if not isinstance(lvl, int):
+            return 400, "text/plain", f"unknown level {name!r}\n"
+        _LOG.setLevel(lvl)
+        return 200, "text/plain", f"log level set to {name}\n"
+    return 200, "text/plain", \
+        f"level={_logging.getLevelName(_LOG.level)}  " \
+        f"(set with /vlog?setlevel=DEBUG)\n"
+
+
+def _dir(server, msg, rest):
+    """/dir — browse the server's working directory (read-only;
+    ≈ builtin/dir_service.cpp)."""
+    base = os.path.realpath(os.getcwd())
+    target = os.path.realpath(os.path.join(base, *rest))
+    if not target.startswith(base):
+        return 403, "text/plain", "outside the working directory\n"
+    if os.path.isdir(target):
+        entries = sorted(os.listdir(target))
+        rel = os.path.relpath(target, base)
+        lines = [f"{rel if rel != '.' else '.'}/:"]
+        for e in entries:
+            full = os.path.join(target, e)
+            mark = "/" if os.path.isdir(full) else \
+                f"  ({os.path.getsize(full)} bytes)"
+            lines.append(f"  {e}{mark}")
+        return 200, "text/plain", "\n".join(lines) + "\n"
+    if os.path.isfile(target):
+        if os.path.getsize(target) > (8 << 20):
+            return 403, "text/plain", "file too large\n"
+        with open(target, "rb") as f:
+            return 200, "application/octet-stream", f.read()
+    return 404, "text/plain", "no such path\n"
+
+
+register_builtin("sockets", _sockets)
+register_builtin("threads", _threads)
+register_builtin("protobufs", _protobufs)
+register_builtin("vlog", _vlog)
+register_builtin("dir", _dir)
 register_builtin("hotspots", _hotspots)
 register_builtin("", _index)
 register_builtin("index", _index)

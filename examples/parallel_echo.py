@@ -1,0 +1,47 @@
+"""ParallelChannel fan-out (≈ reference example/parallel_echo_c++):
+one call fans to 3 servers, responses merge; one dead sub-channel is
+tolerated with fail_limit.  Run: python examples/parallel_echo.py
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from brpc_tpu.client import Channel, ChannelOptions          # noqa: E402
+from brpc_tpu.client.parallel_channel import ParallelChannel  # noqa: E402
+from brpc_tpu.server import Server, Service                   # noqa: E402
+
+
+class Shard(Service):
+    def __init__(self, label: bytes):
+        self.label = label
+
+    def Get(self, cntl, request):
+        return self.label + b":" + request
+
+
+def main():
+    servers = []
+    for i in range(3):
+        s = Server()
+        s.add_service(Shard(b"shard%d" % i), name="Shard")
+        assert s.start("127.0.0.1:0") == 0
+        servers.append(s)
+
+    pc = ParallelChannel(fail_limit=1)
+    for s in servers:
+        sub = Channel(ChannelOptions())
+        sub.init(str(s.listen_endpoint))
+        pc.add_channel(sub)
+
+    c = pc.call_method("Shard.Get", b"key42")
+    assert not c.failed, c.error_text
+    print("merged response:", c.response)
+
+    for s in servers:
+        s.stop()
+
+
+if __name__ == "__main__":
+    main()

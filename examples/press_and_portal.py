@@ -1,0 +1,51 @@
+"""Load + observability tour: drive a server with rpc_press while
+reading live stats, rpcz spans and a CPU flame profile from the builtin
+portal.  Run: python examples/press_and_portal.py
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from brpc_tpu.server import Server, Service                   # noqa: E402
+from brpc_tpu.tools.rpc_press import Press, PressOptions      # noqa: E402
+from brpc_tpu.tools.rpc_view import fetch                     # noqa: E402
+
+
+class Work(Service):
+    def Do(self, cntl, request):
+        return request[::-1]
+
+
+def main():
+    server = Server()
+    server.add_service(Work(), name="W")
+    assert server.start("127.0.0.1:0") == 0
+    addr = str(server.listen_endpoint)
+
+    popts = PressOptions()
+    popts.server = addr
+    popts.method = "W.Do"
+    popts.qps = 500
+    popts.duration_s = 3.0
+    popts.input = b"payload"
+    press = Press(popts)
+    press.start()
+
+    import time
+    time.sleep(1.0)
+    print("== /status ==")
+    print(fetch(addr, "status"))
+    print("== /vars (rpc related) ==")
+    print(fetch(addr, "vars?filter=input_messenger"))
+    print("== /hotspots/cpu (1s flame, flat view) ==")
+    print(fetch(addr, "hotspots/cpu?seconds=1&view=flat"))
+
+    press.stop()
+    print("press summary:", press.summary())
+    server.stop()
+
+
+if __name__ == "__main__":
+    main()

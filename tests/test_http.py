@@ -214,3 +214,24 @@ def test_internal_port_gates_builtin_pages():
         ic.close()
     finally:
         srv.stop()
+
+
+def test_portal_back_half_pages(server):
+    """New portal pages: /sockets, /threads, /protobufs, /vlog, /dir."""
+    status, body = _get(server, "/sockets")
+    assert status == 200 and b"live sockets" in body
+    status, body = _get(server, "/threads")
+    assert status == 200 and b"MainThread" in body
+    status, body = _get(server, "/protobufs")
+    assert status == 200
+    import json as _json
+    schema = _json.loads(body)
+    assert "Calc.Add" in schema
+    status, body = _get(server, "/vlog")
+    assert status == 200 and b"level=" in body
+    status, body = _get(server, "/vlog?setlevel=INFO")
+    assert status == 200 and b"INFO" in body
+    status, body = _get(server, "/dir")
+    assert status == 200
+    status, body = _get(server, "/dir/../../etc")
+    assert status in (403, 404)
