@@ -110,6 +110,15 @@ class Server:
         if sname in self._services:
             LOG.error("service %s already added", sname)
             return -1
+        if sname == "redis" and hasattr(service, "on_command"):
+            # RESP service: the shared port speaks redis to it
+            # (≈ ServerOptions.redis_service, src/brpc/redis.h)
+            self._services[sname] = service
+            return 0
+        if sname == "thrift" and hasattr(service, "handle"):
+            # thrift framed-binary service on the shared port
+            self._services[sname] = service
+            return 0
         methods = extract_methods(service)
         if not methods:
             LOG.error("service %s has no public methods", sname)
@@ -215,7 +224,9 @@ class Server:
         from ..ici import endpoint as _ici        # noqa: F401
         from ..protocol import h2_rpc as _h2      # noqa: F401
         from ..protocol import http as _http      # noqa: F401
+        from ..protocol import resp as _resp      # noqa: F401
         from ..protocol import streaming as _str  # noqa: F401
+        from ..protocol import thrift_proto as _t  # noqa: F401
         from ..protocol import tpu_std as _tpu    # noqa: F401
         handlers = [p for p in list_protocols() if p.support_server]
         self._messenger = InputMessenger(handlers, arg=self)

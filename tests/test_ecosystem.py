@@ -1,0 +1,309 @@
+"""Ecosystem protocol tests: redis (RESP) client+server on the shared
+port, memcached text client, thrift framed-binary client+server
+(≈ /root/reference/src/brpc/redis.h, memcache.h,
+policy/thrift_protocol.cpp capabilities)."""
+
+import socketserver
+import threading
+
+import pytest
+
+from brpc_tpu.client.memcache_client import MemcacheClient
+from brpc_tpu.client.redis_client import RedisClient
+from brpc_tpu.protocol.resp import (NIL, RedisError, decode_one,
+                                    encode_command, encode_reply)
+from brpc_tpu.protocol.thrift_proto import (TBinary, ThriftApplicationError,
+                                            ThriftClient)
+from brpc_tpu.server import Server, Service
+
+
+# -- RESP codec -------------------------------------------------------------
+
+def test_resp_encode_known_bytes():
+    assert encode_reply("OK") == b"+OK\r\n"
+    assert encode_reply(42) == b":42\r\n"
+    assert encode_reply(b"hi") == b"$2\r\nhi\r\n"
+    assert encode_reply(None) == b"$-1\r\n"
+    assert encode_reply([b"a", 1]) == b"*2\r\n$1\r\na\r\n:1\r\n"
+    assert encode_reply(RedisError("boom")) == b"-ERR boom\r\n"
+    assert encode_command("GET", "k") == b"*2\r\n$3\r\nGET\r\n$1\r\nk\r\n"
+
+
+def test_resp_decode_roundtrip_and_partials():
+    v, pos = decode_one(b"+PONG\r\n")
+    assert v == "PONG" and pos == 7
+    v, pos = decode_one(b"$3\r\nabc\r\n")
+    assert v == b"abc"
+    v, pos = decode_one(b"*2\r\n:1\r\n:2\r\n")
+    assert v == [1, 2]
+    v, pos = decode_one(b"$-1\r\n")
+    assert v is NIL
+    # partial: no progress
+    v, pos = decode_one(b"$10\r\nabc")
+    assert pos == 0 and v is None
+
+
+# -- redis on the shared RPC port -------------------------------------------
+
+class MiniRedis:
+    """In-memory command handler registered as the 'redis' service."""
+
+    def __init__(self):
+        self.store = {}
+        self.lock = threading.Lock()
+
+    def on_command(self, args):
+        cmd = args[0].upper()
+        with self.lock:
+            if cmd == b"PING":
+                return "PONG"
+            if cmd == b"SET":
+                self.store[args[1]] = args[2]
+                return "OK"
+            if cmd == b"GET":
+                return self.store.get(args[1])
+            if cmd == b"DEL":
+                n = 0
+                for k in args[1:]:
+                    n += 1 if self.store.pop(k, None) is not None else 0
+                return n
+            if cmd == b"INCR":
+                v = int(self.store.get(args[1], b"0")) + 1
+                self.store[args[1]] = str(v).encode()
+                return v
+            if cmd == b"KEYS":
+                return sorted(self.store)
+            raise RedisError(f"unknown command {cmd.decode()}")
+
+
+@pytest.fixture(scope="module")
+def redis_server():
+    srv = Server()
+    srv.add_service(MiniRedis(), name="redis")
+
+    class Echo(Service):
+        def Echo(self, cntl, request):
+            return request
+
+    srv.add_service(Echo(), name="E")
+    assert srv.start("127.0.0.1:0") == 0
+    yield srv
+    srv.stop()
+
+
+def test_redis_client_against_shared_port(redis_server):
+    r = RedisClient(str(redis_server.listen_endpoint))
+    try:
+        assert r.ping() == "PONG"
+        assert r.set("k1", b"v1") == "OK"
+        assert r.get("k1") == b"v1"
+        assert r.get("missing") is None
+        assert r.incr("ctr") == 1
+        assert r.incr("ctr") == 2
+        assert r.delete("k1") == 1
+        with pytest.raises(RedisError):
+            r.command("NOPE")
+    finally:
+        r.close()
+
+
+def test_redis_pipeline(redis_server):
+    r = RedisClient(str(redis_server.listen_endpoint))
+    try:
+        replies = r.pipeline([("SET", "p%d" % i, "x%d" % i)
+                              for i in range(10)]
+                             + [("GET", "p7")])
+        assert replies[:10] == ["OK"] * 10
+        assert replies[10] == b"x7"
+    finally:
+        r.close()
+
+
+def test_redis_and_rpc_share_the_port(redis_server):
+    """RESP and tpu_std coexist on one port (multi-protocol detection)."""
+    from brpc_tpu.client import Channel
+    ch = Channel()
+    ch.init(str(redis_server.listen_endpoint))
+    assert ch.call("E.Echo", b"rpc-here") == b"rpc-here"
+    r = RedisClient(str(redis_server.listen_endpoint))
+    try:
+        assert r.ping() == "PONG"
+    finally:
+        r.close()
+
+
+# -- memcache client --------------------------------------------------------
+
+class _MiniMemcached(socketserver.ThreadingTCPServer):
+    """Tiny text-protocol memcached for client testing."""
+    allow_reuse_address = True
+    daemon_threads = True
+
+    def __init__(self):
+        self.store = {}
+        self.cas_counter = [0]
+        super().__init__(("127.0.0.1", 0), _McHandler)
+
+
+class _McHandler(socketserver.StreamRequestHandler):
+    def handle(self):
+        srv = self.server
+        while True:
+            line = self.rfile.readline()
+            if not line:
+                return
+            parts = line.strip().split()
+            if not parts:
+                continue
+            verb = parts[0]
+            if verb in (b"set", b"add", b"replace", b"cas"):
+                key, flags, exp, n = (parts[1].decode(), int(parts[2]),
+                                      int(parts[3]), int(parts[4]))
+                data = self.rfile.read(n + 2)[:n]
+                exists = key in srv.store
+                if (verb == b"add" and exists) or \
+                        (verb == b"replace" and not exists):
+                    self.wfile.write(b"NOT_STORED\r\n")
+                    continue
+                if verb == b"cas":
+                    want = int(parts[5])
+                    cur = srv.store.get(key)
+                    if cur is None:
+                        self.wfile.write(b"NOT_FOUND\r\n")
+                        continue
+                    if cur[2] != want:
+                        self.wfile.write(b"EXISTS\r\n")
+                        continue
+                srv.cas_counter[0] += 1
+                srv.store[key] = (data, flags, srv.cas_counter[0])
+                self.wfile.write(b"STORED\r\n")
+            elif verb == b"gets" or verb == b"get":
+                for k in parts[1:]:
+                    ent = srv.store.get(k.decode())
+                    if ent is not None:
+                        data, flags, cas = ent
+                        self.wfile.write(
+                            b"VALUE %s %d %d %d\r\n%s\r\n"
+                            % (k, flags, len(data), cas, data))
+                self.wfile.write(b"END\r\n")
+            elif verb == b"delete":
+                ok = srv.store.pop(parts[1].decode(), None)
+                self.wfile.write(b"DELETED\r\n" if ok else b"NOT_FOUND\r\n")
+            elif verb in (b"incr", b"decr"):
+                k = parts[1].decode()
+                ent = srv.store.get(k)
+                if ent is None:
+                    self.wfile.write(b"NOT_FOUND\r\n")
+                    continue
+                v = int(ent[0]) + (int(parts[2]) if verb == b"incr"
+                                   else -int(parts[2]))
+                srv.store[k] = (str(v).encode(), ent[1], ent[2])
+                self.wfile.write(b"%d\r\n" % v)
+            elif verb == b"version":
+                self.wfile.write(b"VERSION mini-1.0\r\n")
+            else:
+                self.wfile.write(b"ERROR\r\n")
+
+
+@pytest.fixture(scope="module")
+def memcached():
+    srv = _MiniMemcached()
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    yield f"127.0.0.1:{srv.server_address[1]}"
+    srv.shutdown()
+
+
+def test_memcache_client(memcached):
+    mc = MemcacheClient(memcached)
+    try:
+        assert mc.version().startswith("VERSION")
+        assert mc.set("a", b"hello", flags=7)
+        got = mc.gets("a")
+        assert got is not None
+        value, flags, cas = got
+        assert value == b"hello" and flags == 7 and cas is not None
+        assert mc.get("missing") is None
+        assert mc.add("a", b"nope") is False          # exists
+        assert mc.replace("a", b"world") is True
+        assert mc.get("a") == b"world"
+        assert mc.set("n", b"10")
+        assert mc.incr("n", 5) == 15
+        assert mc.decr("n", 3) == 12
+        assert mc.incr("missing") is None
+        assert mc.delete("a") is True
+        assert mc.delete("a") is False
+        # cas: stale id fails, fresh id succeeds
+        mc.set("c", b"1")
+        _, _, cas = mc.gets("c")
+        assert mc.cas("c", b"2", cas) is True
+        assert mc.cas("c", b"3", cas) is False
+    finally:
+        mc.close()
+
+
+# -- thrift -----------------------------------------------------------------
+
+class CalcThrift:
+    """Thrift service: methods handle (method, body) -> body."""
+
+    def handle(self, method, body):
+        if method == "echo":
+            return body
+        if method == "greet":
+            name, _ = TBinary.read_string(body, 0)
+            return TBinary.write_string(b"hello " + name)
+        if method == "boom":
+            raise RuntimeError("kaboom")
+        raise KeyError(method)
+
+
+@pytest.fixture(scope="module")
+def thrift_server():
+    srv = Server()
+    srv.add_service(CalcThrift(), name="thrift")
+    assert srv.start("127.0.0.1:0") == 0
+    yield srv
+    srv.stop()
+
+
+def test_thrift_call_roundtrip(thrift_server):
+    tc = ThriftClient(str(thrift_server.listen_endpoint))
+    try:
+        assert tc.call("echo", b"\x0b\x00\x01payload\x00") \
+            == b"\x0b\x00\x01payload\x00"
+        out = tc.call("greet", TBinary.write_string(b"tpu"))
+        name, _ = TBinary.read_string(out, 0)
+        assert name == b"hello tpu"
+    finally:
+        tc.close()
+
+
+def test_thrift_unknown_method_and_exception(thrift_server):
+    tc = ThriftClient(str(thrift_server.listen_endpoint))
+    try:
+        with pytest.raises(ThriftApplicationError) as ei:
+            tc.call("nope")
+        assert ei.value.code == 1                    # UNKNOWN_METHOD
+        with pytest.raises(ThriftApplicationError) as ei:
+            tc.call("boom")
+        assert ei.value.code == 6                    # INTERNAL_ERROR
+        assert "kaboom" in ei.value.message
+        # connection still alive after exceptions
+        assert tc.call("echo", b"\x00") == b"\x00"
+    finally:
+        tc.close()
+
+
+def test_thrift_wire_format_constants():
+    from brpc_tpu.protocol.thrift_proto import (M_CALL, VERSION_1,
+                                                pack_message,
+                                                unpack_message)
+    frame = pack_message(M_CALL, "m", 7, b"\x00")
+    # [len][0x80 01 00 01][i32 len "m"]["m"][i32 7][body]
+    assert frame[4:8] == b"\x80\x01\x00\x01"
+    assert frame[8:12] == b"\x00\x00\x00\x01"
+    assert frame[12:13] == b"m"
+    mtype, name, seqid, body = unpack_message(frame[4:])
+    assert (mtype, name, seqid, body) == (M_CALL, "m", 7, b"\x00")
+    assert VERSION_1 == 0x80010000
