@@ -90,6 +90,12 @@ class TaskRuntime:
         self._shutdown = False
         self._spawned = Adder()
         self._worker_seq = 0
+        # per-worker local queues (≈ bthread's WorkStealingQueue,
+        # work_stealing_queue.h): a worker spawning a task pushes it to
+        # its OWN queue (LIFO pop keeps the continuation cache-hot);
+        # other workers steal FIFO when their own queue and the shared
+        # queue are dry.  Guarded by self._lock for list mutations.
+        self._local_queues: List = []
 
     # -- introspection (exposed as bvars by Server) --
 
@@ -99,14 +105,29 @@ class TaskRuntime:
 
     @property
     def pending_count(self) -> int:
-        return len(self._queue)
+        return len(self._queue) + sum(len(q) for q in self._local_queues)
 
     def spawn(self, fn: Callable, *args, urgent: bool = False,
               name: str = "") -> TaskHandle:
         """Start a task (≈ bthread_start_urgent/background). ``urgent``
-        tasks go to the front of the queue."""
+        tasks go to the front of the shared queue; a task spawned FROM a
+        worker lands on that worker's local queue (work stealing)."""
         handle = TaskHandle(name or getattr(fn, "__name__", "task"))
         item = (fn, args, handle)
+        wsq = getattr(_tls, "wsq", None) \
+            if getattr(_tls, "runtime", None) is self else None
+        if wsq is not None and not urgent and wsq.push(item):
+            self._spawned.update(1)
+            with self._lock:
+                if self._shutdown:
+                    pass          # drain path below still runs the task
+                if self._idle > 0:
+                    self._not_empty.notify()
+                elif self._effective_workers_locked() < self.concurrency:
+                    self._add_worker_locked()
+                else:
+                    self._ensure_monitor_locked()
+            return handle
         with self._lock:
             if self._shutdown:
                 raise RuntimeError("runtime is shut down")
@@ -137,7 +158,8 @@ class TaskRuntime:
         worker blocks: spawns a replacement if runnable work would starve."""
         with self._lock:
             self._blocked += 1
-            if (self._queue and self._idle == 0
+            if ((self._queue or any(self._local_queues))
+                    and self._idle == 0
                     and self._workers < self.max_workers
                     and self._effective_workers_locked() < self.concurrency):
                 self._add_worker_locked()
@@ -167,7 +189,7 @@ class TaskRuntime:
                 if self._shutdown:
                     self._monitor_running = False
                     return
-                if self._queue:
+                if self._queue or any(self._local_queues):
                     idle_rounds = 0
                     if (self._dequeues == last and self._idle == 0
                             and self._workers < self.max_workers):
@@ -186,36 +208,81 @@ class TaskRuntime:
                              daemon=True)
         t.start()
 
+    def _steal_locked(self, my_wsq):
+        """One item from the shared queue or another worker's local
+        queue; None when everything is dry.  Runs under self._lock."""
+        if self._queue:
+            return self._queue.popleft()
+        for wsq in self._local_queues:
+            if wsq is my_wsq:
+                continue
+            ok, item = wsq.steal()
+            if ok:
+                return item
+        return None
+
     def _worker_loop(self) -> None:
+        from ..butil.work_stealing_queue import WorkStealingQueue
+        my_wsq = WorkStealingQueue()
+        _tls.runtime = self
+        _tls.wsq = my_wsq
+        with self._lock:
+            self._local_queues.append(my_wsq)
         core = True
-        while True:
+        try:
+            while True:
+                ok, item = my_wsq.pop()       # own continuations first
+                if not ok:
+                    with self._lock:
+                        item = self._steal_locked(my_wsq)
+                        while item is None and not self._shutdown:
+                            self._idle += 1
+                            try:
+                                # extra (non-core) workers retire on idle
+                                core = self._workers <= self.concurrency
+                                signalled = self._not_empty.wait(
+                                    None if core else IDLE_TIMEOUT_S)
+                            finally:
+                                self._idle -= 1
+                            item = self._steal_locked(my_wsq)
+                            if item is None and not signalled and not core:
+                                self._workers -= 1
+                                return
+                        if item is None:      # shutdown and dry
+                            self._workers -= 1
+                            return
+                        self._dequeues += 1
+                else:
+                    # GIL-atomic enough for the starvation monitor's
+                    # progress check; no global lock on the hot path
+                    self._dequeues += 1
+                fn, args, handle = item
+                try:
+                    handle._result = fn(*args)
+                except BaseException as e:
+                    handle._exc = e
+                    LOG.error("task %s raised: %s\n%s", handle.fn_name, e,
+                              traceback.format_exc())
+                finally:
+                    handle._done.set()
+        finally:
+            # retirement/shutdown: strand no local work — move remnants
+            # to the shared queue and wake a peer
             with self._lock:
-                while not self._queue and not self._shutdown:
-                    self._idle += 1
-                    try:
-                        # extra (non-core) workers retire after idling
-                        core = self._workers <= self.concurrency
-                        signalled = self._not_empty.wait(
-                            None if core else IDLE_TIMEOUT_S)
-                    finally:
-                        self._idle -= 1
-                    if not signalled and not core and not self._queue:
-                        self._workers -= 1
-                        return
-                if self._shutdown and not self._queue:
-                    self._workers -= 1
-                    return
-                fn, args, handle = self._queue.popleft()
-                self._dequeues += 1
-            _tls.runtime = self
-            try:
-                handle._result = fn(*args)
-            except BaseException as e:
-                handle._exc = e
-                LOG.error("task %s raised: %s\n%s", handle.fn_name, e,
-                          traceback.format_exc())
-            finally:
-                handle._done.set()
+                try:
+                    self._local_queues.remove(my_wsq)
+                except ValueError:
+                    pass
+                moved = False
+                while True:
+                    ok, item = my_wsq.steal()
+                    if not ok:
+                        break
+                    self._queue.append(item)
+                    moved = True
+                if moved:
+                    self._not_empty.notify()
+            _tls.wsq = None
 
     def shutdown(self, wait: bool = True) -> None:
         with self._lock:

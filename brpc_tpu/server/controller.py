@@ -30,6 +30,8 @@ class ServerController:
         "auth_context", "server",
         "_remote_stream_id", "_accepted_stream_id",
         "_accepted_stream_window", "span", "grpc_stream",
+        "http_method", "http_path", "http_unresolved_path",
+        "_session_data", "_progressive",
     )
 
     def __init__(self, request_meta: RpcMeta,
@@ -62,6 +64,11 @@ class ServerController:
         self._accepted_stream_window = 0
         self.span = None                 # rpcz Span when tracing is on
         self.grpc_stream = None          # GrpcServerStream on @grpc_streaming
+        self.http_method = ""            # HTTP verb when bridged
+        self.http_path = ""              # full request path when bridged
+        self.http_unresolved_path = ""   # restful /* remainder
+        self._session_data = None        # borrowed SimpleDataPool object
+        self._progressive = None         # ProgressiveAttachment when used
 
     # -- error reporting ---------------------------------------------------
 
@@ -105,6 +112,29 @@ class ServerController:
                 return
             self._finished = True
         self._send_response(self, response)
+        if self._session_data is not None and self.server is not None \
+                and self.server._session_pool is not None:
+            self.server._session_pool.give_back(self._session_data)
+            self._session_data = None
+
+    def session_local_data(self) -> Any:
+        """Reusable per-request user data from the server's
+        SimpleDataPool (≈ Controller::session_local_data); None when the
+        server has no session_local_data_factory."""
+        if self._session_data is None and self.server is not None \
+                and self.server._session_pool is not None:
+            self._session_data = self.server._session_pool.borrow()
+        return self._session_data
+
+    def create_progressive_attachment(self):
+        """HTTP-bridged methods only: switch the response to chunked
+        transfer and return a ProgressiveAttachment the handler (or a
+        background task) writes to after returning
+        (≈ src/brpc/progressive_attachment.h)."""
+        from .http_dispatch import ProgressiveAttachment
+        if self._progressive is None:
+            self._progressive = ProgressiveAttachment(self.socket_id)
+        return self._progressive
 
     def annotate(self, text: str) -> None:
         """Add a note to the request's rpcz span (no-op when tracing is

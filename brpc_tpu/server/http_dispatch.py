@@ -35,19 +35,99 @@ def portal_restricted(server, sock, first_segment: str) -> bool:
             and first_segment not in PUBLIC_BUILTIN_PAGES)
 
 
+class ProgressiveAttachment:
+    """Chunked-transfer body writer living past the RPC
+    (≈ /root/reference/src/brpc/progressive_attachment.h): the handler
+    calls cntl.create_progressive_attachment(), returns, then any thread
+    writes chunks and close()s.  The connection carries the chunk stream
+    until then."""
+
+    def __init__(self, socket_id: int):
+        import threading as _threading
+        self._socket_id = socket_id
+        self._closed = False
+        self._started = False           # headers on the wire yet?
+        self._pending = []              # chunks written before that
+        self._lock = _threading.Lock()
+
+    def _start(self) -> None:
+        """Called by the dispatcher once the response headers are out:
+        flush chunks the handler raced ahead with.  The flush stays
+        under the lock so a concurrent write() cannot jump ahead of the
+        buffered frames (Socket.write is ordered; this lock orders who
+        reaches it first)."""
+        with self._lock:
+            self._started = True
+            pending, self._pending = self._pending, []
+            s = Socket.address(self._socket_id)
+            if s is not None and not s.failed:
+                for frame in pending:
+                    s.write(IOBuf(frame))
+
+    def _abort(self) -> None:
+        """RPC failed before the chunked response started: kill the
+        attachment so background writers see ECLOSE instead of buffering
+        forever."""
+        with self._lock:
+            self._closed = True
+            self._pending.clear()
+
+    def write(self, data) -> int:
+        """One HTTP/1.1 chunk; returns 0 or an errno."""
+        b = bytes(data)
+        if not b:
+            return 0
+        frame = b"%x\r\n" % len(b) + b + b"\r\n"
+        with self._lock:
+            if self._closed:
+                return int(Errno.ECLOSE)
+            if not self._started:
+                self._pending.append(frame)
+                return 0
+            s = Socket.address(self._socket_id)
+            if s is None or s.failed:
+                return int(Errno.EFAILEDSOCKET)
+            return s.write(IOBuf(frame))
+
+    def close(self) -> None:
+        """Terminal zero chunk; idempotent."""
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+            if not self._started:
+                self._pending.append(b"0\r\n\r\n")
+                return
+            s = Socket.address(self._socket_id)
+            if s is not None and not s.failed:
+                s.write(IOBuf(b"0\r\n\r\n"))
+
+    @property
+    def closed(self) -> bool:
+        return self._closed
+
+
 def handle_http_request(msg: HttpMessage, sock, server) -> None:
     path = msg.path.rstrip("/") or "/"
     parts = [p for p in path.split("/") if p]
     # RPC bridge: /Service/Method (also /Service.Method for symmetry)
     entry = None
+    unresolved = ""
     if len(parts) == 2:
         entry = server.find_method(parts[0], parts[1])
         svc, mth = parts[0], parts[1]
     elif len(parts) == 1 and "." in parts[0]:
         svc, _, mth = parts[0].partition(".")
         entry = server.find_method(svc, mth)
+    if entry is None and server._restful:
+        hit = server.find_restful(parts)
+        if hit is not None:
+            entry, unresolved = hit
+            svc = entry.status.full_name.rsplit(".", 1)[0]
+            mth = entry.method_name
     if entry is not None:
-        _bridge_rpc(msg, sock, server, svc, mth, entry)
+        _bridge_rpc(msg, sock, server, svc, mth, entry,
+                    unresolved=unresolved)
         return
     # With an internal port configured, operator pages are reachable only
     # through it (≈ reference's internal-port-only builtin services);
@@ -69,7 +149,7 @@ def handle_http_request(msg: HttpMessage, sock, server) -> None:
 
 
 def _bridge_rpc(msg: HttpMessage, sock, server, svc: str,
-                mth: str, entry) -> None:
+                mth: str, entry, unresolved: str = "") -> None:
     if not server.on_request_in():
         sock.write(build_response(503, b"server max_concurrency",
                                   keep_alive=msg.keep_alive))
@@ -92,11 +172,25 @@ def _bridge_rpc(msg: HttpMessage, sock, server, svc: str,
         if s is None:
             return
         if cntl.failed:
+            if cntl._progressive is not None:
+                cntl._progressive._abort()
             code = 400 if cntl.error_code in (int(Errno.EREQUEST),) else 500
             s.write(build_response(
                 code, cntl.error_text.encode(),
                 headers=[("x-rpc-error-code", str(cntl.error_code))],
                 keep_alive=msg.keep_alive))
+            return
+        if cntl._progressive is not None:
+            # chunked transfer: headers now, body chunks whenever the
+            # ProgressiveAttachment writes them
+            body, ctype = _encode_http_body(response)
+            head = (b"HTTP/1.1 200 OK\r\n"
+                    b"content-type: " + ctype.encode() + b"\r\n"
+                    b"transfer-encoding: chunked\r\n"
+                    b"connection: keep-alive\r\n\r\n")
+            first = b"%x\r\n" % len(body) + body + b"\r\n" if body else b""
+            s.write(IOBuf(head + first))
+            cntl._progressive._start()
             return
         body, ctype = _encode_http_body(response)
         extra = None
@@ -112,6 +206,9 @@ def _bridge_rpc(msg: HttpMessage, sock, server, svc: str,
 
     cntl = ServerController(meta, sock.remote_side, sock.id, send)
     cntl.server = server
+    cntl.http_method = msg.method
+    cntl.http_path = msg.path
+    cntl.http_unresolved_path = unresolved
     if msg.method in ("GET", "HEAD") and msg.query_string:
         request: Any = json.dumps(msg.query()).encode()
     else:

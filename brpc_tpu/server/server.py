@@ -33,7 +33,8 @@ class ServerOptions:
                  "auth", "interceptor", "idle_timeout_s",
                  "internal_port", "server_info_name",
                  "native", "native_loops", "usercode_inline",
-                 "ssl_cert", "ssl_key", "ssl_context")
+                 "ssl_cert", "ssl_key", "ssl_context",
+                 "restful_mappings", "session_local_data_factory")
 
     def __init__(self):
         self.num_workers = 0            # 0 = leave fiber runtime defaults
@@ -64,6 +65,14 @@ class ServerOptions:
         self.ssl_cert = ""
         self.ssl_key = ""
         self.ssl_context = None
+        # restful routing (≈ restful.cpp): "PATH => Service.Method" pairs,
+        # comma separated; a trailing /* captures the rest of the path
+        # into cntl.http_unresolved_path.
+        #   "/v1/echo => E.Echo, /files/* => Files.Get"
+        self.restful_mappings = ""
+        # SimpleDataPool factory (≈ simple_data_pool.h): per-request
+        # reusable user data via cntl.session_local_data()
+        self.session_local_data_factory = None
 
 
 class _MethodEntry:
@@ -97,6 +106,8 @@ class Server:
         self._inflight = 0
         self._inflight_lock = threading.Lock()
         self.version = ""
+        self._restful = []           # parsed (segments, has_rest, entry_key)
+        self._session_pool = None    # SimpleDataPool when factory set
 
     # -- registry ----------------------------------------------------------
 
@@ -150,6 +161,50 @@ class Server:
     def find_method(self, service_name: str,
                     method_name: str) -> Optional[_MethodEntry]:
         return self._methods.get((service_name, method_name))
+
+    def find_restful(self, parts) -> Optional[Tuple[_MethodEntry, str]]:
+        """Match an HTTP path against restful_mappings
+        (≈ /root/reference/src/brpc/restful.cpp pattern table).
+        Returns (entry, unresolved_path) or None."""
+        for segs, has_rest, key in self._restful:
+            n = len(segs)
+            if has_rest:
+                if len(parts) < n or parts[:n] != segs:
+                    continue
+                entry = self._methods.get(key)
+                if entry is not None:
+                    return entry, "/".join(parts[n:])
+            elif list(parts) == segs:
+                entry = self._methods.get(key)
+                if entry is not None:
+                    return entry, ""
+        return None
+
+    def _parse_restful(self) -> None:
+        self._restful = []
+        spec = self.options.restful_mappings or ""
+        for pair in spec.split(","):
+            pair = pair.strip()
+            if not pair:
+                continue
+            try:
+                pattern, _, target = pair.partition("=>")
+                pattern = pattern.strip()
+                svc, _, mth = target.strip().rpartition(".")
+                segs = [p for p in pattern.split("/") if p]
+                has_rest = bool(segs) and segs[-1] == "*"
+                if has_rest:
+                    segs = segs[:-1]
+                if (svc, mth) not in self._methods:
+                    LOG.error("restful mapping %r: unknown method %s.%s",
+                              pair, svc, mth)
+                    continue
+                self._restful.append((segs, has_rest, (svc, mth)))
+            except ValueError:
+                LOG.error("bad restful mapping %r", pair)
+        # longest (most specific) patterns first; exact beats wildcard
+        # at equal length
+        self._restful.sort(key=lambda t: (-len(t[0]), t[1]))
 
     @property
     def services(self) -> Dict[str, Any]:
@@ -218,6 +273,12 @@ class Server:
         self._listen_endpoint = EndPoint(host=host, port=port)
         self._listener = lst
 
+        if self.options.restful_mappings:
+            self._parse_restful()
+        if self.options.session_local_data_factory is not None:
+            from ..butil.simple_data_pool import SimpleDataPool
+            self._session_pool = SimpleDataPool(
+                self.options.session_local_data_factory)
         # handler table = every registered server-capable protocol
         # (≈ Server::BuildAcceptor collecting protocols, server.cpp:572);
         # importing the modules registers the builtins

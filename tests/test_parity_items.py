@@ -1,0 +1,259 @@
+"""Small parity items: restful mapping, progressive attachment,
+SimpleDataPool, PeriodicTask, WorkStealingQueue
+(≈ /root/reference/src/brpc/restful.cpp, progressive_attachment.h,
+simple_data_pool.h, periodic_task.h, bthread/work_stealing_queue.h)."""
+
+import http.client
+import threading
+import time
+
+import pytest
+
+from brpc_tpu.butil.periodic_task import PeriodicTask
+from brpc_tpu.butil.simple_data_pool import SimpleDataPool
+from brpc_tpu.butil.work_stealing_queue import WorkStealingQueue
+from brpc_tpu.server import Server, ServerOptions, Service
+
+
+# -- restful ----------------------------------------------------------------
+
+class Files(Service):
+    def Get(self, cntl, request):
+        return b"file:" + cntl.http_unresolved_path.encode()
+
+    def Echo(self, cntl, request):
+        return b"restful:" + request
+
+
+@pytest.fixture(scope="module")
+def restful_server():
+    opts = ServerOptions()
+    opts.restful_mappings = \
+        "/v1/echo => F.Echo, /files/* => F.Get"
+    srv = Server(opts)
+    srv.add_service(Files(), name="F")
+    assert srv.start("127.0.0.1:0") == 0
+    yield srv
+    srv.stop()
+
+
+def _http(server, method, path, body=b""):
+    ep = server.listen_endpoint
+    c = http.client.HTTPConnection(ep.host, ep.port, timeout=10)
+    c.request(method, path, body=body or None)
+    r = c.getresponse()
+    data = r.read()
+    c.close()
+    return r.status, data
+
+
+def test_restful_exact_mapping(restful_server):
+    status, body = _http(restful_server, "POST", "/v1/echo", b"hi")
+    assert status == 200 and body == b"restful:hi"
+
+
+def test_restful_wildcard_captures_rest(restful_server):
+    status, body = _http(restful_server, "GET", "/files/a/b/c.txt")
+    assert status == 200 and body == b"file:a/b/c.txt"
+    status, body = _http(restful_server, "GET", "/files")
+    assert status == 200 and body == b"file:"
+
+
+def test_restful_direct_path_still_works(restful_server):
+    status, body = _http(restful_server, "POST", "/F/Echo", b"direct")
+    assert status == 200 and body == b"restful:direct"
+
+
+# -- progressive attachment -------------------------------------------------
+
+def test_progressive_attachment_chunked():
+    done = threading.Event()
+
+    class Prog(Service):
+        def Download(self, cntl, request):
+            pa = cntl.create_progressive_attachment()
+
+            def feed():
+                for i in range(3):
+                    pa.write(b"part%d|" % i)
+                pa.close()
+                done.set()
+            threading.Thread(target=feed, daemon=True).start()
+            return b"head|"
+
+    srv = Server()
+    srv.add_service(Prog(), name="P")
+    assert srv.start("127.0.0.1:0") == 0
+    try:
+        ep = srv.listen_endpoint
+        c = http.client.HTTPConnection(ep.host, ep.port, timeout=10)
+        c.request("GET", "/P/Download")
+        r = c.getresponse()
+        assert r.getheader("transfer-encoding") == "chunked"
+        data = r.read()          # http.client de-chunks
+        c.close()
+        assert done.wait(5)
+        assert data == b"head|part0|part1|part2|"
+    finally:
+        srv.stop()
+
+
+# -- SimpleDataPool ---------------------------------------------------------
+
+def test_simple_data_pool_recycles():
+    made = []
+
+    def factory():
+        obj = {"n": len(made)}
+        made.append(obj)
+        return obj
+
+    pool = SimpleDataPool(factory, max_cached=2)
+    a = pool.borrow()
+    b = pool.borrow()
+    assert pool.created == 2
+    pool.give_back(a)
+    c = pool.borrow()
+    assert c is a                    # recycled, not re-created
+    assert pool.created == 2
+    pool.give_back(b)
+    pool.give_back(c)
+    assert pool.free_count == 2
+
+
+def test_session_local_data_end_to_end():
+    from brpc_tpu.client import Channel
+
+    class Svc(Service):
+        def Use(self, cntl, request):
+            d = cntl.session_local_data()
+            d["hits"] = d.get("hits", 0) + 1
+            return b"%d" % d["hits"]
+
+    opts = ServerOptions()
+    opts.session_local_data_factory = dict
+    srv = Server(opts)
+    srv.add_service(Svc(), name="S")
+    assert srv.start("127.0.0.1:0") == 0
+    try:
+        ch = Channel()
+        ch.init(str(srv.listen_endpoint))
+        for _ in range(5):
+            n = int(ch.call("S.Use", b""))
+            assert n >= 1            # data object is reused across calls
+        assert srv._session_pool.created <= 2   # pooled, not per-request
+    finally:
+        srv.stop()
+
+
+# -- PeriodicTask -----------------------------------------------------------
+
+def test_periodic_task_runs_and_stops():
+    runs = []
+    t = PeriodicTask(0.05, lambda: runs.append(time.monotonic()))
+    time.sleep(0.4)
+    t.stop()
+    n = len(runs)
+    assert 2 <= n <= 10, n
+    time.sleep(0.2)
+    assert len(runs) == n            # stopped means stopped
+
+
+def test_periodic_task_return_false_stops():
+    runs = []
+
+    def once():
+        runs.append(1)
+        return False
+
+    t = PeriodicTask(0.05, once)
+    time.sleep(0.3)
+    assert len(runs) == 1
+    t.stop()
+
+
+def test_periodic_task_retargets_interval():
+    stamps = []
+
+    def fn():
+        stamps.append(time.monotonic())
+        return 0.2                    # slow down after the first run
+
+    t = PeriodicTask(0.02, fn)
+    time.sleep(0.5)
+    t.stop()
+    assert len(stamps) >= 2
+    assert stamps[1] - stamps[0] >= 0.15   # retargeted gap
+
+
+# -- WorkStealingQueue ------------------------------------------------------
+
+def test_wsq_lifo_pop_fifo_steal():
+    q = WorkStealingQueue()
+    for i in range(5):
+        assert q.push(i)
+    ok, item = q.pop()
+    assert ok and item == 4          # owner pops newest
+    ok, item = q.steal()
+    assert ok and item == 0          # thief steals oldest
+    assert len(q) == 3
+
+
+def test_wsq_concurrent_steal_exactly_once():
+    q = WorkStealingQueue(capacity=100000)
+    N = 20000
+    for i in range(N):
+        q.push(i)
+    got = []
+    lock = threading.Lock()
+
+    def thief():
+        local = []
+        while True:
+            ok, item = q.steal()
+            if not ok:
+                break
+            local.append(item)
+        with lock:
+            got.extend(local)
+
+    owner_got = []
+
+    def owner():
+        while True:
+            ok, item = q.pop()
+            if not ok:
+                break
+            owner_got.append(item)
+
+    ts = [threading.Thread(target=thief) for _ in range(4)] \
+        + [threading.Thread(target=owner)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    allv = got + owner_got
+    assert len(allv) == N
+    assert sorted(allv) == list(range(N))     # exactly once each
+
+
+def test_runtime_local_queue_spawn_chain():
+    """A task spawned from a worker rides the local queue; chains still
+    complete and stealing drains them."""
+    from brpc_tpu.fiber import runtime as fr
+
+    results = []
+    done = threading.Event()
+
+    def leaf(i):
+        results.append(i)
+        if len(results) >= 20:
+            done.set()
+
+    def root():
+        for i in range(20):
+            fr.spawn(leaf, i)
+
+    fr.spawn(root)
+    assert done.wait(10)
+    assert sorted(results) == list(range(20))
