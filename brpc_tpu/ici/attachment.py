@@ -101,7 +101,7 @@ class DeviceAttachment:
         # dropped without redemption (user ignored the attachment):
         # return the poster's window credit instead of pinning it until
         # the TTL sweep
-        if self.kind == KIND_INPROC and not self._redeemed:
+        if self.kind in (KIND_INPROC, KIND_TRANSFER) and not self._redeemed:
             try:
                 from .endpoint import _send_ack
                 _send_ack(self._socket_id, (self.desc_id,))

@@ -35,9 +35,11 @@ from ..butil.logging_util import LOG
 from ..butil.status import Errno
 from ..protocol.base import (ParseResult, Protocol, ProtocolType,
                              register_protocol)
-from .attachment import (KIND_INLINE, KIND_INPROC, DeviceAttachment,
-                         decode_descriptor, encode_descriptor)
-from .fabric import in_process_fabric, local_domain_id
+from .attachment import (KIND_INLINE, KIND_INPROC, KIND_TRANSFER,
+                         DeviceAttachment, decode_descriptor,
+                         encode_descriptor)
+from .fabric import (in_process_fabric, local_domain_id,
+                     peer_transfer_addr, transfer_fabric, transfer_ready)
 
 define_flag("ici_enabled", True,
             "exchange ICI domains and send device attachments "
@@ -49,6 +51,11 @@ define_flag("ici_window_bytes", 256 * 1024 * 1024,
 define_flag("ici_desc_ttl_s", 120,
             "reclaim posted descriptors never redeemed after this many "
             "seconds", validator=lambda v: int(v) > 0)
+define_flag("ici_transfer_enabled", False,
+            "advertise a jax.experimental.transfer server so peers in "
+            "OTHER processes pull device attachments directly (needs a "
+            "runtime with the PJRT transfer hooks)",
+            validator=lambda v: True)
 
 
 def ici_enabled() -> bool:
@@ -73,10 +80,11 @@ class IciEndpoint:
         self.acked_count = 0
 
     def post(self, array: Any, nbytes: int, timeout_s: float = 30.0,
-             conn_key=None) -> Optional[int]:
-        """Reserve window credit and post to the fabric. Returns the
-        descriptor id, or None if the window stayed full (the
-        EOVERCROWDED analogue of a stuffed RDMA send queue)."""
+             conn_key=None, fabric=None) -> Optional[int]:
+        """Reserve window credit and post to the fabric (default: the
+        in-process registry). Returns the descriptor id, or None if the
+        window stayed full (the EOVERCROWDED analogue of a stuffed RDMA
+        send queue)."""
         window = int(get_flag("ici_window_bytes", 256 * 1024 * 1024))
         with self._cond:
             ok = self._cond.wait_for(
@@ -87,9 +95,10 @@ class IciEndpoint:
                 return None
             self.outstanding_bytes += nbytes
             self.posted_count += 1
-        return in_process_fabric().post(array, nbytes, self._on_release,
-                                        socket_id=self.socket_id,
-                                        conn_key=conn_key)
+        if fabric is None:
+            fabric = in_process_fabric()
+        return fabric.post(array, nbytes, self._on_release,
+                           socket_id=self.socket_id, conn_key=conn_key)
 
     def _on_release(self, nbytes: int) -> None:
         with self._cond:
@@ -196,6 +205,23 @@ def prepare_send(sock, meta, array,
         meta.ici_desc = encode_descriptor(KIND_INPROC, desc_id, nbytes,
                                           dtype, shape)
         return None
+    # cross-process: the peer advertises a transfer-server address and
+    # this process has one too — the payload moves HBM→HBM via the PJRT
+    # transfer engine, descriptors+acks ride the connection as usual
+    peer_addr = peer_transfer_addr(peer) if ici_enabled() else None
+    local_addr = transfer_ready() if peer_addr is not None else None
+    if peer_addr is not None and local_addr is not None:
+        desc_id = endpoint_of(sock).post(array, nbytes,
+                                         timeout_s=timeout_s,
+                                         conn_key=None,
+                                         fabric=transfer_fabric())
+        if desc_id is None:
+            raise RuntimeError(
+                "ICI window full: posted device payloads awaiting ack "
+                f"exceed ici_window_bytes on socket {sock.id}")
+        meta.ici_desc = encode_descriptor(KIND_TRANSFER, desc_id, nbytes,
+                                          dtype, shape, extra=local_addr)
+        return None
     # fallback: one explicit D2H, bytes ride the regular attachment
     from ..ops.device_ops import tensor_bytes
     data, dtype, shape = tensor_bytes(array)
@@ -218,7 +244,7 @@ def split_device_attachment(meta, attachment: IOBuf, socket_id: int
             decode_descriptor(meta.ici_desc)
     except (struct.error, IndexError):
         return attachment, None          # malformed wire field: drop
-    if kind not in (KIND_INLINE, KIND_INPROC):
+    if kind not in (KIND_INLINE, KIND_INPROC, KIND_TRANSFER):
         return attachment, None          # unknown/unsupported kind: drop
     host_bytes: Optional[bytes] = None
     if kind == KIND_INLINE:
@@ -252,6 +278,21 @@ def redeem_attachment(att: DeviceAttachment, device: Any = None):
                 f"ICI descriptor {att.desc_id} expired, already redeemed, "
                 "or bound to a different connection")
         _send_ack(att._socket_id, (att.desc_id,))
+        return arr
+    if att.kind == KIND_TRANSFER:
+        import jax
+        fab = transfer_fabric()
+        if fab is None:
+            raise RuntimeError(
+                "peer sent a transfer descriptor but this process has no "
+                "transfer fabric (enable ici_transfer_enabled)")
+        import numpy as _np
+        spec = jax.ShapeDtypeStruct(att.shape, _np.dtype(att.dtype))
+        out = fab.redeem(att._extra, att.desc_id, [spec])
+        _send_ack(att._socket_id, (att.desc_id,))
+        arr = out[0]
+        if device is not None:
+            arr = jax.device_put(arr, device)
         return arr
     # inline fallback: host bytes → device (one H2D)
     from ..ops.device_ops import bytes_to_tensor
@@ -319,11 +360,14 @@ def ack_unused(meta, socket_id: int) -> None:
 
 def _process_ack(msg, sock, server=None) -> None:
     fabric = in_process_fabric()
+    xfab = transfer_fabric()
     sid = getattr(sock, "id", None)
     for desc_id in msg:
         # bound to the posting connection: forged acks naming another
         # connection's descriptors are dropped
-        fabric.release(desc_id, only_socket=sid)
+        if not fabric.release(desc_id, only_socket=sid) \
+                and xfab is not None:
+            xfab.release(desc_id, only_socket=sid)
 
 
 ICI_ACK = Protocol(

@@ -52,7 +52,25 @@ _LOCAL_DOMAIN = os.urandom(16)
 
 
 def local_domain_id() -> bytes:
-    return _LOCAL_DOMAIN
+    """Domain advertised in every RpcMeta: the process token, plus this
+    process's transfer-server address when the cross-process fabric is
+    up (``token@address``) — peers in OTHER processes use the address to
+    pull device payloads directly (≈ the GID/QPN the reference sends in
+    its RDMA handshake)."""
+    addr = transfer_ready()
+    return _LOCAL_DOMAIN + b"@" + addr if addr else _LOCAL_DOMAIN
+
+
+def domain_token(domain: bytes) -> bytes:
+    return domain.split(b"@", 1)[0]
+
+
+def peer_transfer_addr(domain: Optional[bytes]) -> Optional[bytes]:
+    """The transfer-server address inside a peer's domain id (None when
+    the peer has no cross-process fabric)."""
+    if not domain or b"@" not in domain:
+        return None
+    return domain.split(b"@", 1)[1] or None
 
 
 class PostedEntry:
@@ -85,7 +103,7 @@ class InProcessFabric:
         self.posted_bytes = 0          # live accounting (all connections)
 
     def can_reach(self, peer_domain: bytes) -> bool:
-        return peer_domain == _LOCAL_DOMAIN
+        return domain_token(peer_domain) == _LOCAL_DOMAIN
 
     def post(self, array: Any, nbytes: int, on_release=None,
              socket_id: int = 0, conn_key=None) -> int:
@@ -179,14 +197,16 @@ class JaxTransferFabric:
     schedules ``await_pull(uuid, arrays)`` and the receiver's
     ``TransferConnection.pull`` moves HBM→HBM over ICI/DCN.  Domain id =
     token + server address; redeem connects to the address inside the
-    peer's descriptor.
-    """
+    peer's descriptor.  Post/release mirror the in-process registry so
+    window accounting and TICI acks work identically."""
 
     def __init__(self):
         self._server = None
         self._addr = b""
         self._conns: Dict[bytes, Any] = {}
         self._lock = threading.Lock()
+        self._posted: Dict[int, PostedEntry] = {}
+        self._next_id = int.from_bytes(os.urandom(4), "little") | 1
 
     @staticmethod
     def supported() -> bool:
@@ -215,8 +235,17 @@ class JaxTransferFabric:
     def address(self) -> bytes:
         return self._addr
 
-    def post(self, uuid: int, arrays) -> None:
-        self._server.await_pull(uuid, arrays)
+    def post(self, array: Any, nbytes: int, on_release=None,
+             socket_id: int = 0, conn_key=None) -> int:
+        """Schedule an await_pull; returns the descriptor uuid the peer
+        pulls with (same contract as InProcessFabric.post)."""
+        with self._lock:
+            uuid = self._next_id
+            self._next_id += 1
+            self._posted[uuid] = PostedEntry(array, nbytes, on_release,
+                                             socket_id, conn_key)
+        self._server.await_pull(uuid, [array])
+        return uuid
 
     def redeem(self, peer_addr: bytes, uuid: int, specs):
         with self._lock:
@@ -225,6 +254,27 @@ class JaxTransferFabric:
                 conn = self._server.connect(peer_addr.decode())
                 self._conns[peer_addr] = conn
         return conn.pull(uuid, specs)
+
+    def release(self, uuid: int, only_socket: Optional[int] = None) -> bool:
+        """Ack arrived: drop the local ref + return window credit."""
+        with self._lock:
+            entry = self._posted.get(uuid)
+            if entry is None:
+                return False
+            if only_socket is not None and entry.socket_id != only_socket:
+                return False
+            del self._posted[uuid]
+        if entry.on_release is not None:
+            try:
+                entry.on_release(entry.nbytes)
+            except Exception:
+                LOG.exception("ici on_release callback raised")
+        return True
+
+    @property
+    def live_descriptors(self) -> int:
+        with self._lock:
+            return len(self._posted)
 
 
 _TRANSFER_SUPPORTED: Optional[bool] = None
@@ -248,6 +298,8 @@ def _probe_transfer_runtime() -> bool:
 
 _fabric_lock = threading.Lock()
 _in_process: Optional[InProcessFabric] = None
+_xfer: Optional[JaxTransferFabric] = None
+_xfer_tried = False
 
 
 def in_process_fabric() -> InProcessFabric:
@@ -256,3 +308,42 @@ def in_process_fabric() -> InProcessFabric:
         if _in_process is None:
             _in_process = InProcessFabric()
         return _in_process
+
+
+def transfer_fabric() -> Optional[JaxTransferFabric]:
+    """The process's cross-process fabric, started on first use; None
+    when the runtime can't support it or the flag is off.  Tests may
+    install a stand-in via set_transfer_fabric()."""
+    global _xfer, _xfer_tried
+    from ..butil.flags import get_flag
+    if not get_flag("ici_transfer_enabled", False):
+        return _xfer            # explicit installs (tests) still count
+    with _fabric_lock:
+        if _xfer is not None or _xfer_tried:
+            return _xfer
+        _xfer_tried = True
+    if not JaxTransferFabric.supported():
+        LOG.warning("ici_transfer_enabled but the runtime lacks the "
+                    "PJRT transfer hooks; device attachments fall back "
+                    "to host staging across processes")
+        return None
+    f = JaxTransferFabric()
+    if not f.start():
+        return None
+    with _fabric_lock:
+        _xfer = f
+    return _xfer
+
+
+def set_transfer_fabric(f) -> None:
+    """Install a transfer fabric explicitly (tests / custom runtimes)."""
+    global _xfer, _xfer_tried
+    with _fabric_lock:
+        _xfer = f
+        _xfer_tried = True
+
+
+def transfer_ready() -> Optional[bytes]:
+    """This process's transfer address, when the fabric is live."""
+    f = transfer_fabric()
+    return f.address if f is not None and f.address else None

@@ -1,0 +1,149 @@
+"""FaultyTransport — an in-process TCP proxy with injectable faults.
+
+The adversarial test harness SURVEY §4 calls for (fixture shape
+≈ /root/reference/test/brpc_channel_unittest.cpp:166-230's mocked
+failure paths): client → proxy → server, with live-togglable
+
+- ``delay_s``            added latency on every forwarded segment
+- ``partition``          blackhole: accept + read, forward nothing
+- ``drop_after_bytes``   cut the connection after N forwarded bytes
+- ``corrupt_byte_at``    flip one byte at stream offset N
+- ``reorder_window``     hold segments and flush them out of order
+
+Faults apply to NEW data after the toggle; heal() restores clean
+forwarding for subsequent connections.
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+import time
+from typing import List, Optional, Tuple
+
+
+class FaultyTransport:
+    def __init__(self, upstream_host: str, upstream_port: int):
+        self._up = (upstream_host, upstream_port)
+        self._lst = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._lst.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._lst.bind(("127.0.0.1", 0))
+        self._lst.listen(64)
+        self.port = self._lst.getsockname()[1]
+        self._stop = False
+        self.delay_s = 0.0
+        self.partition = False
+        self.drop_after_bytes = -1
+        self.corrupt_byte_at = -1
+        self.reorder_window = 0
+        self.forwarded_bytes = 0
+        self.connections = 0
+        self._lock = threading.Lock()
+        self._conns: List[socket.socket] = []
+        self._thr = threading.Thread(target=self._accept_loop, daemon=True)
+        self._thr.start()
+
+    @property
+    def address(self) -> str:
+        return f"127.0.0.1:{self.port}"
+
+    def heal(self) -> None:
+        self.delay_s = 0.0
+        self.partition = False
+        self.drop_after_bytes = -1
+        self.corrupt_byte_at = -1
+        self.reorder_window = 0
+
+    def kill_connections(self) -> None:
+        with self._lock:
+            conns, self._conns = self._conns, []
+        for c in conns:
+            try:
+                c.close()
+            except OSError:
+                pass
+
+    def close(self) -> None:
+        self._stop = True
+        try:
+            self._lst.close()
+        except OSError:
+            pass
+        self.kill_connections()
+
+    # -- internals ----------------------------------------------------------
+
+    def _accept_loop(self) -> None:
+        while not self._stop:
+            try:
+                cli, _ = self._lst.accept()
+            except OSError:
+                return
+            self.connections += 1
+            try:
+                srv = socket.create_connection(self._up, timeout=5)
+            except OSError:
+                cli.close()
+                continue
+            with self._lock:
+                self._conns += [cli, srv]
+            state = {"fwd": 0}
+            threading.Thread(target=self._pump, args=(cli, srv, state),
+                             daemon=True).start()
+            threading.Thread(target=self._pump, args=(srv, cli, state),
+                             daemon=True).start()
+
+    def _pump(self, src: socket.socket, dst: socket.socket, state) -> None:
+        held: List[bytes] = []
+        try:
+            while not self._stop:
+                try:
+                    data = src.recv(65536)
+                except OSError:
+                    break
+                if not data:
+                    break
+                if self.partition:
+                    continue                      # blackhole
+                if self.delay_s > 0:
+                    time.sleep(self.delay_s)
+                off = self.corrupt_byte_at
+                if 0 <= off - state["fwd"] < len(data):
+                    i = off - state["fwd"]
+                    data = data[:i] + bytes([data[i] ^ 0xFF]) + data[i + 1:]
+                    self.corrupt_byte_at = -1
+                cut = self.drop_after_bytes
+                if cut >= 0 and state["fwd"] + len(data) >= cut:
+                    take = max(0, cut - state["fwd"])
+                    if take:
+                        dst.sendall(data[:take])
+                        state["fwd"] += take
+                    break                         # cut the connection
+                if self.reorder_window > 0:
+                    held.append(data)
+                    if len(held) >= self.reorder_window:
+                        for chunk in reversed(held):
+                            dst.sendall(chunk)
+                            state["fwd"] += len(chunk)
+                        held.clear()
+                    continue
+                dst.sendall(data)
+                state["fwd"] += len(data)
+                self.forwarded_bytes += len(data)
+        except OSError:
+            pass
+        finally:
+            for chunk in held:
+                try:
+                    dst.sendall(chunk)
+                except OSError:
+                    break
+            for s in (src, dst):
+                try:
+                    s.shutdown(socket.SHUT_RDWR)
+                except OSError:
+                    pass
+                try:
+                    s.close()
+                except OSError:
+                    pass

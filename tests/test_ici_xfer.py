@@ -1,0 +1,207 @@
+"""Cross-process ICI fabric tests.
+
+Two layers (≈ the reference's RdmaEndpoint TCP-handshake-then-QP shape,
+/root/reference/src/brpc/rdma/rdma_endpoint.cpp):
+
+1. REAL subprocess: a tensor-echo server in another interpreter.  The
+   domain tokens differ, so the in-process fabric must refuse; with no
+   transfer runtime the HOST-STAGED fallback must carry the tensor both
+   ways (the ``use_rdma=false`` analogue asserted end to end).
+2. Transfer-descriptor wire path: a stand-in transfer fabric (the PJRT
+   runtime here lacks the transfer hooks — JaxTransferFabric.supported()
+   is probed False) installed on both ends proves the KIND_TRANSFER
+   flow: descriptor posted in A, pulled by B via the advertised address,
+   TICI ack returns the credit.
+"""
+
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from brpc_tpu.client import Channel, Controller
+from brpc_tpu.ici import fabric as fabric_mod
+from brpc_tpu.ici.attachment import KIND_INLINE, KIND_TRANSFER
+from brpc_tpu.server import Server, Service
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_CHILD = r"""
+import os, sys
+os.environ["JAX_PLATFORMS"] = "cpu"
+sys.path.insert(0, %(repo)r)
+import jax
+jax.config.update("jax_platforms", "cpu")
+from brpc_tpu.server import Server, Service
+
+class TensorEcho(Service):
+    def Echo(self, cntl, request):
+        att = cntl.request_device_attachment
+        if att is None:
+            return b"no-tensor"
+        t = att.tensor()
+        cntl.response_device_attachment = t * 2
+        return b"doubled"
+
+srv = Server()
+srv.add_service(TensorEcho(), name="TE")
+assert srv.start("127.0.0.1:0") == 0
+print("PORT=%%d" %% srv.listen_endpoint.port, flush=True)
+sys.stdin.readline()        # parent closes stdin to stop us
+srv.stop()
+"""
+
+
+@pytest.fixture(scope="module")
+def child_server():
+    proc = subprocess.Popen(
+        [sys.executable, "-c", _CHILD % {"repo": REPO}],
+        stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+        stderr=subprocess.DEVNULL, text=True)
+    port = None
+    deadline = time.time() + 60
+    while time.time() < deadline:
+        line = proc.stdout.readline()
+        if line.startswith("PORT="):
+            port = int(line.strip().split("=")[1])
+            break
+    assert port, "child server did not come up"
+    yield f"127.0.0.1:{port}"
+    try:
+        proc.stdin.close()
+        proc.wait(timeout=10)
+    except Exception:
+        proc.kill()
+
+
+def test_cross_process_host_staged_fallback(child_server):
+    """Different processes, no transfer runtime: device attachments must
+    arrive via the inline fallback and still round-trip correctly."""
+    ch = Channel()
+    assert ch.init(child_server) == 0
+    x = jnp.arange(256, dtype=jnp.float32)
+    for i in range(2):                   # first exchanges domains, second
+        cntl = Controller()              # knows the peer is foreign
+        cntl.timeout_ms = 30_000
+        cntl.request_device_attachment = x
+        c = ch.call_method("TE.Echo", b"", cntl=cntl)
+        assert not c.failed, c.error_text
+        assert c.response == b"doubled"
+        att = c.response_device_attachment
+        assert att is not None
+        assert att.kind == KIND_INLINE          # foreign domain ⇒ fallback
+        assert not att.device_resident
+        np.testing.assert_allclose(np.asarray(att.tensor()),
+                                   np.asarray(x) * 2)
+
+
+# -- KIND_TRANSFER wire path with a stand-in fabric -------------------------
+
+class StandInXfer:
+    """In-memory transfer engine with the JaxTransferFabric surface —
+    moves arrays by uuid the way the PJRT transfer server would."""
+
+    def __init__(self, addr: bytes):
+        self.address = addr
+        self._posted = {}
+        self._lock = threading.Lock()
+        self.pulls = 0
+
+    def post(self, array, nbytes, on_release=None, socket_id=0,
+             conn_key=None):
+        with self._lock:
+            uuid = len(self._posted) + 1000
+            self._posted[uuid] = (array, nbytes, on_release, socket_id)
+        return uuid
+
+    def redeem(self, peer_addr, uuid, specs):
+        self.pulls += 1
+        with self._lock:
+            entry = self._posted.get(uuid)
+        assert entry is not None, f"uuid {uuid} not posted"
+        return [entry[0]]
+
+    def release(self, uuid, only_socket=None):
+        with self._lock:
+            entry = self._posted.get(uuid)
+            if entry is None:
+                return False
+            if only_socket is not None and entry[3] != only_socket:
+                return False
+            del self._posted[uuid]
+        if entry[2] is not None:
+            entry[2](entry[1])
+        return True
+
+    @property
+    def live_descriptors(self):
+        return len(self._posted)
+
+
+class XferEcho(Service):
+    def Echo(self, cntl, request):
+        att = cntl.request_device_attachment
+        assert att is not None
+        cntl.response_device_attachment = att.tensor() + 1
+        return b"plus-one"
+
+
+@pytest.fixture()
+def standin_fabric(monkeypatch):
+    fab = StandInXfer(b"standin-addr:7777")
+    fabric_mod.set_transfer_fabric(fab)
+    # force the "different process" decision: the in-process fast path
+    # requires a loopback peer; refusing it here pushes prepare_send to
+    # the transfer branch exactly as a foreign-domain peer would
+    from brpc_tpu.ici import endpoint as ep_mod
+    monkeypatch.setattr(ep_mod, "_is_local_peer", lambda sock: False)
+    yield fab
+    fabric_mod.set_transfer_fabric(None)
+    fabric_mod._xfer_tried = False
+
+
+def test_transfer_descriptor_path(standin_fabric):
+    srv = Server()
+    srv.add_service(XferEcho(), name="X")
+    assert srv.start("127.0.0.1:0") == 0
+    try:
+        ch = Channel()
+        ch.init(str(srv.listen_endpoint))
+        x = jnp.arange(64, dtype=jnp.float32)
+        got_kind = None
+        for _ in range(2):               # round 1 exchanges domains
+            cntl = Controller()
+            cntl.timeout_ms = 30_000
+            cntl.request_device_attachment = x
+            c = ch.call_method("X.Echo", b"", cntl=cntl)
+            assert not c.failed, c.error_text
+            att = c.response_device_attachment
+            assert att is not None
+            got_kind = att.kind
+            np.testing.assert_allclose(np.asarray(att.tensor()),
+                                       np.asarray(x) + 1)
+        # once domains are known, payloads ride the transfer fabric
+        assert got_kind == KIND_TRANSFER
+        assert standin_fabric.pulls >= 2     # request + response legs
+        # acks returned every descriptor's credit
+        deadline = time.time() + 5
+        while standin_fabric.live_descriptors and time.time() < deadline:
+            time.sleep(0.01)
+        assert standin_fabric.live_descriptors == 0
+    finally:
+        srv.stop()
+
+
+def test_transfer_domain_advertised(standin_fabric):
+    d = fabric_mod.local_domain_id()
+    assert d.endswith(b"@standin-addr:7777")
+    assert fabric_mod.peer_transfer_addr(d) == b"standin-addr:7777"
+    assert fabric_mod.peer_transfer_addr(b"plain-token") is None
+    # foreign token with an address: unreachable in-process, pullable
+    assert not fabric_mod.in_process_fabric().can_reach(
+        b"other-token@addr:1")
