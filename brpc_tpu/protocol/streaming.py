@@ -38,8 +38,14 @@ def parse(source: IOBuf, sock, read_eof: bool, arg) -> ParseResult:
     if avail < HEADER + ln:
         return ParseResult.not_enough_data()
     source.pop_front(HEADER)
-    payload = source.fetch(ln)
-    source.pop_front(ln)
+    if flags == F_DATA and ln >= 8192:
+        # zero-copy: large payloads share the portal's blocks (the
+        # reference hands handlers butil::IOBuf* for the same reason);
+        # small messages materialize to bytes for handler ergonomics
+        payload = source.cutn(ln)
+    else:
+        payload = source.fetch(ln)
+        source.pop_front(ln)
     return ParseResult.make_message((flags, dest, payload))
 
 

@@ -47,7 +47,10 @@ class StreamOptions:
                  on_closed: Optional[Callable] = None,
                  max_buf_size: int = DEFAULT_WINDOW,
                  write_timeout_s: float = 30.0):
-        self.on_received = on_received      # (stream, [bytes, ...])
+        # (stream, [msg, ...]); small messages arrive as bytes, large
+        # (>=8KB) ones as zero-copy IOBuf views — both support len()
+        # and bytes(), like the reference's butil::IOBuf* batches
+        self.on_received = on_received
         self.on_closed = on_closed          # (stream)
         self.max_buf_size = max_buf_size
         self.write_timeout_s = write_timeout_s
@@ -132,10 +135,10 @@ class Stream:
 
     def write(self, data) -> int:
         """Ordered write; blocks while the peer's window is full
-        (≈ StreamWrite returning EAGAIN→wait, stream.cpp:277)."""
-        if isinstance(data, IOBuf):
-            data = data.to_bytes()
-        elif isinstance(data, str):
+        (≈ StreamWrite returning EAGAIN→wait, stream.cpp:277).
+        IOBuf payloads ride zero-copy (block refs shared into the
+        frame, never flattened)."""
+        if isinstance(data, str):
             data = data.encode()
         if not self._established.wait(self.options.write_timeout_s):
             return int(Errno.EINTERNAL)
@@ -167,8 +170,10 @@ class Stream:
         frame = IOBuf(MAGIC + struct.pack("<BQI", flags,
                                           self.peer_stream_id,
                                           len(payload)))
-        if payload:
-            frame.append(payload)
+        if isinstance(payload, IOBuf):
+            frame.append_iobuf(payload)      # share blocks, no flatten
+        elif payload:
+            frame.append(payload)            # zero-copy for large bytes
         return sock.write(frame)
 
     # -- frame ingestion (called by the protocol layer) -------------------
