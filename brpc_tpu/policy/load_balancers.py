@@ -282,7 +282,38 @@ class LocalityAwareLB(LoadBalancer):
             self._bump_locked(ep)
 
 
+class DynPartLB(LoadBalancer):
+    """Weighted-random by declared node weight
+    (≈ /root/reference/src/brpc/policy/dynpart_load_balancer.cpp, which
+    weights partitioned sub-channels by capacity): a node's ``w=<n>``
+    tag token sets its weight (default 1), so heterogeneous partitions
+    of a dynamically re-partitioning cluster receive proportional
+    traffic."""
+
+    @staticmethod
+    def _weight(node) -> int:
+        for token in (node.tag or "").split():
+            if token.startswith("w="):
+                try:
+                    return max(0, int(token[2:]))
+                except ValueError:
+                    return 1
+        return 1
+
+    def select(self, nodes, cntl):
+        total = sum(self._weight(n) for n in nodes)
+        if total <= 0:
+            return nodes[fast_rand() % len(nodes)]
+        r = fast_rand() % total
+        for n in nodes:
+            r -= self._weight(n)
+            if r < 0:
+                return n
+        return nodes[-1]
+
+
 lb_registry().register("rr", RoundRobinLB)
+lb_registry().register("dynpart", DynPartLB)
 lb_registry().register("wrr", WeightedRoundRobinLB)
 lb_registry().register("random", RandomLB)
 lb_registry().register("wr", WeightedRandomLB)

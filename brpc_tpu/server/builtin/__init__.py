@@ -97,12 +97,28 @@ def _status(server, msg, rest):
 
 
 def _vars(server, msg, rest):
+    q = msg.query()
+    if "expand" in q:
+        # live trend graph (≈ the reference portal's flot charts): the
+        # first request starts 1Hz recording; refreshes show the curve
+        from ...bvar.trend import render_sparkline_svg, track
+        name = q["expand"]
+        t = track(name)
+        if t is None:
+            return 404, "text/plain", f"no var {name}\n"
+        v = find_exposed(name)
+        svg = render_sparkline_svg(list(t.ring))
+        return (200, "text/html",
+                f"<html><body style='font:13px monospace'>"
+                f"<h3>{name} = {v.describe()}</h3>{svg}"
+                f"<p><a href=''>refresh</a> · <a href='/vars'>all vars"
+                f"</a></p></body></html>")
     if rest:
         v = find_exposed(rest[0])
         if v is None:
             return 404, "text/plain", f"no var {rest[0]}\n"
         return 200, "text/plain", f"{rest[0]} : {v.describe()}\n"
-    filt = msg.query().get("filter", "")
+    filt = q.get("filter", "")
     dump = dump_exposed(filt)
     body = "".join(f"{k} : {v}\n" for k, v in sorted(dump.items()))
     return 200, "text/plain", body
@@ -334,6 +350,15 @@ def _dir(server, msg, rest):
     return 404, "text/plain", "no such path\n"
 
 
+def _trackme(server, msg, rest):
+    """/trackme?ver=X — fleet version check-in (≈ trackme.cpp)."""
+    from ...trackme import handle_trackme_query
+    ver = msg.query().get("ver", "")
+    return (200, "application/json",
+            json.dumps(handle_trackme_query(ver)))
+
+
+register_builtin("trackme", _trackme)
 register_builtin("sockets", _sockets)
 register_builtin("threads", _threads)
 register_builtin("protobufs", _protobufs)

@@ -257,3 +257,106 @@ def test_runtime_local_queue_spawn_chain():
     fr.spawn(root)
     assert done.wait(10)
     assert sorted(results) == list(range(20))
+
+
+# -- trackme ----------------------------------------------------------------
+
+def test_trackme_roundtrip():
+    import json
+
+    from brpc_tpu import __version__, trackme
+    from brpc_tpu.butil.flags import set_flag
+    from brpc_tpu.tools.rpc_view import fetch
+
+    srv = Server()
+
+    class Dummy(Service):
+        def Ping(self, cntl, request):
+            return b"pong"
+
+    srv.add_service(Dummy(), name="D")
+    assert srv.start("127.0.0.1:0") == 0
+    try:
+        addr = str(srv.listen_endpoint)
+        reply = json.loads(fetch(addr, f"trackme?ver={__version__}"))
+        assert reply["severity"] == trackme.SEV_OK
+        set_flag("trackme_min_version", "99.0.0")
+        try:
+            reply = json.loads(fetch(addr, "trackme?ver=0.0.1"))
+            assert reply["severity"] == trackme.SEV_WARN
+            set_flag("trackme_fatal_version", "98.0.0")
+            reply = json.loads(fetch(addr, "trackme?ver=0.0.1"))
+            assert reply["severity"] == trackme.SEV_FATAL
+        finally:
+            set_flag("trackme_min_version", "")
+            set_flag("trackme_fatal_version", "")
+        # client ping task fires and parses without raising
+        assert trackme.start_trackme(addr, interval_s=60)
+        trackme.stop_trackme()
+    finally:
+        srv.stop()
+
+
+# -- /vars live trend graphs ------------------------------------------------
+
+def test_vars_expand_sparkline():
+    import http.client
+
+    from brpc_tpu.bvar.reducer import Adder
+    from brpc_tpu.bvar.sampler import tick_once_for_tests
+
+    counter = Adder("trend_test_counter")
+    srv = Server()
+
+    class Dummy(Service):
+        def Ping(self, cntl, request):
+            return b"pong"
+
+    srv.add_service(Dummy(), name="D")
+    assert srv.start("127.0.0.1:0") == 0
+    try:
+        ep = srv.listen_endpoint
+
+        def get(path):
+            c = http.client.HTTPConnection(ep.host, ep.port, timeout=10)
+            c.request("GET", path)
+            r = c.getresponse()
+            body = r.read()
+            c.close()
+            return r.status, body
+
+        status, body = get("/vars?expand=trend_test_counter")
+        assert status == 200 and b"collecting" in body
+        for i in range(4):
+            counter << (i + 1)
+            tick_once_for_tests()
+        status, body = get("/vars?expand=trend_test_counter")
+        assert status == 200 and b"polyline" in body   # curve rendered
+        status, body = get("/vars?expand=no_such_var")
+        assert status == 404
+    finally:
+        srv.stop()
+
+
+# -- dynpart LB -------------------------------------------------------------
+
+def test_dynpart_lb_weights_by_tag():
+    from brpc_tpu.butil.endpoint import EndPoint
+    from brpc_tpu.client.load_balancer import create_load_balancer
+    from brpc_tpu.client.naming_service import ServerNode
+    from brpc_tpu.policy import load_balancers  # noqa: F401
+
+    lb = create_load_balancer("dynpart")
+    nodes = [
+        ServerNode(endpoint=EndPoint(host="10.0.0.1", port=1), tag="w=1"),
+        ServerNode(endpoint=EndPoint(host="10.0.0.1", port=2), tag="w=9"),
+    ]
+    lb.reset_servers(nodes)
+
+    class C:
+        excluded_servers = set()
+        remote_side = None
+
+    picks = [lb.select_server(C()).port for _ in range(1000)]
+    heavy = picks.count(2)
+    assert 800 <= heavy <= 980, heavy    # ~90% to the w=9 node
