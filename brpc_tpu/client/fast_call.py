@@ -357,6 +357,108 @@ def _slow_path(channel, cntl, method_full, request, response_type) -> None:
     cntl._sync_wait()
 
 
+def run_scatter(branches, timeout_ms: Optional[int]) -> bool:
+    """Fan-out fast lane for ParallelChannel: write every branch's
+    request first, then collect the responses — wire-level parallelism
+    from ONE thread, no dispatcher/fiber machinery per branch.
+
+    ``branches``: list of (channel, cntl, method_full, request,
+    response_type).  Returns False (nothing sent) when any branch is
+    ineligible — the caller falls back to the async path.  On True,
+    every branch cntl is completed (success or failure; no retries —
+    ParallelChannel's fail_limit is the recovery story here)."""
+    for channel, cntl, _m, request, _r in branches:
+        if not eligible(channel, cntl) or channel.load_balancer is not None:
+            return False
+        if not isinstance(request, (bytes, bytearray, memoryview)):
+            return False
+    inflight = []      # (channel, cntl, sock, sid, cid, response_type)
+    nat = _native()
+    for channel, cntl, method_full, request, response_type in branches:
+        opts = channel.options
+        if cntl.timeout_ms is None:
+            cntl.timeout_ms = timeout_ms or opts.timeout_ms
+        cntl.connection_type = cntl.connection_type or opts.connection_type
+        cntl._begin_us = monotonic_us()
+        remote = channel.single_server
+        cntl.remote_side = remote
+        pooled = cntl.connection_type == "pooled"
+        sid, rc = pooled_socket(remote) if pooled else short_socket(remote)
+        sock = Socket.address(sid)
+        if sock is None or (rc != 0 and sock.failed) \
+                or (sock.fd is None and sock.connect_if_not() != 0) \
+                or not sock.direct_read or not sock.read_portal.empty():
+            if sock is not None:
+                sock.release()
+            _finish(channel, cntl, Errno.EFAILEDSOCKET,
+                    f"connect to {remote} failed")
+            continue
+        tlv = channel._method_tlvs.get(method_full)
+        if tlv is None:
+            tlv = channel._method_tlvs[method_full] = \
+                method_tlv(method_full)
+        cid = _next_cid()
+        mb = _CID_TAG + struct.pack("<Q", cid) + tlv
+        if cntl.timeout_ms and cntl.timeout_ms > 0:
+            mb += _TMO_TAG + struct.pack("<I", int(cntl.timeout_ms))
+        frame = (_MAGIC
+                 + struct.pack("<II", len(mb) + len(request), len(mb))
+                 + mb + request)
+        try:
+            _send_all(sock, frame, (cntl.timeout_ms or 1000) / 1e3)
+        except (OSError, TimeoutError) as e:
+            sock.set_failed(Errno.EFAILEDSOCKET, str(e))
+            sock.release()
+            _finish(channel, cntl, Errno.EFAILEDSOCKET, f"send: {e}")
+            continue
+        inflight.append((channel, cntl, sock, sid, cid, response_type,
+                         pooled))
+    # phase 2: collect responses (arrival order ≈ completion order)
+    for channel, cntl, sock, sid, cid, response_type, pooled in inflight:
+        timeout_s = max(0.001, (cntl.timeout_ms or 1000) / 1e3
+                        - (monotonic_us() - cntl._begin_us) / 1e6)
+        try:
+            if nat is not None:
+                buf, meta_size = nat.sync_call(sock.fd.fileno(), (),
+                                               timeout_s)
+            else:
+                buf, meta_size = _py_sync_call(sock, b"", timeout_s)
+        except TimeoutError:
+            sock.set_failed(Errno.ERPCTIMEDOUT, "rpc timeout")
+            sock.release()
+            _finish(channel, cntl, Errno.ERPCTIMEDOUT,
+                    f"deadline {cntl.timeout_ms}ms exceeded")
+            continue
+        except (ConnectionError, ValueError, OSError) as e:
+            sock.set_failed(Errno.EFAILEDSOCKET, str(e))
+            sock.release()
+            _finish(channel, cntl, Errno.EFAILEDSOCKET, str(e))
+            continue
+        done, code, text = _handle_response(channel, cntl, sock, sid,
+                                            pooled, buf, meta_size, cid,
+                                            response_type)
+        if not done:
+            _finish(channel, cntl, code, text)
+    return True
+
+
+def _send_all(sock, frame: bytes, timeout_s: float) -> None:
+    """Blocking-with-deadline send of one frame on a non-blocking fd."""
+    import time as _time
+    fd = sock.fd
+    view = memoryview(frame)
+    deadline = _time.monotonic() + timeout_s
+    while view:
+        try:
+            n = fd.send(view)
+            view = view[n:]
+        except (BlockingIOError, InterruptedError):
+            left = deadline - _time.monotonic()
+            if left <= 0:
+                raise TimeoutError("send timed out")
+            _select.select([], [fd], [], left)
+
+
 def run_batch(channel, method_full: str, requests, response_type: Any,
               timeout_ms: Optional[int], method_tlvs: bytes):
     """Pipelined batch of unary calls on ONE exclusive connection: all

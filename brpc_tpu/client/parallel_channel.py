@@ -73,6 +73,43 @@ class ParallelChannel:
 
         n = len(branches)
         fail_limit = self.fail_limit if self.fail_limit >= 0 else n
+
+        if done is None:
+            # scatter-gather fast lane: all requests on the wire first,
+            # then collect — no per-branch dispatcher/fiber machinery
+            from . import fast_call
+            sub_cntls = []
+            scatter = []
+            for i, sub, mapped in branches:
+                sc = Controller()
+                sc.timeout_ms = c.timeout_ms
+                sc.max_retry = c.max_retry
+                # branches are unary one-shots: exclusive pooled
+                # connections let one thread own all the reads
+                sc.connection_type = "pooled"
+                sub_cntls.append(sc)
+                scatter.append((sub, sc, method_full, mapped,
+                                response_type))
+            if fast_call.run_scatter(scatter, c.timeout_ms):
+                failed = sum(1 for sc in sub_cntls if sc.failed)
+                if failed > 0 and (failed >= fail_limit or failed == n):
+                    codes = [sc.error_code for sc in sub_cntls
+                             if sc.failed]
+                    texts = [sc.error_text for sc in sub_cntls
+                             if sc.failed]
+                    c.set_failed(Errno.ETOOMANYFAILS,
+                                 f"{failed}/{n} branches failed "
+                                 f"(codes={codes[:4]}, first={texts[:1]})")
+                else:
+                    try:
+                        c.response = merger(
+                            [sc.response if not sc.failed else None
+                             for sc in sub_cntls])
+                    except Exception as e:
+                        c.set_failed(Errno.EINTERNAL, f"merger raised: {e}")
+                c._ended.set()
+                return c
+
         state = {
             "remaining": n, "failed": 0,
             "responses": [None] * n,
