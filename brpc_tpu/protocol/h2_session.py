@@ -173,9 +173,18 @@ class H2Session:
         if end_stream:
             st.closed_local = True
 
+    MAX_PENDING = 64 << 20      # per-stream window-blocked buffer cap
+
     def send_data(self, sid: int, data: bytes,
                   end_stream: bool = False) -> None:
         st = self._stream(sid)
+        if len(st.pending) + len(data) > self.MAX_PENDING:
+            # a peer sitting on its window must not buffer us to death:
+            # reset the stream instead of accumulating unboundedly
+            self.send_rst(sid, E_FLOW_CONTROL)
+            raise H2Error(E_FLOW_CONTROL,
+                          f"stream {sid} window-blocked beyond "
+                          f"{self.MAX_PENDING} pending bytes")
         st.pending += data
         st.end_after_pending = st.end_after_pending or end_stream
         self._pump_stream(st)

@@ -220,8 +220,15 @@ def _bridge_rpc(msg: HttpMessage, sock, server, svc: str,
                 cntl.request_attachment = IOBuf(request[len(request) - n:])
                 request = request[:len(request) - n]
     try:
-        from ..protocol.tpu_std import parse_payload
-        request = parse_payload(request, entry.request_type)
+        from ..protocol.json2pb import maybe_parse_request
+        converted = maybe_parse_request(
+            request if isinstance(request, bytes) else bytes(request),
+            entry.request_type, msg.headers.get("content-type", ""))
+        if converted is not None:
+            request = converted          # json2pb: JSON → pb message
+        else:
+            from ..protocol.tpu_std import parse_payload
+            request = parse_payload(request, entry.request_type)
     except Exception as e:
         cntl.set_failed(Errno.EREQUEST, f"request parse failed: {e}")
         cntl.finish(None)
@@ -241,6 +248,10 @@ def _bridge_rpc(msg: HttpMessage, sock, server, svc: str,
 def _encode_http_body(response: Any) -> Tuple[bytes, str]:
     if response is None:
         return b"", "text/plain"
+    from ..protocol.json2pb import maybe_encode_response
+    as_json = maybe_encode_response(response)
+    if as_json is not None:              # pb message → JSON (pb2json)
+        return as_json, "application/json"
     if isinstance(response, (dict, list)):
         return json.dumps(response).encode(), "application/json"
     if isinstance(response, str):
