@@ -9,5 +9,9 @@ service moving tensors in attachments.
 """
 
 from .embedding_ps import PSConfig, EmbeddingPS
+from .transformer_lm import (LMConfig, batch_specs, init_params,
+                             make_forward, make_train_step, param_specs)
 
-__all__ = ["PSConfig", "EmbeddingPS"]
+__all__ = ["PSConfig", "EmbeddingPS", "LMConfig", "init_params",
+           "make_forward", "make_train_step", "param_specs",
+           "batch_specs"]

@@ -1,0 +1,203 @@
+"""TransformerLM — the long-context flagship model family.
+
+A decoder-only transformer built TPU-first:
+
+- **bfloat16 matmuls on the MXU**: weights/activations cast to bf16 at
+  the matmul boundary, accumulation in fp32;
+- **sequence parallelism**: attention runs through
+  :mod:`brpc_tpu.parallel.ring_attention` when a mesh axis is given —
+  KV blocks rotate around the ring (ICI), so context length scales with
+  the number of chips;
+- **tensor parallelism**: MLP + attention projections shard on a ``tp``
+  axis via ``NamedSharding`` specs (XLA inserts the collectives);
+- **rematerialisation**: blocks are wrapped in ``jax.checkpoint`` to
+  trade FLOPs for HBM on long sequences;
+- static shapes, ``lax.scan``-free simple layer stack (layers unrolled
+  — tiny configs are jit-compiled per depth; scanned-weights variants
+  drop in when depth grows).
+
+The capability analogue in the reference is its flagship *service*
+workloads (echo/PS); a TPU framework's flagship is a model — this plus
+EmbeddingPS cover the dense-compute and sparse-lookup families.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, Optional, Tuple
+
+
+class LMConfig:
+    def __init__(self, vocab: int = 256, dim: int = 64, heads: int = 4,
+                 depth: int = 2, mlp_mult: int = 4, max_seq: int = 256,
+                 causal: bool = True, remat: bool = True,
+                 lr: float = 0.05):
+        assert dim % heads == 0
+        assert (dim // heads) % 2 == 0, "head dim must be even for RoPE"
+        self.vocab = vocab
+        self.dim = dim
+        self.heads = heads
+        self.depth = depth
+        self.mlp_mult = mlp_mult
+        self.max_seq = max_seq
+        self.causal = causal
+        self.remat = remat
+        self.lr = lr
+
+
+def init_params(rng, cfg: LMConfig) -> Dict[str, Any]:
+    import jax
+    import jax.numpy as jnp
+
+    ks = jax.random.split(rng, 2 + cfg.depth)
+    scale = 1.0 / math.sqrt(cfg.dim)
+    params: Dict[str, Any] = {
+        "embed": jax.random.normal(ks[0], (cfg.vocab, cfg.dim),
+                                   jnp.float32) * scale,
+        "unembed": jax.random.normal(ks[1], (cfg.dim, cfg.vocab),
+                                     jnp.float32) * scale,
+    }
+    for i in range(cfg.depth):
+        bk = jax.random.split(ks[2 + i], 6)
+        h = cfg.dim * cfg.mlp_mult
+        params[f"blk{i}"] = {
+            "wqkv": jax.random.normal(bk[0], (cfg.dim, 3 * cfg.dim),
+                                      jnp.float32) * scale,
+            "wo": jax.random.normal(bk[1], (cfg.dim, cfg.dim),
+                                    jnp.float32) * scale,
+            "w1": jax.random.normal(bk[2], (cfg.dim, h),
+                                    jnp.float32) * scale,
+            "w2": jax.random.normal(bk[3], (h, cfg.dim),
+                                    jnp.float32) * (scale / cfg.mlp_mult),
+            "ln1": jnp.ones((cfg.dim,), jnp.float32),
+            "ln2": jnp.ones((cfg.dim,), jnp.float32),
+        }
+    return params
+
+
+def _rmsnorm(x, g):
+    import jax.numpy as jnp
+    return x * g / jnp.sqrt(jnp.mean(x * x, axis=-1, keepdims=True) + 1e-6)
+
+
+def _rope_tables(seq: int, head_dim: int):
+    """sin/cos tables for rotary embedding, shaped (1, s, 1, d/2).
+    Built once per forward and passed into every block so remat regions
+    cover only the matmuls, not the table computation."""
+    import jax.numpy as jnp
+    half = head_dim // 2
+    pos = jnp.arange(seq, dtype=jnp.float32)[None, :, None, None]
+    freq = jnp.exp(-math.log(10000.0)
+                   * jnp.arange(half, dtype=jnp.float32) / half)
+    ang = pos * freq[None, None, None, :]
+    return jnp.sin(ang), jnp.cos(ang)
+
+
+def _rope(x, sin, cos):
+    """Rotary position embedding — static shapes, fused by XLA."""
+    import jax.numpy as jnp
+    half = x.shape[-1] // 2
+    x1, x2 = x[..., :half], x[..., half:]
+    return jnp.concatenate([x1 * cos - x2 * sin,
+                            x1 * sin + x2 * cos], axis=-1)
+
+
+def make_forward(cfg: LMConfig, mesh=None, sp_axis: Optional[str] = None):
+    """Forward fn: (params, ids[b, s]) -> logits[b, s, vocab].
+    With ``mesh`` + ``sp_axis``, attention is ring attention over the
+    mesh axis (sequence-parallel long context)."""
+    import jax
+    import jax.numpy as jnp
+
+    if mesh is not None and sp_axis is not None:
+        from ..parallel.ring_attention import make_ring_attention
+        attend = make_ring_attention(mesh, sp_axis, causal=cfg.causal)
+    else:
+        from ..parallel.ring_attention import reference_attention
+
+        def attend(q, k, v):
+            return reference_attention(q, k, v, causal=cfg.causal)
+
+    def block(bp, x, sin, cos):
+        b, s, _ = x.shape
+        h = _rmsnorm(x, bp["ln1"])
+        qkv = (h.astype(jnp.bfloat16) @ bp["wqkv"].astype(jnp.bfloat16)
+               ).astype(jnp.float32)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        shp = (b, s, cfg.heads, cfg.dim // cfg.heads)
+        q, k = (_rope(t.reshape(shp), sin, cos) for t in (q, k))
+        v = v.reshape(shp)
+        att = attend(q, k, v).reshape(b, s, cfg.dim)
+        x = x + (att.astype(jnp.bfloat16) @ bp["wo"].astype(jnp.bfloat16)
+                 ).astype(jnp.float32)
+        h = _rmsnorm(x, bp["ln2"])
+        up = (h.astype(jnp.bfloat16) @ bp["w1"].astype(jnp.bfloat16))
+        gated = jax.nn.gelu(up.astype(jnp.float32)).astype(jnp.bfloat16)
+        return x + (gated @ bp["w2"].astype(jnp.bfloat16)
+                    ).astype(jnp.float32)
+
+    if cfg.remat:
+        block = jax.checkpoint(block)
+
+    def forward(params, ids):
+        assert ids.shape[-1] <= cfg.max_seq, (
+            f"seq {ids.shape[-1]} exceeds max_seq {cfg.max_seq}")
+        x = params["embed"][ids]
+        sin, cos = _rope_tables(ids.shape[-1], cfg.dim // cfg.heads)
+        for i in range(cfg.depth):
+            x = block(params[f"blk{i}"], x, sin, cos)
+        return (x.astype(jnp.bfloat16)
+                @ params["unembed"].astype(jnp.bfloat16)).astype(
+                    jnp.float32)
+
+    return forward
+
+
+def make_train_step(cfg: LMConfig, mesh=None, sp_axis=None):
+    """(params, ids, labels) -> (new_params, loss); plain SGD."""
+    import jax
+    import jax.numpy as jnp
+
+    forward = make_forward(cfg, mesh, sp_axis)
+
+    def loss_fn(params, ids, labels):
+        logits = forward(params, ids)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        nll = -jnp.take_along_axis(logp, labels[..., None],
+                                   axis=-1).squeeze(-1)
+        return nll.mean()
+
+    def train_step(params, ids, labels, lr: float = cfg.lr):
+        loss, grads = jax.value_and_grad(loss_fn)(params, ids, labels)
+        new_params = jax.tree_util.tree_map(
+            lambda p, g: p - lr * g, params, grads)
+        return new_params, loss
+
+    return train_step
+
+
+def param_specs(cfg: LMConfig) -> Dict[str, Any]:
+    """NamedSharding PartitionSpecs for a ("dp", "tp") mesh: attention/
+    MLP projections shard their wide dim over tp (XLA inserts the
+    all-reduces), embeddings shard the vocab."""
+    from jax.sharding import PartitionSpec as P
+
+    specs: Dict[str, Any] = {
+        "embed": P("tp", None),
+        "unembed": P(None, "tp"),
+    }
+    for i in range(cfg.depth):
+        specs[f"blk{i}"] = {
+            "wqkv": P(None, "tp"),
+            "wo": P("tp", None),
+            "w1": P(None, "tp"),
+            "w2": P("tp", None),
+            "ln1": P(None),
+            "ln2": P(None),
+        }
+    return specs
+
+
+def batch_specs() -> Tuple[Any, Any]:
+    from jax.sharding import PartitionSpec as P
+    return P("dp", None), P("dp", None)
