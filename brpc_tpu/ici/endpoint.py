@@ -149,15 +149,7 @@ def conn_key_of(sock):
     (local, remote) address pair.  Binds a descriptor to the exact TCP
     connection it was posted for — a peer on another connection forging
     ids cannot redeem them (fabric.redeem enforces equality)."""
-    local = sock.local_side
-    if local is None and sock.fd is not None:
-        try:
-            name = sock.fd.getsockname()
-            from ..butil.endpoint import EndPoint
-            local = EndPoint(host=name[0], port=name[1])
-            sock.local_side = local
-        except (OSError, IndexError):
-            return None
+    local = sock.pin_local_side()
     remote = sock.remote_side
     if local is None or remote is None:
         return None

@@ -88,6 +88,7 @@ class Acceptor:
             fd=conn, remote_side=remote,
             on_edge_triggered_events=self._messenger.on_new_messages))
         s = Socket.address(sid)
+        s.pin_local_side()
         s.tag = self._tag
         s.attach_dispatcher(self._dispatcher)
         with self._conn_lock:

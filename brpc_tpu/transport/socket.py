@@ -192,11 +192,30 @@ class Socket:
                     fd, server_hostname=str(self.remote_side.host))
             fd.setblocking(False)
             self.fd = fd
+            self.pin_local_side()
             return 0
         except OSError as e:
             self.set_failed(Errno.EFAILEDSOCKET,
                             f"connect to {self.remote_side}: {e}")
             return e.errno or int(Errno.EFAILEDSOCKET)
+
+    def pin_local_side(self) -> Optional[EndPoint]:
+        """Resolve and cache the local address of ``self.fd``.  Called
+        eagerly when the fd is installed (connect/accept): resolving it
+        lazily can fail on a concurrently-failed fd, and a missing
+        conn-pair key silently degrades device attachments to
+        host-staged bytes (ici/endpoint.py conn_key_of)."""
+        if self.local_side is not None:
+            return self.local_side
+        if self.fd is None:
+            return None
+        try:
+            name = self.fd.getsockname()
+            self.local_side = EndPoint(host=name[0], port=name[1])
+        except (OSError, IndexError) as e:
+            LOG.warning("socket %s: local address unresolvable (%s); "
+                        "device attachments will go host-staged", self.id, e)
+        return self.local_side
 
     # -- failure & revival -------------------------------------------------
 
