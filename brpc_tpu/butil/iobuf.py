@@ -523,3 +523,35 @@ class IOBufReader:
 
     def remaining(self) -> int:
         return self._buf.size - self._pos
+
+
+class LazyAttachmentsMixin:
+    """Lazily-constructed request/response attachment IOBufs for the
+    client and server controllers.  A sync unary call usually replaces
+    both attachments, so eager construction cost ~2 IOBufs/call on the
+    echo hot path.  Subclasses declare ``_req_att``/``_resp_att`` in
+    their ``__slots__`` and initialize both to None."""
+
+    __slots__ = ()
+
+    @property
+    def request_attachment(self) -> "IOBuf":
+        a = self._req_att
+        if a is None:
+            a = self._req_att = IOBuf()
+        return a
+
+    @request_attachment.setter
+    def request_attachment(self, v: "IOBuf") -> None:
+        self._req_att = v
+
+    @property
+    def response_attachment(self) -> "IOBuf":
+        a = self._resp_att
+        if a is None:
+            a = self._resp_att = IOBuf()
+        return a
+
+    @response_attachment.setter
+    def response_attachment(self, v: "IOBuf") -> None:
+        self._resp_att = v

@@ -197,7 +197,7 @@ class Channel:
                 c.set_failed(1004, f"response parse failed: {e}")
         if self.load_balancer is not None:
             self.load_balancer.feedback(c)
-        c._ended.set()
+        c._signal_ended()
         if done is not None:
             try:
                 done(c)

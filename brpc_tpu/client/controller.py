@@ -20,7 +20,7 @@ from __future__ import annotations
 import threading
 from typing import Any, Callable, Optional, Set
 
-from ..butil.iobuf import IOBuf
+from ..butil.iobuf import IOBuf, LazyAttachmentsMixin
 from ..butil.logging_util import LOG
 from ..butil.status import Errno
 from ..butil.time_utils import monotonic_us
@@ -35,6 +35,10 @@ from ..transport.socket_map import (global_socket_map, pooled_socket,
 
 _idp = global_id_pool()
 
+# guards lazy creation of per-controller completion Events (rare: only
+# async joins ever create one; sync fast-path calls complete inline)
+_EV_CREATE_LOCK = threading.Lock()
+
 # errors worth retrying on another attempt (≈ DefaultRetryPolicy,
 # /root/reference/src/brpc/retry_policy.cpp)
 _RETRIABLE = {int(Errno.EFAILEDSOCKET), int(Errno.EEOF),
@@ -45,11 +49,11 @@ def default_retry_policy(cntl: "Controller", error_code: int) -> bool:
     return error_code in _RETRIABLE
 
 
-class Controller:
+class Controller(LazyAttachmentsMixin):
     # user-facing knobs (None = inherit from ChannelOptions)
     __slots__ = (
         "timeout_ms", "max_retry", "backup_request_ms",
-        "request_attachment", "response_attachment",
+        "_req_att", "_resp_att",
         "request_device_attachment", "response_device_attachment",
         "request_compress_type", "connection_type", "retry_policy",
         "request_code", "excluded_servers",
@@ -59,7 +63,7 @@ class Controller:
         # internals
         "_error_code", "_error_text", "_cid_base", "_nretry",
         "_live_versions", "_done", "_response_type", "_request_payload",
-        "_method_full", "_remote", "_begin_us", "_ended",
+        "_method_full", "_remote", "_begin_us", "_ended", "_ended_flag",
         "_timeout_timer", "_backup_timer", "_sending_sid",
         "_attempt_sids", "attempt_remotes", "_stream_to_create",
         "_channel", "_lb_ctx", "trace_id", "span_id", "_direct_ok",
@@ -69,8 +73,8 @@ class Controller:
         self.timeout_ms: Optional[int] = None
         self.max_retry: Optional[int] = None
         self.backup_request_ms: Optional[int] = None
-        self.request_attachment = IOBuf()
-        self.response_attachment = IOBuf()
+        self._req_att: Optional[IOBuf] = None      # lazy (hot path)
+        self._resp_att: Optional[IOBuf] = None     # lazy (hot path)
         # device tensors (ici/): out = a jax array to ship
         # device-resident; in = DeviceAttachment handle (.tensor())
         self.request_device_attachment = None
@@ -92,11 +96,12 @@ class Controller:
         self._live_versions: Set[int] = set()
         self._done: Optional[Callable] = None
         self._response_type: Any = None
-        self._request_payload = IOBuf()
+        self._request_payload: Optional[IOBuf] = None   # set by _launch
         self._method_full = ""
         self._remote = None
         self._begin_us = 0
-        self._ended = threading.Event()
+        self._ended: Optional[threading.Event] = None   # lazy (hot path)
+        self._ended_flag = False
         self._timeout_timer = 0
         self._backup_timer = 0
         self._sending_sid = 0
@@ -108,6 +113,32 @@ class Controller:
         self._lb_ctx = None
         self.trace_id = 0
         self.span_id = 0
+
+    # -- lazy hot-path members ---------------------------------------------
+    # attachments: LazyAttachmentsMixin.  The Event is also lazy: a sync
+    # unary call never touches it (completed inline on the caller).
+
+    def _signal_ended(self) -> None:
+        """Completion signal: flag first, then wake any created Event."""
+        self._ended_flag = True
+        ev = self._ended
+        if ev is not None:
+            ev.set()
+
+    def _ended_event(self) -> threading.Event:
+        """The completion Event, created on first wait (double-checked
+        against the flag so a signal between create and wait is never
+        lost)."""
+        ev = self._ended
+        if ev is None:
+            with _EV_CREATE_LOCK:
+                ev = self._ended
+                if ev is None:
+                    ev = threading.Event()
+                    self._ended = ev
+            if self._ended_flag:
+                ev.set()
+        return ev
 
     # -- results -----------------------------------------------------------
 
@@ -134,7 +165,8 @@ class Controller:
 
     def join(self, timeout: Optional[float] = None) -> bool:
         return _idp.join(self._cid_base, timeout) if self._cid_base \
-            else self._ended.wait(timeout)
+            else (self._ended_flag
+                  or self._ended_event().wait(timeout))
 
     def _sync_wait(self) -> None:
         """Block until completion.  Fast path: on an exclusive
@@ -152,13 +184,13 @@ class Controller:
         deadline = None
         if self.timeout_ms and self.timeout_ms > 0:
             deadline = self._begin_us / 1e6 + self.timeout_ms / 1e3
-        while not self._ended.is_set():
+        while not self._ended_flag:
             if deadline is not None:
                 left = deadline - monotonic_us() / 1e6
                 if left <= 0:
                     _idp.error(self._cid_base, int(Errno.ERPCTIMEDOUT),
                                f"deadline {self.timeout_ms}ms exceeded")
-                    self._ended.wait(1.0)
+                    self._ended_event().wait(1.0)
                     return
             else:
                 left = 0.1
@@ -167,15 +199,15 @@ class Controller:
                     or sock.fd is None:
                 # the id machinery owns this phase (connect error, retry
                 # in flight, converted socket): poll-join briefly
-                self._ended.wait(0.01)
+                self._ended_event().wait(0.01)
                 continue
             try:
                 r, _, _ = _select.select([sock.fd], [], [],
                                          min(left or 0.1, 0.1))
             except (OSError, ValueError):
-                self._ended.wait(0.005)       # fd closed under us
+                self._ended_event().wait(0.005)  # fd closed under us
                 continue
-            if not r or self._ended.is_set():
+            if not r or self._ended_flag:
                 continue
             nread = sock.read_into_portal()
             if nread == 0:
@@ -189,7 +221,7 @@ class Controller:
         """Failure before a correlation id exists: set results and end so
         join() returns instead of hanging."""
         self.set_failed(code, text)
-        self._ended.set()
+        self._signal_ended()
         if done is not None:
             try:
                 done(self)
@@ -511,7 +543,7 @@ class Controller:
         if ch is not None and ch.load_balancer is not None:
             ch.load_balancer.feedback(self)
         _idp.unlock_and_destroy(self._cid_base)
-        self._ended.set()
+        self._signal_ended()
         done = self._done
         if done is not None:
             try:

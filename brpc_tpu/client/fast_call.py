@@ -28,14 +28,20 @@ from typing import Any, Optional, Tuple
 
 from ..butil.iobuf import IOBuf
 from ..butil.status import Errno
+from time import monotonic_ns as _mono_ns
+
 from ..butil.time_utils import monotonic_us
 from ..transport.socket import Socket
 from ..transport.socket_map import (pooled_socket, return_pooled_socket,
                                     short_socket)
 
-from ..protocol.meta import (TAG_AUTH, TAG_ICI_DOMAIN, TAG_METHOD,
+from ..protocol.meta import (RpcMeta, TAG_AUTH, TAG_ICI_DOMAIN, TAG_METHOD,
                              TAG_SERVICE, TLV_ATTACHMENT, TLV_CORRELATION,
                              TLV_SPAN, TLV_TIMEOUT, TLV_TRACE, encode_tlv)
+from ..protocol.tpu_std import parse_payload, serialize_payload
+from ..ici.endpoint import (ici_enabled as _ici_enabled,
+                            local_domain_id as _local_domain_id,
+                            split_device_attachment as _split_device_att)
 
 _MAGIC = b"TRPC"
 _CID_TAG = TLV_CORRELATION
@@ -59,6 +65,19 @@ def _native():
 
 
 _fast_cid = 0x46_0000_0000            # distinct range from the IdPool's ids
+
+# (domain bytes, encoded TLV) — the domain id object is cached by
+# fabric.local_domain_id, so identity comparison suffices
+_domain_tlv_cache: Tuple[Optional[bytes], bytes] = (None, b"")
+
+
+def _domain_tlv(domain: bytes) -> bytes:
+    global _domain_tlv_cache
+    cached_domain, cached = _domain_tlv_cache
+    if cached_domain is not domain:
+        cached = encode_tlv(TAG_ICI_DOMAIN, domain)
+        _domain_tlv_cache = (domain, cached)
+    return cached
 
 
 def _next_cid() -> int:
@@ -147,7 +166,7 @@ def run(channel, cntl, method_full: str, request: Any,
         cntl.max_retry = opts.max_retry
     if cntl.connection_type is None:
         cntl.connection_type = opts.connection_type
-    begin = monotonic_us()
+    begin = _mono_ns() // 1000
     cntl._begin_us = begin
     timeout_ms = cntl.timeout_ms
     deadline_us = begin + timeout_ms * 1000 \
@@ -156,9 +175,8 @@ def run(channel, cntl, method_full: str, request: Any,
     if isinstance(request, (bytes, bytearray, memoryview)):
         payload_b = request
     else:
-        from ..protocol.tpu_std import serialize_payload
         payload_b = serialize_payload(request).to_bytes()
-    att = cntl.request_attachment
+    att = cntl._req_att
     att_parts: Tuple = ()
     att_len = 0
     if att is not None and len(att):
@@ -170,8 +188,7 @@ def run(channel, cntl, method_full: str, request: Any,
             # flattens rather than poisoning the socket with a ValueError
             att_parts = (att.to_bytes(),)
 
-    from ..ici.endpoint import ici_enabled, local_domain_id
-    domain = local_domain_id() if ici_enabled() else b""
+    domain = _local_domain_id() if _ici_enabled() else b""
     auth = opts.auth_data or b""
 
     nat = _native()
@@ -220,10 +237,11 @@ def run(channel, cntl, method_full: str, request: Any,
                 mb += encode_tlv(TAG_AUTH, auth)
                 sock.app_data = "authed"
             if deadline_us is not None:
-                left_ms = max(1, int((deadline_us - monotonic_us()) // 1000))
+                left_ms = max(1, (deadline_us - _mono_ns() // 1000)
+                              // 1000)
                 mb += _TMO_TAG + struct.pack("<I", left_ms)
             if domain:
-                mb += encode_tlv(TAG_ICI_DOMAIN, domain)
+                mb += _domain_tlv(domain)
             if cntl.trace_id:
                 mb += TLV_TRACE + struct.pack("<Q", cntl.trace_id)
             if cntl.span_id:
@@ -231,7 +249,7 @@ def run(channel, cntl, method_full: str, request: Any,
             header = _MAGIC + struct.pack(
                 "<II", len(mb) + len(payload_b) + att_len, len(mb))
             timeout_s = -1.0 if deadline_us is None \
-                else max(0.001, (deadline_us - monotonic_us()) / 1e6)
+                else max(0.001, (deadline_us - _mono_ns() // 1000) / 1e6)
             try:
                 if nat is not None:
                     buf, meta_size = nat.sync_call(
@@ -266,7 +284,7 @@ def run(channel, cntl, method_full: str, request: Any,
         if cntl.retry_policy(cntl, code) and nretry < cntl.max_retry:
             nretry += 1
             cntl.retried_count = nretry
-            if deadline_us is not None and monotonic_us() >= deadline_us:
+            if deadline_us is not None and _mono_ns() // 1000 >= deadline_us:
                 _finish(channel, cntl, Errno.ERPCTIMEDOUT,
                         f"deadline {timeout_ms}ms exceeded")
                 return
@@ -280,7 +298,6 @@ def _handle_response(channel, cntl, sock, sid: int, pooled: bool, buf,
                      response_type: Any) -> Tuple[bool, int, str]:
     """Decode one response frame.  Returns (done, code, text); done=False
     means a retriable failure the caller's loop should handle."""
-    from ..protocol.meta import RpcMeta
     mv = memoryview(buf)
     meta = RpcMeta.decode(bytes(mv[:meta_size]))
     if meta is None or meta.correlation_id != cid:
@@ -305,9 +322,8 @@ def _handle_response(channel, cntl, sock, sid: int, pooled: bool, buf,
             attachment.append_user_data(body[len(body) - n:])
             body = body[:len(body) - n]
     if meta.ici_desc:
-        from ..ici.endpoint import split_device_attachment
         attachment, cntl.response_device_attachment = \
-            split_device_attachment(meta, attachment, sid)
+            _split_device_att(meta, attachment, sid)
     raw = bytes(body)
     if meta.compress_type:
         from ..protocol import compress as compress_mod
@@ -320,7 +336,6 @@ def _handle_response(channel, cntl, sock, sid: int, pooled: bool, buf,
             _finish(channel, cntl, Errno.ERESPONSE,
                     "undecompressable response")
             return True, 0, ""
-    from ..protocol.tpu_std import parse_payload
     try:
         cntl.response = parse_payload(raw, response_type)
     except Exception as e:
@@ -343,15 +358,14 @@ def _handle_response(channel, cntl, sock, sid: int, pooled: bool, buf,
 def _finish(channel, cntl, code, text: str) -> None:
     if code:
         cntl.set_failed(code, text)
-    cntl.latency_us = monotonic_us() - cntl._begin_us
+    cntl.latency_us = _mono_ns() // 1000 - cntl._begin_us
     if channel.load_balancer is not None:
         channel.load_balancer.feedback(cntl)
-    cntl._ended.set()
+    cntl._signal_ended()
 
 
 def _slow_path(channel, cntl, method_full, request, response_type) -> None:
     """Escape hatch: run the full Controller machinery."""
-    from ..protocol.tpu_std import serialize_payload
     payload = serialize_payload(request)
     cntl._launch(channel, method_full, payload, response_type, None)
     cntl._sync_wait()

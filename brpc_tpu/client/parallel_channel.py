@@ -107,7 +107,7 @@ class ParallelChannel:
                              for sc in sub_cntls])
                     except Exception as e:
                         c.set_failed(Errno.EINTERNAL, f"merger raised: {e}")
-                c._ended.set()
+                c._signal_ended()
                 return c
 
         state = {
@@ -134,7 +134,7 @@ class ParallelChannel:
                     c.response = merger(list(state["responses"]))
                 except Exception as e:
                     c.set_failed(Errno.EINTERNAL, f"merger raised: {e}")
-            c._ended.set()
+            c._signal_ended()
             finished_evt.set()
             if done is not None:
                 done(c)
@@ -211,7 +211,7 @@ class SelectiveChannel:
             idx = self._pick(excluded)
             if idx is None:
                 c.set_failed(Errno.ETOOMANYFAILS, "all sub channels failed")
-                c._ended.set()
+                c._signal_ended()
                 if done is not None:
                     done(c)
                 return
@@ -223,7 +223,7 @@ class SelectiveChannel:
                     c.response = sc.response
                     c.response_attachment = sc.response_attachment
                     c.remote_side = sc.remote_side
-                    c._ended.set()
+                    c._signal_ended()
                     if done is not None:
                         done(c)
                     return
@@ -232,7 +232,7 @@ class SelectiveChannel:
                     attempt(k + 1)
                 else:
                     c.set_failed(sc.error_code, sc.error_text)
-                    c._ended.set()
+                    c._signal_ended()
                     if done is not None:
                         done(c)
 
@@ -242,5 +242,5 @@ class SelectiveChannel:
 
         attempt(0)
         if done is None:
-            c._ended.wait()
+            c.join()
         return c

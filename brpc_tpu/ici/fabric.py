@@ -43,6 +43,7 @@ import threading
 import time
 from typing import Any, Dict, Optional, Tuple
 
+from ..butil.flags import get_flag
 from ..butil.logging_util import LOG
 
 # 16-byte process-unique token: same token on both ends of a connection
@@ -51,14 +52,29 @@ from ..butil.logging_util import LOG
 _LOCAL_DOMAIN = os.urandom(16)
 
 
+_domain_cache: Optional[bytes] = None
+_domain_cache_addr: Optional[bytes] = None
+
+
 def local_domain_id() -> bytes:
     """Domain advertised in every RpcMeta: the process token, plus this
     process's transfer-server address when the cross-process fabric is
     up (``token@address``) — peers in OTHER processes use the address to
     pull device payloads directly (≈ the GID/QPN the reference sends in
-    its RDMA handshake)."""
-    addr = transfer_ready()
-    return _LOCAL_DOMAIN + b"@" + addr if addr else _LOCAL_DOMAIN
+    its RDMA handshake).  Cached: this runs on every RPC, so the common
+    flag-off case is one dict lookup."""
+    global _domain_cache, _domain_cache_addr
+    if not get_flag("ici_transfer_enabled", False) and _xfer is None:
+        addr = None
+    else:
+        # probing transfer_ready() here also lazily starts the transfer
+        # server on the first RPC after the flag flips on
+        addr = transfer_ready()
+    if _domain_cache is None or addr != _domain_cache_addr:
+        _domain_cache_addr = addr
+        _domain_cache = _LOCAL_DOMAIN + b"@" + addr if addr \
+            else _LOCAL_DOMAIN
+    return _domain_cache
 
 
 def domain_token(domain: bytes) -> bytes:
@@ -315,7 +331,6 @@ def transfer_fabric() -> Optional[JaxTransferFabric]:
     when the runtime can't support it or the flag is off.  Tests may
     install a stand-in via set_transfer_fabric()."""
     global _xfer, _xfer_tried
-    from ..butil.flags import get_flag
     if not get_flag("ici_transfer_enabled", False):
         return _xfer            # explicit installs (tests) still count
     with _fabric_lock:

@@ -376,18 +376,28 @@ static bool parse_frames(EngineImpl* eng, Loop* lp, Conn* c) {
     }
     size_t total = hdr + (size_t)body;
     if (avail >= total) {
-      // whole frame in the buffer: one copy into its NativeBuf
-      NativeBuf* b;
-      {
-        PyGILState_STATE gs = PyGILState_Ensure();
-        b = nativebuf_new((Py_ssize_t)body);
-        if (b) memcpy(b->data, p + hdr, body);
-        PyGILState_Release(gs);
-      }
-      if (!b) return false;
+      // whole frame in the buffer: ONE GIL acquisition covers the
+      // NativeBuf alloc+copy and the Python dispatch (two round trips
+      // here doubled the GIL-convoy exposure per message)
       c->in_start += total;
       eng->nmessages++;
-      call_dispatch(eng, lp, kind, c->id, (PyObject*)b, (long)meta);
+      bool ok;
+      {
+        PyGILState_STATE gs = PyGILState_Ensure();
+        flush_decrefs_locked_gil(lp);
+        NativeBuf* b = nativebuf_new((Py_ssize_t)body);
+        ok = (b != nullptr);
+        if (ok) {
+          memcpy(b->data, p + hdr, body);
+          PyObject* r = PyObject_CallFunction(
+              eng->dispatch, "iKNl", kind, (unsigned long long)c->id,
+              (PyObject*)b, (long)meta);
+          if (!r) PyErr_WriteUnraisable(eng->dispatch);
+          else Py_DECREF(r);
+        }
+        PyGILState_Release(gs);
+      }
+      if (!ok) return false;
       continue;
     }
     // incomplete: large bodies switch to direct-into-buffer reads
@@ -912,6 +922,7 @@ static PyObject* sync_call(PyObject*, PyObject* args) {
   int err = 0;               // 0 ok, 1 timeout, 2 conn error, 3 bad frame
   char errbuf[96] = {0};
   char header[kHeaderSize];
+  char scratch[65536];       // greedy-read landing zone (header + body)
   size_t got = 0;
   uint32_t body = 0, meta = 0;
   NativeBuf* out = nullptr;
@@ -951,9 +962,13 @@ static PyObject* sync_call(PyObject*, PyObject* args) {
       }
     }
   }
-  // phase 2: read the 12-byte header
+  // phase 2: greedy read — header + (usually the whole small frame) land
+  // in one recv into the scratch buffer.  Safe on this exclusive
+  // connection: exactly one response is outstanding and nothing else
+  // (no acks, streams, or pushes in the fast lane) can follow it until
+  // the next request is written.
   while (!err && got < kHeaderSize) {
-    ssize_t r = recv(fd, header + got, kHeaderSize - got, 0);
+    ssize_t r = recv(fd, scratch + got, sizeof scratch - got, 0);
     if (r == 0) { err = 2; snprintf(errbuf, sizeof errbuf, "connection closed by peer"); break; }
     if (r < 0) {
       if (errno == EAGAIN || errno == EWOULDBLOCK) {
@@ -970,6 +985,7 @@ static PyObject* sync_call(PyObject*, PyObject* args) {
     got += (size_t)r;
   }
   if (!err) {
+    memcpy(header, scratch, kHeaderSize);
     if (memcmp(header, "TRPC", 4) != 0) {
       err = 3;
       snprintf(errbuf, sizeof errbuf, "unexpected magic on fast-path read");
@@ -991,8 +1007,11 @@ static PyObject* sync_call(PyObject*, PyObject* args) {
       Py_DECREF(seq);
       return nullptr;
     }
+    size_t have = got - kHeaderSize;         // surplus from the greedy read
+    if (have > (size_t)body) have = body;    // (cannot happen; defensive)
+    if (have) memcpy(out->data, scratch + kHeaderSize, have);
     Py_BEGIN_ALLOW_THREADS;
-    size_t filled = 0;
+    size_t filled = have;
     while (filled < body && !err) {
       ssize_t r = recv(fd, out->data + filled, body - filled, 0);
       if (r == 0) { err = 2; snprintf(errbuf, sizeof errbuf, "connection closed mid-frame"); break; }

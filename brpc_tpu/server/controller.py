@@ -11,17 +11,18 @@ from __future__ import annotations
 import threading
 from typing import Any, Callable, Optional
 
+from time import monotonic_ns as _mono_ns
+
 from ..butil.endpoint import EndPoint
-from ..butil.iobuf import IOBuf
+from ..butil.iobuf import IOBuf, LazyAttachmentsMixin
 from ..butil.status import Errno
-from ..butil.time_utils import monotonic_us
 from ..protocol.meta import CompressType, RpcMeta
 
 
-class ServerController:
+class ServerController(LazyAttachmentsMixin):
     __slots__ = (
         "request_meta", "remote_side", "socket_id",
-        "request_attachment", "response_attachment",
+        "_req_att", "_resp_att",
         "request_device_attachment", "response_device_attachment",
         "response_compress_type",
         "_error_code", "_error_text",
@@ -41,8 +42,8 @@ class ServerController:
         self.request_meta = request_meta
         self.remote_side = remote_side
         self.socket_id = socket_id
-        self.request_attachment = IOBuf()
-        self.response_attachment = IOBuf()
+        self._req_att: Optional[IOBuf] = None    # lazy (hot path)
+        self._resp_att: Optional[IOBuf] = None   # lazy (hot path)
         # device tensors: in = DeviceAttachment handle (redeem with
         # .tensor()), out = a jax array to ship device-resident (ici/)
         self.request_device_attachment = None
@@ -54,7 +55,7 @@ class ServerController:
         self._finished = False
         self._finish_lock = threading.Lock()
         self._send_response = send_response
-        self.begin_time_us = monotonic_us()
+        self.begin_time_us = _mono_ns() // 1000
         self.trace_id = request_meta.trace_id
         self.span_id = request_meta.span_id
         self.auth_context: Any = None

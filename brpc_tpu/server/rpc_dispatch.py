@@ -10,13 +10,16 @@ from __future__ import annotations
 
 from typing import Any
 
+from time import monotonic_ns as _mono_ns
+
 from ..butil.iobuf import IOBuf
 from ..butil.logging_util import LOG
 from ..butil.status import Errno
-from ..butil.time_utils import monotonic_us
 from ..protocol import compress as compress_mod
 from ..protocol.meta import RpcMeta
 from ..protocol.tpu_std import RpcMessage, pack_frame, parse_payload, serialize_payload
+from ..rpcz import start_server_span
+from ..tools import rpc_dump as _rpc_dump
 from ..transport.socket import Socket
 from .controller import ServerController
 
@@ -61,7 +64,7 @@ def _domain_tlv() -> bytes:
 def _send_response(server, entry, cntl: ServerController,
                    response: Any) -> None:
     sock = Socket.address(cntl.socket_id)
-    latency_us = monotonic_us() - cntl.begin_time_us
+    latency_us = _mono_ns() // 1000 - cntl.begin_time_us
     entry.status.on_responded(cntl.error_code, latency_us)
     server.on_request_out()
     if cntl.span is not None:
@@ -72,7 +75,7 @@ def _send_response(server, entry, cntl: ServerController,
             and cntl.response_device_attachment is None
             and isinstance(response, (bytes, bytearray, memoryview))):
         # echo-class fast path: flat TLV meta, no IOBuf/RpcMeta churn
-        att = cntl.response_attachment
+        att = cntl._resp_att
         na = len(att) if att is not None else 0
         mb = _CID_TAG + _struct.pack("<Q", cntl.request_meta.correlation_id)
         if na:
@@ -152,11 +155,10 @@ def process_rpc_request(msg: RpcMessage, sock: Socket, server) -> None:
     meta = msg.meta
     cid = meta.correlation_id
 
-    from ..tools import rpc_dump
-    if rpc_dump.dump_enabled():
+    if _rpc_dump.dump_enabled():
         # sampled wire capture for rpc_replay (payload still carries the
         # attachment tail here — the dump is the original frame body)
-        rpc_dump.maybe_dump_request(meta, msg.payload.to_bytes())
+        _rpc_dump.maybe_dump_request(meta, msg.payload.to_bytes())
 
     entry = server.find_method(meta.service_name, meta.method_name)
     if entry is None:
@@ -194,7 +196,6 @@ def process_rpc_request(msg: RpcMessage, sock: Socket, server) -> None:
         from ..ici.endpoint import split_device_attachment
         cntl.request_attachment, cntl.request_device_attachment = \
             split_device_attachment(meta, cntl.request_attachment, sock.id)
-    from ..rpcz import start_server_span
     cntl.span = start_server_span(entry.status.full_name, meta,
                                   sock.remote_side)
     if cntl.span is not None:

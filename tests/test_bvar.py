@@ -173,6 +173,30 @@ class TestLatencyRecorder:
         assert "echo_service_qps" in names
         assert "echo_service_latency" in names
 
+    def test_dead_thread_window_data_survives(self):
+        """A worker dying between sampler ticks must not lose its
+        un-drained windowed max / percentile reservoir: the dead-agent
+        fold keeps them for the next drain."""
+        import threading
+
+        lr = LatencyRecorder(window_size=5)
+
+        def worker():
+            for _ in range(1000):
+                lr << 5.0
+            lr << 9999.0
+
+        t = threading.Thread(target=worker)
+        t.start()
+        t.join()
+        # a cumulative read first: triggers the dead-agent fold BEFORE
+        # any sampler drain (the regression path)
+        assert lr.count() == 1001
+        tick_once_for_tests()
+        assert lr.max_latency() == 9999.0
+        assert lr.latency_percentile(0.5) == 5.0
+        assert lr.count() == 1001
+
 
 class TestRegistry:
     def test_expose_find_hide(self):
