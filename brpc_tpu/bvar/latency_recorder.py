@@ -26,7 +26,6 @@ from __future__ import annotations
 import threading
 from typing import List, Optional, Tuple
 
-from ..butil.fast_rand import fast_rand_less_than
 from .percentile import (SAMPLES_PER_SECOND, SAMPLES_PER_THREAD,
                          GlobalSample, Percentile)
 from .reducer import Adder, IntRecorder, Maxer
@@ -38,7 +37,7 @@ _NEG_INF = float("-inf")
 
 class _LatAgent:
     __slots__ = ("sum", "num", "mx", "epoch_mx", "samples", "scount",
-                 "thread")
+                 "rng", "thread")
 
     def __init__(self, thread):
         self.sum = 0.0
@@ -47,6 +46,10 @@ class _LatAgent:
         self.epoch_mx = _NEG_INF     # max since the last sampler drain
         self.samples: List[float] = []
         self.scount = 0
+        # inline xorshift64 state for reservoir sampling: a
+        # fast_rand_less_than() call per update costs more than the
+        # whole rest of the fused write path
+        self.rng = (id(thread) ^ 0x9E3779B97F4A7C15) | 1
         self.thread = thread
 
 
@@ -219,7 +222,12 @@ class LatencyRecorder(Variable):
         if len(s) < SAMPLES_PER_THREAD:
             s.append(latency_us)
         else:
-            idx = fast_rand_less_than(n)
+            r = a.rng
+            r ^= (r << 13) & 0xFFFFFFFFFFFFFFFF
+            r ^= r >> 7
+            r ^= (r << 17) & 0xFFFFFFFFFFFFFFFF
+            a.rng = r
+            idx = r % n                      # reservoir: uniform keep
             if idx < SAMPLES_PER_THREAD:
                 s[idx] = latency_us
         return self
