@@ -9,9 +9,10 @@ service moving tensors in attachments.
 """
 
 from .embedding_ps import PSConfig, EmbeddingPS
+from .moe import MoEConfig
 from .transformer_lm import (LMConfig, batch_specs, init_params,
                              make_forward, make_train_step, param_specs)
 
-__all__ = ["PSConfig", "EmbeddingPS", "LMConfig", "init_params",
-           "make_forward", "make_train_step", "param_specs",
-           "batch_specs"]
+__all__ = ["PSConfig", "EmbeddingPS", "LMConfig", "MoEConfig",
+           "init_params", "make_forward", "make_train_step",
+           "param_specs", "batch_specs"]

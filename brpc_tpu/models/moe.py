@@ -1,0 +1,160 @@
+"""Mixture-of-Experts FFN with expert parallelism (ep).
+
+The third flagship model family next to EmbeddingPS (sparse lookup) and
+TransformerLM (dense compute): sparse *compute*, where each token visits
+only ``k`` of E expert FFNs and experts shard over an ``ep`` mesh axis.
+
+TPU-first design (the reference has no MoE; its ep analogue is
+partitioned services — ``DynamicPartitionChannel`` routing a request to
+the shard that owns it, /root/reference/src/brpc/partition_channel.h):
+
+- **static shapes**: capacity-factor routing — each expert processes a
+  fixed ``C = ceil(k * T / E * capacity)`` token slots; overflow tokens
+  are dropped (their residual passes through), so nothing in the traced
+  program is data-dependent and XLA can tile every einsum on the MXU;
+- **dispatch/combine as einsums** (the Mesh-TensorFlow formulation):
+  a (T, E, C) one-hot dispatch tensor gathers token slots, expert FFNs
+  run batched as (E, C, d) einsums, and the combine einsum scatters
+  results back weighted by router probabilities;
+- **expert parallelism by sharding, not message passing**: expert
+  weights carry ``P("ep", ...)`` specs; under ``jit`` over a mesh XLA
+  inserts the all_to_all/all_gather collectives that move token slots
+  onto the devices owning their experts (ICI, not host);
+- router in fp32 (numerics), expert matmuls in bf16 (MXU);
+- **grouped routing** (GShard): :func:`forward_grouped` routes within
+  fixed-size groups, so dispatch memory is linear in total tokens and
+  the routing cumsum never crosses a dp shard boundary (groups align
+  with the data-parallel batch dim).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, Tuple
+
+
+class MoEConfig:
+    def __init__(self, dim: int = 64, hidden: int = 128,
+                 num_experts: int = 4, capacity_factor: float = 1.5,
+                 aux_loss_weight: float = 0.01):
+        self.dim = dim
+        self.hidden = hidden
+        self.num_experts = num_experts
+        self.capacity_factor = capacity_factor
+        self.aux_loss_weight = aux_loss_weight
+
+    def capacity(self, tokens: int) -> int:
+        c = math.ceil(tokens / self.num_experts * self.capacity_factor)
+        return max(1, c)
+
+
+def init_params(rng, cfg: MoEConfig) -> Dict[str, Any]:
+    import jax
+    import jax.numpy as jnp
+
+    kg, k1, k2 = jax.random.split(rng, 3)
+    scale = 1.0 / math.sqrt(cfg.dim)
+    return {
+        "wg": jax.random.normal(kg, (cfg.dim, cfg.num_experts),
+                                jnp.float32) * scale,
+        "w1": jax.random.normal(k1, (cfg.num_experts, cfg.dim, cfg.hidden),
+                                jnp.float32) * scale,
+        "w2": jax.random.normal(k2, (cfg.num_experts, cfg.hidden, cfg.dim),
+                                jnp.float32) * (scale / 2),
+    }
+
+
+def param_specs(cfg: MoEConfig, ep_axis: str = "ep") -> Dict[str, Any]:
+    """PartitionSpecs: experts shard over the ep axis, router replicated."""
+    from jax.sharding import PartitionSpec as P
+
+    return {
+        "wg": P(None, None),
+        "w1": P(ep_axis, None, None),
+        "w2": P(ep_axis, None, None),
+    }
+
+
+def forward(params: Dict[str, Any], x, cfg: MoEConfig
+            ) -> Tuple[Any, Any]:
+    """MoE FFN: x (T, d) -> (out (T, d), aux_loss ()).
+
+    Top-1 routing with capacity; dropped tokens contribute zero (the
+    caller's residual connection carries them through unchanged)."""
+    import jax
+    import jax.numpy as jnp
+
+    T, d = x.shape
+    E = cfg.num_experts
+    C = cfg.capacity(T)
+
+    logits = x @ params["wg"]                      # (T, E) fp32 router
+    probs = jax.nn.softmax(logits, axis=-1)
+    gate = jnp.max(probs, axis=-1)                 # (T,)
+    expert = jnp.argmax(probs, axis=-1)            # (T,)
+
+    # position of each token within its expert's capacity (static shape)
+    onehot = jax.nn.one_hot(expert, E, dtype=jnp.int32)       # (T, E)
+    pos = jnp.cumsum(onehot, axis=0) * onehot - 1             # (T, E)
+    pos_in_expert = pos.max(axis=1)                           # (T,)
+    kept = pos_in_expert < C                                  # overflow drop
+
+    # dispatch (T, E, C): token t -> slot (expert[t], pos[t])
+    dispatch = (jax.nn.one_hot(expert, E, dtype=x.dtype)[:, :, None]
+                * jax.nn.one_hot(jnp.clip(pos_in_expert, 0, C - 1), C,
+                                 dtype=x.dtype)[:, None, :])
+    dispatch = dispatch * kept[:, None, None].astype(x.dtype)
+
+    # gather token slots, run every expert as one batched bf16 einsum
+    expert_in = jnp.einsum("td,tec->ecd", x.astype(jnp.bfloat16),
+                           dispatch.astype(jnp.bfloat16))     # (E, C, d)
+    h = jnp.einsum("ecd,edh->ech", expert_in,
+                   params["w1"].astype(jnp.bfloat16))
+    h = jax.nn.gelu(h.astype(jnp.float32)).astype(jnp.bfloat16)
+    expert_out = jnp.einsum("ech,ehd->ecd", h,
+                            params["w2"].astype(jnp.bfloat16))
+
+    # combine weighted by the router probability of the chosen expert
+    combine = dispatch * gate[:, None, None].astype(x.dtype)
+    out = jnp.einsum("ecd,tec->td", expert_out.astype(x.dtype), combine)
+
+    # load-balancing aux loss (Switch Transformer): fraction of tokens
+    # per expert x mean router prob per expert, scaled by E
+    frac = jnp.mean(onehot.astype(jnp.float32), axis=0)
+    mean_prob = jnp.mean(probs, axis=0)
+    aux = E * jnp.sum(frac * mean_prob) * cfg.aux_loss_weight
+    return out, aux
+
+
+def forward_grouped(params: Dict[str, Any], x, cfg: MoEConfig
+                    ) -> Tuple[Any, Any]:
+    """Grouped MoE: x (G, N, d) -> (out (G, N, d), aux ()).
+
+    Routes each group of N tokens independently (capacity per group),
+    so the (N, E, C) dispatch tensors stay linear in total tokens and —
+    when G is the dp-sharded batch dim — routing is local to each data
+    shard (no cross-replica cumsum).  This is the form the transformer
+    block uses; plain :func:`forward` is the single-group case."""
+    import jax
+
+    out, aux = jax.vmap(lambda xg: forward(params, xg, cfg))(x)
+    return out, aux.mean()
+
+
+def make_train_step(cfg: MoEConfig, lr: float = 0.1):
+    """(params, x, target) -> (new_params, loss): regression toy task
+    exercising routing + expert grads end to end."""
+    import jax
+    import jax.numpy as jnp
+
+    def loss_fn(params, x, target):
+        out, aux = forward(params, x, cfg)
+        return jnp.mean((out - target) ** 2) + aux
+
+    def step(params, x, target):
+        loss, grads = jax.value_and_grad(loss_fn)(params, x, target)
+        new_params = jax.tree_util.tree_map(lambda p, g: p - lr * g,
+                                            params, grads)
+        return new_params, loss
+
+    return step

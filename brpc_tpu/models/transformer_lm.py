@@ -31,7 +31,8 @@ class LMConfig:
     def __init__(self, vocab: int = 256, dim: int = 64, heads: int = 4,
                  depth: int = 2, mlp_mult: int = 4, max_seq: int = 256,
                  causal: bool = True, remat: bool = True,
-                 lr: float = 0.05):
+                 lr: float = 0.05, moe_experts: int = 0,
+                 moe_capacity: float = 2.0, moe_aux_weight: float = 0.01):
         assert dim % heads == 0
         assert (dim // heads) % 2 == 0, "head dim must be even for RoPE"
         self.vocab = vocab
@@ -43,6 +44,19 @@ class LMConfig:
         self.causal = causal
         self.remat = remat
         self.lr = lr
+        # moe_experts > 0 swaps the dense MLP for a Mixture-of-Experts
+        # FFN (models/moe.py): sparse compute, experts shardable over
+        # the tp axis (expert parallelism)
+        self.moe_experts = moe_experts
+        self.moe_capacity = moe_capacity
+        self.moe_aux_weight = moe_aux_weight
+
+    def moe_cfg(self):
+        from .moe import MoEConfig
+        return MoEConfig(dim=self.dim, hidden=self.dim * self.mlp_mult,
+                         num_experts=self.moe_experts,
+                         capacity_factor=self.moe_capacity,
+                         aux_loss_weight=self.moe_aux_weight)
 
 
 def init_params(rng, cfg: LMConfig) -> Dict[str, Any]:
@@ -60,18 +74,23 @@ def init_params(rng, cfg: LMConfig) -> Dict[str, Any]:
     for i in range(cfg.depth):
         bk = jax.random.split(ks[2 + i], 6)
         h = cfg.dim * cfg.mlp_mult
-        params[f"blk{i}"] = {
+        blk = {
             "wqkv": jax.random.normal(bk[0], (cfg.dim, 3 * cfg.dim),
                                       jnp.float32) * scale,
             "wo": jax.random.normal(bk[1], (cfg.dim, cfg.dim),
                                     jnp.float32) * scale,
-            "w1": jax.random.normal(bk[2], (cfg.dim, h),
-                                    jnp.float32) * scale,
-            "w2": jax.random.normal(bk[3], (h, cfg.dim),
-                                    jnp.float32) * (scale / cfg.mlp_mult),
             "ln1": jnp.ones((cfg.dim,), jnp.float32),
             "ln2": jnp.ones((cfg.dim,), jnp.float32),
         }
+        if cfg.moe_experts > 0:
+            from .moe import init_params as moe_init
+            blk["moe"] = moe_init(bk[2], cfg.moe_cfg())
+        else:
+            blk["w1"] = jax.random.normal(bk[2], (cfg.dim, h),
+                                          jnp.float32) * scale
+            blk["w2"] = jax.random.normal(
+                bk[3], (h, cfg.dim), jnp.float32) * (scale / cfg.mlp_mult)
+        params[f"blk{i}"] = blk
     return params
 
 
@@ -118,6 +137,10 @@ def make_forward(cfg: LMConfig, mesh=None, sp_axis: Optional[str] = None):
         def attend(q, k, v):
             return reference_attention(q, k, v, causal=cfg.causal)
 
+    if cfg.moe_experts > 0:
+        from .moe import forward_grouped as moe_forward
+        moe_cfg = cfg.moe_cfg()
+
     def block(bp, x, sin, cos):
         b, s, _ = x.shape
         h = _rmsnorm(x, bp["ln1"])
@@ -131,24 +154,32 @@ def make_forward(cfg: LMConfig, mesh=None, sp_axis: Optional[str] = None):
         x = x + (att.astype(jnp.bfloat16) @ bp["wo"].astype(jnp.bfloat16)
                  ).astype(jnp.float32)
         h = _rmsnorm(x, bp["ln2"])
+        if cfg.moe_experts > 0:
+            # grouped routing: each batch row routes independently, so
+            # dispatch stays linear in tokens and dp-local (moe.py)
+            out, aux = moe_forward(bp["moe"], h, moe_cfg)
+            return x + out, aux
         up = (h.astype(jnp.bfloat16) @ bp["w1"].astype(jnp.bfloat16))
-        gated = jax.nn.gelu(up.astype(jnp.float32)).astype(jnp.bfloat16)
-        return x + (gated @ bp["w2"].astype(jnp.bfloat16)
-                    ).astype(jnp.float32)
+        return x + (jax.nn.gelu(up.astype(jnp.float32)).astype(jnp.bfloat16)
+                    @ bp["w2"].astype(jnp.bfloat16)
+                    ).astype(jnp.float32), jnp.float32(0.0)
 
     if cfg.remat:
         block = jax.checkpoint(block)
 
-    def forward(params, ids):
+    def forward(params, ids, with_aux: bool = False):
         assert ids.shape[-1] <= cfg.max_seq, (
             f"seq {ids.shape[-1]} exceeds max_seq {cfg.max_seq}")
         x = params["embed"][ids]
         sin, cos = _rope_tables(ids.shape[-1], cfg.dim // cfg.heads)
+        aux_total = jnp.float32(0.0)
         for i in range(cfg.depth):
-            x = block(params[f"blk{i}"], x, sin, cos)
-        return (x.astype(jnp.bfloat16)
-                @ params["unembed"].astype(jnp.bfloat16)).astype(
-                    jnp.float32)
+            x, aux = block(params[f"blk{i}"], x, sin, cos)
+            aux_total = aux_total + aux
+        logits = (x.astype(jnp.bfloat16)
+                  @ params["unembed"].astype(jnp.bfloat16)).astype(
+                      jnp.float32)
+        return (logits, aux_total) if with_aux else logits
 
     return forward
 
@@ -161,11 +192,11 @@ def make_train_step(cfg: LMConfig, mesh=None, sp_axis=None):
     forward = make_forward(cfg, mesh, sp_axis)
 
     def loss_fn(params, ids, labels):
-        logits = forward(params, ids)
+        logits, aux = forward(params, ids, with_aux=True)
         logp = jax.nn.log_softmax(logits, axis=-1)
         nll = -jnp.take_along_axis(logp, labels[..., None],
                                    axis=-1).squeeze(-1)
-        return nll.mean()
+        return nll.mean() + aux
 
     def train_step(params, ids, labels, lr: float = cfg.lr):
         loss, grads = jax.value_and_grad(loss_fn)(params, ids, labels)
@@ -187,14 +218,21 @@ def param_specs(cfg: LMConfig) -> Dict[str, Any]:
         "unembed": P(None, "tp"),
     }
     for i in range(cfg.depth):
-        specs[f"blk{i}"] = {
+        blk = {
             "wqkv": P(None, "tp"),
             "wo": P("tp", None),
-            "w1": P(None, "tp"),
-            "w2": P("tp", None),
             "ln1": P(None),
             "ln2": P(None),
         }
+        if cfg.moe_experts > 0:
+            # expert parallelism over the tp axis: each device owns
+            # num_experts/tp whole experts (moe.param_specs)
+            from .moe import param_specs as moe_specs
+            blk["moe"] = moe_specs(cfg.moe_cfg(), ep_axis="tp")
+        else:
+            blk["w1"] = P(None, "tp")
+            blk["w2"] = P("tp", None)
+        specs[f"blk{i}"] = blk
     return specs
 
 
