@@ -1,0 +1,10 @@
+"""Cross-cutting utilities with no reference counterpart.
+
+The reference is a stateless RPC framework (SURVEY §5.4: "checkpoint /
+resume: none"); a TPU training framework is not — model/optimizer
+state must survive preemption.  These modules are fresh designs.
+"""
+
+from .checkpoint import TrainCheckpointer, abstract_like
+
+__all__ = ["TrainCheckpointer", "abstract_like"]
