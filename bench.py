@@ -289,21 +289,24 @@ def bench_device_echo(extra: dict) -> None:
         ch.init(str(srv.listen_endpoint))
         x = jnp.arange((1 << 20) // 4, dtype=jnp.float32)   # 1MB in HBM
         x.block_until_ready()
-        for _ in range(3):
+        def one():
             cntl = Controller()
-            cntl.timeout_ms = 60_000
+            cntl.timeout_ms = 120_000
             cntl.request_device_attachment = x
             c = ch.call_method("PS.EchoTensor", b"", cntl=cntl)
             assert not c.failed, c.error_text
-            c.response_device_attachment.tensor()
-        N = 300
+            return c.response_device_attachment.tensor()
+
+        # warm + gauge the chip's current speed (the tunneled chip has
+        # throttled phases 100x apart); size N to a ~4s window
+        t0 = time.perf_counter()
+        for _ in range(5):
+            one()
+        per_call = (time.perf_counter() - t0) / 5
+        N = max(10, min(300, int(4.0 / max(per_call, 1e-6))))
         t0 = time.perf_counter()
         for _ in range(N):
-            cntl = Controller()
-            cntl.timeout_ms = 60_000
-            cntl.request_device_attachment = x
-            c = ch.call_method("PS.EchoTensor", b"", cntl=cntl)
-            out = c.response_device_attachment.tensor()
+            out = one()
         dt = time.perf_counter() - t0
         assert out is x          # zero-copy end to end
         extra["ici_1mb_tensor_gbps"] = round(N * x.nbytes * 2 / dt / 1e9, 3)
@@ -313,8 +316,78 @@ def bench_device_echo(extra: dict) -> None:
         srv.stop()
 
 
+def bench_device_compute(extra: dict) -> None:
+    """Model-side hot ops on the real chip: the Pallas flash-attention
+    kernel vs XLA dense attention, and a TransformerLM train step."""
+    import time as _t
+
+    import jax
+    import jax.numpy as jnp
+
+    from brpc_tpu.ops.flash_attention import flash_attention
+    from brpc_tpu.parallel.ring_attention import reference_attention
+
+    b, s, h, d = 2, 2048, 8, 128
+    ks = jax.random.split(jax.random.PRNGKey(0), 3)
+    q, k, v = (jax.random.normal(kk, (b, s, h, d), jnp.bfloat16) * 0.5
+               for kk in ks)
+
+    # n calls queued back-to-back on the device stream, ONE scalar D2H
+    # sync on the last (float() — the reliable completion barrier on
+    # this tunneled backend; TPU executes queued programs in order, so
+    # the last scalar transfers only after all n finish).  Best of two
+    # windows: the tunnel has throttled phases.
+    def amortized_us(f, n=16):
+        float(f(q, k, v))                       # compile + warm
+        best = float("inf")
+        for _ in range(2):
+            t0 = _t.perf_counter()
+            for _ in range(n - 1):
+                f(q, k, v)
+            float(f(q, k, v))
+            best = min(best, (_t.perf_counter() - t0) / n * 1e6)
+        return best
+
+    flash = jax.jit(
+        lambda q, k, v: jnp.sum(flash_attention(q, k, v, True)))
+    dense = jax.jit(
+        lambda q, k, v: jnp.sum(reference_attention(q, k, v, causal=True)))
+    tf = amortized_us(flash)
+    td = amortized_us(dense)
+    extra["flash_attn_2k_us"] = round(tf, 1)
+    extra["flash_vs_xla_dense"] = round(td / tf, 2)
+
+    from brpc_tpu.models.transformer_lm import (LMConfig, init_params,
+                                                make_train_step)
+    cfg = LMConfig(vocab=4096, dim=512, heads=8, depth=4, max_seq=1024,
+                   mlp_mult=4)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    ids = jax.random.randint(jax.random.PRNGKey(1), (8, 1024), 0,
+                             cfg.vocab, jnp.int32)
+    labels = jnp.roll(ids, -1, axis=-1)
+    step = jax.jit(make_train_step(cfg))
+    params, loss = step(params, ids, labels)       # compile + warm
+    float(loss)
+    N = 6
+    best = float("inf")
+    for _ in range(2):
+        t0 = _t.perf_counter()
+        for _ in range(N):
+            params, loss = step(params, ids, labels)
+        float(loss)                 # one scalar sync barriers the chain
+        best = min(best, _t.perf_counter() - t0)
+    extra["lm_train_tokens_per_s"] = round(ids.size * N / best, 0)
+
+
 def main() -> None:
     extra: dict = {}
+    try:
+        # first: device compute wants the host un-throttled (dispatch
+        # happens on the single host core; the RPC sections burn its
+        # cgroup quota)
+        bench_device_compute(extra)
+    except Exception as e:
+        extra["compute_error"] = f"{type(e).__name__}: {e}"
     headline = bench_headline_and_sweep(extra)
     bench_streaming(extra)
     bench_fanout(extra)

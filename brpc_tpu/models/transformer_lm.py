@@ -32,7 +32,8 @@ class LMConfig:
                  depth: int = 2, mlp_mult: int = 4, max_seq: int = 256,
                  causal: bool = True, remat: bool = True,
                  lr: float = 0.05, moe_experts: int = 0,
-                 moe_capacity: float = 2.0, moe_aux_weight: float = 0.01):
+                 moe_capacity: float = 2.0, moe_aux_weight: float = 0.01,
+                 use_flash: bool = False):
         assert dim % heads == 0
         assert (dim // heads) % 2 == 0, "head dim must be even for RoPE"
         self.vocab = vocab
@@ -50,6 +51,9 @@ class LMConfig:
         self.moe_experts = moe_experts
         self.moe_capacity = moe_capacity
         self.moe_aux_weight = moe_aux_weight
+        # single-device attention via the Pallas flash kernel
+        # (ops/flash_attention.py); the sp path keeps ring attention
+        self.use_flash = use_flash
 
     def moe_cfg(self):
         from .moe import MoEConfig
@@ -131,6 +135,11 @@ def make_forward(cfg: LMConfig, mesh=None, sp_axis: Optional[str] = None):
     if mesh is not None and sp_axis is not None:
         from ..parallel.ring_attention import make_ring_attention
         attend = make_ring_attention(mesh, sp_axis, causal=cfg.causal)
+    elif cfg.use_flash:
+        from ..ops.flash_attention import flash_attention
+
+        def attend(q, k, v):
+            return flash_attention(q, k, v, cfg.causal)
     else:
         from ..parallel.ring_attention import reference_attention
 

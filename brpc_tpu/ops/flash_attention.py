@@ -1,0 +1,179 @@
+"""Pallas flash attention — the hot-op kernel for the dense models.
+
+No reference counterpart (the reference is an RPC framework; its hot
+path is framing/IO).  This is the TPU-first answer to SURVEY §5.7's
+"blockwise attention" prescription, written against the Pallas TPU
+playbook (/opt/skills/guides/pallas_guide.md):
+
+- grid (b, h, q_blocks, k_blocks), innermost dimension "arbitrary":
+  VMEM scratch (running max / denominator / accumulator) persists
+  across the k-block sweep — the classic online-softmax flash schedule,
+  O(seq) memory per q block instead of O(seq²);
+- q·kᵀ and p·v on the MXU via dot_general with
+  ``preferred_element_type=float32``; masking built from
+  ``broadcasted_iota`` (TPU-safe, pitfall #4);
+- causal blocks entirely above the diagonal are skipped with
+  ``pl.when`` (predication, no dynamic shapes);
+- head dim and sequence are padded to lane/block multiples in the
+  wrapper; pad keys are masked out in-kernel, pad rows sliced off;
+- **custom VJP**: the backward pass recomputes attention with the
+  dense XLA formulation — gradients are exact, forward is flash.
+  (A fused backward kernel is a further optimization, not a semantic
+  change.)
+- ``interpret=True`` automatically off-TPU, so the same code path is
+  unit-testable on the CPU mesh.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+
+
+def _ceil_to(x: int, m: int) -> int:
+    return (x + m - 1) // m * m
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
+                scale: float, causal: bool, bq: int, bk: int,
+                seq_len: int):
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    ik = pl.program_id(3)
+    nk = pl.num_programs(3)
+
+    @pl.when(ik == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, -1e30)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    iq = pl.program_id(2)
+    q0 = iq * bq
+    k0 = ik * bk
+    # causal: skip k blocks strictly above the diagonal; always skip
+    # blocks entirely in the padded tail
+    live = k0 < seq_len
+    if causal:
+        live = jnp.logical_and(live, k0 <= q0 + bq - 1)
+
+    @pl.when(live)
+    def _step():
+        q = q_ref[0, 0].astype(jnp.float32)
+        k = k_ref[0, 0].astype(jnp.float32)
+        v = v_ref[0, 0].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale        # (bq, bk)
+        kpos = k0 + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+        mask = kpos < seq_len
+        if causal:
+            qpos = q0 + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+            mask = jnp.logical_and(mask, qpos >= kpos)
+        s = jnp.where(mask, s, -1e30)
+        m_prev = m_scr[:]                                      # (bq, 1)
+        m_new = jnp.maximum(m_prev, s.max(axis=-1, keepdims=True))
+        corr = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new)
+        l_scr[:] = l_scr[:] * corr + p.sum(axis=-1, keepdims=True)
+        m_scr[:] = m_new
+        acc_scr[:] = acc_scr[:] * corr + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)                # (bq, d)
+
+    @pl.when(ik == nk - 1)
+    def _finalize():
+        o_ref[0, 0] = (acc_scr[:]
+                       / jnp.maximum(l_scr[:], 1e-30)).astype(o_ref.dtype)
+
+
+def _pallas_forward(q, k, v, causal: bool, block_q: int, block_k: int,
+                    interpret: Optional[bool]):
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, s, h, d = q.shape
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    d_pad = _ceil_to(max(d, 1), 128)
+    bq = min(block_q, _ceil_to(s, 8))
+    bk = min(block_k, _ceil_to(s, 8))
+    # pad to a common multiple: padding only to max(bq, bk) would
+    # floor-truncate the other grid dimension and silently drop keys
+    s_pad = _ceil_to(s, math.lcm(bq, bk))
+    nq, nk = s_pad // bq, s_pad // bk
+
+    def prep(x):
+        # (b, s, h, d) -> (b, h, s_pad, d_pad)
+        x = jnp.moveaxis(x, 2, 1)
+        return jnp.pad(x, ((0, 0), (0, 0), (0, s_pad - s),
+                           (0, d_pad - d)))
+
+    qp, kp, vp = prep(q), prep(k), prep(v)
+    kernel = functools.partial(
+        _fwd_kernel, scale=1.0 / (d ** 0.5), causal=causal,
+        bq=bq, bk=bk, seq_len=s)
+    blk = lambda ib, ih, iq, ik: (ib, ih, iq, 0)        # noqa: E731
+    kblk = lambda ib, ih, iq, ik: (ib, ih, ik, 0)       # noqa: E731
+    out = pl.pallas_call(
+        kernel,
+        grid=(b, h, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, 1, bq, d_pad), blk,
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, bk, d_pad), kblk,
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, bk, d_pad), kblk,
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((1, 1, bq, d_pad), blk,
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((b, h, s_pad, d_pad), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((bq, 1), jnp.float32),       # running max
+            pltpu.VMEM((bq, 1), jnp.float32),       # running denom
+            pltpu.VMEM((bq, d_pad), jnp.float32),   # accumulator
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel",
+                                 "arbitrary")),
+        interpret=interpret,
+    )(qp, kp, vp)
+    return jnp.moveaxis(out[:, :, :s, :d], 1, 2)       # (b, s, h, d)
+
+
+def _dense(q, k, v, causal: bool):
+    from ..parallel.ring_attention import reference_attention
+    return reference_attention(q, k, v, causal=causal)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def flash_attention(q, k, v, causal: bool = False, block_q: int = 256,
+                    block_k: int = 256, interpret: Optional[bool] = None):
+    """Flash attention: (b, s, h, d) q/k/v -> (b, s, h, d).
+
+    Forward runs the Pallas kernel (interpret mode off-TPU); backward
+    recomputes with the dense XLA formulation, so it is differentiable
+    everywhere the dense oracle is."""
+    return _pallas_forward(q, k, v, causal, block_q, block_k, interpret)
+
+
+def _fa_fwd(q, k, v, causal, block_q, block_k, interpret):
+    return (_pallas_forward(q, k, v, causal, block_q, block_k, interpret),
+            (q, k, v))
+
+
+def _fa_bwd(causal, block_q, block_k, interpret, res, g):
+    q, k, v = res
+    _, vjp = jax.vjp(lambda q, k, v: _dense(q, k, v, causal), q, k, v)
+    return vjp(g)
+
+
+flash_attention.defvjp(_fa_fwd, _fa_bwd)
