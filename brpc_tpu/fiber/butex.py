@@ -10,6 +10,7 @@ from __future__ import annotations
 import threading
 from typing import Optional
 
+from ..butil import sanitizers as _san
 from .runtime import blocking
 
 
@@ -51,6 +52,9 @@ class Butex:
                     timeout)
                 if profiling.contention_active():
                     return profiling.timed_wait("butex", waitfn)
+                if _san.watchdog_enabled():
+                    with _san.watched_wait("butex"):
+                        return waitfn()
                 return waitfn()
 
     def wake(self, n: int = 1) -> None:
@@ -96,6 +100,9 @@ class CountdownEvent:
                     lambda: self._butex._value <= 0, timeout)
                 if profiling.contention_active():
                     return profiling.timed_wait("countdown", waitfn)
+                if _san.watchdog_enabled():
+                    with _san.watched_wait("countdown"):
+                        return waitfn()
                 return waitfn()
 
     @property

@@ -180,8 +180,14 @@ class IdPool:
         if slot is None:
             return True
         with slot.cond:
-            return slot.cond.wait_for(
+            waitfn = lambda: slot.cond.wait_for(       # noqa: E731
                 lambda: not self._valid_locked(slot, version), timeout)
+            from ..butil import sanitizers as _san
+            if _san.watchdog_enabled():
+                # the RPC-join wait: the one users see when a call hangs
+                with _san.watched_wait("rpc_join"):
+                    return waitfn()
+            return waitfn()
 
 
 def _default_on_error(pool: "IdPool") -> ErrorHandler:

@@ -1,0 +1,89 @@
+"""Runtime sanitizers: stall watchdog catches a stuck butex wait; the
+lock-order detector flags an ABBA inversion without needing the actual
+deadlock timing."""
+
+import threading
+import time
+
+import pytest
+
+from brpc_tpu.butil.flags import set_flag
+from brpc_tpu.butil.sanitizers import (DebugLock, check_stalls,
+                                       lock_order_warnings,
+                                       reset_for_tests)
+from brpc_tpu.fiber.butex import Butex
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    reset_for_tests()
+    yield
+    set_flag("stall_watchdog_s", 0.0)
+    set_flag("debug_lock_order", False)
+    reset_for_tests()
+
+
+def test_stall_watchdog_reports_stuck_wait_once():
+    set_flag("stall_watchdog_s", 0.05)
+    bx = Butex(0)
+    t = threading.Thread(target=lambda: bx.wait(0, timeout=5.0),
+                         daemon=True)
+    t.start()
+    time.sleep(0.15)                      # wait is now past the limit
+    assert check_stalls() == 1            # reported
+    assert check_stalls() == 0            # only once per wait
+    bx.wake_all()
+    t.join(2)
+    assert not t.is_alive()
+
+
+def test_no_report_under_threshold():
+    set_flag("stall_watchdog_s", 5.0)
+    bx = Butex(0)
+    t = threading.Thread(target=lambda: bx.wait(0, timeout=2.0),
+                         daemon=True)
+    t.start()
+    time.sleep(0.05)
+    assert check_stalls() == 0
+    bx.wake_all()
+    t.join(2)
+
+
+def test_lock_order_cycle_detected():
+    set_flag("debug_lock_order", True)
+    a, b = DebugLock("A"), DebugLock("B")
+
+    with a:
+        with b:                           # records A -> B
+            pass
+    assert lock_order_warnings() == 0
+
+    def inverted():
+        with b:
+            with a:                       # B -> A closes the cycle
+                pass
+
+    t = threading.Thread(target=inverted)
+    t.start()
+    t.join(2)
+    assert lock_order_warnings() == 1
+
+    # the same cycle does not re-warn — in either direction
+    t = threading.Thread(target=inverted)
+    t.start()
+    t.join(2)
+    assert lock_order_warnings() == 1
+    with a:
+        with b:                       # original order re-trips the path
+            pass
+    assert lock_order_warnings() == 1
+
+
+def test_consistent_order_never_warns():
+    set_flag("debug_lock_order", True)
+    a, b = DebugLock("A2"), DebugLock("B2")
+    for _ in range(5):
+        with a:
+            with b:
+                pass
+    assert lock_order_warnings() == 0
