@@ -12,9 +12,10 @@ A decoder-only transformer built TPU-first:
   axis via ``NamedSharding`` specs (XLA inserts the collectives);
 - **rematerialisation**: blocks are wrapped in ``jax.checkpoint`` to
   trade FLOPs for HBM on long sequences;
-- static shapes, ``lax.scan``-free simple layer stack (layers unrolled
-  — tiny configs are jit-compiled per depth; scanned-weights variants
-  drop in when depth grows).
+- static shapes; layers unrolled by default (tiny configs compile per
+  depth), or ``scan_layers=True`` stacks the per-layer weights and runs
+  one ``lax.scan`` over depth — compile time O(1) in depth for deep
+  models.
 
 The capability analogue in the reference is its flagship *service*
 workloads (echo/PS); a TPU framework's flagship is a model — this plus
@@ -33,7 +34,7 @@ class LMConfig:
                  causal: bool = True, remat: bool = True,
                  lr: float = 0.05, moe_experts: int = 0,
                  moe_capacity: float = 2.0, moe_aux_weight: float = 0.01,
-                 use_flash: bool = False):
+                 use_flash: bool = False, scan_layers: bool = False):
         assert dim % heads == 0
         assert (dim // heads) % 2 == 0, "head dim must be even for RoPE"
         self.vocab = vocab
@@ -54,6 +55,10 @@ class LMConfig:
         # single-device attention via the Pallas flash kernel
         # (ops/flash_attention.py); the sp path keeps ring attention
         self.use_flash = use_flash
+        # scan_layers stacks per-layer weights and runs one lax.scan
+        # over the depth axis: trace/compile time is O(1) in depth
+        # instead of O(depth) — the XLA-idiomatic deep-model form
+        self.scan_layers = scan_layers
 
     def moe_cfg(self):
         from .moe import MoEConfig
@@ -95,6 +100,11 @@ def init_params(rng, cfg: LMConfig) -> Dict[str, Any]:
             blk["w2"] = jax.random.normal(
                 bk[3], (h, cfg.dim), jnp.float32) * (scale / cfg.mlp_mult)
         params[f"blk{i}"] = blk
+    if cfg.scan_layers:
+        # stack per-layer trees along a leading depth axis for lax.scan
+        blks = [params.pop(f"blk{i}") for i in range(cfg.depth)]
+        params["blocks"] = jax.tree_util.tree_map(
+            lambda *xs: jnp.stack(xs), *blks)
     return params
 
 
@@ -181,10 +191,18 @@ def make_forward(cfg: LMConfig, mesh=None, sp_axis: Optional[str] = None):
             f"seq {ids.shape[-1]} exceeds max_seq {cfg.max_seq}")
         x = params["embed"][ids]
         sin, cos = _rope_tables(ids.shape[-1], cfg.dim // cfg.heads)
-        aux_total = jnp.float32(0.0)
-        for i in range(cfg.depth):
-            x, aux = block(params[f"blk{i}"], x, sin, cos)
-            aux_total = aux_total + aux
+        if cfg.scan_layers:
+            def body(x, bp):
+                x, aux = block(bp, x, sin, cos)
+                return x, aux
+
+            x, auxs = jax.lax.scan(body, x, params["blocks"])
+            aux_total = auxs.sum()
+        else:
+            aux_total = jnp.float32(0.0)
+            for i in range(cfg.depth):
+                x, aux = block(params[f"blk{i}"], x, sin, cos)
+                aux_total = aux_total + aux
         logits = (x.astype(jnp.bfloat16)
                   @ params["unembed"].astype(jnp.bfloat16)).astype(
                       jnp.float32)
@@ -226,22 +244,30 @@ def param_specs(cfg: LMConfig) -> Dict[str, Any]:
         "embed": P("tp", None),
         "unembed": P(None, "tp"),
     }
-    for i in range(cfg.depth):
-        blk = {
-            "wqkv": P(None, "tp"),
-            "wo": P("tp", None),
-            "ln1": P(None),
-            "ln2": P(None),
-        }
-        if cfg.moe_experts > 0:
-            # expert parallelism over the tp axis: each device owns
-            # num_experts/tp whole experts (moe.param_specs)
-            from .moe import param_specs as moe_specs
-            blk["moe"] = moe_specs(cfg.moe_cfg(), ep_axis="tp")
-        else:
-            blk["w1"] = P(None, "tp")
-            blk["w2"] = P("tp", None)
-        specs[f"blk{i}"] = blk
+    blk = {
+        "wqkv": P(None, "tp"),
+        "wo": P("tp", None),
+        "ln1": P(None),
+        "ln2": P(None),
+    }
+    if cfg.moe_experts > 0:
+        # expert parallelism over the tp axis: each device owns
+        # num_experts/tp whole experts (moe.param_specs)
+        from .moe import param_specs as moe_specs
+        blk["moe"] = moe_specs(cfg.moe_cfg(), ep_axis="tp")
+    else:
+        blk["w1"] = P(None, "tp")
+        blk["w2"] = P("tp", None)
+    if cfg.scan_layers:
+        import jax
+
+        # stacked weights: replicated leading depth axis + per-layer spec
+        specs["blocks"] = jax.tree_util.tree_map(
+            lambda s: P(None, *s), blk,
+            is_leaf=lambda x: isinstance(x, P))
+    else:
+        for i in range(cfg.depth):
+            specs[f"blk{i}"] = blk
     return specs
 
 
