@@ -2,9 +2,11 @@
 
 The client half of the h2/gRPC interop story (≈ the client paths of
 /root/reference/src/brpc/policy/http2_rpc_protocol.cpp): one TCP
-connection per peer, streams multiplexed, a dedicated reader thread
-distributing frames to waiting callers (h2 responses are unordered
-across streams, so the tpu_std direct-read trick does not apply).
+connection per peer, streams multiplexed, and ONE process-wide
+selector-driven reader thread distributing frames to waiting callers
+across ALL connections (h2 responses are unordered across streams, so
+the tpu_std direct-read trick does not apply; a thread per connection
+would not scale to pod-sized peer sets).
 
 Used by Channel when ``options.protocol == "grpc"``; also usable
 standalone against any gRPC server (oracle: grpcio in the tests).
@@ -12,15 +14,131 @@ standalone against any gRPC server (oracle: grpcio in the tests).
 
 from __future__ import annotations
 
+import selectors
 import socket as _socket
 import struct
 import threading
+from collections import deque
 from typing import Dict, List, Optional, Tuple
 
 from ..butil.endpoint import EndPoint
 from ..butil.logging_util import LOG
 from ..protocol.h2_rpc import GRPC_CT, pack_grpc_message, unpack_grpc_messages
 from ..protocol.h2_session import H2Error, H2Session
+
+
+class _SharedReader:
+    """One selector loop reading for every GrpcConnection.
+
+    Sockets stay BLOCKING: the loop issues exactly one recv per
+    readiness event (select guarantees it cannot block), so writer
+    threads keep their simple sendall path.  Register/unregister
+    requests are queued and applied on the loop thread (selectors are
+    not thread-safe), with a socketpair as the wakeup."""
+
+    def __init__(self):
+        self._sel = selectors.DefaultSelector()
+        self._rd, self._wr = _socket.socketpair()
+        self._rd.setblocking(False)
+        self._wr.setblocking(False)    # _wake must never block a caller
+                                       # holding a connection lock
+        self._sel.register(self._rd, selectors.EVENT_READ, None)
+        self._ops: deque = deque()     # ("add", sock, conn) | ("del", sock)
+        self._lock = threading.Lock()
+        self._thread: Optional[threading.Thread] = None
+
+    def _ensure_thread(self) -> None:
+        if self._thread is None or not self._thread.is_alive():
+            self._thread = threading.Thread(target=self._loop,
+                                            name="grpc_shared_reader",
+                                            daemon=True)
+            self._thread.start()
+
+    def _wake(self) -> None:
+        try:
+            self._wr.send(b"x")
+        except OSError:
+            pass
+
+    def register(self, sock: _socket.socket, conn: "GrpcConnection") -> None:
+        with self._lock:
+            self._ops.append(("add", sock, conn))
+            self._ensure_thread()
+        self._wake()
+
+    def unregister(self, sock: _socket.socket) -> None:
+        """Queue removal; the loop thread closes the socket after
+        deregistering (closing first would poison the selector)."""
+        with self._lock:
+            self._ops.append(("del", sock, None))
+            self._ensure_thread()      # a dead loop must still close fds
+        self._wake()
+
+    def _apply_ops(self) -> None:
+        while True:
+            with self._lock:
+                if not self._ops:
+                    return
+                op, sock, conn = self._ops.popleft()
+            try:
+                if op == "add":
+                    self._sel.register(sock, selectors.EVENT_READ, conn)
+                else:
+                    try:
+                        self._sel.unregister(sock)
+                    except (KeyError, ValueError):
+                        pass
+                    try:
+                        sock.close()
+                    except OSError:
+                        pass
+            except (OSError, ValueError) as e:
+                LOG.warning("grpc shared reader op %s failed: %s", op, e)
+
+    def _loop(self) -> None:
+        while True:
+            self._apply_ops()
+            try:
+                events = self._sel.select(1.0)
+            except OSError:
+                # a registered fd died outside the queue (should not
+                # happen; defensive): rebuild by dropping dead entries
+                for key in list(self._sel.get_map().values()):
+                    if key.data is not None and key.fileobj.fileno() < 0:
+                        try:
+                            self._sel.unregister(key.fileobj)
+                        except (KeyError, ValueError):
+                            pass
+                continue
+            for key, _mask in events:
+                if key.data is None:
+                    try:
+                        self._rd.recv(4096)
+                    except OSError:
+                        pass
+                    continue
+                try:
+                    key.data._on_readable(key.fileobj)
+                except Exception as e:   # noqa: BLE001 - blast radius:
+                    # ONE connection, never the process-wide loop
+                    LOG.exception("grpc reader: connection dispatch "
+                                  "raised")
+                    try:
+                        key.data._fail_all(f"reader: {e}")
+                    except Exception:
+                        pass
+
+
+_shared_reader: Optional[_SharedReader] = None
+_shared_reader_lock = threading.Lock()
+
+
+def shared_reader() -> _SharedReader:
+    global _shared_reader
+    with _shared_reader_lock:
+        if _shared_reader is None:
+            _shared_reader = _SharedReader()
+        return _shared_reader
 
 
 class _Call:
@@ -58,7 +176,6 @@ class GrpcConnection:
         self._sock: Optional[_socket.socket] = None
         self._session: Optional[H2Session] = None
         self._calls: Dict[int, _Call] = {}
-        self._reader: Optional[threading.Thread] = None
         self._dead = True
 
     # -- connection management --------------------------------------------
@@ -77,9 +194,7 @@ class GrpcConnection:
             self._session.start()
             self._flush_locked()
             self._dead = False
-            self._reader = threading.Thread(target=self._read_loop,
-                                            name="grpc_reader", daemon=True)
-            self._reader.start()
+            shared_reader().register(sock, self)
 
     def _flush_locked(self) -> None:
         out = self._session.take_output()
@@ -91,11 +206,9 @@ class GrpcConnection:
             self._dead = True
             calls = list(self._calls.values())
             self._calls.clear()
-            try:
-                if self._sock is not None:
-                    self._sock.close()
-            except OSError:
-                pass
+            if self._sock is not None:
+                # the reader loop deregisters, then closes
+                shared_reader().unregister(self._sock)
             self._sock = None
         for call in calls:
             call.rst_code = -1
@@ -106,29 +219,39 @@ class GrpcConnection:
                 call.cond.notify_all()
             call.event.set()
 
-    def _read_loop(self) -> None:
-        sock = self._sock
-        session = self._session
-        while True:
-            try:
-                data = sock.recv(256 * 1024)
-            except OSError as e:
-                self._fail_all(f"recv: {e}")
+    def _on_readable(self, sock: _socket.socket) -> None:
+        """Runs on the shared reader loop: one recv (select said it
+        cannot block), feed the session, dispatch events."""
+        with self._lock:
+            if sock is not self._sock:
+                # superseded by a reconnect: drop the orphan
+                shared_reader().unregister(sock)
                 return
-            if not data:
-                self._fail_all("connection closed by server")
-                return
-            try:
-                with self._lock:
-                    if self._session is not session:
-                        return                   # superseded
-                    events = session.feed(data)
-                    self._flush_locked()
-            except H2Error as e:
-                self._fail_all(f"h2: {e}")
-                return
-            for ev in events:
-                self._on_event(ev)
+            session = self._session
+        try:
+            # MSG_DONTWAIT: the socket itself stays blocking for the
+            # writers' sendall, but a spurious readiness event (select
+            # raced a discarded packet) must not hang the shared loop
+            data = sock.recv(256 * 1024, _socket.MSG_DONTWAIT)
+        except BlockingIOError:
+            return                     # spurious readiness
+        except OSError as e:
+            self._fail_all(f"recv: {e}")
+            return
+        if not data:
+            self._fail_all("connection closed by server")
+            return
+        try:
+            with self._lock:
+                if self._session is not session:
+                    return                   # superseded mid-recv
+                events = session.feed(data)
+                self._flush_locked()
+        except (H2Error, OSError) as e:
+            self._fail_all(f"h2: {e}")
+            return
+        for ev in events:
+            self._on_event(ev)
 
     def _on_event(self, ev: tuple) -> None:
         kind = ev[0]

@@ -166,6 +166,30 @@ def test_our_client_against_grpcio_server(grpcio_server):
         conn.close()
 
 
+def test_many_connections_share_one_reader_thread(grpcio_server):
+    """N concurrent GrpcConnections must not spawn N reader threads:
+    the shared selector loop serves them all (pod-scale peer sets)."""
+    from brpc_tpu.butil.endpoint import parse_endpoint
+    from brpc_tpu.client.grpc_client import GrpcConnection
+
+    before = {t.name for t in threading.enumerate()}
+    conns = [GrpcConnection(parse_endpoint(f"127.0.0.1:{grpcio_server}"))
+             for _ in range(8)]
+    try:
+        for i, conn in enumerate(conns):
+            status, msg, body = conn.unary_call(
+                "/oracle.Echo/Echo", f"c{i}".encode(), 10.0)
+            assert status == 0, (status, msg)
+            assert body == f"c{i}".encode()
+        after = [t.name for t in threading.enumerate()
+                 if t.name not in before]
+        readers = [n for n in after if "reader" in n]
+        assert readers in ([], ["grpc_shared_reader"]), readers
+    finally:
+        for conn in conns:
+            conn.close()
+
+
 def test_channel_protocol_grpc_end_to_end(grpcio_server):
     opts = ChannelOptions()
     opts.protocol = "grpc"
