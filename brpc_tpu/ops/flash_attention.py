@@ -5,30 +5,34 @@ path is framing/IO).  This is the TPU-first answer to SURVEY §5.7's
 "blockwise attention" prescription, written against the Pallas TPU
 playbook (/opt/skills/guides/pallas_guide.md):
 
-- grid (b, h, q_blocks, k_blocks), innermost dimension "arbitrary":
-  VMEM scratch (running max / denominator / accumulator) persists
-  across the k-block sweep — the classic online-softmax flash schedule,
-  O(seq) memory per q block instead of O(seq²);
-- q·kᵀ and p·v on the MXU via dot_general with
-  ``preferred_element_type=float32``; masking built from
-  ``broadcasted_iota`` (TPU-safe, pitfall #4);
-- causal blocks entirely above the diagonal are skipped with
-  ``pl.when`` (predication, no dynamic shapes);
-- head dim and sequence are padded to lane/block multiples in the
-  wrapper; pad keys are masked out in-kernel, pad rows sliced off;
-- **custom VJP**: the backward pass recomputes attention with the
-  dense XLA formulation — gradients are exact, forward is flash.
-  (A fused backward kernel is a further optimization, not a semantic
-  change.)
-- ``interpret=True`` automatically off-TPU, so the same code path is
-  unit-testable on the CPU mesh.
+- forward: grid (b, h, q_blocks, k_blocks), innermost dimension
+  "arbitrary" — VMEM scratch (running max / denominator / accumulator)
+  persists across the k-block sweep, the classic online-softmax flash
+  schedule with O(seq) memory per q block; also emits the per-row
+  logsumexp for the backward pass;
+- backward: FUSED flash kernels too — a dq kernel sweeping k blocks and
+  a dk/dv kernel sweeping q blocks, both recomputing p = exp(s - lse)
+  blockwise from the saved logsumexp (the standard flash backward), so
+  training memory is O(seq) as well, never O(seq²);
+- q·kᵀ / p·v / ds·k / dsᵀ·q on the MXU via dot_general with
+  ``preferred_element_type=float32``; masking from ``broadcasted_iota``
+  (TPU-safe, pitfall #4); causal blocks above the diagonal predicated
+  off with ``pl.when``;
+- head dim padded to the 128 lane, sequence padded to lcm(bq, bk); pad
+  keys are masked in-kernel; pad q rows are gradient-safe because their
+  cotangents and dd are zero (they do attend real keys forward, but the
+  rows are sliced off and contribute nothing backward).  The lse/dd
+  blocks use a 1-wide lane (legal: equal to the array's last dim —
+  verified compiling and running on real TPU hardware);
+- ``interpret=True`` automatically off-TPU, so the same code paths are
+  unit-tested on the CPU mesh.
 """
 
 from __future__ import annotations
 
 import functools
 import math
-from typing import Optional
+from typing import Optional, Tuple
 
 import jax
 
@@ -37,8 +41,10 @@ def _ceil_to(x: int, m: int) -> int:
     return (x + m - 1) // m * m
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
-                scale: float, causal: bool, bq: int, bk: int,
+# -- forward ----------------------------------------------------------------
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr,
+                acc_scr, *, scale: float, causal: bool, bq: int, bk: int,
                 seq_len: int):
     import jax
     import jax.numpy as jnp
@@ -88,54 +94,93 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
 
     @pl.when(ik == nk - 1)
     def _finalize():
-        o_ref[0, 0] = (acc_scr[:]
-                       / jnp.maximum(l_scr[:], 1e-30)).astype(o_ref.dtype)
+        l = jnp.maximum(l_scr[:], 1e-30)
+        o_ref[0, 0] = (acc_scr[:] / l).astype(o_ref.dtype)
+        # logsumexp per row.  The dead-row guard only matters if a
+        # future mask can fully mask a LIVE row (today even pad q rows
+        # attend k block 0): exp(s - 1e30) underflows to zero then.
+        lse = m_scr[:] + jnp.log(l)                            # (bq, 1)
+        dead = l_scr[:] <= 0.0
+        lse_ref[0, 0] = jnp.where(dead, 1e30, lse)
 
 
-def _pallas_forward(q, k, v, causal: bool, block_q: int, block_k: int,
-                    interpret: Optional[bool]):
+def _resolve_interpret(interpret: Optional[bool]) -> bool:
     import jax
-    import jax.numpy as jnp
-    from jax.experimental import pallas as pl
-    from jax.experimental.pallas import tpu as pltpu
+    return jax.default_backend() != "tpu" if interpret is None \
+        else interpret
 
-    b, s, h, d = q.shape
-    if interpret is None:
-        interpret = jax.default_backend() != "tpu"
+
+def _make_prep(s_pad: int, d_pad: int, s: int, d: int):
+    """(b, s, h, d) -> (b, h, s_pad, d_pad), zero-padded."""
+    import jax.numpy as jnp
+
+    def prep(x):
+        x = jnp.moveaxis(x, 2, 1)
+        return jnp.pad(x, ((0, 0), (0, 0), (0, s_pad - s),
+                           (0, d_pad - d)))
+
+    return prep
+
+
+# index maps shared by every kernel: block row iq / ik is the third
+# grid axis for forward+dq, swapped for dkdv
+_IXQ = lambda ib, ih, iq, ik: (ib, ih, iq, 0)       # noqa: E731
+_IXK = lambda ib, ih, iq, ik: (ib, ih, ik, 0)       # noqa: E731
+_IXQ2 = lambda ib, ih, ik, iq: (ib, ih, iq, 0)      # noqa: E731
+_IXK2 = lambda ib, ih, ik, iq: (ib, ih, ik, 0)      # noqa: E731
+
+
+def _block_geometry(s: int, d: int, block_q: int, block_k: int):
     d_pad = _ceil_to(max(d, 1), 128)
     bq = min(block_q, _ceil_to(s, 8))
     bk = min(block_k, _ceil_to(s, 8))
     # pad to a common multiple: padding only to max(bq, bk) would
     # floor-truncate the other grid dimension and silently drop keys
     s_pad = _ceil_to(s, math.lcm(bq, bk))
+    return d_pad, bq, bk, s_pad
+
+
+def _pallas_forward(q, k, v, causal: bool, block_q: int, block_k: int,
+                    interpret: Optional[bool]) -> Tuple:
+    """Returns (out (b,s,h,d), lse (b,h,s_pad,1) fp32 — padded layout,
+    consumed only by _pallas_backward which recomputes the identical
+    block geometry)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, s, h, d = q.shape
+    interpret = _resolve_interpret(interpret)
+    d_pad, bq, bk, s_pad = _block_geometry(s, d, block_q, block_k)
     nq, nk = s_pad // bq, s_pad // bk
-
-    def prep(x):
-        # (b, s, h, d) -> (b, h, s_pad, d_pad)
-        x = jnp.moveaxis(x, 2, 1)
-        return jnp.pad(x, ((0, 0), (0, 0), (0, s_pad - s),
-                           (0, d_pad - d)))
-
+    prep = _make_prep(s_pad, d_pad, s, d)
     qp, kp, vp = prep(q), prep(k), prep(v)
     kernel = functools.partial(
         _fwd_kernel, scale=1.0 / (d ** 0.5), causal=causal,
         bq=bq, bk=bk, seq_len=s)
-    blk = lambda ib, ih, iq, ik: (ib, ih, iq, 0)        # noqa: E731
-    kblk = lambda ib, ih, iq, ik: (ib, ih, ik, 0)       # noqa: E731
-    out = pl.pallas_call(
+    qblk, kblk, rowblk = _IXQ, _IXK, _IXQ
+    out, lse = pl.pallas_call(
         kernel,
         grid=(b, h, nq, nk),
         in_specs=[
-            pl.BlockSpec((1, 1, bq, d_pad), blk,
+            pl.BlockSpec((1, 1, bq, d_pad), qblk,
                          memory_space=pltpu.VMEM),
             pl.BlockSpec((1, 1, bk, d_pad), kblk,
                          memory_space=pltpu.VMEM),
             pl.BlockSpec((1, 1, bk, d_pad), kblk,
                          memory_space=pltpu.VMEM),
         ],
-        out_specs=pl.BlockSpec((1, 1, bq, d_pad), blk,
-                               memory_space=pltpu.VMEM),
-        out_shape=jax.ShapeDtypeStruct((b, h, s_pad, d_pad), q.dtype),
+        out_specs=[
+            pl.BlockSpec((1, 1, bq, d_pad), qblk,
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, bq, 1), rowblk,
+                         memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, h, s_pad, d_pad), q.dtype),
+            jax.ShapeDtypeStruct((b, h, s_pad, 1), jnp.float32),
+        ],
         scratch_shapes=[
             pltpu.VMEM((bq, 1), jnp.float32),       # running max
             pltpu.VMEM((bq, 1), jnp.float32),       # running denom
@@ -146,34 +191,223 @@ def _pallas_forward(q, k, v, causal: bool, block_q: int, block_k: int,
                                  "arbitrary")),
         interpret=interpret,
     )(qp, kp, vp)
-    return jnp.moveaxis(out[:, :, :s, :d], 1, 2)       # (b, s, h, d)
+    return jnp.moveaxis(out[:, :, :s, :d], 1, 2), lse
 
 
-def _dense(q, k, v, causal: bool):
-    from ..parallel.ring_attention import reference_attention
-    return reference_attention(q, k, v, causal=causal)
+# -- backward ---------------------------------------------------------------
 
+def _masked_p(q, k, lse, scale, causal, q0, k0, bq, bk, seq_len):
+    """Recompute p = exp(s - lse) for one block (shared by dq/dkdv)."""
+    import jax
+    import jax.numpy as jnp
+
+    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * scale
+    kpos = k0 + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+    mask = kpos < seq_len
+    if causal:
+        qpos = q0 + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+        mask = jnp.logical_and(mask, qpos >= kpos)
+    s = jnp.where(mask, s, -1e30)
+    return jnp.exp(s - lse)                       # (bq, bk)
+
+
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dd_ref, dq_ref,
+               acc_scr, *, scale: float, causal: bool, bq: int, bk: int,
+               seq_len: int):
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    ik = pl.program_id(3)
+    nk = pl.num_programs(3)
+
+    @pl.when(ik == 0)
+    def _init():
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    iq = pl.program_id(2)
+    q0 = iq * bq
+    k0 = ik * bk
+    live = k0 < seq_len
+    if causal:
+        live = jnp.logical_and(live, k0 <= q0 + bq - 1)
+
+    @pl.when(live)
+    def _step():
+        q = q_ref[0, 0].astype(jnp.float32)
+        k = k_ref[0, 0].astype(jnp.float32)
+        v = v_ref[0, 0].astype(jnp.float32)
+        do = do_ref[0, 0].astype(jnp.float32)
+        lse = lse_ref[0, 0]                       # (bq, 1)
+        dd = dd_ref[0, 0]                         # D = rowsum(do * o)
+        p = _masked_p(q, k, lse, scale, causal, q0, k0, bq, bk, seq_len)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - dd)                        # (bq, bk)
+        acc_scr[:] = acc_scr[:] + jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+
+    @pl.when(ik == nk - 1)
+    def _finalize():
+        dq_ref[0, 0] = acc_scr[:].astype(dq_ref.dtype)
+
+
+def _dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dd_ref, dk_ref,
+                 dv_ref, dk_scr, dv_scr, *, scale: float, causal: bool,
+                 bq: int, bk: int, seq_len: int):
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    iq = pl.program_id(3)                  # q innermost: sweep per k blk
+    nq = pl.num_programs(3)
+
+    @pl.when(iq == 0)
+    def _init():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    ikb = pl.program_id(2)
+    k0 = ikb * bk
+    q0 = iq * bq
+    live = k0 < seq_len
+    if causal:
+        live = jnp.logical_and(live, k0 <= q0 + bq - 1)
+
+    @pl.when(live)
+    def _step():
+        q = q_ref[0, 0].astype(jnp.float32)
+        k = k_ref[0, 0].astype(jnp.float32)
+        v = v_ref[0, 0].astype(jnp.float32)
+        do = do_ref[0, 0].astype(jnp.float32)
+        lse = lse_ref[0, 0]                       # (bq, 1)
+        dd = dd_ref[0, 0]
+        p = _masked_p(q, k, lse, scale, causal, q0, k0, bq, bk, seq_len)
+        dv_scr[:] = dv_scr[:] + jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)   # (bk, d)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - dd)
+        dk_scr[:] = dk_scr[:] + jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+
+    @pl.when(iq == nq - 1)
+    def _finalize():
+        dk_ref[0, 0] = dk_scr[:].astype(dk_ref.dtype)
+        dv_ref[0, 0] = dv_scr[:].astype(dv_ref.dtype)
+
+
+def _pallas_backward(q, k, v, o, lse, g, causal: bool, block_q: int,
+                     block_k: int, interpret: Optional[bool]):
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, s, h, d = q.shape
+    interpret = _resolve_interpret(interpret)
+    d_pad, bq, bk, s_pad = _block_geometry(s, d, block_q, block_k)
+    nq, nk = s_pad // bq, s_pad // bk
+    scale = 1.0 / (d ** 0.5)
+    prep = _make_prep(s_pad, d_pad, s, d)
+    qp, kp, vp, op, dop = prep(q), prep(k), prep(v), prep(o), prep(g)
+    # lse arrives already in the padded layout: _block_geometry is a
+    # pure function of (s, d, block_q, block_k), so forward and
+    # backward always agree on s_pad
+    assert lse.shape == (b, h, s_pad, 1), (lse.shape, s_pad)
+    lsep = lse
+    dd = jnp.sum(dop.astype(jnp.float32) * op.astype(jnp.float32),
+                 axis=-1, keepdims=True)           # (b, h, s_pad, 1)
+
+    qblk, kblk, qrow = _IXQ, _IXK, _IXQ
+    # dq: sweep k blocks per q block
+    dq = pl.pallas_call(
+        functools.partial(_dq_kernel, scale=scale, causal=causal,
+                          bq=bq, bk=bk, seq_len=s),
+        grid=(b, h, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, 1, bq, d_pad), qblk, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, bk, d_pad), kblk, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, bk, d_pad), kblk, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, bq, d_pad), qblk, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, bq, 1), qrow, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, bq, 1), qrow, memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((1, 1, bq, d_pad), qblk,
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((b, h, s_pad, d_pad), q.dtype),
+        scratch_shapes=[pltpu.VMEM((bq, d_pad), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel",
+                                 "arbitrary")),
+        interpret=interpret,
+    )(qp, kp, vp, dop, lsep, dd)
+
+    # dk/dv: sweep q blocks per k block (q is the innermost grid dim)
+    kblk2, qblk2, qrow2 = _IXK2, _IXQ2, _IXQ2
+    dk, dv = pl.pallas_call(
+        functools.partial(_dkdv_kernel, scale=scale, causal=causal,
+                          bq=bq, bk=bk, seq_len=s),
+        grid=(b, h, nk, nq),
+        in_specs=[
+            pl.BlockSpec((1, 1, bq, d_pad), qblk2, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, bk, d_pad), kblk2, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, bk, d_pad), kblk2, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, bq, d_pad), qblk2, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, bq, 1), qrow2,
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, bq, 1), qrow2,
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, bk, d_pad), kblk2,
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, bk, d_pad), kblk2,
+                         memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, h, s_pad, d_pad), k.dtype),
+            jax.ShapeDtypeStruct((b, h, s_pad, d_pad), v.dtype),
+        ],
+        scratch_shapes=[pltpu.VMEM((bk, d_pad), jnp.float32),
+                        pltpu.VMEM((bk, d_pad), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel",
+                                 "arbitrary")),
+        interpret=interpret,
+    )(qp, kp, vp, dop, lsep, dd)
+
+    unprep = lambda x: jnp.moveaxis(x[:, :, :s, :d], 1, 2)  # noqa: E731
+    return unprep(dq), unprep(dk), unprep(dv)
+
+
+# -- public api -------------------------------------------------------------
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
 def flash_attention(q, k, v, causal: bool = False, block_q: int = 256,
                     block_k: int = 256, interpret: Optional[bool] = None):
     """Flash attention: (b, s, h, d) q/k/v -> (b, s, h, d).
 
-    Forward runs the Pallas kernel (interpret mode off-TPU); backward
-    recomputes with the dense XLA formulation, so it is differentiable
-    everywhere the dense oracle is."""
-    return _pallas_forward(q, k, v, causal, block_q, block_k, interpret)
+    Forward AND backward run fused Pallas kernels (interpret mode
+    off-TPU) — O(seq) memory in both directions."""
+    out, _ = _pallas_forward(q, k, v, causal, block_q, block_k, interpret)
+    return out
 
 
 def _fa_fwd(q, k, v, causal, block_q, block_k, interpret):
-    return (_pallas_forward(q, k, v, causal, block_q, block_k, interpret),
-            (q, k, v))
+    out, lse = _pallas_forward(q, k, v, causal, block_q, block_k,
+                               interpret)
+    return out, (q, k, v, out, lse)
 
 
 def _fa_bwd(causal, block_q, block_k, interpret, res, g):
-    q, k, v = res
-    _, vjp = jax.vjp(lambda q, k, v: _dense(q, k, v, causal), q, k, v)
-    return vjp(g)
+    q, k, v, o, lse = res
+    return _pallas_backward(q, k, v, o, lse, g, causal, block_q, block_k,
+                            interpret)
 
 
 flash_attention.defvjp(_fa_fwd, _fa_bwd)
