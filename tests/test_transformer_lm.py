@@ -158,6 +158,23 @@ def test_moe_lm_ep_sharded_step():
     assert len(new_params["blk0"]["moe"]["w1"].sharding.device_set) >= tp
 
 
+def test_all_features_compose():
+    """MoE FFN + flash attention + scanned layers + remat in ONE config
+    trains and stays finite — the options are orthogonal."""
+    cfg = LMConfig(vocab=32, dim=32, heads=4, depth=2, lr=0.1,
+                   moe_experts=2, use_flash=True, scan_layers=True,
+                   remat=True)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    ids, labels = _data(cfg, batch=2, seq=16)
+    step = jax.jit(make_train_step(cfg))
+    first = None
+    for _ in range(8):
+        params, loss = step(params, ids, labels)
+        first = first if first is not None else float(loss)
+    assert jnp.isfinite(loss)
+    assert float(loss) < first, (first, float(loss))
+
+
 def test_dp_tp_sharded_training():
     n = len(jax.devices())
     if n < 4:
