@@ -95,9 +95,14 @@ def make_ring_attention(mesh, axis: str = "sp", causal: bool = False):
                                    out_specs=spec))
 
 
-def make_ulysses_attention(mesh, axis: str = "sp", causal: bool = False):
+def make_ulysses_attention(mesh, axis: str = "sp", causal: bool = False,
+                           use_flash: bool = False):
     """Sequence↔head all_to_all, full local attention, exchange back.
-    Heads must be divisible by the mesh axis size."""
+    Heads must be divisible by the mesh axis size.  ``use_flash`` runs
+    the local attention through the Pallas flash kernel
+    (ops/flash_attention.py) — O(s) memory per chip instead of the
+    dense (s, s) score matrix, which is what makes Ulysses viable at
+    genuinely long context."""
     import jax
     import jax.numpy as jnp
     from jax.sharding import PartitionSpec as P
@@ -115,6 +120,10 @@ def make_ulysses_attention(mesh, axis: str = "sp", causal: bool = False):
                                       concat_axis=2, tiled=True)
 
         qf, kf, vf = seq_to_head(q), seq_to_head(k), seq_to_head(v)
+        if use_flash:
+            from ..ops.flash_attention import flash_attention
+            out = flash_attention(qf, kf, vf, causal)
+            return head_to_seq(out.astype(q.dtype))
         b, s, hh, d = qf.shape
         scale = 1.0 / (d ** 0.5)
         s_mat = jnp.einsum("bqhd,bkhd->bhqk", qf, kf,

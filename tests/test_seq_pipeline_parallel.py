@@ -52,6 +52,19 @@ def test_ulysses_attention_matches_dense(mesh, causal):
                                rtol=2e-4, atol=2e-5)
 
 
+@pytest.mark.parametrize("causal", [False, True])
+def test_ulysses_flash_matches_dense(mesh, causal):
+    """Ulysses with the Pallas flash kernel as the local attention —
+    the O(s)-memory long-context configuration."""
+    q, k, v = _qkv(h=8)
+    want = reference_attention(q, k, v, causal=causal)
+    uly = make_ulysses_attention(mesh, "sp", causal=causal,
+                                 use_flash=True)
+    got = uly(*_shard_seq(mesh, q, k, v))
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-4, atol=2e-5)
+
+
 def test_ring_attention_long_sequence(mesh):
     # sequence larger than any single shard would typically hold
     q, k, v = _qkv(b=1, s=512, h=4, d=8, seed=3)
