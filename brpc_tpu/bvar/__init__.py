@@ -12,3 +12,4 @@ from .sampler import tick_once_for_tests, add_sampler, remove_sampler, Sampler
 from .collector import Collector, Collected
 from .prometheus import render_prometheus
 from .default_variables import expose_default_variables
+from .dump import dump_once, ensure_dumper

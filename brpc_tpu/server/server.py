@@ -336,6 +336,8 @@ class Server:
             self._internal_acceptor.start_accept(ilst)
         self._started = True
         self._stopped_event.clear()
+        from ..bvar.dump import ensure_dumper
+        ensure_dumper()     # no-op unless the bvar_dump flag is on
         LOG.info("Server started at %s (%d services, %d methods)",
                  self._listen_endpoint, len(self._services),
                  len(self._methods))

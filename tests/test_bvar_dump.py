@@ -1,0 +1,57 @@
+"""Periodic bvar dump-to-file: snapshot contents, prefix filter,
+atomic swap, and live flag gating."""
+
+import os
+
+import pytest
+
+from brpc_tpu.butil.flags import set_flag
+from brpc_tpu.bvar import Adder
+from brpc_tpu.bvar.dump import dump_once
+from brpc_tpu.bvar.variable import clear_registry_for_tests
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    clear_registry_for_tests()
+    yield
+    set_flag("bvar_dump", False)
+    set_flag("bvar_dump_prefix", "")
+    clear_registry_for_tests()
+
+
+def test_dump_once_writes_snapshot(tmp_path):
+    a = Adder("dump_test_requests")
+    a << 41
+    a << 1
+    path = str(tmp_path / "monitor" / "bvar.data")
+    got = dump_once(path)
+    assert got == path
+    text = open(path).read()
+    assert "dump_test_requests : 42" in text
+    # atomic swap leaves no temp file behind
+    assert not [f for f in os.listdir(tmp_path / "monitor")
+                if f.startswith("bvar.data.tmp")]
+
+
+def test_dump_prefix_filters(tmp_path):
+    Adder("svc_a_count") << 1
+    Adder("other_count") << 2
+    set_flag("bvar_dump_prefix", "svc_a")
+    path = str(tmp_path / "bvar.data")
+    dump_once(path)
+    text = open(path).read()
+    assert "svc_a_count" in text
+    assert "other_count" not in text
+
+
+def test_dump_overwrites_previous_snapshot(tmp_path):
+    a = Adder("dump_test_counter")
+    path = str(tmp_path / "bvar.data")
+    a << 1
+    dump_once(path)
+    a << 1
+    dump_once(path)
+    text = open(path).read()
+    assert "dump_test_counter : 2" in text
+    assert text.count("dump_test_counter") == 1
