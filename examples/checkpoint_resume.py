@@ -1,0 +1,66 @@
+"""Checkpoint/resume — training state surviving preemption.
+
+Trains the TransformerLM, checkpoints every few steps, then simulates a
+preemption: a fresh process-state resumes from the newest step with
+shardings restored in place and continues bit-identically.
+
+Run: python examples/checkpoint_resume.py
+"""
+
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from brpc_tpu.models import LMConfig, init_params, make_train_step
+    from brpc_tpu.utils import TrainCheckpointer, abstract_like
+
+    cfg = LMConfig(vocab=128, dim=64, heads=4, depth=2, lr=0.2)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    ids = jnp.tile(jnp.arange(32, dtype=jnp.int32), (4, 2))
+    labels = jnp.roll(ids, -1, axis=-1)
+    step = jax.jit(make_train_step(cfg))
+
+    workdir = tempfile.mkdtemp(prefix="ckpt_demo_")
+    ckpt = TrainCheckpointer(workdir, max_to_keep=2)
+    print(f"checkpoints -> {workdir}")
+
+    state = {"params": params, "step": jnp.int32(0)}
+    for i in range(1, 9):
+        p, loss = step(state["params"], ids, labels)
+        state = {"params": p, "step": jnp.int32(i)}
+        if i % 2 == 0:
+            ckpt.save(i, state)
+        print(f"step {i}  loss {float(loss):.4f}")
+    final_before = state
+
+    print(f"\n-- simulated preemption; kept steps: {ckpt.all_steps()} --\n")
+
+    # resume from the OLDER kept step so the replayed tail is real work
+    # (shards land straight on their devices via the abstract target)
+    oldest = min(ckpt.all_steps())
+    restored = ckpt.restore(step=oldest, like=abstract_like(final_before))
+    start = int(restored["step"]) + 1
+    state = restored
+    for i in range(start, 9):
+        p, loss = step(state["params"], ids, labels)
+        state = {"params": p, "step": jnp.int32(i)}
+        print(f"resumed step {i}  loss {float(loss):.4f}")
+
+    same = jax.tree_util.tree_all(jax.tree_util.tree_map(
+        lambda a, b: bool(jnp.array_equal(a, b)),
+        state["params"], final_before["params"]))
+    print(f"\nresumed trajectory bit-identical to uninterrupted: {same}")
+    assert same
+    ckpt.close()
+
+
+if __name__ == "__main__":
+    main()
