@@ -36,15 +36,21 @@ from typing import Any, Dict, Tuple
 class MoEConfig:
     def __init__(self, dim: int = 64, hidden: int = 128,
                  num_experts: int = 4, capacity_factor: float = 1.5,
-                 aux_loss_weight: float = 0.01):
+                 aux_loss_weight: float = 0.01, top_k: int = 1):
+        assert 1 <= top_k <= num_experts
         self.dim = dim
         self.hidden = hidden
         self.num_experts = num_experts
         self.capacity_factor = capacity_factor
         self.aux_loss_weight = aux_loss_weight
+        # top_k=1 is Switch-style routing; top_k=2 the GShard/Mixtral
+        # configuration (each token visits its k best experts, outputs
+        # mixed by the renormalized router probabilities)
+        self.top_k = top_k
 
     def capacity(self, tokens: int) -> int:
-        c = math.ceil(tokens / self.num_experts * self.capacity_factor)
+        c = math.ceil(tokens * self.top_k / self.num_experts
+                      * self.capacity_factor)
         return max(1, c)
 
 
@@ -79,31 +85,49 @@ def forward(params: Dict[str, Any], x, cfg: MoEConfig
             ) -> Tuple[Any, Any]:
     """MoE FFN: x (T, d) -> (out (T, d), aux_loss ()).
 
-    Top-1 routing with capacity; dropped tokens contribute zero (the
-    caller's residual connection carries them through unchanged)."""
+    Top-k routing with capacity; each of a token's k expert slots is
+    dispatched as its own "slot token", outputs mix back weighted by
+    the renormalized router probabilities.  Dropped slots contribute
+    zero (the caller's residual carries the token through)."""
     import jax
     import jax.numpy as jnp
 
     T, d = x.shape
     E = cfg.num_experts
+    K = cfg.top_k
     C = cfg.capacity(T)
 
     logits = x @ params["wg"]                      # (T, E) fp32 router
     probs = jax.nn.softmax(logits, axis=-1)
-    gate = jnp.max(probs, axis=-1)                 # (T,)
-    expert = jnp.argmax(probs, axis=-1)            # (T,)
+    topv, tope = jax.lax.top_k(probs, K)           # (T, K)
+    if K > 1:
+        # renormalize over the selected experts (Mixtral-style mixing);
+        # K=1 keeps the raw router prob as the scale (Switch style —
+        # renormalizing would pin the gate to 1.0 and starve the router
+        # of gate gradients)
+        topv = topv / jnp.maximum(topv.sum(axis=-1, keepdims=True), 1e-9)
+    # capacity positions are assigned over (choice-major) slots so every
+    # token's FIRST choice queues ahead of all second choices; since a
+    # token's K chosen experts are distinct, its slots never collide and
+    # the K per-choice masks fold into ONE (T, E, C) dispatch/combine —
+    # the Mesh-TF formulation, keeping every einsum at T rows
+    slot_expert = tope.transpose(1, 0).reshape(K * T)          # (K*T,)
+    slot_onehot = jax.nn.one_hot(slot_expert, E,
+                                 dtype=jnp.int32)              # (K*T, E)
+    pos = jnp.cumsum(slot_onehot, axis=0) * slot_onehot - 1    # (K*T, E)
+    pos_in_expert = pos.max(axis=1)                            # (K*T,)
+    kept = pos_in_expert < C                                   # drop tail
 
-    # position of each token within its expert's capacity (static shape)
-    onehot = jax.nn.one_hot(expert, E, dtype=jnp.int32)       # (T, E)
-    pos = jnp.cumsum(onehot, axis=0) * onehot - 1             # (T, E)
-    pos_in_expert = pos.max(axis=1)                           # (T,)
-    kept = pos_in_expert < C                                  # overflow drop
-
-    # dispatch (T, E, C): token t -> slot (expert[t], pos[t])
-    dispatch = (jax.nn.one_hot(expert, E, dtype=x.dtype)[:, :, None]
-                * jax.nn.one_hot(jnp.clip(pos_in_expert, 0, C - 1), C,
-                                 dtype=x.dtype)[:, None, :])
-    dispatch = dispatch * kept[:, None, None].astype(x.dtype)
+    dispatch = jnp.zeros((T, E, C), x.dtype)      # slot indicator
+    combine = jnp.zeros((T, E, C), x.dtype)       # gate-weighted
+    for k in range(K):                            # static unroll, K small
+        sl = slice(k * T, (k + 1) * T)
+        mask_k = (jax.nn.one_hot(tope[:, k], E, dtype=x.dtype)[:, :, None]
+                  * jax.nn.one_hot(jnp.clip(pos_in_expert[sl], 0, C - 1),
+                                   C, dtype=x.dtype)[:, None, :]
+                  * kept[sl][:, None, None].astype(x.dtype))
+        dispatch = dispatch + mask_k
+        combine = combine + mask_k * topv[:, k][:, None, None]
 
     # gather token slots, run every expert as one batched bf16 einsum
     expert_in = jnp.einsum("td,tec->ecd", x.astype(jnp.bfloat16),
@@ -114,13 +138,12 @@ def forward(params: Dict[str, Any], x, cfg: MoEConfig
     expert_out = jnp.einsum("ech,ehd->ecd", h,
                             params["w2"].astype(jnp.bfloat16))
 
-    # combine weighted by the router probability of the chosen expert
-    combine = dispatch * gate[:, None, None].astype(x.dtype)
+    # scatter back, weighted by the (renormalized) router probability
     out = jnp.einsum("ecd,tec->td", expert_out.astype(x.dtype), combine)
 
-    # load-balancing aux loss (Switch Transformer): fraction of tokens
-    # per expert x mean router prob per expert, scaled by E
-    frac = jnp.mean(onehot.astype(jnp.float32), axis=0)
+    # load-balancing aux loss (Switch Transformer): fraction of FIRST-
+    # choice assignments per expert x mean router prob, scaled by E
+    frac = jnp.mean(slot_onehot[:T].astype(jnp.float32), axis=0)
     mean_prob = jnp.mean(probs, axis=0)
     aux = E * jnp.sum(frac * mean_prob) * cfg.aux_loss_weight
     return out, aux

@@ -34,7 +34,8 @@ class LMConfig:
                  causal: bool = True, remat: bool = True,
                  lr: float = 0.05, moe_experts: int = 0,
                  moe_capacity: float = 2.0, moe_aux_weight: float = 0.01,
-                 use_flash: bool = False, scan_layers: bool = False):
+                 moe_top_k: int = 1, use_flash: bool = False,
+                 scan_layers: bool = False):
         assert dim % heads == 0
         assert (dim // heads) % 2 == 0, "head dim must be even for RoPE"
         self.vocab = vocab
@@ -52,6 +53,7 @@ class LMConfig:
         self.moe_experts = moe_experts
         self.moe_capacity = moe_capacity
         self.moe_aux_weight = moe_aux_weight
+        self.moe_top_k = moe_top_k
         # single-device attention via the Pallas flash kernel
         # (ops/flash_attention.py); the sp path keeps ring attention
         self.use_flash = use_flash
@@ -65,7 +67,8 @@ class LMConfig:
         return MoEConfig(dim=self.dim, hidden=self.dim * self.mlp_mult,
                          num_experts=self.moe_experts,
                          capacity_factor=self.moe_capacity,
-                         aux_loss_weight=self.moe_aux_weight)
+                         aux_loss_weight=self.moe_aux_weight,
+                         top_k=self.moe_top_k)
 
 
 def init_params(rng, cfg: LMConfig) -> Dict[str, Any]:
