@@ -122,12 +122,14 @@ def forward(params: Dict[str, Any], x, cfg: MoEConfig
     combine = jnp.zeros((T, E, C), x.dtype)       # gate-weighted
     for k in range(K):                            # static unroll, K small
         sl = slice(k * T, (k + 1) * T)
-        mask_k = (jax.nn.one_hot(tope[:, k], E, dtype=x.dtype)[:, :, None]
+        # slot_onehot[sl] IS one_hot(tope[:, k]) in choice-major layout
+        mask_k = (slot_onehot[sl].astype(x.dtype)[:, :, None]
                   * jax.nn.one_hot(jnp.clip(pos_in_expert[sl], 0, C - 1),
                                    C, dtype=x.dtype)[:, None, :]
                   * kept[sl][:, None, None].astype(x.dtype))
         dispatch = dispatch + mask_k
-        combine = combine + mask_k * topv[:, k][:, None, None]
+        combine = combine + mask_k * topv[:, k].astype(
+            x.dtype)[:, None, None]
 
     # gather token slots, run every expert as one batched bf16 einsum
     expert_in = jnp.einsum("td,tec->ecd", x.astype(jnp.bfloat16),
