@@ -10,9 +10,10 @@ service moving tensors in attachments.
 
 from .embedding_ps import PSConfig, EmbeddingPS
 from .moe import MoEConfig
-from .transformer_lm import (LMConfig, batch_specs, init_params,
-                             make_forward, make_train_step, param_specs)
+from .transformer_lm import (LMConfig, batch_specs, generate,
+                             init_params, make_decode, make_forward,
+                             make_train_step, param_specs)
 
 __all__ = ["PSConfig", "EmbeddingPS", "LMConfig", "MoEConfig",
            "init_params", "make_forward", "make_train_step",
-           "param_specs", "batch_specs"]
+           "make_decode", "generate", "param_specs", "batch_specs"]

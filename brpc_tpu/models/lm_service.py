@@ -1,0 +1,87 @@
+"""LM serving over the framework — autoregressive generation as an RPC.
+
+The capstone wiring: the TransformerLM's KV-cache decode path
+(``make_decode``/``generate``) behind a Service, so a Channel client
+(or grpc/HTTP through the bridges) asks for completions the way it
+would ask any brpc-style service.  The reference's analogue is its
+model-serving example services; here the "model" is an actual LM.
+
+Wire format (framework control plane is schema-free TLV; payloads are
+the service's own): request = ``<u32 batch><u32 prompt_len>
+<u32 max_new>`` + int32 prompt ids; response = int32 generated ids,
+shape (batch, max_new).
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Optional
+
+import numpy as np
+
+from ..butil.status import Errno
+from ..server.service import Service
+from .transformer_lm import LMConfig, init_params, make_generator
+
+
+def pack_generate_request(prompt: np.ndarray, max_new: int) -> bytes:
+    prompt = np.ascontiguousarray(prompt, dtype=np.int32)
+    b, s = prompt.shape
+    return struct.pack("<III", b, s, max_new) + prompt.tobytes()
+
+
+def unpack_generated(data: bytes) -> np.ndarray:
+    b, n = struct.unpack_from("<II", data)
+    return np.frombuffer(data, dtype=np.int32, offset=8).reshape(b, n)
+
+
+class LMService(Service):
+    """``Generate`` — greedy completion; ``Info`` — model config JSON."""
+
+    def __init__(self, cfg: Optional[LMConfig] = None, params=None,
+                 max_new_cap: int = 128):
+        import jax
+
+        self.cfg = cfg or LMConfig(vocab=256, dim=64, heads=4, depth=2,
+                                   max_seq=128, remat=False)
+        self.params = params if params is not None else init_params(
+            jax.random.PRNGKey(0), self.cfg)
+        self.max_new_cap = max_new_cap
+        # prefill/decode programs compile once per (batch, prompt) shape
+        # and are reused across requests
+        self._gen = make_generator(self.cfg, self.params)
+
+    def Generate(self, cntl, request):
+        try:
+            b, s, max_new = struct.unpack_from("<III", request)
+            prompt = np.frombuffer(request, dtype=np.int32,
+                                   offset=12).reshape(b, s)
+        except (struct.error, ValueError) as e:
+            cntl.set_failed(Errno.EREQUEST, f"bad generate request: {e}")
+            return None
+        if b == 0 or s == 0:
+            cntl.set_failed(Errno.EREQUEST, "empty prompt")
+            return None
+        if max_new <= 0 or max_new > self.max_new_cap:
+            cntl.set_failed(Errno.EREQUEST,
+                            f"max_new must be in [1, {self.max_new_cap}]")
+            return None
+        if s + max_new > self.cfg.max_seq:
+            cntl.set_failed(
+                Errno.EREQUEST,
+                f"prompt {s} + max_new {max_new} exceeds max_seq "
+                f"{self.cfg.max_seq}")
+            return None
+        if (prompt < 0).any() or (prompt >= self.cfg.vocab).any():
+            cntl.set_failed(Errno.EREQUEST, "prompt ids out of vocab")
+            return None
+        out = np.asarray(self._gen(prompt, int(max_new)),
+                         dtype=np.int32)
+        return struct.pack("<II", *out.shape) + out.tobytes()
+
+    def Info(self, cntl, request):
+        import json
+        c = self.cfg
+        return json.dumps({"vocab": c.vocab, "dim": c.dim,
+                           "heads": c.heads, "depth": c.depth,
+                           "max_seq": c.max_seq}).encode()

@@ -214,6 +214,162 @@ def make_forward(cfg: LMConfig, mesh=None, sp_axis: Optional[str] = None):
     return forward
 
 
+def _rope_at(x, pos, head_dim: int):
+    """Rotary embedding for ONE position (traced scalar) — same math as
+    the table path, built for a single position and fed to _rope so the
+    rotation (and any future base/NTK change) has one home."""
+    import jax.numpy as jnp
+    half = head_dim // 2
+    freq = jnp.exp(-math.log(10000.0)
+                   * jnp.arange(half, dtype=jnp.float32) / half)
+    ang = (pos.astype(jnp.float32) * freq)[None, None, None, :]
+    return _rope(x, jnp.sin(ang), jnp.cos(ang))
+
+
+def make_decode(cfg: LMConfig):
+    """Autoregressive serving path: static-shape KV cache, one token per
+    step — the jit-friendly inference loop (no dynamic shapes: the cache
+    is (b, max_seq, heads, hd) from the start, positions masked).
+
+    Returns ``(prefill, decode_step)``:
+      - ``prefill(params, ids[b, s]) -> (cache, logits[b, vocab])`` —
+        runs the prompt once, fills the cache, returns last-position
+        logits;
+      - ``decode_step(params, cache, token[b]) -> (cache, logits)`` —
+        appends one token (rope at its true position) and attends over
+        the cached prefix.  Donate the cache at the jit boundary for
+        in-place updates."""
+    import jax
+    import jax.numpy as jnp
+
+    assert not cfg.scan_layers, "decode supports unrolled layers"
+    hd = cfg.dim // cfg.heads
+    if cfg.moe_experts > 0:
+        from .moe import forward_grouped as moe_forward
+        moe_cfg = cfg.moe_cfg()
+
+    def mlp(bp, h):
+        if cfg.moe_experts > 0:
+            out, _ = moe_forward(bp["moe"], h, moe_cfg)
+            return out
+        up = (h.astype(jnp.bfloat16) @ bp["w1"].astype(jnp.bfloat16))
+        return (jax.nn.gelu(up.astype(jnp.float32)).astype(jnp.bfloat16)
+                @ bp["w2"].astype(jnp.bfloat16)).astype(jnp.float32)
+
+    def unembed(params, x_last):
+        return (x_last.astype(jnp.bfloat16)
+                @ params["unembed"].astype(jnp.bfloat16)).astype(
+                    jnp.float32)
+
+    def prefill(params, ids):
+        b, s = ids.shape
+        assert s <= cfg.max_seq
+        fwd_x = params["embed"][ids]
+        sin, cos = _rope_tables(s, hd)
+        cache = {"len": jnp.int32(s)}
+        x = fwd_x
+        for i in range(cfg.depth):
+            bp = params[f"blk{i}"]
+            h = _rmsnorm(x, bp["ln1"])
+            qkv = (h.astype(jnp.bfloat16)
+                   @ bp["wqkv"].astype(jnp.bfloat16)).astype(jnp.float32)
+            q, k, v = jnp.split(qkv, 3, axis=-1)
+            shp = (b, s, cfg.heads, hd)
+            q, k = (_rope(t.reshape(shp), sin, cos) for t in (q, k))
+            v = v.reshape(shp)
+            kc = jnp.zeros((b, cfg.max_seq, cfg.heads, hd), jnp.float32)
+            vc = jnp.zeros((b, cfg.max_seq, cfg.heads, hd), jnp.float32)
+            cache[f"k{i}"] = jax.lax.dynamic_update_slice(
+                kc, k, (0, 0, 0, 0))
+            cache[f"v{i}"] = jax.lax.dynamic_update_slice(
+                vc, v, (0, 0, 0, 0))
+            from ..parallel.ring_attention import reference_attention
+            att = reference_attention(q, k, v, causal=cfg.causal)
+            x = x + (att.reshape(b, s, cfg.dim).astype(jnp.bfloat16)
+                     @ bp["wo"].astype(jnp.bfloat16)).astype(jnp.float32)
+            x = x + mlp(bp, _rmsnorm(x, bp["ln2"]))
+        return cache, unembed(params, x[:, -1])
+
+    def decode_step(params, cache, token):
+        cache = dict(cache)      # never mutate the caller's dict (an
+                                 # eager caller may fork it — beam/retry)
+        b = token.shape[0]
+        pos = cache["len"]                           # traced scalar
+        x = params["embed"][token][:, None, :]       # (b, 1, d)
+        for i in range(cfg.depth):
+            bp = params[f"blk{i}"]
+            h = _rmsnorm(x, bp["ln1"])
+            qkv = (h.astype(jnp.bfloat16)
+                   @ bp["wqkv"].astype(jnp.bfloat16)).astype(jnp.float32)
+            q, k, v = jnp.split(qkv, 3, axis=-1)
+            shp = (b, 1, cfg.heads, hd)
+            q = _rope_at(q.reshape(shp), pos, hd)
+            k = _rope_at(k.reshape(shp), pos, hd)
+            v = v.reshape(shp)
+            kc = jax.lax.dynamic_update_slice(
+                cache[f"k{i}"], k, (0, pos, 0, 0))
+            vc = jax.lax.dynamic_update_slice(
+                cache[f"v{i}"], v, (0, pos, 0, 0))
+            cache[f"k{i}"], cache[f"v{i}"] = kc, vc
+            # attend the single query over the cached prefix
+            s_mat = jnp.einsum("bqhd,bkhd->bhqk", q, kc,
+                               preferred_element_type=jnp.float32
+                               ) / (hd ** 0.5)
+            live = jnp.arange(cfg.max_seq) <= pos    # prefix + self
+            s_mat = jnp.where(live[None, None, None, :], s_mat, -1e30)
+            p = jax.nn.softmax(s_mat, axis=-1)
+            att = jnp.einsum("bhqk,bkhd->bqhd", p, vc,
+                             preferred_element_type=jnp.float32)
+            x = x + (att.reshape(b, 1, cfg.dim).astype(jnp.bfloat16)
+                     @ bp["wo"].astype(jnp.bfloat16)).astype(jnp.float32)
+            x = x + mlp(bp, _rmsnorm(x, bp["ln2"]))
+        cache["len"] = pos + 1
+        return cache, unembed(params, x[:, 0])
+
+    return prefill, decode_step
+
+
+def make_generator(cfg: LMConfig, params):
+    """Build a greedy ``gen(prompt_ids, max_new) -> (b, max_new)``
+    closure with the prefill and decode-step programs jitted ONCE —
+    the serving form (LMService holds one of these; re-jitting per
+    request would pay XLA compilation on every RPC).  The decode step
+    donates the cache for in-place updates."""
+    import functools as _ft
+
+    import jax
+    import jax.numpy as jnp
+
+    prefill, decode_step = make_decode(cfg)
+    prefill_j = jax.jit(prefill)
+    step_j = jax.jit(_ft.partial(decode_step, params),
+                     donate_argnums=(0,))
+
+    def gen(prompt_ids, max_new: int):
+        s = prompt_ids.shape[1]
+        if s + max_new > cfg.max_seq:
+            raise ValueError(
+                f"prompt {s} + max_new {max_new} exceeds max_seq "
+                f"{cfg.max_seq} (the cache would silently wrap)")
+        cache, logits = prefill_j(params, prompt_ids)
+        out = []
+        token = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        out.append(token)
+        for _ in range(max_new - 1):     # the last emitted token needs
+            cache, logits = step_j(cache, token)   # no further step
+            token = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            out.append(token)
+        return jnp.stack(out, axis=1)
+
+    return gen
+
+
+def generate(params, cfg: LMConfig, prompt_ids, max_new: int):
+    """One-off greedy decoding convenience (compiles per call — hold a
+    :func:`make_generator` closure to amortize compilation)."""
+    return make_generator(cfg, params)(prompt_ids, max_new)
+
+
 def make_train_step(cfg: LMConfig, mesh=None, sp_axis=None):
     """(params, ids, labels) -> (new_params, loss); plain SGD."""
     import jax
