@@ -330,11 +330,13 @@ def make_decode(cfg: LMConfig):
 
 
 def make_generator(cfg: LMConfig, params):
-    """Build a greedy ``gen(prompt_ids, max_new) -> (b, max_new)``
+    """Build a ``gen(prompt_ids, max_new, temperature=0.0, rng=None)``
     closure with the prefill and decode-step programs jitted ONCE —
     the serving form (LMService holds one of these; re-jitting per
-    request would pay XLA compilation on every RPC).  The decode step
-    donates the cache for in-place updates."""
+    request would pay XLA compilation on every RPC).  temperature 0 is
+    greedy; > 0 samples and REQUIRES an rng key (each call should pass
+    a fresh one).  The decode step donates the cache for in-place
+    updates."""
     import functools as _ft
 
     import jax
@@ -345,20 +347,36 @@ def make_generator(cfg: LMConfig, params):
     step_j = jax.jit(_ft.partial(decode_step, params),
                      donate_argnums=(0,))
 
-    def gen(prompt_ids, max_new: int):
+    def pick(logits, temperature, rng):
+        if temperature <= 0.0:
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        return jax.random.categorical(
+            rng, logits / temperature, axis=-1).astype(jnp.int32)
+
+    def gen(prompt_ids, max_new: int, temperature: float = 0.0,
+            rng=None):
+        """temperature 0 = greedy (deterministic); > 0 samples from the
+        softmax at that temperature (pass ``rng`` for reproducibility)."""
         s = prompt_ids.shape[1]
         if s + max_new > cfg.max_seq:
             raise ValueError(
                 f"prompt {s} + max_new {max_new} exceeds max_seq "
                 f"{cfg.max_seq} (the cache would silently wrap)")
+        if temperature > 0.0 and rng is None:
+            raise ValueError(
+                "temperature > 0 requires an rng key (a silent default "
+                "would make every sampled completion identical)")
         cache, logits = prefill_j(params, prompt_ids)
         out = []
-        token = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-        out.append(token)
-        for _ in range(max_new - 1):     # the last emitted token needs
-            cache, logits = step_j(cache, token)   # no further step
-            token = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        for i in range(max_new):
+            if temperature > 0.0:
+                rng, sub = jax.random.split(rng)
+            else:
+                sub = None
+            token = pick(logits, temperature, sub)
             out.append(token)
+            if i < max_new - 1:          # the last emitted token needs
+                cache, logits = step_j(cache, token)   # no further step
         return jnp.stack(out, axis=1)
 
     return gen
