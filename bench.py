@@ -416,22 +416,87 @@ def bench_device_compute(extra: dict) -> None:
     extra["lm_train_tokens_per_s"] = round(ids.size * N / best, 0)
 
 
-def main() -> None:
+def _device_section_worker(which: str, label: str, q) -> None:
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
     extra: dict = {}
     try:
-        # first: device compute wants the host un-throttled (dispatch
-        # happens on the single host core; the RPC sections burn its
-        # cgroup quota)
-        bench_device_compute(extra)
+        if which == "compute":
+            bench_device_compute(extra)
+        else:
+            bench_device_echo(extra)
     except Exception as e:
-        extra["compute_error"] = f"{type(e).__name__}: {e}"
-    headline = bench_headline_and_sweep(extra)
-    bench_streaming(extra)
-    bench_fanout(extra)
-    try:
-        bench_device_echo(extra)
-    except Exception as e:            # device bench must not sink the run
-        extra["ici_error"] = f"{type(e).__name__}: {e}"
+        extra[f"{label}_error"] = f"{type(e).__name__}: {e}"[:160]
+    q.put(extra)
+
+
+def _run_device_section(which: str, label: str, timeout_s: float,
+                        extra: dict) -> None:
+    """Device-touching sections run in a CHILD process with a hard kill
+    timeout: the tunneled chip has been seen to stall for minutes, and a
+    wedged device call cannot be preempted in-process — but the bench
+    must always print its JSON line."""
+    import queue as _queue
+
+    ctx = mp.get_context("spawn")
+    q = ctx.Queue()
+    p = ctx.Process(target=_device_section_worker, args=(which, label, q))
+    p.start()
+    deadline = time.time() + timeout_s
+    got = False
+    while time.time() < deadline:
+        try:
+            # short poll: a child that DIED without reporting (OOM kill,
+            # segfault in the device stack) must not eat the full budget
+            extra.update(q.get(timeout=2.0))
+            got = True
+            break
+        except _queue.Empty:
+            if not p.is_alive():
+                break
+    if not got:
+        why = ("died without result" if not p.is_alive()
+               else f"no result within {timeout_s:.0f}s")
+        extra[f"{label}_skipped"] = why
+    if p.is_alive():
+        p.terminate()
+    p.join(10)
+    if p.is_alive():
+        # SIGTERM-resistant (wedged in a native device call): SIGKILL,
+        # or the interpreter's exit joins would hang the whole bench
+        p.kill()
+        p.join(10)
+
+
+def main() -> None:
+    extra: dict = {}
+    # hard internal budget: a throttled window can stretch sections into
+    # minutes; the run must ALWAYS print its JSON before any outer
+    # timeout, so optional sections are skipped once the budget is spent
+    deadline = time.time() + float(os.environ.get("BENCH_BUDGET_S", 420))
+
+    def budget_left() -> bool:
+        return time.time() < deadline
+
+    # first: device compute wants the host un-throttled (dispatch
+    # happens on the single host core; the RPC sections burn its
+    # cgroup quota).  Child process + kill timeout: a stalled tunnel
+    # must not take the whole bench down with it.
+    _run_device_section("compute", "compute",
+                        min(240.0, deadline - time.time()), extra)
+    headline = bench_headline_and_sweep(extra)     # the metric: always
+    for name, fn in (("streaming", bench_streaming),
+                     ("fanout", bench_fanout)):
+        if not budget_left():
+            extra[f"{name}_skipped"] = "bench budget spent"
+            continue
+        fn(extra)
+    if budget_left():
+        # cap by the remaining budget: overshooting the deadline would
+        # defeat the always-print guarantee
+        _run_device_section("echo", "ici",
+                            min(150.0, deadline - time.time()), extra)
+    else:
+        extra["ici_skipped"] = "bench budget spent"
     print(json.dumps({
         "metric": "echo_1mb_attachment_throughput",
         "value": round(headline, 3),
