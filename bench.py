@@ -31,6 +31,8 @@ BASELINE_GBPS = 2.3
 HEADLINE_PAYLOAD = 1 << 20
 HEADLINE_SECONDS = 4.0
 HEADLINE_PROCS = 2
+WALL_CAP_S = 20.0      # per-measurement wall cap: failing calls each
+                       # burn their timeout; a window must never spiral
 
 
 def _echo_worker(addr: str, payload: int, seconds: float, q) -> None:
@@ -106,9 +108,25 @@ def bench_headline_and_sweep(extra: dict) -> float:
                          for _ in range(nprocs)]
                 for p in procs:
                     p.start()
-                results = [q.get() for _ in procs]
+                results = []
+                # ONE shared deadline for the whole window — a wedged
+                # run costs at most this, not nprocs x timeout
+                qdl = time.perf_counter() + HEADLINE_SECONDS * 5 + 60
+                for _ in procs:
+                    try:
+                        results.append(q.get(
+                            timeout=max(0.1, qdl - time.perf_counter())))
+                    except Exception:
+                        pass
+                if len(results) < nprocs:
+                    # fewer workers reported than the label claims:
+                    # record it rather than silently skewing the sweep
+                    extra[f"echo_1mb_{nprocs}proc_missing"] = \
+                        nprocs - len(results)
                 for p in procs:
-                    p.join()
+                    if p.is_alive():
+                        p.terminate()
+                    p.join(10)
                 gbps = sum(n * HEADLINE_PAYLOAD * 2 / dt / 1e9
                            for n, dt in results)
                 best = max(best, gbps)
@@ -142,6 +160,8 @@ def bench_headline_and_sweep(extra: dict) -> float:
                 c = ch.call_method("Bench.Echo", b"", cntl=cntl)
                 if not c.failed:
                     done += 1
+                if time.perf_counter() - t0 > WALL_CAP_S:
+                    break
             dt = time.perf_counter() - t0
             return done * size * 2 / dt / 1e9, done / dt
 
@@ -182,6 +202,7 @@ def bench_headline_and_sweep(extra: dict) -> float:
         best_p50, best_p99 = float("inf"), float("inf")
         for _window in range(2):
             lats = []
+            w0 = time.perf_counter()
             for _ in range(1500):
                 cntl = Controller()
                 cntl.timeout_ms = 10_000
@@ -190,6 +211,8 @@ def bench_headline_and_sweep(extra: dict) -> float:
                 c = ch.call_method("Bench.Echo", b"", cntl=cntl)
                 if not c.failed:
                     lats.append((time.perf_counter() - t0) * 1e6)
+                if time.perf_counter() - w0 > WALL_CAP_S:
+                    break
             if not lats:
                 continue     # whole window failed: never index empty
             lats.sort()
