@@ -256,6 +256,16 @@ class IOBuf:
         self._refs.append([blk, 0, n])
         self._size += n
 
+    def prepend_user_data(self, data) -> None:
+        """Zero-copy attach of an external buffer at the FRONT (control
+        frames piggybacking ahead of a queued payload frame)."""
+        n = len(data)
+        if n == 0:
+            return
+        blk = Block(data, n, None)
+        self._refs.appendleft([blk, 0, n])
+        self._size += n
+
     def append_iobuf(self, other: "IOBuf") -> None:
         """Share other's refs — O(#blocks), zero payload copies."""
         for blk, off, ln in other._refs:

@@ -35,12 +35,15 @@ from ..transport.socket import Socket
 from ..transport.socket_map import (pooled_socket, return_pooled_socket,
                                     short_socket)
 
-from ..protocol.meta import (RpcMeta, TAG_AUTH, TAG_ICI_DOMAIN, TAG_METHOD,
+from ..protocol.meta import (RpcMeta, TAG_AUTH, TAG_ICI_DESC,
+                             TAG_ICI_DOMAIN, TAG_METHOD,
                              TAG_SERVICE, TLV_ATTACHMENT, TLV_CORRELATION,
                              TLV_SPAN, TLV_TIMEOUT, TLV_TRACE, encode_tlv)
 from ..protocol.tpu_std import parse_payload, serialize_payload
-from ..ici.endpoint import (ici_enabled as _ici_enabled,
+from ..ici.endpoint import (_process_ack as _ici_process_ack,
+                            ici_enabled as _ici_enabled,
                             local_domain_id as _local_domain_id,
+                            prepare_send as _ici_prepare_send,
                             split_device_attachment as _split_device_att)
 
 _MAGIC = b"TRPC"
@@ -101,7 +104,6 @@ def eligible(channel, cntl) -> bool:
             and not opts.ssl and opts.ssl_context is None
             and ctype in ("pooled", "short")
             and not cntl.request_compress_type
-            and cntl.request_device_attachment is None
             and cntl._stream_to_create is None
             and (cntl.backup_request_ms is None
                  or cntl.backup_request_ms <= 0)
@@ -110,8 +112,11 @@ def eligible(channel, cntl) -> bool:
 
 
 def _py_sync_call(sock, frame: bytes,
-                  timeout_s: float) -> Tuple[memoryview, int]:
-    """Python fallback for native sync_call: same single-frame contract."""
+                  timeout_s: float) -> Tuple[memoryview, int, tuple]:
+    """Python fallback for native sync_call: one response frame, with
+    TICI credit-return frames (acks for device descriptors this request
+    carried; the server redeems in-handler so its ack precedes the
+    response) consumed along the way and returned as the third element."""
     import time as _time
     deadline = _time.monotonic() + timeout_s if timeout_s >= 0 else None
     fd = sock.fd
@@ -126,9 +131,68 @@ def _py_sync_call(sock, frame: bytes,
                 raise TimeoutError("rpc deadline exceeded")
             _select.select([], [fd], [], left)
     buf = bytearray()
-    need = 12
-    body = meta = 0
+    acks: list = []
     while True:
+        # drain everything already buffered before blocking again
+        while True:
+            if len(buf) >= 8 and buf[:4] == b"TICI":
+                (cnt,) = struct.unpack_from("<I", buf, 4)
+                if cnt > 1 << 20:
+                    raise ValueError("oversized ack frame")
+                total = 8 + 8 * cnt
+                if len(buf) < total:
+                    break
+                acks.extend(struct.unpack_from(f"<{cnt}Q", buf, 8))
+                del buf[:total]
+                continue
+            if len(buf) >= 12:
+                if buf[:4] != _MAGIC:
+                    raise ValueError("unexpected magic on fast-path read")
+                body, meta = struct.unpack_from("<II", buf, 4)
+                if meta > body:
+                    raise ValueError("bad frame sizes")
+                if len(buf) >= 12 + body:
+                    # drain any trailing TICI frames the greedy recv
+                    # pulled in (acks a lazy redeem sent after the
+                    # response) — dropping them would desync the
+                    # stream.  The response is complete: grace the
+                    # deadline for ack bytes already in flight.
+                    tdl = None if deadline is None \
+                        else max(deadline, _time.monotonic() + 2.0)
+                    off = 12 + body
+                    while True:
+                        avail = len(buf) - off
+                        if avail == 0:
+                            break
+                        if avail >= 4 and buf[off:off + 4] != b"TICI":
+                            raise ValueError(
+                                "unexpected trailing bytes after response")
+                        if avail >= 8:
+                            (cnt,) = struct.unpack_from("<I", buf, off + 4)
+                            if cnt > 1 << 20:
+                                raise ValueError("oversized ack frame")
+                            total = 8 + 8 * cnt
+                            if avail >= total:
+                                acks.extend(struct.unpack_from(
+                                    f"<{cnt}Q", buf, off + 8))
+                                off += total
+                                continue
+                        # partial trailing ack frame: finish reading it
+                        left = None if tdl is None \
+                            else tdl - _time.monotonic()
+                        if left is not None and left <= 0:
+                            raise TimeoutError("rpc deadline exceeded")
+                        r, _, _ = _select.select([fd], [], [], left)
+                        if not r:
+                            raise TimeoutError("rpc deadline exceeded")
+                        chunk = fd.recv(65536)
+                        if not chunk:
+                            raise ConnectionError(
+                                "connection closed mid-ack")
+                        buf += chunk
+                    return (memoryview(buf)[12:12 + body], meta,
+                            tuple(acks))
+            break
         left = None if deadline is None else deadline - _time.monotonic()
         if left is not None and left <= 0:
             raise TimeoutError("rpc deadline exceeded")
@@ -136,21 +200,12 @@ def _py_sync_call(sock, frame: bytes,
         if not r:
             raise TimeoutError("rpc deadline exceeded")
         try:
-            chunk = fd.recv(65536 if need <= 65536 else need)
+            chunk = fd.recv(65536)
         except BlockingIOError:
             continue
         if not chunk:
             raise ConnectionError("connection closed by peer")
         buf += chunk
-        if body == 0 and len(buf) >= 12:
-            if buf[:4] != _MAGIC:
-                raise ValueError("unexpected magic on fast-path read")
-            body, meta = struct.unpack_from("<II", buf, 4)
-            if meta > body:
-                raise ValueError("bad frame sizes")
-            need = 12 + body
-        if body and len(buf) >= 12 + body:
-            return memoryview(buf)[12:12 + body], meta
 
 
 def run(channel, cntl, method_full: str, request: Any,
@@ -214,10 +269,12 @@ def run(channel, cntl, method_full: str, request: Any,
             code, text = int(Errno.EFAILEDSOCKET), f"connect to {remote} failed"
         elif sock.fd is None and sock.connect_if_not() != 0:
             code, text = int(Errno.EFAILEDSOCKET), f"connect to {remote} failed"
-        elif not sock.direct_read or not sock.read_portal.empty():
+        elif not sock.direct_read or not sock.read_portal.empty() \
+                or not sock.write_path_idle():
             # converted to dispatcher-managed reads (an async call used
-            # it) or carrying buffered bytes: this lane cannot own the
-            # reads — route the call through the full state machine
+            # it), carrying buffered bytes, or a queued write (ack
+            # flush) still draining: this lane cannot own the fd —
+            # route the call through the full state machine
             if sock is not None:
                 if pooled:
                     return_pooled_socket(sid)
@@ -227,12 +284,39 @@ def run(channel, cntl, method_full: str, request: Any,
             return
 
         if code == 0:
+            # device attachment: post to the window per attempt; the
+            # descriptor TLV rides the frame, an inline tail (host-staged
+            # fallback) extends the attachment region
+            a_len, a_parts = att_len, att_parts
+            dev_desc = b""
+            if cntl.request_device_attachment is not None:
+                post_timeout = 30.0 if deadline_us is None else max(
+                    0.001, (deadline_us - _mono_ns() // 1000) / 1e6)
+                m = RpcMeta()
+                try:
+                    tail = _ici_prepare_send(
+                        sock, m, cntl.request_device_attachment,
+                        timeout_s=post_timeout)
+                except RuntimeError as e:
+                    if pooled:
+                        return_pooled_socket(sid)
+                    else:
+                        sock.release()
+                    _finish(channel, cntl, Errno.EOVERCROWDED, str(e))
+                    return
+                dev_desc = m.ici_desc
+                if tail is not None:
+                    tb = tail.to_bytes()
+                    a_parts = a_parts + (tb,)
+                    a_len += len(tb)
             cid = _next_cid()
             mb = bytearray(_CID_TAG)
             mb += struct.pack("<Q", cid)
-            if att_len:
-                mb += _ATT_TAG + struct.pack("<I", att_len)
+            if a_len:
+                mb += _ATT_TAG + struct.pack("<I", a_len)
             mb += method_tlvs
+            if dev_desc:
+                mb += encode_tlv(TAG_ICI_DESC, dev_desc)
             if auth and getattr(sock, "app_data", None) is None:
                 mb += encode_tlv(TAG_AUTH, auth)
                 sock.app_data = "authed"
@@ -247,21 +331,34 @@ def run(channel, cntl, method_full: str, request: Any,
             if cntl.span_id:
                 mb += TLV_SPAN + struct.pack("<Q", cntl.span_id)
             header = _MAGIC + struct.pack(
-                "<II", len(mb) + len(payload_b) + att_len, len(mb))
+                "<II", len(mb) + len(payload_b) + a_len, len(mb))
             timeout_s = -1.0 if deadline_us is None \
                 else max(0.001, (deadline_us - _mono_ns() // 1000) / 1e6)
+            # acks this side owes from earlier redemptions on this
+            # connection ride in front of the request (we own the fd —
+            # the only safe writer for a direct-read socket)
+            ack0 = sock._take_ack_frame() if sock._pending_acks else None
+            head_parts = (ack0, header) if ack0 is not None else (header,)
             try:
                 if nat is not None:
-                    buf, meta_size = nat.sync_call(
+                    res = nat.sync_call(
                         sock.fd.fileno(),
-                        (header, bytes(mb), payload_b) + att_parts,
+                        head_parts + (bytes(mb), payload_b) + a_parts,
                         timeout_s)
                 else:
-                    buf, meta_size = _py_sync_call(
+                    res = _py_sync_call(
                         sock,
-                        b"".join((header, bytes(mb), payload_b, *att_parts)),
+                        b"".join(head_parts + (bytes(mb), payload_b)
+                                 + a_parts),
                         timeout_s)
+                buf, meta_size = res[0], res[1]
+                if len(res) > 2 and res[2]:
+                    _ici_process_ack(res[2], sock)   # window credit back
             except TimeoutError:
+                # the posted descriptor is NOT released: the request
+                # usually reached the server, whose in-flight handler
+                # may still redeem it — settle/TTL own reclamation
+                # (same semantics as the Controller slow path)
                 sock.set_failed(Errno.ERPCTIMEDOUT, "rpc timeout")
                 sock.release()
                 _finish(channel, cntl, Errno.ERPCTIMEDOUT,
@@ -384,6 +481,8 @@ def run_scatter(branches, timeout_ms: Optional[int]) -> bool:
     for channel, cntl, _m, request, _r in branches:
         if not eligible(channel, cntl) or channel.load_balancer is not None:
             return False
+        if cntl.request_device_attachment is not None:
+            return False      # scatter frames carry no descriptor logic
         if not isinstance(request, (bytes, bytearray, memoryview)):
             return False
     inflight = []      # (channel, cntl, sock, sid, cid, response_type)
@@ -401,7 +500,8 @@ def run_scatter(branches, timeout_ms: Optional[int]) -> bool:
         sock = Socket.address(sid)
         if sock is None or (rc != 0 and sock.failed) \
                 or (sock.fd is None and sock.connect_if_not() != 0) \
-                or not sock.direct_read or not sock.read_portal.empty():
+                or not sock.direct_read or not sock.read_portal.empty() \
+                or not sock.write_path_idle():
             if sock is not None:
                 sock.release()
             _finish(channel, cntl, Errno.EFAILEDSOCKET,
@@ -418,6 +518,9 @@ def run_scatter(branches, timeout_ms: Optional[int]) -> bool:
         frame = (_MAGIC
                  + struct.pack("<II", len(mb) + len(request), len(mb))
                  + mb + request)
+        ack0 = sock._take_ack_frame() if sock._pending_acks else None
+        if ack0 is not None:
+            frame = ack0 + frame
         try:
             _send_all(sock, frame, (cntl.timeout_ms or 1000) / 1e3)
         except (OSError, TimeoutError) as e:
@@ -433,10 +536,12 @@ def run_scatter(branches, timeout_ms: Optional[int]) -> bool:
                         - (monotonic_us() - cntl._begin_us) / 1e6)
         try:
             if nat is not None:
-                buf, meta_size = nat.sync_call(sock.fd.fileno(), (),
-                                               timeout_s)
+                res = nat.sync_call(sock.fd.fileno(), (), timeout_s)
             else:
-                buf, meta_size = _py_sync_call(sock, b"", timeout_s)
+                res = _py_sync_call(sock, b"", timeout_s)
+            buf, meta_size = res[0], res[1]
+            if len(res) > 2 and res[2]:
+                _ici_process_ack(res[2], sock)
         except TimeoutError:
             sock.set_failed(Errno.ERPCTIMEDOUT, "rpc timeout")
             sock.release()
@@ -501,7 +606,8 @@ def run_batch(channel, method_full: str, requests, response_type: Any,
             sock.release()
         raise RpcError(int(Errno.EFAILEDSOCKET),
                        f"connect to {remote} failed")
-    if not sock.direct_read or not sock.read_portal.empty():
+    if not sock.direct_read or not sock.read_portal.empty() \
+            or not sock.write_path_idle():
         return_pooled_socket(sid)
         return [channel.call(method_full, r, response_type,
                              timeout_ms=timeout_ms) for r in requests]
@@ -532,16 +638,26 @@ def run_batch(channel, method_full: str, requests, response_type: Any,
         parts.append(pb)
     timeout_s = timeout_ms / 1e3 if timeout_ms and timeout_ms > 0 else -1.0
     nat = _native()
+    ack0 = sock._take_ack_frame() if sock._pending_acks else None
     try:
         if nat is not None:
-            frames = nat.sync_call_many(sock.fd.fileno(), parts,
+            wire = [ack0] + parts if ack0 is not None else parts
+            frames = nat.sync_call_many(sock.fd.fileno(), wire,
                                         len(cids), timeout_s)
+            if isinstance(frames, tuple):     # (frames, interleaved acks)
+                frames, batch_acks = frames
+                _ici_process_ack(batch_acks, sock)
         else:
             frames = []
             it = iter(range(len(cids)))
             for i in it:
-                frames.append(_py_sync_call(
-                    sock, parts[2 * i] + parts[2 * i + 1], timeout_s))
+                head = parts[2 * i] if i or ack0 is None \
+                    else ack0 + parts[0]
+                view, msize, acks = _py_sync_call(
+                    sock, head + parts[2 * i + 1], timeout_s)
+                if acks:
+                    _ici_process_ack(acks, sock)
+                frames.append((view, msize))
     except (TimeoutError, ConnectionError, ValueError, OSError) as e:
         sock.set_failed(Errno.EFAILEDSOCKET, str(e))
         sock.release()

@@ -97,6 +97,18 @@ class DeviceAttachment:
         import numpy as np
         return np.asarray(self.tensor())
 
+    def settle(self) -> None:
+        """Ack the poster NOW if the attachment was never redeemed.
+        The server calls this right before writing the response so the
+        credit-return frame always PRECEDES the response on the wire —
+        the invariant the client's sync fast lane relies on.  Handlers
+        must redeem (``tensor()``) before finishing the RPC; a handle
+        kept past the response is settled here and redeems no more."""
+        if self.kind in (KIND_INPROC, KIND_TRANSFER) and not self._redeemed:
+            self._redeemed = True
+            from .endpoint import _send_ack
+            _send_ack(self._socket_id, (self.desc_id,))
+
     def __del__(self):
         # dropped without redemption (user ignored the attachment):
         # return the poster's window credit instead of pinning it until

@@ -867,6 +867,27 @@ static int64_t now_ms() {
   return (int64_t)ts.tv_sec * 1000 + ts.tv_nsec / 1000000;
 }
 
+// one recv into buf[*got..cap], blocking on the deadline when the socket
+// is dry.  Returns 0 ok (>=1 byte appended), 1 timeout, 2 conn error.
+static int wait_fd(int fd, short events, int64_t deadline_ms);
+static int recv_more(int fd, char* buf, size_t* got, size_t cap,
+                     int64_t deadline, char* errbuf, size_t errcap) {
+  for (;;) {
+    ssize_t r = recv(fd, buf + *got, cap - *got, 0);
+    if (r > 0) { *got += (size_t)r; return 0; }
+    if (r == 0) { snprintf(errbuf, errcap, "connection closed by peer"); return 2; }
+    if (errno == EAGAIN || errno == EWOULDBLOCK) {
+      int pr = wait_fd(fd, POLLIN, deadline);
+      if (pr == 0) return 1;
+      if (pr < 0) { snprintf(errbuf, errcap, "poll: %s", strerror(errno)); return 2; }
+      continue;
+    }
+    if (errno == EINTR) continue;
+    snprintf(errbuf, errcap, "read: %s", strerror(errno));
+    return 2;
+  }
+}
+
 // poll helper honoring an absolute deadline (ms, CLOCK_MONOTONIC); -1 = none
 static int wait_fd(int fd, short events, int64_t deadline_ms) {
   struct pollfd p;
@@ -926,6 +947,7 @@ static PyObject* sync_call(PyObject*, PyObject* args) {
   size_t got = 0;
   uint32_t body = 0, meta = 0;
   NativeBuf* out = nullptr;
+  std::vector<uint64_t> ack_vec;  // TICI credit-returns around the response
 
   Py_BEGIN_ALLOW_THREADS;
   struct iovec iov[62];
@@ -964,27 +986,42 @@ static PyObject* sync_call(PyObject*, PyObject* args) {
   }
   // phase 2: greedy read — header + (usually the whole small frame) land
   // in one recv into the scratch buffer.  Safe on this exclusive
-  // connection: exactly one response is outstanding and nothing else
-  // (no acks, streams, or pushes in the fast lane) can follow it until
-  // the next request is written.
-  while (!err && got < kHeaderSize) {
-    ssize_t r = recv(fd, scratch + got, sizeof scratch - got, 0);
-    if (r == 0) { err = 2; snprintf(errbuf, sizeof errbuf, "connection closed by peer"); break; }
-    if (r < 0) {
-      if (errno == EAGAIN || errno == EWOULDBLOCK) {
-        int pr = wait_fd(fd, POLLIN, deadline);
-        if (pr == 0) err = 1;
-        else if (pr < 0) { err = 2; snprintf(errbuf, sizeof errbuf, "poll: %s", strerror(errno)); }
-        continue;
+  // connection: exactly one response is outstanding; the only frames
+  // that may precede it are TICI credit-returns for device descriptors
+  // this request carried (the server redeems in-handler, so its ack
+  // piggybacks in front of the response) — consume those and hand the
+  // ids back to Python for window release.
+  while (!err) {
+    while (!err && got < 8)
+      err = recv_more(fd, scratch, &got, sizeof scratch, deadline,
+                      errbuf, sizeof errbuf);
+    if (err) break;
+    if (memcmp(scratch, "TICI", 4) == 0) {
+      uint32_t cnt = 0;
+      memcpy(&cnt, scratch + 4, 4);
+      size_t total = 8 + 8ul * cnt;
+      if (cnt > 8000 || total > sizeof scratch) {
+        err = 3;
+        snprintf(errbuf, sizeof errbuf, "oversized ack frame cnt=%u", cnt);
+        break;
       }
-      if (errno == EINTR) continue;
-      err = 2;
-      snprintf(errbuf, sizeof errbuf, "read: %s", strerror(errno));
-      break;
+      while (!err && got < total)
+        err = recv_more(fd, scratch, &got, sizeof scratch, deadline,
+                        errbuf, sizeof errbuf);
+      if (err) break;
+      for (uint32_t i = 0; i < cnt; i++) {
+        uint64_t id;
+        memcpy(&id, scratch + 8 + 8ul * i, 8);
+        ack_vec.push_back(id);
+      }
+      memmove(scratch, scratch + total, got - total);
+      got -= total;
+      continue;
     }
-    got += (size_t)r;
-  }
-  if (!err) {
+    while (!err && got < kHeaderSize)
+      err = recv_more(fd, scratch, &got, sizeof scratch, deadline,
+                      errbuf, sizeof errbuf);
+    if (err) break;
     memcpy(header, scratch, kHeaderSize);
     if (memcmp(header, "TRPC", 4) != 0) {
       err = 3;
@@ -997,6 +1034,7 @@ static PyObject* sync_call(PyObject*, PyObject* args) {
         snprintf(errbuf, sizeof errbuf, "bad frame sizes body=%u meta=%u", body, meta);
       }
     }
+    break;
   }
   Py_END_ALLOW_THREADS;
 
@@ -1008,7 +1046,7 @@ static PyObject* sync_call(PyObject*, PyObject* args) {
       return nullptr;
     }
     size_t have = got - kHeaderSize;         // surplus from the greedy read
-    if (have > (size_t)body) have = body;    // (cannot happen; defensive)
+    if (have > (size_t)body) have = body;
     if (have) memcpy(out->data, scratch + kHeaderSize, have);
     Py_BEGIN_ALLOW_THREADS;
     size_t filled = have;
@@ -1029,6 +1067,53 @@ static PyObject* sync_call(PyObject*, PyObject* args) {
       }
       filled += (size_t)r;
     }
+    // trailing TICI frames the greedy read pulled in past the response
+    // (acks from a lazy redeem): drain to a frame boundary — silently
+    // dropping them would leak window credit AND desync the next call.
+    // The response is already complete here, so a nearly-expired RPC
+    // deadline must not fail the call over bytes already in flight:
+    // allow a small grace window to finish a partial ack frame.
+    size_t tail_off = kHeaderSize + (size_t)body;
+    if (!err && got > tail_off) {
+      int64_t tdl = deadline;
+      if (tdl >= 0) {
+        int64_t grace = now_ms() + 2000;
+        if (tdl < grace) tdl = grace;
+      }
+      size_t tgot = got - tail_off;
+      memmove(scratch, scratch + tail_off, tgot);
+      while (!err && tgot > 0) {
+        while (!err && tgot < 8)
+          err = recv_more(fd, scratch, &tgot, sizeof scratch, tdl,
+                          errbuf, sizeof errbuf);
+        if (err) break;
+        if (memcmp(scratch, "TICI", 4) != 0) {
+          err = 3;
+          snprintf(errbuf, sizeof errbuf,
+                   "unexpected trailing bytes after response");
+          break;
+        }
+        uint32_t cnt = 0;
+        memcpy(&cnt, scratch + 4, 4);
+        size_t total = 8 + 8ul * cnt;
+        if (cnt > 8000 || total > sizeof scratch) {
+          err = 3;
+          snprintf(errbuf, sizeof errbuf, "oversized ack frame cnt=%u", cnt);
+          break;
+        }
+        while (!err && tgot < total)
+          err = recv_more(fd, scratch, &tgot, sizeof scratch, tdl,
+                          errbuf, sizeof errbuf);
+        if (err) break;
+        for (uint32_t i = 0; i < cnt; i++) {
+          uint64_t id;
+          memcpy(&id, scratch + 8 + 8ul * i, 8);
+          ack_vec.push_back(id);
+        }
+        memmove(scratch, scratch + total, tgot - total);
+        tgot -= total;
+      }
+    }
     Py_END_ALLOW_THREADS;
   }
 
@@ -1043,6 +1128,14 @@ static PyObject* sync_call(PyObject*, PyObject* args) {
     else
       PyErr_SetString(PyExc_ValueError, errbuf);
     return nullptr;
+  }
+  if (!ack_vec.empty()) {
+    PyObject* acks = PyList_New((Py_ssize_t)ack_vec.size());
+    if (!acks) { Py_DECREF((PyObject*)out); return nullptr; }
+    for (size_t i = 0; i < ack_vec.size(); i++)
+      PyList_SET_ITEM(acks, (Py_ssize_t)i,
+                      PyLong_FromUnsignedLongLong(ack_vec[i]));
+    return Py_BuildValue("(NkN)", (PyObject*)out, (unsigned long)meta, acks);
   }
   PyObject* tup = Py_BuildValue("(Nk)", (PyObject*)out, (unsigned long)meta);
   return tup;
@@ -1133,15 +1226,38 @@ static PyObject* sync_call_many(PyObject*, PyObject* args) {
     // a single GIL section.
     std::vector<char> acc;
     acc.reserve(1 << 20);
+    std::vector<size_t> offs;       // start offsets of TRPC frames in acc
+    offs.reserve((size_t)expect);
+    std::vector<uint64_t> batch_acks;  // TICI ids interleaved in the batch
     size_t scanned = 0;   // prefix covered by complete frames
     int found = 0;
     Py_BEGIN_ALLOW_THREADS;
     while (found < expect && !err) {
-      // scan newly complete frames
+      // scan newly complete frames (TICI credit-returns may interleave
+      // when pipelined calls carry device descriptors — collect, skip)
       for (;;) {
         size_t avail = acc.size() - scanned;
-        if (avail < kHeaderSize) break;
+        if (avail < 8) break;
         const char* p = acc.data() + scanned;
+        if (memcmp(p, "TICI", 4) == 0) {
+          uint32_t cnt = 0;
+          memcpy(&cnt, p + 4, 4);
+          size_t total = 8 + 8ul * cnt;
+          if (cnt > 8000) {
+            err = 3;
+            snprintf(errbuf, sizeof errbuf, "oversized ack frame cnt=%u", cnt);
+            break;
+          }
+          if (avail < total) break;
+          for (uint32_t i = 0; i < cnt; i++) {
+            uint64_t id;
+            memcpy(&id, p + 8 + 8ul * i, 8);
+            batch_acks.push_back(id);
+          }
+          scanned += total;
+          continue;
+        }
+        if (avail < kHeaderSize) break;
         if (memcmp(p, "TRPC", 4) != 0) {
           err = 3;
           snprintf(errbuf, sizeof errbuf, "unexpected magic in batch read");
@@ -1157,6 +1273,7 @@ static PyObject* sync_call_many(PyObject*, PyObject* args) {
           break;
         }
         if (avail < kHeaderSize + (size_t)body) break;
+        offs.push_back(scanned);
         scanned += kHeaderSize + body;
         if (++found >= expect) break;
       }
@@ -1178,24 +1295,80 @@ static PyObject* sync_call_many(PyObject*, PyObject* args) {
       }
       acc.insert(acc.end(), tmp, tmp + r);
     }
+    // trailing bytes past the last expected response can only be TICI
+    // credit-returns — drain to a frame boundary (a partial ack frame
+    // left unread would desync the connection's next reader).  All
+    // responses are in hand: grace the deadline for in-flight bytes.
+    int64_t tdl = deadline;
+    if (tdl >= 0) {
+      int64_t grace = now_ms() + 2000;
+      if (tdl < grace) tdl = grace;
+    }
+    while (!err && scanned < acc.size()) {
+      size_t avail = acc.size() - scanned;
+      const char* p = acc.data() + scanned;
+      if (avail >= 4 && memcmp(p, "TICI", 4) != 0) {
+        err = 3;
+        snprintf(errbuf, sizeof errbuf, "unexpected trailing bytes in batch read");
+        break;
+      }
+      if (avail >= 8) {
+        uint32_t cnt = 0;
+        memcpy(&cnt, p + 4, 4);
+        if (cnt > 8000) {
+          err = 3;
+          snprintf(errbuf, sizeof errbuf, "oversized ack frame cnt=%u", cnt);
+          break;
+        }
+        size_t total = 8 + 8ul * cnt;
+        if (avail >= total) {
+          for (uint32_t i = 0; i < cnt; i++) {
+            uint64_t id;
+            memcpy(&id, p + 8 + 8ul * i, 8);
+            batch_acks.push_back(id);
+          }
+          scanned += total;
+          continue;
+        }
+      }
+      char tmp2[4096];
+      ssize_t r = recv(fd, tmp2, sizeof tmp2, 0);
+      if (r > 0) { acc.insert(acc.end(), tmp2, tmp2 + r); continue; }
+      if (r == 0) { err = 2; snprintf(errbuf, sizeof errbuf, "connection closed mid-ack"); break; }
+      if (errno == EAGAIN || errno == EWOULDBLOCK) {
+        int pr = wait_fd(fd, POLLIN, tdl);
+        if (pr == 0) err = 1;
+        else if (pr < 0) { err = 2; snprintf(errbuf, sizeof errbuf, "poll: %s", strerror(errno)); }
+        continue;
+      }
+      if (errno == EINTR) continue;
+      err = 2;
+      snprintf(errbuf, sizeof errbuf, "read: %s", strerror(errno));
+    }
     Py_END_ALLOW_THREADS;
     if (!err) {
       PyObject* out_list = PyList_New(expect);
       if (!out_list) return nullptr;
-      size_t off = 0;
       for (int k = 0; k < expect; k++) {
-        const char* p = acc.data() + off;
+        const char* p = acc.data() + offs[(size_t)k];
         uint32_t body = 0, meta = 0;
         memcpy(&body, p + 4, 4);
         memcpy(&meta, p + 8, 4);
         NativeBuf* b = nativebuf_new((Py_ssize_t)body);
         if (!b) { Py_DECREF(out_list); return nullptr; }
         memcpy(b->data, p + kHeaderSize, body);
-        off += kHeaderSize + body;
         PyObject* tup = Py_BuildValue("(Nk)", (PyObject*)b,
                                       (unsigned long)meta);
         if (!tup) { Py_DECREF(out_list); return nullptr; }
         PyList_SET_ITEM(out_list, k, tup);
+      }
+      if (!batch_acks.empty()) {
+        PyObject* acks = PyList_New((Py_ssize_t)batch_acks.size());
+        if (!acks) { Py_DECREF(out_list); return nullptr; }
+        for (size_t i = 0; i < batch_acks.size(); i++)
+          PyList_SET_ITEM(acks, (Py_ssize_t)i,
+                          PyLong_FromUnsignedLongLong(batch_acks[i]));
+        return Py_BuildValue("(NN)", out_list, acks);
       }
       return out_list;
     }

@@ -58,6 +58,7 @@ TAG_SERVICE = _T_SERVICE
 TAG_METHOD = _T_METHOD
 TAG_AUTH = _T_AUTH
 TAG_ICI_DOMAIN = _T_ICI_DOMAIN
+TAG_ICI_DESC = _T_ICI_DESC
 
 
 class RpcMeta:

@@ -67,6 +67,13 @@ def _send_response(server, entry, cntl: ServerController,
     latency_us = _mono_ns() // 1000 - cntl.begin_time_us
     entry.status.on_responded(cntl.error_code, latency_us)
     server.on_request_out()
+    if cntl.request_device_attachment is not None:
+        # invariant the client's sync fast lane relies on: the credit-
+        # return for a request descriptor always PRECEDES the response
+        # on the wire.  Redeemed in-handler ⇒ the ack is already queued;
+        # never redeemed (handler ignored it / failed early) ⇒ settle
+        # acks it now.  Handlers must redeem before finishing the RPC.
+        cntl.request_device_attachment.settle()
     if cntl.span is not None:
         cntl.span.finish(cntl.error_code)
     elif (not cntl.failed and sock is not None

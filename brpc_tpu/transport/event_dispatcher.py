@@ -19,6 +19,7 @@ Fresh design notes:
 
 from __future__ import annotations
 
+import errno
 import os
 import selectors
 import socket as _socket
@@ -131,6 +132,12 @@ class EventDispatcher:
                     except (KeyError, ValueError, OSError):
                         pass
             except (ValueError, OSError) as e:
+                if isinstance(e, OSError) and e.errno == errno.EBADF:
+                    # fd closed under a queued op (socket torn down
+                    # between enqueue and apply): drop the stale
+                    # interest quietly — set_failed owns the cleanup
+                    self._interest.pop(op[1], None)
+                    continue
                 LOG.warning("dispatcher op %s failed: %s", kind, e)
 
     def _reregister(self, fd: int) -> None:

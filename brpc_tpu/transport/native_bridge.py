@@ -54,6 +54,9 @@ class NativeSocket(Socket):
                 global_id_pool().error(id_wait, code, self._error_text)
             return code
         try:
+            ack = self._take_ack_frame() if self._pending_acks else None
+            if ack is not None:
+                parts = (ack, *parts)
             self.engine.send(self.conn_id, parts)
             return 0
         except ConnectionError as e:
@@ -72,7 +75,11 @@ class NativeSocket(Socket):
                 global_id_pool().error(id_wait, code, self._error_text)
             return code
         try:
-            self.engine.send(self.conn_id, tuple(buf.backing_views()))
+            parts = tuple(buf.backing_views())
+            ack = self._take_ack_frame() if self._pending_acks else None
+            if ack is not None:
+                parts = (ack, *parts)
+            self.engine.send(self.conn_id, parts)
             return 0
         except ConnectionError as e:
             self.set_failed(Errno.EFAILEDSOCKET, str(e))

@@ -47,6 +47,23 @@ _write_errors = Adder("socket_write_error_count")
 _sockets_created = Adder("socket_count")
 
 
+def encode_ack_frame(ids) -> bytes:
+    """"TICI" credit-return frames: [TICI][u32 count][count × u64 id].
+    The single encoder for every Python-side producer (the parser lives
+    in ici/endpoint.py, the native one in native/src/engine.cpp).
+    Chunks at 4096 ids per frame — safely under the native readers'
+    8000-id sanity cap — emitting several frames back to back when a
+    burst of redemptions queued more."""
+    import struct as _struct
+    ids = list(ids)
+    out = []
+    for i in range(0, len(ids), 4096):
+        chunk = ids[i:i + 4096]
+        out.append(b"TICI" + _struct.pack("<I", len(chunk))
+                   + b"".join(_struct.pack("<Q", d) for d in chunk))
+    return b"".join(out)
+
+
 class SocketOptions:
     __slots__ = ("fd", "remote_side", "on_edge_triggered_events", "user",
                  "health_check_interval_s", "connect_timeout_s", "app_data",
@@ -94,6 +111,7 @@ class Socket:
         "stream_map", "_stream_lock", "tag",
         "ici_endpoint", "ici_peer_domain",
         "direct_read", "_dispatch_lock", "h2_conn", "ssl_context",
+        "_pending_acks", "_ack_flush_scheduled",
     )
 
     # -- lifecycle ---------------------------------------------------------
@@ -138,6 +156,8 @@ class Socket:
         self._dispatch_lock = threading.Lock()
         self.h2_conn = None               # server-side HTTP/2 session state
         self.ssl_context = None           # TLS: wrap on connect
+        self._pending_acks = []           # ICI desc ids awaiting piggyback
+        self._ack_flush_scheduled = False
 
     @staticmethod
     def create(options: SocketOptions) -> int:
@@ -297,6 +317,87 @@ class Socket:
         self.set_failed(Errno.ECLOSE, "released")
         _pool.release(self.id)
 
+    # -- ICI ack piggybacking ----------------------------------------------
+    #
+    # Redeeming a device descriptor owes the poster a "TICI" credit-return
+    # frame.  Request/response traffic means the redeemer almost always
+    # writes on this same connection within microseconds — so instead of
+    # paying a standalone write (+ an extra epoll wake at the poster) per
+    # ack, acks queue here and ride in front of the next outgoing frame.
+    # A timer flush bounds the credit-return delay when the connection
+    # goes quiet — EXCEPT on direct-read sockets, whose exclusive owner
+    # writes to the raw fd outside the write queue (sync fast lane): a
+    # timer-thread write could interleave bytes into the middle of an
+    # in-flight request frame there, so those acks wait for the owner's
+    # next call (the fast lane prepends them to its request parts) or
+    # the poster's TTL sweep — the window is 256MB, the delay harmless.
+
+    _ACK_FLUSH_DELAY_S = 0.002
+
+    def queue_ack(self, desc_ids) -> None:
+        """Queue ICI ack ids to piggyback on the next write (or a timer
+        flush).  Failed socket ⇒ drop: the poster's TTL sweep reclaims."""
+        if self._failed:
+            return
+        schedule = False
+        with self._write_lock:
+            self._pending_acks.extend(desc_ids)
+            if not self._ack_flush_scheduled:
+                self._ack_flush_scheduled = True
+                schedule = True
+        if schedule:
+            from ..fiber.timer_thread import global_timer_thread
+            global_timer_thread().schedule(self._flush_acks,
+                                           self._ACK_FLUSH_DELAY_S)
+
+    def flush_pending_acks(self) -> None:
+        """Write queued acks now.  Caller must own the connection (its
+        exclusive checkout, or a non-direct-read socket where queued
+        writes are always safe)."""
+        frame = self._take_ack_frame()
+        if frame is not None and not self._failed:
+            self.write(IOBuf(frame))
+
+    def write_path_idle(self) -> bool:
+        """True when no queued write is pending or draining — the only
+        state in which a raw-fd writer (sync fast lane) may bypass the
+        write queue without interleaving into a half-sent frame (an
+        ack flush that hit EAGAIN keeps a keep-write fiber draining
+        after the socket returns to its pool)."""
+        return not self._draining and not self._write_queue
+
+    def _take_ack_frame(self) -> Optional[bytes]:
+        """Pop queued acks as one encoded TICI frame (caller holds no
+        locks).  None when nothing is pending."""
+        with self._write_lock:
+            if not self._pending_acks:
+                return None
+            ids = self._pending_acks
+            self._pending_acks = []
+        return encode_ack_frame(ids)
+
+    def _flush_acks(self) -> None:
+        with self._write_lock:
+            self._ack_flush_scheduled = False
+        if self._failed or not self._pending_acks:
+            return
+        if not self.direct_read:
+            self.flush_pending_acks()
+            return
+        # direct-read: the exclusive owner writes to the raw fd outside
+        # the write queue, so only flush while holding the checkout —
+        # take the connection from its pool if it is idle there.  If it
+        # is checked out, the owner flushes: the fast lane prepends
+        # pending acks to its next request, and SocketPool.put flushes
+        # on return.  Short (unpooled) sockets release soon anyway —
+        # the poster's TTL sweep reclaims.
+        home = self._pooled_home
+        if home is not None and home.try_take(self.id):
+            try:
+                self.flush_pending_acks()
+            finally:
+                home.put(self.id)
+
     # -- write path --------------------------------------------------------
 
     def write(self, buf: IOBuf, id_wait: int = 0) -> int:
@@ -306,11 +407,16 @@ class Socket:
         became_drainer = False
         failed_code = 0
         epoch = 0
+        ack_frame = self._take_ack_frame() if self._pending_acks else None
         with self._write_lock:
             if self._failed:
                 failed_code = self._error_code or int(Errno.EFAILEDSOCKET)
                 failed_text = self._error_text
             else:
+                if ack_frame is not None:
+                    # merge into the same queue entry: one vectored send
+                    buf.prepend_user_data(ack_frame)
+                    ack_frame = None
                 self._write_queue.append((buf, id_wait))
                 if not self._draining:
                     self._draining = True

@@ -150,11 +150,27 @@ class SocketPool:
         if s.failed:
             s.release()      # free the slot; do not pool dead conns
             return
+        if s._pending_acks:
+            # flush ICI credit-returns while we still own the connection
+            # exclusively — queued writes are safe here; once pooled, a
+            # new owner's raw-fd fast-lane write could be in flight
+            s.flush_pending_acks()
         with self._lock:
             if len(self._free) < self._max:
                 self._free.append(sid)
                 return
         s.release()
+
+    def try_take(self, sid: int) -> bool:
+        """Remove ``sid`` from the free list if (and only if) it is
+        idle there.  True ⇒ the caller owns the connection exclusively
+        (nobody else can check it out) and must ``put`` it back."""
+        with self._lock:
+            try:
+                self._free.remove(sid)
+                return True
+            except ValueError:
+                return False
 
 
 _global_map: Optional[SocketMap] = None

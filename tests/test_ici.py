@@ -474,3 +474,108 @@ def test_oversized_attachment_fails_cleanly(server):
             prepare_send(SockStub(), RpcMeta(), Fake())
     finally:
         _jax.Array = real
+
+
+def test_device_attachment_on_fast_lane(server):
+    """Device descriptors ride the sync fast lane (pooled connections):
+    request AND response stay device-resident, the server's in-handler
+    ack piggybacks in front of the response (consumed by sync_call),
+    and window credit drains back to zero without a dispatcher."""
+    from brpc_tpu.client import ChannelOptions
+    from brpc_tpu.ici.endpoint import live_endpoints
+
+    opts = ChannelOptions()
+    opts.connection_type = "pooled"
+    ch = Channel(opts)
+    ch.init(str(server.listen_endpoint))
+
+    x = jnp.arange(65536, dtype=jnp.float32)          # 256KB
+    out = None
+    for i in range(3):        # first call learns the domain (fallback)
+        cntl = Controller()
+        cntl.timeout_ms = 30_000
+        cntl.request_device_attachment = x
+        c = ch.call_method("TE.Echo", b"", cntl=cntl)
+        assert not c.failed, (i, c.error_text)
+        att = c.response_device_attachment
+        assert att is not None
+        out = att.tensor()
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(x))
+    # descriptor path engaged: same-process redemption is the same buffer
+    assert out.unsafe_buffer_pointer() == x.unsafe_buffer_pointer()
+    # acks flowed back through sync_call: no credit left outstanding
+    deadline = time.time() + 5.0
+    while time.time() < deadline:
+        if all(ep.outstanding_bytes == 0 for ep in live_endpoints()):
+            break
+        time.sleep(0.01)
+    assert all(ep.outstanding_bytes == 0 for ep in live_endpoints()), \
+        [(ep.posted_count, ep.acked_count, ep.outstanding_bytes)
+         for ep in live_endpoints()]
+
+
+def test_fast_lane_batch_with_descriptors(server):
+    """Pipelined sibling: several descriptor-carrying calls in flight on
+    one pooled connection; every response redeems to the posted buffer
+    and every ack (interleaved TICI frames in the batch read) lands."""
+    from brpc_tpu.client import ChannelOptions
+    from brpc_tpu.ici.endpoint import live_endpoints
+
+    opts = ChannelOptions()
+    opts.connection_type = "pooled"
+    ch = Channel(opts)
+    ch.init(str(server.listen_endpoint))
+    x = jnp.arange(16384, dtype=jnp.float32)
+    for _ in range(2):                     # learn domain
+        cntl = Controller()
+        cntl.timeout_ms = 30_000
+        cntl.request_device_attachment = x
+        c = ch.call_method("TE.Echo", b"", cntl=cntl)
+        assert not c.failed, c.error_text
+        c.response_device_attachment.tensor()
+    for _ in range(8):
+        cntl = Controller()
+        cntl.timeout_ms = 30_000
+        cntl.request_device_attachment = x
+        c = ch.call_method("TE.Echo", b"", cntl=cntl)
+        assert not c.failed, c.error_text
+        got = c.response_device_attachment.tensor()
+        assert got.unsafe_buffer_pointer() == x.unsafe_buffer_pointer()
+    deadline = time.time() + 5.0
+    while time.time() < deadline:
+        if all(ep.outstanding_bytes == 0 for ep in live_endpoints()):
+            break
+        time.sleep(0.01)
+    assert all(ep.outstanding_bytes == 0 for ep in live_endpoints())
+
+
+def test_ignored_request_attachment_settles_before_response(server):
+    """A handler that never redeems the request descriptor: the server
+    settles it when the response is sent, so the credit-return still
+    PRECEDES the response on the wire (the fast lane's read loop
+    depends on that) and the window drains without the TTL sweep."""
+    from brpc_tpu.client import ChannelOptions
+    from brpc_tpu.ici.endpoint import live_endpoints
+
+    opts = ChannelOptions()
+    opts.connection_type = "pooled"
+    ch = Channel(opts)
+    ch.init(str(server.listen_endpoint))
+    x = jnp.arange(8192, dtype=jnp.float32)
+    for i in range(4):
+        cntl = Controller()
+        cntl.timeout_ms = 30_000
+        cntl.request_device_attachment = x
+        # TE.Make ignores the request attachment entirely
+        c = ch.call_method("TE.Make", b"8", cntl=cntl)
+        assert not c.failed, (i, c.error_text)
+        assert c.response == b"made"
+        c.response_device_attachment.tensor()
+    deadline = time.time() + 5.0
+    while time.time() < deadline:
+        if all(ep.outstanding_bytes == 0 for ep in live_endpoints()):
+            break
+        time.sleep(0.01)
+    assert all(ep.outstanding_bytes == 0 for ep in live_endpoints()), \
+        [(ep.posted_count, ep.acked_count, ep.outstanding_bytes)
+         for ep in live_endpoints()]
