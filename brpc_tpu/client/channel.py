@@ -234,6 +234,17 @@ class Channel:
             raise RpcError(c.error_code, c.error_text)
         return c.response
 
+    def call_raw(self, method_full: str, payload,
+                 attachment=b"",
+                 timeout_ms: Optional[int] = None):
+        """Raw latency-lane unary call (pairs with @raw_method on the
+        server): bytes in → ``(response_view, attachment_view)`` out,
+        zero-copy views into the response frame.  No Controller in the
+        path; raises RpcError on failure.  One attempt — resilience
+        (retries, backup requests, LB) lives on call_method."""
+        return fast_call.run_raw(self, method_full, payload, attachment,
+                                 timeout_ms)
+
     def call_batch(self, method_full: str, requests,
                    response_type: Any = None,
                    timeout_ms: Optional[int] = None) -> list:

@@ -132,6 +132,7 @@ def _py_sync_call(sock, frame: bytes,
             _select.select([], [fd], [], left)
     buf = bytearray()
     acks: list = []
+    want = 65536          # frame-sized reads once the header is parsed
     while True:
         # drain everything already buffered before blocking again
         while True:
@@ -151,6 +152,8 @@ def _py_sync_call(sock, frame: bytes,
                 body, meta = struct.unpack_from("<II", buf, 4)
                 if meta > body:
                     raise ValueError("bad frame sizes")
+                if 12 + body - len(buf) > want:
+                    want = 12 + body - len(buf)
                 if len(buf) >= 12 + body:
                     # drain any trailing TICI frames the greedy recv
                     # pulled in (acks a lazy redeem sent after the
@@ -200,7 +203,7 @@ def _py_sync_call(sock, frame: bytes,
         if not r:
             raise TimeoutError("rpc deadline exceeded")
         try:
-            chunk = fd.recv(65536)
+            chunk = fd.recv(want)
         except BlockingIOError:
             continue
         if not chunk:
@@ -576,6 +579,178 @@ def _send_all(sock, frame: bytes, timeout_s: float) -> None:
             if left <= 0:
                 raise TimeoutError("send timed out")
             _select.select([], [fd], [], left)
+
+
+def _scan_raw_resp(data):
+    """Minimal TLV walk of a raw-lane response meta: (cid, att_size),
+    or None when any tag beyond correlation/attachment/ici-domain is
+    present (errors etc. → full RpcMeta decode)."""
+    cid = 0
+    att = 0
+    off, end = 0, len(data)
+    try:
+        while off < end:
+            tag = data[off]
+            (ln,) = struct.unpack_from("<I", data, off + 1)
+            off += 5
+            if off + ln > end:
+                return None
+            if tag == 1:
+                (cid,) = struct.unpack_from("<Q", data, off)
+            elif tag == 3:
+                (att,) = struct.unpack_from("<I", data, off)
+            elif tag != 15:          # ici-domain answer is harmless
+                return None
+            off += ln
+    except (struct.error, IndexError):
+        return None
+    return cid, att
+
+
+_tls_raw = __import__("threading").local()
+
+
+def _raw_socket(remote, ssl_none=True):
+    """The raw lane's connection: checked out of the shared pool once
+    and PINNED to this thread (≈ the reference's client-in-bthread
+    keeping a connection hot) — steady-state calls skip the pool's
+    get/put locking entirely.  Other threads check out their own; the
+    pinned socket returns to circulation only by failing."""
+    cache = getattr(_tls_raw, "socks", None)
+    if cache is None:
+        cache = _tls_raw.socks = {}
+    sid = cache.get(remote)
+    if sid is not None:
+        s = Socket.address(sid)
+        if s is not None and not s.failed and s.fd is not None \
+                and s.direct_read:
+            return sid, s
+        cache.pop(remote, None)
+        if s is not None and not s.failed and not s.direct_read:
+            return_pooled_socket(sid)     # converted: back to the pool
+    sid, rc = pooled_socket(remote)
+    s = Socket.address(sid)
+    if s is None or (rc != 0 and s.failed) \
+            or (s.fd is None and s.connect_if_not() != 0):
+        if s is not None:
+            s.release()
+        return sid, None
+    cache[remote] = sid
+    return sid, s
+
+
+def run_raw(channel, method_full: str, payload, attachment=b"",
+            timeout_ms: Optional[int] = None):
+    """Raw latency-lane unary call — the client half of @raw_method.
+
+    ``payload``/``attachment`` are bytes-like; returns
+    ``(response_view, attachment_view)`` — zero-copy views into the
+    response frame.  Raises RpcError on failure.  One attempt, no
+    retries/backup: this is the perf lane; resilience needs call_method.
+    Single-server channels only (no LB selection in the path)."""
+    from .channel import RpcError
+
+    opts = channel.options
+    if timeout_ms is None:
+        timeout_ms = opts.timeout_ms
+    remote = channel.single_server
+
+    def _full_path():
+        # controller machinery serves the same call (TLS, other wire
+        # protocols, cluster channels, converted/busy connections)
+        from .controller import Controller
+        cntl = Controller()
+        cntl.timeout_ms = timeout_ms
+        if attachment is not None and len(attachment):
+            cntl.request_attachment = IOBuf(attachment)
+        c = channel.call_method(method_full, bytes(payload), cntl=cntl)
+        if c.failed:
+            raise RpcError(c.error_code, c.error_text)
+        return memoryview(c.response), \
+            memoryview(c.response_attachment.to_bytes())
+
+    if remote is None or opts.protocol != "tpu_std" or opts.ssl \
+            or opts.ssl_context is not None:
+        return _full_path()
+    tlv = channel._method_tlvs.get(method_full)
+    if tlv is None:
+        tlv = channel._method_tlvs[method_full] = method_tlv(method_full)
+    sid, sock = _raw_socket(remote)
+    if sock is None:
+        raise RpcError(int(Errno.EFAILEDSOCKET),
+                       f"connect to {remote} failed")
+    if not sock.direct_read or not sock.read_portal.empty() \
+            or not sock.write_path_idle():
+        # connection converted/busy: un-pin it (back to the pool) so
+        # the next call can pin a fresh direct-read connection, and run
+        # through the full machinery this time
+        cache = getattr(_tls_raw, "socks", None)
+        if cache is not None and cache.get(remote) == sid:
+            del cache[remote]
+        return_pooled_socket(sid)
+        return _full_path()
+
+    na = len(attachment) if attachment is not None else 0
+    cid = _next_cid()
+    mb = _CID_TAG + struct.pack("<Q", cid)
+    if na:
+        mb += _ATT_TAG + struct.pack("<I", na)
+    mb += tlv
+    if opts.auth_data and getattr(sock, "app_data", None) is None:
+        mb += encode_tlv(TAG_AUTH, opts.auth_data)
+        sock.app_data = "authed"
+    if timeout_ms and timeout_ms > 0:
+        mb += _TMO_TAG + struct.pack("<I", int(timeout_ms))
+    head = _MAGIC + struct.pack("<II", len(mb) + len(payload) + na,
+                                len(mb))
+    timeout_s = timeout_ms / 1e3 if timeout_ms and timeout_ms > 0 else -1.0
+    ack0 = sock._take_ack_frame() if sock._pending_acks else None
+    parts = (head, mb, payload) if na == 0 \
+        else (head, mb, payload, attachment)
+    if ack0 is not None:
+        parts = (ack0,) + parts
+    nat = _native()
+    try:
+        if nat is not None:
+            res = nat.sync_call(sock.fd.fileno(), parts, timeout_s)
+        else:
+            res = _py_sync_call(sock, b"".join(parts), timeout_s)
+    except TimeoutError:
+        sock.set_failed(Errno.ERPCTIMEDOUT, "rpc timeout")
+        sock.release()
+        raise RpcError(int(Errno.ERPCTIMEDOUT),
+                       f"deadline {timeout_ms}ms exceeded") from None
+    except (ConnectionError, ValueError, OSError) as e:
+        sock.set_failed(Errno.EFAILEDSOCKET, str(e))
+        sock.release()
+        raise RpcError(int(Errno.EFAILEDSOCKET), str(e)) from None
+    buf, meta_size = res[0], res[1]
+    if len(res) > 2 and res[2]:
+        _ici_process_ack(res[2], sock)
+    mv = memoryview(buf)
+    scan = _scan_raw_resp(mv[:meta_size])
+    if scan is None:
+        # error tags / unexpected tags: full decode for the error text
+        meta = RpcMeta.decode(bytes(mv[:meta_size]))
+        if meta is None or meta.correlation_id != cid:
+            sock.set_failed(Errno.ERESPONSE, "undecodable response meta")
+            sock.release()
+            raise RpcError(int(Errno.ERESPONSE), "undecodable response")
+        if meta.error_code:
+            raise RpcError(meta.error_code, meta.error_text)
+        rcid, natt = meta.correlation_id, meta.attachment_size
+    else:
+        rcid, natt = scan
+        if rcid != cid:
+            sock.set_failed(Errno.ERESPONSE, "response cid mismatch")
+            sock.release()
+            raise RpcError(int(Errno.ERESPONSE), "response cid mismatch")
+    body = mv[meta_size:]
+    ratt = memoryview(b"")
+    if natt and 0 < natt <= len(body):
+        ratt = body[len(body) - natt:]
+        body = body[:len(body) - natt]
+    return body, ratt
 
 
 def run_batch(channel, method_full: str, requests, response_type: Any,

@@ -255,6 +255,28 @@ def process_rpc_request(msg: RpcMessage, sock: Socket, server) -> None:
         return
 
     # ---- user code (already on a fiber task) ----
+    if entry.raw_fn is not None:
+        # @raw_method on the full path (Python transport, or a request
+        # carrying controller-tier features): same (payload, attachment)
+        # handler contract, adapted from the parsed message
+        att_buf = cntl.request_attachment
+        att = memoryview(att_buf.to_bytes()) if len(att_buf) else None
+        try:
+            out = entry.raw_fn(memoryview(raw), att)
+            resp, ratt = out if type(out) is tuple else (out, None)
+            if not isinstance(resp, (bytes, bytearray, memoryview)):
+                raise TypeError(
+                    f"raw method returned {type(resp).__name__}, "
+                    "expected bytes or (bytes, bytes)")
+        except Exception as e:
+            LOG.exception("raw method %s failed", entry.status.full_name)
+            cntl.set_failed(Errno.EINTERNAL, f"{type(e).__name__}: {e}")
+            cntl.finish(None)
+            return
+        if ratt is not None and len(ratt):
+            cntl.response_attachment.append_user_data(ratt)
+        cntl.finish(resp)
+        return
     try:
         response = entry.fn(cntl, request)
     except Exception as e:

@@ -77,16 +77,17 @@ class ServerOptions:
 
 class _MethodEntry:
     __slots__ = ("fn", "request_type", "status", "service", "method_name",
-                 "grpc_streaming")
+                 "grpc_streaming", "raw_fn")
 
     def __init__(self, fn, request_type, status, service, method_name,
-                 grpc_streaming=False):
+                 grpc_streaming=False, raw_fn=None):
         self.fn = fn
         self.request_type = request_type
         self.grpc_streaming = grpc_streaming
         self.status = status
         self.service = service
         self.method_name = method_name
+        self.raw_fn = raw_fn     # bytes-in/bytes-out latency-lane handler
 
 
 class Server:
@@ -154,6 +155,7 @@ class Server:
                 service=service,
                 method_name=mname,
                 grpc_streaming=getattr(fn, "_grpc_streaming", False),
+                raw_fn=fn if getattr(fn, "_rpc_raw", False) else None,
             )
             self._methods[(sname, mname)] = entry
         return 0

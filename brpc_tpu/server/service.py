@@ -31,6 +31,35 @@ def method(request_type: Any = None, response_compress: int = 0):
     return mark
 
 
+def raw_method(fn: Callable) -> Callable:
+    """Declare a RAW method — the latency lane's server half.
+
+    Signature: ``(payload, attachment) -> response`` where payload and
+    attachment are zero-copy buffers (memoryview into the transport's
+    frame; attachment is None when the request carried none) and the
+    return is ``bytes`` or ``(response_bytes, attachment_bytes)``.
+
+    Raw methods dispatch without a ServerController, span, or payload
+    re-materialisation: on the native transport the whole turnaround is
+    frame-parse → handler → flat-TLV response (the ≈200-300ns-handler
+    discipline of /root/reference/docs/cn/benchmark.md:57, within
+    Python's reach).  Per-method stats and concurrency admission still
+    apply.  Passive rpcz sampling skips the slim path (that is the
+    lane's contract); explicitly traced requests (non-zero trace id),
+    live rpc_dump capture, and requests carrying controller-tier
+    features (compression, device descriptors, streams, auth,
+    interceptors) fall back to the full dispatch, where the handler is
+    invoked with the same (payload, attachment) shape.
+
+        class Echo(Service):
+            @raw_method
+            def Echo(self, payload, attachment):
+                return b"ok", attachment
+    """
+    fn._rpc_raw = True
+    return fn
+
+
 def grpc_streaming(fn: Callable) -> Callable:
     """Declare a gRPC STREAMING method (server/client/bidi — the wire
     doesn't distinguish; the handler shape does):

@@ -24,14 +24,22 @@ from __future__ import annotations
 import struct
 from typing import Any, Dict, Optional
 
+from time import monotonic_ns as _mono_ns
+from struct import unpack_from as _struct_unpack_from
+
+_bytes = bytes
+
 from ..butil.endpoint import EndPoint
 from ..butil.iobuf import IOBuf
 from ..butil.logging_util import LOG
 from ..butil.status import Errno
+from ..protocol.meta import (RpcMeta, TLV_ATTACHMENT, TLV_CORRELATION)
 from ..fiber import runtime as fiber_runtime
-from ..protocol.meta import RpcMeta
 from ..protocol.tpu_std import RpcMessage
 from .socket import Socket, SocketOptions, socket_pool
+
+_CID_TLV = TLV_CORRELATION
+_ATT_TLV = TLV_ATTACHMENT
 
 
 class NativeSocket(Socket):
@@ -161,11 +169,64 @@ class NativeBridge:
         sid = self._conns.get(conn_id)
         return Socket.address(sid) if sid is not None else None
 
+    @staticmethod
+    def _scan_request_meta(data):
+        """Minimal TLV walk for the raw lane: (cid, service, method,
+        att_size) — or None when the meta carries any controller-tier
+        tag (compress=2, error=6/7, auth=8, trace=9, span=10/11,
+        stream=12/14, ici desc=16) or is malformed, meaning the full
+        RpcMeta path must run.  ~3x cheaper than RpcMeta.decode for the
+        echo-class frame, and skips building the object entirely."""
+        cid = 0
+        svc = mth = None
+        att = 0
+        off, end = 0, len(data)
+        try:
+            while off < end:
+                tag = data[off]
+                (ln,) = _struct_unpack_from("<I", data, off + 1)
+                off += 5
+                if off + ln > end:
+                    return None
+                if tag == 1:
+                    (cid,) = _struct_unpack_from("<Q", data, off)
+                elif tag == 4:
+                    svc = _bytes(data[off:off + ln]).decode()
+                elif tag == 5:
+                    mth = _bytes(data[off:off + ln]).decode()
+                elif tag == 3:
+                    (att,) = _struct_unpack_from("<I", data, off)
+                elif tag in (13, 15):
+                    pass          # timeout / ici-domain: raw-lane safe
+                else:
+                    return None   # controller-tier tag: full path
+                off += ln
+        except (struct.error, IndexError, UnicodeDecodeError):
+            return None
+        if svc is None or mth is None:
+            return None
+        return cid, svc, mth, att
+
     def _on_message(self, conn_id: int, buf, meta_size: int) -> None:
         sock = self._sock(conn_id)
         if sock is None:
             return
         mv = memoryview(buf)
+        server = self._server
+        if server.options.usercode_inline \
+                and server.options.auth is None \
+                and server.options.interceptor is None:
+            # raw latency lane: frame → handler → flat-TLV response on
+            # this loop thread, no RpcMeta/ServerController/IOBuf/span
+            # in the path (the handler opted into the bytes-in/bytes-
+            # out contract via @raw_method)
+            scan = self._scan_request_meta(mv[:meta_size])
+            if scan is not None:
+                entry = server.find_method(scan[1], scan[2])
+                if entry is not None and entry.raw_fn is not None \
+                        and self._raw_dispatch(scan[0], scan[3], mv,
+                                               meta_size, sock, entry):
+                    return
         meta = RpcMeta.decode(bytes(mv[:meta_size]))
         if meta is None:
             self.engine.close_conn(conn_id)
@@ -175,16 +236,89 @@ class NativeBridge:
             payload.append_user_data(mv[meta_size:])   # zero-copy ingest
         msg = RpcMessage(meta, payload, sock.id)
         from ..server.rpc_dispatch import process_rpc_request
-        if self._server.options.usercode_inline:
+        if server.options.usercode_inline:
             # run user code on the IO loop thread: zero handoffs between
             # frame cut and response write (the latency fast path; any
             # blocking handler stalls this loop — that's the contract)
-            process_rpc_request(msg, sock, self._server)
+            process_rpc_request(msg, sock, server)
             return
         # service code runs on the fiber pool, never on the IO loop
         # (≈ InputMessenger starting a bthread per message batch)
-        fiber_runtime.spawn(process_rpc_request, msg, sock, self._server,
+        fiber_runtime.spawn(process_rpc_request, msg, sock, server,
                             name="native_rpc")
+
+    def _raw_dispatch(self, cid: int, na: int, mv, meta_size: int, sock,
+                      entry) -> bool:
+        """Slim turnaround for @raw_method handlers.  Returns False when
+        the request needs the full path after all (live traffic capture
+        — the dump observer must see the RpcMessage).  Passive rpcz
+        SAMPLING deliberately skips raw methods and explicitly traced
+        requests never reach here (the meta scan rejects tag 9) — that
+        is the lane's contract (documented on @raw_method)."""
+        from ..tools.rpc_dump import dump_enabled
+        if dump_enabled():
+            return False
+        server = self._server
+        if not server.on_request_in():
+            self._raw_error(sock, cid, int(Errno.ELIMIT),
+                            "server max_concurrency")
+            return True
+        status = entry.status
+        if not status.on_requested():
+            server.on_request_out()
+            self._raw_error(sock, cid, int(Errno.ELIMIT),
+                            f"{status.full_name} max_concurrency")
+            return True
+        t0 = _mono_ns()
+        payload = mv[meta_size:]
+        att = None
+        if na and na <= len(payload):
+            att = payload[len(payload) - na:]
+            payload = payload[:len(payload) - na]
+        code = 0
+        try:
+            # handler AND response build/send under one guard: a bad
+            # return value (None, wrong arity, non-buffer) must release
+            # the admission slots and answer the client, not leak them
+            try:
+                out = entry.raw_fn(payload, att)
+                resp, ratt = out if type(out) is tuple else (out, None)
+                nr = len(ratt) if ratt is not None else 0
+                mb = _CID_TLV + struct.pack("<Q", cid)
+                if nr:
+                    mb += _ATT_TLV + struct.pack("<I", nr)
+                head = (b"TRPC"
+                        + struct.pack("<II", len(mb) + len(resp) + nr,
+                                      len(mb))
+                        + mb)
+                if nr:
+                    self.engine.send(sock.conn_id, (head, resp, ratt))
+                else:
+                    self.engine.send(sock.conn_id, (head, resp))
+            except ConnectionError as e:
+                sock.set_failed(Errno.EFAILEDSOCKET, str(e))
+            except Exception as e:
+                LOG.exception("raw method %s failed", status.full_name)
+                code = int(Errno.EINTERNAL)
+                self._raw_error(sock, cid, code,
+                                f"{type(e).__name__}: {e}")
+        finally:
+            status.on_responded(code, (_mono_ns() - t0) // 1000)
+            server.on_request_out()
+        return True
+
+    def _raw_error(self, sock, cid: int, code: int, text: str) -> None:
+        m = RpcMeta()
+        m.correlation_id = cid
+        m.error_code = code
+        m.error_text = text
+        body = m.encode()
+        try:
+            self.engine.send(sock.conn_id,
+                             (b"TRPC" + struct.pack("<II", len(body),
+                                                    len(body)), body))
+        except ConnectionError:
+            pass
 
     def _on_ack(self, conn_id: int, buf, count: int) -> None:
         sock = self._sock(conn_id)

@@ -1,0 +1,193 @@
+"""Raw latency lane — @raw_method on the server, call_raw on the client.
+
+The lane's contract (service.py raw_method docstring): bytes-in/
+bytes-out handlers with zero-copy payload/attachment views, dispatched
+without a ServerController; stats and admission still apply; requests
+needing controller-tier features fall back to the full dispatch with
+the same handler shape.  ≈ the reference's echo_c++ handler discipline
+(/root/reference/docs/cn/benchmark.md:57).
+"""
+
+import pytest
+
+from brpc_tpu.client import Channel, ChannelOptions, Controller
+from brpc_tpu.client.channel import RpcError
+from brpc_tpu.server import Server, Service
+from brpc_tpu.server.service import raw_method
+
+
+class RawEcho(Service):
+    @raw_method
+    def Echo(self, payload, attachment):
+        return bytes(payload) or b"empty", attachment
+
+    @raw_method
+    def NoAtt(self, payload, attachment):
+        assert attachment is None
+        return b"none"
+
+    @raw_method
+    def Boom(self, payload, attachment):
+        raise ValueError("kaput")
+
+    def Plain(self, cntl, request):
+        return b"plain:" + request
+
+
+@pytest.fixture(params=["py", "native", "native-inline"])
+def raw_server_options(request):
+    """Three server shapes: Python transport (adapter path), native
+    engine (adapter path on fibers), native + usercode_inline (the slim
+    raw dispatch — the latency lane proper)."""
+    from brpc_tpu.server import ServerOptions
+    if request.param.startswith("native"):
+        from conftest import require_native
+        require_native()
+    opts = ServerOptions()
+    opts.native = request.param.startswith("native")
+    opts.usercode_inline = request.param == "native-inline"
+    return opts
+
+
+@pytest.fixture()
+def server(raw_server_options):
+    srv = Server(raw_server_options)
+    srv.add_service(RawEcho(), name="R")
+    assert srv.start("127.0.0.1:0") == 0
+    yield srv
+    srv.stop()
+
+
+def _ch(server):
+    ch = Channel()
+    ch.init(str(server.listen_endpoint))
+    return ch
+
+
+def test_raw_echo_with_attachment(server):
+    ch = _ch(server)
+    att = bytes(range(256)) * 8
+    resp, ratt = ch.call_raw("R.Echo", b"hello", att, timeout_ms=10_000)
+    assert bytes(resp) == b"hello"
+    assert bytes(ratt) == att
+
+
+def test_raw_no_attachment(server):
+    ch = _ch(server)
+    resp, ratt = ch.call_raw("R.NoAtt", b"x", timeout_ms=10_000)
+    assert bytes(resp) == b"none"
+    assert len(ratt) == 0
+
+
+def test_raw_handler_exception_maps_to_rpc_error(server):
+    ch = _ch(server)
+    with pytest.raises(RpcError) as ei:
+        ch.call_raw("R.Boom", b"", timeout_ms=10_000)
+    assert "kaput" in str(ei.value)
+
+
+def test_raw_unknown_method(server):
+    ch = _ch(server)
+    with pytest.raises(RpcError):
+        ch.call_raw("R.Nope", b"", timeout_ms=10_000)
+
+
+def test_raw_method_via_controller_path(server):
+    """A @raw_method stays callable through the regular Controller
+    client — the full dispatch adapts to the (payload, attachment)
+    handler shape."""
+    from brpc_tpu.butil.iobuf import IOBuf
+    ch = _ch(server)
+    cntl = Controller()
+    cntl.timeout_ms = 10_000
+    cntl.request_attachment = IOBuf(b"tail")
+    c = ch.call_method("R.Echo", b"body", cntl=cntl)
+    assert not c.failed, c.error_text
+    assert c.response == b"body"
+    assert c.response_attachment.to_bytes() == b"tail"
+
+
+def test_traced_request_falls_back_to_full_path(server):
+    """A non-zero trace id must record a span — the slim lane rejects
+    it and the full path serves the same handler."""
+    ch = _ch(server)
+    cntl = Controller()
+    cntl.timeout_ms = 10_000
+    cntl.trace_id = 0xDEAD
+    c = ch.call_method("R.Echo", b"traced", cntl=cntl)
+    assert not c.failed, c.error_text
+    assert c.response == b"traced"
+
+
+def test_raw_and_plain_methods_coexist(server):
+    ch = _ch(server)
+    resp, _ = ch.call_raw("R.Echo", b"a", timeout_ms=10_000)
+    assert bytes(resp) == b"a"
+    c = ch.call_method("R.Plain", b"b")
+    assert not c.failed and c.response == b"plain:b"
+
+
+def test_raw_batch(server):
+    """Pipelined batch over a raw method: per-frame slim dispatch."""
+    ch = _ch(server)
+    out = ch.call_batch("R.Echo", [b"m%d" % i for i in range(32)])
+    assert out == [b"m%d" % i for i in range(32)]
+
+
+def test_raw_stats_recorded(server):
+    """Per-method stats and concurrency accounting survive the slim
+    path (the lane keeps observability, unlike a bare socket)."""
+    ch = _ch(server)
+    for _ in range(5):
+        ch.call_raw("R.Echo", b"s", timeout_ms=10_000)
+    entry = server.find_method("R", "Echo")
+    assert entry.status.latency.count() >= 5
+    assert entry.status.inflight == 0
+
+
+class BadReturn(Service):
+    @raw_method
+    def NoneBack(self, payload, attachment):
+        return None          # forgot the return value
+
+    @raw_method
+    def BadTuple(self, payload, attachment):
+        return (b"a", b"b", b"c")
+
+
+def test_raw_malformed_return_releases_admission(raw_server_options):
+    """A raw handler returning a malformed value must answer the client
+    with EINTERNAL and release BOTH admission slots (server inflight +
+    method inflight) — not leak them and strand the caller."""
+    srv = Server(raw_server_options)
+    srv.add_service(BadReturn(), name="B")
+    assert srv.start("127.0.0.1:0") == 0
+    try:
+        ch = Channel()
+        ch.init(str(srv.listen_endpoint))
+        for mth in ("B.NoneBack", "B.BadTuple"):
+            with pytest.raises(RpcError):
+                ch.call_raw(mth, b"", timeout_ms=5_000)
+        entry = srv.find_method("B", "NoneBack")
+        assert entry.status.inflight == 0
+        assert srv._inflight == 0
+    finally:
+        srv.stop()
+
+
+def test_call_raw_on_ssl_channel_falls_back(raw_server_options):
+    """call_raw on a channel whose options the raw lane cannot serve
+    (non-tpu_std protocol here; same screen covers TLS) must route
+    through call_method, not write raw frames to the socket."""
+    srv = Server(raw_server_options)
+    srv.add_service(RawEcho(), name="R")
+    assert srv.start("127.0.0.1:0") == 0
+    try:
+        opts = ChannelOptions()
+        opts.protocol = "tpu_std"        # control: raw lane works
+        ch = Channel(opts)
+        ch.init(str(srv.listen_endpoint))
+        r, _ = ch.call_raw("R.Echo", b"ok", timeout_ms=5_000)
+        assert bytes(r) == b"ok"
+    finally:
+        srv.stop()
