@@ -35,7 +35,7 @@ class LMConfig:
                  lr: float = 0.05, moe_experts: int = 0,
                  moe_capacity: float = 2.0, moe_aux_weight: float = 0.01,
                  moe_top_k: int = 1, use_flash: bool = False,
-                 scan_layers: bool = False):
+                 scan_layers: bool = False, attn_impl: str = "auto"):
         assert dim % heads == 0
         assert (dim // heads) % 2 == 0, "head dim must be even for RoPE"
         self.vocab = vocab
@@ -54,9 +54,12 @@ class LMConfig:
         self.moe_capacity = moe_capacity
         self.moe_aux_weight = moe_aux_weight
         self.moe_top_k = moe_top_k
-        # single-device attention via the Pallas flash kernel
-        # (ops/flash_attention.py); the sp path keeps ring attention
+        # single-device attention: "auto" picks dense (XLA-fused) vs
+        # the Pallas flash kernel by sequence length
+        # (ops/flash_attention.py attention()); use_flash=True forces
+        # the kernel (back-compat); the sp path keeps ring attention
         self.use_flash = use_flash
+        self.attn_impl = attn_impl
         # scan_layers stacks per-layer weights and runs one lax.scan
         # over the depth axis: trace/compile time is O(1) in depth
         # instead of O(depth) — the XLA-idiomatic deep-model form
@@ -148,16 +151,14 @@ def make_forward(cfg: LMConfig, mesh=None, sp_axis: Optional[str] = None):
     if mesh is not None and sp_axis is not None:
         from ..parallel.ring_attention import make_ring_attention
         attend = make_ring_attention(mesh, sp_axis, causal=cfg.causal)
-    elif cfg.use_flash:
-        from ..ops.flash_attention import flash_attention
-
-        def attend(q, k, v):
-            return flash_attention(q, k, v, cfg.causal)
     else:
-        from ..parallel.ring_attention import reference_attention
+        from ..ops.flash_attention import attention
+        impl = "flash" if cfg.use_flash else cfg.attn_impl
 
         def attend(q, k, v):
-            return reference_attention(q, k, v, causal=cfg.causal)
+            # seq-adaptive: XLA-fused dense below the crossover, the
+            # Pallas flash kernel above (each where it measures faster)
+            return attention(q, k, v, causal=cfg.causal, impl=impl)
 
     if cfg.moe_experts > 0:
         from .moe import forward_grouped as moe_forward

@@ -411,6 +411,58 @@ def flash_attention(q, k, v, causal: bool = False,
     return out
 
 
+# Measured crossover on the v5e-class chip (bench.py device-compute
+# section): at 2k tokens one XLA-fused einsum→softmax→einsum chain is
+# on par with or ahead of the kernel's block pipeline (0.8-1.3x), while
+# from ~4k the O(s) memory + streaming K/V blocks win decisively (2-2.6x
+# at 16k).  Dense also costs O(s^2) activation memory, so the crossover
+# stays low enough that the scores tensor is cheap.
+DENSE_FLASH_CROSSOVER = 2048
+
+
+def dense_attention(q, k, v, causal: bool = False):
+    """XLA-fused dense attention — materializes the (s, s) scores and
+    lets the compiler tile the matmul chain onto the MXU.  The fastest
+    impl below the crossover; the correctness oracle everywhere."""
+    import jax
+    import jax.numpy as jnp
+
+    d = q.shape[-1]
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) / (d ** 0.5)
+    if causal:
+        n = q.shape[1]
+        pos = jnp.arange(n)
+        mask = (pos[:, None] >= pos[None, :])[None, None]
+        s = jnp.where(mask, s, -1e30)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, v).astype(q.dtype)
+
+
+def attention(q, k, v, causal: bool = False, impl: str = "auto",
+              block_q: Optional[int] = None,
+              block_k: Optional[int] = None,
+              interpret: Optional[bool] = None):
+    """Sequence-adaptive attention dispatch.
+
+    ``impl="auto"`` picks dense (XLA-fused, O(s²) memory) below
+    :data:`DENSE_FLASH_CROSSOVER` tokens and the Pallas flash kernel
+    (O(s) memory) at or above it — each impl where it measures faster.
+    Off-TPU, auto always picks dense: the kernel would run in Pallas
+    interpret mode there, which is never the faster choice.
+    ``impl="dense"``/``"flash"`` force.  Shapes are static under jit,
+    so the choice is made at trace time: no runtime branching."""
+    if impl == "auto":
+        import jax
+        impl = "flash" if (q.shape[1] >= DENSE_FLASH_CROSSOVER
+                           and jax.default_backend() == "tpu") else "dense"
+    if impl == "dense":
+        return dense_attention(q, k, v, causal)
+    if impl == "flash":
+        return flash_attention(q, k, v, causal, block_q=block_q,
+                               block_k=block_k, interpret=interpret)
+    raise ValueError(f"unknown attention impl {impl!r}")
+
+
 def _fa_fwd(q, k, v, causal, block_q, block_k, interpret):
     out, lse = _pallas_forward(q, k, v, causal, block_q, block_k,
                                interpret)

@@ -144,16 +144,7 @@ def make_ulysses_attention(mesh, axis: str = "sp", causal: bool = False,
 
 
 def reference_attention(q, k, v, causal: bool = False):
-    """Dense single-device attention — the correctness oracle for tests."""
-    import jax
-    import jax.numpy as jnp
-
-    d = q.shape[-1]
-    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) / (d ** 0.5)
-    if causal:
-        n = q.shape[1]
-        pos = jnp.arange(n)
-        mask = (pos[:, None] >= pos[None, :])[None, None]
-        s = jnp.where(mask, s, -1e30)
-    p = jax.nn.softmax(s, axis=-1)
-    return jnp.einsum("bhqk,bkhd->bqhd", p, v).astype(q.dtype)
+    """Dense single-device attention — the correctness oracle for tests
+    (one implementation: ops.flash_attention.dense_attention)."""
+    from ..ops.flash_attention import dense_attention
+    return dense_attention(q, k, v, causal=causal)

@@ -136,6 +136,17 @@ def test_partition_then_heal(proxy):
 def test_corrupted_byte_detected(proxy):
     ch = _channel(proxy, timeout_ms=2000, max_retry=0)
     assert ch.call("E.Echo", b"warm") == b"warm"
+    # the pumps count forwarded bytes AFTER sendall, so the client can
+    # see the warm response before the counter includes it — wait for
+    # the counter to go quiet or the +2 offset can land in the past
+    # (never matching) and the poisoned call sails through clean
+    stable, deadline = -1, time.time() + 2.0
+    while time.time() < deadline:
+        cur = proxy.forwarded_bytes
+        if cur == stable:
+            break
+        stable = cur
+        time.sleep(0.05)
     proxy.corrupt_byte_at = proxy.forwarded_bytes + 2   # clobber a header
     cntl = Controller()
     cntl.timeout_ms = 2000

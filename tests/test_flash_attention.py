@@ -128,3 +128,36 @@ def test_lm_forward_with_flash():
     got = jax.jit(make_forward(cfg_f))(params, ids)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                rtol=3e-2, atol=3e-3)
+
+
+def test_adaptive_attention_dispatch():
+    """attention(impl="auto") picks dense below the crossover and flash
+    at/above it, and both agree with the oracle."""
+    import numpy as np
+    import jax
+
+    from brpc_tpu.ops import flash_attention as fa
+
+    k1, k2, k3 = jax.random.split(jax.random.PRNGKey(0), 3)
+    q = jax.random.normal(k1, (2, 64, 2, 16), jnp.float32)
+    k = jax.random.normal(k2, (2, 64, 2, 16), jnp.float32)
+    v = jax.random.normal(k3, (2, 64, 2, 16), jnp.float32)
+    want = fa.dense_attention(q, k, v, causal=True)
+    for impl in ("auto", "dense", "flash"):
+        got = fa.attention(q, k, v, causal=True, impl=impl)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=2e-3, atol=2e-3)
+    # trace-time selection: short seq -> dense einsum in the jaxpr; on
+    # this CPU test backend auto NEVER picks the kernel (interpret mode
+    # would be the slow choice) even past the crossover
+    short = jax.make_jaxpr(
+        lambda a, b, c: fa.attention(a, b, c, impl="auto"))(q, k, v)
+    assert "pallas" not in str(short)
+    s = min(fa.DENSE_FLASH_CROSSOVER, 4096)
+    ql = jax.numpy.zeros((1, s, 1, 16), jnp.float32)
+    long = jax.make_jaxpr(
+        lambda a, b, c: fa.attention(a, b, c, impl="auto"))(ql, ql, ql)
+    assert "pallas" not in str(long)       # off-TPU: dense
+    forced = jax.make_jaxpr(
+        lambda a, b, c: fa.attention(a, b, c, impl="flash"))(q, k, v)
+    assert "pallas" in str(forced) or "custom" in str(forced)

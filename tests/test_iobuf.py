@@ -264,4 +264,7 @@ def test_views_pin_blocks_against_recycling():
     # churn the pool hard: any recycled storage would be overwritten
     for i in range(64):
         IOBuf(bytes([i]) * 1000)
-    assert bytes(views[0]) == b"A" * 1000
+    # the append may have split across blocks (depends on how full the
+    # thread's open block was) — the pinning guarantee covers the
+    # concatenation
+    assert b"".join(bytes(v) for v in views) == b"A" * 1000
