@@ -39,14 +39,23 @@ class LMService(Service):
     """``Generate`` — greedy completion; ``Info`` — model config JSON."""
 
     def __init__(self, cfg: Optional[LMConfig] = None, params=None,
-                 max_new_cap: int = 128):
+                 max_new_cap: int = 128, quantize: bool = False):
         import jax
 
         self.cfg = cfg or LMConfig(vocab=256, dim=64, heads=4, depth=2,
                                    max_seq=128, remat=False)
         self.params = params if params is not None else init_params(
             jax.random.PRNGKey(0), self.cfg)
+        self.quantized = quantize
+        if quantize:
+            # weight-only int8 for serving: decode streams every weight
+            # per token, so halving the bytes ≈ halves the step time
+            # (ops/quant.py); training params stay untouched upstream
+            from ..ops.quant import quantize_lm_params
+            self.params = quantize_lm_params(self.params)
         self.max_new_cap = max_new_cap
+        from ..ops.quant import quantized_nbytes
+        self._param_bytes = quantized_nbytes(self.params)  # immutable
         # prefill/decode programs compile once per (batch, prompt) shape
         # and are reused across requests
         self._gen = make_generator(self.cfg, self.params)
@@ -84,4 +93,7 @@ class LMService(Service):
         c = self.cfg
         return json.dumps({"vocab": c.vocab, "dim": c.dim,
                            "heads": c.heads, "depth": c.depth,
-                           "max_seq": c.max_seq}).encode()
+                           "max_seq": c.max_seq,
+                           "quantized": self.quantized,
+                           "param_bytes": self._param_bytes,
+                           }).encode()

@@ -249,18 +249,21 @@ def make_decode(cfg: LMConfig):
         from .moe import forward_grouped as moe_forward
         moe_cfg = cfg.moe_cfg()
 
+    # every weight matmul goes through qmatmul: plain arrays take the
+    # usual bf16 path, QuantTensors (quantize_lm_params) stream int8
+    # weights — the serving win, since single-token decode is bound by
+    # weight bytes read per step, not FLOPs (ops/quant.py)
+    from ..ops.quant import qmatmul
+
     def mlp(bp, h):
         if cfg.moe_experts > 0:
             out, _ = moe_forward(bp["moe"], h, moe_cfg)
             return out
-        up = (h.astype(jnp.bfloat16) @ bp["w1"].astype(jnp.bfloat16))
-        return (jax.nn.gelu(up.astype(jnp.float32)).astype(jnp.bfloat16)
-                @ bp["w2"].astype(jnp.bfloat16)).astype(jnp.float32)
+        up = qmatmul(h, bp["w1"])
+        return qmatmul(jax.nn.gelu(up), bp["w2"])
 
     def unembed(params, x_last):
-        return (x_last.astype(jnp.bfloat16)
-                @ params["unembed"].astype(jnp.bfloat16)).astype(
-                    jnp.float32)
+        return qmatmul(x_last, params["unembed"])
 
     def prefill(params, ids):
         b, s = ids.shape
@@ -272,8 +275,7 @@ def make_decode(cfg: LMConfig):
         for i in range(cfg.depth):
             bp = params[f"blk{i}"]
             h = _rmsnorm(x, bp["ln1"])
-            qkv = (h.astype(jnp.bfloat16)
-                   @ bp["wqkv"].astype(jnp.bfloat16)).astype(jnp.float32)
+            qkv = qmatmul(h, bp["wqkv"])
             q, k, v = jnp.split(qkv, 3, axis=-1)
             shp = (b, s, cfg.heads, hd)
             q, k = (_rope(t.reshape(shp), sin, cos) for t in (q, k))
@@ -284,10 +286,12 @@ def make_decode(cfg: LMConfig):
                 kc, k, (0, 0, 0, 0))
             cache[f"v{i}"] = jax.lax.dynamic_update_slice(
                 vc, v, (0, 0, 0, 0))
-            from ..parallel.ring_attention import reference_attention
-            att = reference_attention(q, k, v, causal=cfg.causal)
-            x = x + (att.reshape(b, s, cfg.dim).astype(jnp.bfloat16)
-                     @ bp["wo"].astype(jnp.bfloat16)).astype(jnp.float32)
+            # seq-adaptive: long prompts prefill through the flash
+            # kernel (O(s) memory) instead of materializing (s, s)
+            # scores per layer
+            from ..ops.flash_attention import attention
+            att = attention(q, k, v, causal=cfg.causal)
+            x = x + qmatmul(att.reshape(b, s, cfg.dim), bp["wo"])
             x = x + mlp(bp, _rmsnorm(x, bp["ln2"]))
         return cache, unembed(params, x[:, -1])
 
@@ -300,8 +304,7 @@ def make_decode(cfg: LMConfig):
         for i in range(cfg.depth):
             bp = params[f"blk{i}"]
             h = _rmsnorm(x, bp["ln1"])
-            qkv = (h.astype(jnp.bfloat16)
-                   @ bp["wqkv"].astype(jnp.bfloat16)).astype(jnp.float32)
+            qkv = qmatmul(h, bp["wqkv"])
             q, k, v = jnp.split(qkv, 3, axis=-1)
             shp = (b, 1, cfg.heads, hd)
             q = _rope_at(q.reshape(shp), pos, hd)
@@ -321,8 +324,7 @@ def make_decode(cfg: LMConfig):
             p = jax.nn.softmax(s_mat, axis=-1)
             att = jnp.einsum("bhqk,bkhd->bqhd", p, vc,
                              preferred_element_type=jnp.float32)
-            x = x + (att.reshape(b, 1, cfg.dim).astype(jnp.bfloat16)
-                     @ bp["wo"].astype(jnp.bfloat16)).astype(jnp.float32)
+            x = x + qmatmul(att.reshape(b, 1, cfg.dim), bp["wo"])
             x = x + mlp(bp, _rmsnorm(x, bp["ln2"]))
         cache["len"] = pos + 1
         return cache, unembed(params, x[:, 0])

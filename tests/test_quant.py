@@ -1,0 +1,128 @@
+"""Weight-only int8 quantization (ops/quant.py) — the serving-memory
+half of the LM family: per-channel symmetric quantization, fused
+dequant matmul, quantized KV-cache decode, and the RPC service flag."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from brpc_tpu.ops.quant import (QuantTensor, dequantize, qmatmul,
+                                quantize_int8, quantize_lm_params,
+                                quantized_nbytes)
+
+
+def test_quantize_roundtrip_error():
+    w = jax.random.normal(jax.random.PRNGKey(0), (128, 256), jnp.float32)
+    qw = quantize_int8(w)
+    assert qw.q.dtype == jnp.int8
+    assert qw.s.shape == (256,)
+    err = np.abs(np.asarray(dequantize(qw)) - np.asarray(w))
+    # symmetric int8: max error is half a quantization step per channel
+    step = np.asarray(qw.s)
+    assert (err <= step[None, :] * 0.51).all()
+
+
+def test_qmatmul_close_to_float():
+    k1, k2 = jax.random.split(jax.random.PRNGKey(1))
+    x = jax.random.normal(k1, (4, 128), jnp.float32)
+    w = jax.random.normal(k2, (128, 64), jnp.float32)
+    want = x @ w
+    got = qmatmul(x, quantize_int8(w))
+    # relative error budget: int8 weight noise + bf16 accumulation
+    rel = np.abs(np.asarray(got - want)) / (np.abs(np.asarray(want)) + 1)
+    assert rel.mean() < 0.02, rel.mean()
+
+
+def test_qmatmul_passthrough_plain_weight():
+    x = jnp.ones((2, 8), jnp.float32)
+    w = jnp.ones((8, 4), jnp.float32)
+    np.testing.assert_allclose(np.asarray(qmatmul(x, w)),
+                               np.asarray(jnp.full((2, 4), 8.0)),
+                               rtol=1e-2)
+
+
+def test_quantized_params_shrink_4x():
+    from brpc_tpu.models.transformer_lm import LMConfig, init_params
+    cfg = LMConfig(vocab=128, dim=64, heads=4, depth=2, max_seq=64)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    qparams = quantize_lm_params(params)
+    full = quantized_nbytes(params)
+    quant = quantized_nbytes(qparams)
+    # matmul weights dominate this config; overall shrink must be >2x
+    # (embeddings stay f32), matmul weights themselves 4x
+    assert quant < full / 2, (full, quant)
+    blk = qparams["blk0"]
+    assert isinstance(blk["wqkv"], QuantTensor)
+    assert isinstance(qparams["unembed"], QuantTensor)
+    assert not isinstance(qparams["embed"], QuantTensor)
+
+
+def test_quantized_decode_matches_float_greedy():
+    """Greedy generation from quantized weights should track the float
+    model closely on a short horizon (same argmax most steps)."""
+    from brpc_tpu.models.transformer_lm import (LMConfig, init_params,
+                                                make_generator)
+    cfg = LMConfig(vocab=64, dim=64, heads=4, depth=2, max_seq=48,
+                   remat=False)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    gen_f = make_generator(cfg, params)
+    gen_q = make_generator(cfg, quantize_lm_params(params))
+    prompt = np.arange(8, dtype=np.int32)[None, :] % cfg.vocab
+    out_f = np.asarray(gen_f(prompt, 12))
+    out_q = np.asarray(gen_q(prompt, 12))
+    assert out_f.shape == out_q.shape
+    agree = (out_f == out_q).mean()
+    assert agree >= 0.75, (agree, out_f, out_q)
+
+
+def test_quantized_lm_service_over_rpc(server_options):
+    from brpc_tpu.client import Channel
+    from brpc_tpu.models.lm_service import (LMService,
+                                            pack_generate_request,
+                                            unpack_generated)
+    from brpc_tpu.models.transformer_lm import LMConfig
+    from brpc_tpu.server import Server
+
+    cfg = LMConfig(vocab=64, dim=32, heads=4, depth=1, max_seq=64,
+                   remat=False)
+    srv = Server(server_options)
+    srv.add_service(LMService(cfg=cfg, quantize=True), name="LM")
+    assert srv.start("127.0.0.1:0") == 0
+    try:
+        from brpc_tpu.client import Controller
+        ch = Channel()
+        ch.init(str(srv.listen_endpoint))
+        prompt = np.array([[1, 2, 3]], dtype=np.int32)
+        cntl = Controller()
+        cntl.timeout_ms = 120_000       # first call compiles the jits
+        c = ch.call_method("LM.Generate",
+                           pack_generate_request(prompt, 4), cntl=cntl)
+        assert not c.failed, c.error_text
+        out = unpack_generated(c.response)
+        assert out.shape == (1, 4)      # the new tokens
+        assert ((out >= 0) & (out < cfg.vocab)).all()
+        import json
+        info = json.loads(ch.call("LM.Info", b""))
+        assert info["quantized"] is True
+        assert info["param_bytes"] > 0
+    finally:
+        srv.stop()
+
+
+def test_quantize_rejects_scan_layers_tree():
+    from brpc_tpu.models.transformer_lm import LMConfig, init_params
+    import pytest as _pytest
+    cfg = LMConfig(vocab=64, dim=32, heads=4, depth=2, max_seq=32,
+                   scan_layers=True)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    with _pytest.raises(ValueError, match="scan_layers"):
+        quantize_lm_params(params)
+
+
+def test_quantize_is_idempotent():
+    from brpc_tpu.models.transformer_lm import LMConfig, init_params
+    cfg = LMConfig(vocab=64, dim=32, heads=4, depth=1, max_seq=32)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    q1 = quantize_lm_params(params)
+    q2 = quantize_lm_params(q1)          # no crash, same tensors
+    assert q2["blk0"]["wqkv"].q is q1["blk0"]["wqkv"].q
