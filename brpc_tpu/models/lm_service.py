@@ -21,7 +21,7 @@ import numpy as np
 
 from ..butil.status import Errno
 from ..server.service import Service
-from .transformer_lm import LMConfig, init_params, make_generator
+from .transformer_lm import LMConfig, init_params
 
 
 def pack_generate_request(prompt: np.ndarray, max_new: int) -> bytes:
@@ -56,9 +56,12 @@ class LMService(Service):
         self.max_new_cap = max_new_cap
         from ..ops.quant import quantized_nbytes
         self._param_bytes = quantized_nbytes(self.params)  # immutable
-        # prefill/decode programs compile once per (batch, prompt) shape
-        # and are reused across requests
-        self._gen = make_generator(self.cfg, self.params)
+        # whole-completion scan generator: one device program per
+        # request instead of one per token (per-token dispatch dominates
+        # single-stream decode).  Programs compile per
+        # (batch, prompt_len, bucketed max_new) and are reused.
+        from .transformer_lm import make_scan_generator
+        self._gen = make_scan_generator(self.cfg, self.params)
 
     def Generate(self, cntl, request):
         try:
@@ -84,8 +87,15 @@ class LMService(Service):
         if (prompt < 0).any() or (prompt >= self.cfg.vocab).any():
             cntl.set_failed(Errno.EREQUEST, "prompt ids out of vocab")
             return None
-        out = np.asarray(self._gen(prompt, int(max_new)),
-                         dtype=np.int32)
+        # bucket max_new to the next power of two so distinct requests
+        # share compiled programs; slice the surplus off
+        bucket = 1
+        while bucket < max_new:
+            bucket <<= 1
+        bucket = min(bucket, self.max_new_cap,
+                     self.cfg.max_seq - s)
+        out = np.asarray(self._gen(prompt, int(bucket)),
+                         dtype=np.int32)[:, :max_new]
         return struct.pack("<II", *out.shape) + out.tobytes()
 
     def Info(self, cntl, request):
