@@ -12,7 +12,8 @@ The "extra" dict carries the rest of the BASELINE.md north-star set:
                              intended path for echo-class RPCs; the
                              _cntl variants measure the full Controller
                              path) (target < 50 µs)
-  - sweep_*_gbps             64B → 1MB payload sweep
+  - sweep_*_gbps             64B → 1MB payload sweep (raw latency lane;
+                             _cntl variants cover the Controller path)
   - streaming_gbps           windowed stream, 1MB chunks
   - fanout_qps               ParallelChannel over 3 servers
   - ici_1mb_tensor_gbps      device-resident 1MB tensor echo on the
@@ -149,50 +150,75 @@ def bench_headline_and_sweep(extra: dict) -> float:
                 break                    # past the knee; stop burning time
             headline = max(headline, best)
 
-        # sweep on an in-process client (pooled)
+        # sweep on an in-process client (pooled).  Primary keys measure
+        # the raw latency lane (@raw_method + call_raw — the framework's
+        # intended echo path, mirroring the reference's do-nothing echo
+        # handler); _cntl variants keep the full Controller path
+        # visible at the ends of the range.
         from brpc_tpu.butil.iobuf import IOBuf
         from brpc_tpu.client import Channel, ChannelOptions, Controller
         opts = ChannelOptions()
         opts.connection_type = "pooled"
         ch = Channel(opts)
         ch.init(addr)
-        def measure(size: int):
+
+        def _call_raw(att):
+            try:
+                ch.call_raw("Bench.EchoRaw", b"", att, timeout_ms=10_000)
+                return True
+            except Exception:
+                return False
+
+        def _call_cntl(att):
+            cntl = Controller()
+            cntl.timeout_ms = 10_000
+            cntl.request_attachment = IOBuf(att)
+            return not ch.call_method("Bench.Echo", b"",
+                                      cntl=cntl).failed
+
+        def measure(size: int, one_call):
+            """Echo throughput at one payload size.  Runs at least
+            ``reps`` calls AND at least MIN_WINDOW_S of wall time (small
+            payloads need the longer window — scheduler-phase swings on
+            this box are ~2x), capped at WALL_CAP_S."""
+            MIN_WINDOW_S = 1.5
             att = bytes(size)
             reps = max(30, min(2000, (64 << 20) // max(size, 1) // 8))
             for _ in range(3):
-                cntl = Controller(); cntl.timeout_ms = 10_000
-                cntl.request_attachment = IOBuf(att)
-                ch.call_method("Bench.Echo", b"", cntl=cntl)
+                one_call(att)                  # warmup; failures ignored
             t0 = time.perf_counter()
             done = 0
-            for _ in range(reps):
-                cntl = Controller()
-                cntl.timeout_ms = 10_000
-                cntl.request_attachment = IOBuf(att)
-                c = ch.call_method("Bench.Echo", b"", cntl=cntl)
-                if not c.failed:
+            while True:
+                if one_call(att):
                     done += 1
-                if time.perf_counter() - t0 > WALL_CAP_S:
+                dt = time.perf_counter() - t0
+                if dt > WALL_CAP_S:
+                    break
+                if done >= reps and dt >= MIN_WINDOW_S:
                     break
             dt = time.perf_counter() - t0
             return done * size * 2 / dt / 1e9, done / dt
 
         for size, label in ((64, "64b"), (4096, "4kb"),
                             (65536, "64kb"), (1 << 20, "1mb")):
-            gbps, qps = measure(size)
-            # every sweep key records its FIRST window (keeps sizes
-            # comparable); the 1MB point may add a retry window that
-            # feeds ONLY the headline, mirroring the worker-process
-            # loop's retry-when-unlucky rule
+            gbps, qps = measure(size, _call_raw)
             extra[f"sweep_{label}_gbps"] = round(gbps, 3)
             extra[f"sweep_{label}_qps"] = round(qps, 1)
             if size == HEADLINE_PAYLOAD:
-                # in-process pooled 1MB is the same configuration as the
-                # baseline's "pooled connections, large payloads" row
-                if gbps < headline * 0.9:
-                    g2, _ = measure(size)
-                    gbps = max(gbps, g2)
-                headline = max(headline, gbps)
+                # the HEADLINE stays the full-Controller-stack number
+                # (the baseline's "pooled connections, large payloads"
+                # row is brpc's full stack too); the raw-lane 1MB point
+                # is reported but never feeds the headline.
+                # Retry-when-unlucky applies to the headline candidate.
+                cg, _ = measure(size, _call_cntl)
+                extra["sweep_1mb_cntl_gbps"] = round(cg, 3)
+                if cg < headline * 0.9:
+                    g2, _ = measure(size, _call_cntl)
+                    cg = max(cg, g2)
+                headline = max(headline, cg)
+            elif size == 64:
+                _, cq = measure(size, _call_cntl)
+                extra["sweep_64b_cntl_qps"] = round(cq, 1)
 
         # pipelined small-message QPS (batch fast lane: one vectored
         # write per 256 calls, responses matched by correlation id —
@@ -203,25 +229,19 @@ def bench_headline_and_sweep(extra: dict) -> float:
         for mth, key in (("Bench.EchoRaw", "sweep_64b_pipelined_qps"),
                          ("Bench.Echo", "sweep_64b_pipelined_cntl_qps")):
             for _ in range(3):
-                ch.call_batch(mth, reqs)
+                try:
+                    ch.call_batch(mth, reqs)
+                except Exception:
+                    pass                    # warmup failure ≠ bench death
             t0 = time.perf_counter()
             n = 0
             while time.perf_counter() - t0 < 3.0:
-                ch.call_batch(mth, reqs)
-                n += len(reqs)
+                try:
+                    ch.call_batch(mth, reqs)
+                    n += len(reqs)
+                except Exception:
+                    pass
             extra[key] = round(n / (time.perf_counter() - t0), 1)
-
-        # sync 64B QPS on the raw lane (@raw_method + call_raw: the
-        # latency lane both sides; ≈ the reference's echo handler shape)
-        for _ in range(200):
-            ch.call_raw("Bench.EchoRaw", b"x" * 64)
-        t0 = time.perf_counter()
-        n = 0
-        while time.perf_counter() - t0 < 2.0:
-            ch.call_raw("Bench.EchoRaw", b"x" * 64)
-            n += 1
-        extra["sweep_64b_raw_qps"] = round(
-            n / (time.perf_counter() - t0), 1)
 
         # 1KB sync latency distribution — best of 2 windows (the box's
         # scheduler phases can inflate a single window's tail 2x).
@@ -624,13 +644,20 @@ def main() -> None:
     # must not take the whole bench down with it.
     _run_device_section("compute", "compute",
                         min(240.0, deadline - time.time()), extra)
-    headline = bench_headline_and_sweep(extra)     # the metric: always
+    headline = 0.0
+    try:
+        headline = bench_headline_and_sweep(extra)  # the metric: always
+    except Exception as e:                          # the JSON still prints
+        extra["headline_error"] = f"{type(e).__name__}: {e}"[:160]
     for name, fn in (("streaming", bench_streaming),
                      ("fanout", bench_fanout)):
         if not budget_left():
             extra[f"{name}_skipped"] = "bench budget spent"
             continue
-        fn(extra)
+        try:
+            fn(extra)
+        except Exception as e:
+            extra[f"{name}_error"] = f"{type(e).__name__}: {e}"[:160]
     if budget_left():
         # cap by the remaining budget: overshooting the deadline would
         # defeat the always-print guarantee
