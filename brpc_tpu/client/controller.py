@@ -119,11 +119,23 @@ class Controller(LazyAttachmentsMixin):
     # unary call never touches it (completed inline on the caller).
 
     def _signal_ended(self) -> None:
-        """Completion signal: flag first, then wake any created Event."""
+        """Completion signal: flag first, then wake any created Event.
+        Also unhooks every attempt's correlation id from its socket's
+        in-flight set — a call that ends without a response (timeout,
+        cancel, abandoned retry) must not leave its id pinned on a
+        long-lived connection."""
         self._ended_flag = True
         ev = self._ended
         if ev is not None:
             ev.set()
+        if self._cid_base:
+            sids = set(self._attempt_sids)
+            sids.add(self._sending_sid)
+            for sid in sids:
+                s = Socket.address(sid) if sid else None
+                if s is not None:
+                    for n in range(self._nretry + 1):
+                        s.remove_inflight(self._cid_base + n)
 
     def _ended_event(self) -> threading.Event:
         """The completion Event, created on first wait (double-checked
@@ -373,11 +385,15 @@ class Controller(LazyAttachmentsMixin):
                 meta.compress_type = self.request_compress_type
                 payload = IOBuf(data)
         attachment = self.request_attachment
-        from ..ici.endpoint import ici_enabled, local_domain_id, prepare_send
+        from ..ici.endpoint import (conn_nonce_of, ici_enabled,
+                                    local_domain_id, prepare_send)
         if ici_enabled():
             # advertise our fabric domain on every frame (one-roundtrip
-            # handshake, ≈ RdmaEndpoint's TCP-then-QP bring-up)
+            # handshake, ≈ RdmaEndpoint's TCP-then-QP bring-up), plus
+            # the connection nonce descriptor binding keys off (proxy/
+            # NAT-safe identity; must precede prepare_send's post)
             meta.ici_domain = local_domain_id()
+            meta.ici_conn = conn_nonce_of(sock)
         if self.request_device_attachment is not None:
             # with ici disabled prepare_send degrades to host-staged
             # bytes itself — the attachment must never be dropped
@@ -399,7 +415,10 @@ class Controller(LazyAttachmentsMixin):
                 combined.append_iobuf(tail)
                 attachment = combined
         frame = pack_frame(meta, payload, attachment=attachment)
-        sock.write(frame, id_wait=attempt_id)
+        sock.add_inflight(attempt_id)       # socket death must error us
+        rc = sock.write(frame, id_wait=attempt_id)
+        if rc:
+            sock.remove_inflight(attempt_id)   # write already errored it
 
     # -- asynchronous events (timers / socket failures / cancel) ----------
 
@@ -563,6 +582,7 @@ def process_rpc_response(msg: RpcMessage, sock: Socket) -> None:
     """Entry from the client InputMessenger (≈ ProcessRpcResponse,
     baidu_rpc_protocol.cpp:565)."""
     cid = msg.meta.correlation_id
+    sock.remove_inflight(cid)
     ok, cntl = _idp.lock(cid)
     if not ok or cntl is None:
         if ok:

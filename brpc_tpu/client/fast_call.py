@@ -35,12 +35,14 @@ from ..transport.socket import Socket
 from ..transport.socket_map import (pooled_socket, return_pooled_socket,
                                     short_socket)
 
-from ..protocol.meta import (RpcMeta, TAG_AUTH, TAG_ICI_DESC,
+from ..protocol.meta import (RpcMeta, TAG_AUTH, TAG_ICI_CONN,
+                             TAG_ICI_DESC,
                              TAG_ICI_DOMAIN, TAG_METHOD,
                              TAG_SERVICE, TLV_ATTACHMENT, TLV_CORRELATION,
                              TLV_SPAN, TLV_TIMEOUT, TLV_TRACE, encode_tlv)
 from ..protocol.tpu_std import parse_payload, serialize_payload
 from ..ici.endpoint import (_process_ack as _ici_process_ack,
+                            conn_nonce_of as _conn_nonce_of,
                             ici_enabled as _ici_enabled,
                             local_domain_id as _local_domain_id,
                             prepare_send as _ici_prepare_send,
@@ -292,6 +294,10 @@ def run(channel, cntl, method_full: str, request: Any,
             # fallback) extends the attachment region
             a_len, a_parts = att_len, att_parts
             dev_desc = b""
+            if domain:
+                # the conn nonce must exist BEFORE any descriptor post
+                # binds to it (prepare_send keys off conn_key_of)
+                _conn_nonce_of(sock)
             if cntl.request_device_attachment is not None:
                 post_timeout = 30.0 if deadline_us is None else max(
                     0.001, (deadline_us - _mono_ns() // 1000) / 1e6)
@@ -329,6 +335,10 @@ def run(channel, cntl, method_full: str, request: Any,
                 mb += _TMO_TAG + struct.pack("<I", left_ms)
             if domain:
                 mb += _domain_tlv(domain)
+                # conn nonce: the proxy/NAT-safe identity descriptor
+                # binding keys off (needed before any post on this
+                # attempt; cached on the socket after the first call)
+                mb += encode_tlv(TAG_ICI_CONN, _conn_nonce_of(sock))
             if cntl.trace_id:
                 mb += TLV_TRACE + struct.pack("<Q", cntl.trace_id)
             if cntl.span_id:

@@ -144,11 +144,32 @@ def _is_local_peer(sock) -> bool:
         in _LOOPBACK_HOSTS
 
 
+def conn_nonce_of(sock) -> bytes:
+    """The initiator's connection nonce: generated lazily on the client
+    socket, carried in every ici-enabled request meta, pinned by the
+    receiver from the first frame (first write wins — a later frame
+    cannot re-bind an established connection's identity)."""
+    tok = sock.ici_conn_token
+    if tok is None:
+        import os as _os
+        tok = sock.ici_conn_token = _os.urandom(8)
+    return tok
+
+
 def conn_key_of(sock):
-    """Connection identity both ends compute identically: the unordered
-    (local, remote) address pair.  Binds a descriptor to the exact TCP
-    connection it was posted for — a peer on another connection forging
-    ids cannot redeem them (fabric.redeem enforces equality)."""
+    """Connection identity both ends compute identically.
+
+    Preferred: the in-band connection nonce (``conn_nonce_of``) — it
+    survives proxies and NAT, where the two TCP legs see different
+    address pairs.  Fallback (nonce not yet exchanged): the unordered
+    (local, remote) address pair.  Either way a descriptor binds to the
+    exact connection it was posted for — a peer on another connection
+    forging ids cannot redeem them (fabric.redeem enforces equality; an
+    on-path observer who could replay the nonce could also spoof the
+    address pair, so the threat model is unchanged)."""
+    tok = sock.ici_conn_token
+    if tok is not None:
+        return tok
     local = sock.pin_local_side()
     remote = sock.remote_side
     if local is None or remote is None:

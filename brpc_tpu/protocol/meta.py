@@ -33,6 +33,9 @@ _T_TIMEOUT_MS = 13      # u32 remaining-deadline propagation
 _T_STREAM_WINDOW = 14   # u32 receiver buffer size (stream handshake)
 _T_ICI_DOMAIN = 15      # bytes: sender's device-fabric domain id
 _T_ICI_DESC = 16        # bytes: device attachment descriptor (ici/)
+_T_ICI_CONN = 17        # bytes: initiator's connection nonce — the
+                        # conn identity descriptor binding uses (address
+                        # pairs disagree across proxies/NAT)
 
 
 class CompressType:
@@ -59,6 +62,7 @@ TAG_METHOD = _T_METHOD
 TAG_AUTH = _T_AUTH
 TAG_ICI_DOMAIN = _T_ICI_DOMAIN
 TAG_ICI_DESC = _T_ICI_DESC
+TAG_ICI_CONN = _T_ICI_CONN
 
 
 class RpcMeta:
@@ -66,7 +70,7 @@ class RpcMeta:
                  "service_name", "method_name", "error_code", "error_text",
                  "auth_data", "trace_id", "span_id", "parent_span_id",
                  "stream_id", "timeout_ms", "stream_window",
-                 "ici_domain", "ici_desc")
+                 "ici_domain", "ici_desc", "ici_conn")
 
     def __init__(self):
         self.correlation_id = 0
@@ -85,6 +89,7 @@ class RpcMeta:
         self.stream_window = 0
         self.ici_domain = b""
         self.ici_desc = b""
+        self.ici_conn = b""
 
     @property
     def is_request(self) -> bool:
@@ -132,6 +137,8 @@ class RpcMeta:
             put(_T_ICI_DOMAIN, self.ici_domain)
         if self.ici_desc:
             put(_T_ICI_DESC, self.ici_desc)
+        if self.ici_conn:
+            put(_T_ICI_CONN, self.ici_conn)
         return bytes(out)
 
     @staticmethod
@@ -179,6 +186,8 @@ class RpcMeta:
                     m.ici_domain = field
                 elif tag == _T_ICI_DESC:
                     m.ici_desc = field
+                elif tag == _T_ICI_CONN:
+                    m.ici_conn = field
                 # unknown tags are skipped: forward compatibility
         except (struct.error, IndexError, UnicodeDecodeError):
             return None

@@ -199,6 +199,10 @@ def process_rpc_request(msg: RpcMessage, sock: Socket, server) -> None:
         # learn the peer's device-fabric domain (enables device-resident
         # response attachments from the very first exchange)
         sock.ici_peer_domain = meta.ici_domain
+    if meta.ici_conn and sock.ici_conn_token is None:
+        # pin the initiator's connection nonce (first write wins): the
+        # conn identity descriptor binding uses on both ends
+        sock.ici_conn_token = meta.ici_conn
     if meta.ici_desc:
         from ..ici.endpoint import split_device_attachment
         cntl.request_attachment, cntl.request_device_attachment = \

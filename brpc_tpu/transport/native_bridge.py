@@ -196,8 +196,10 @@ class NativeBridge:
                     mth = _bytes(data[off:off + ln]).decode()
                 elif tag == 3:
                     (att,) = _struct_unpack_from("<I", data, off)
-                elif tag in (13, 15):
-                    pass          # timeout / ici-domain: raw-lane safe
+                elif tag in (13, 15, 17):
+                    pass    # timeout / ici-domain / conn-nonce: safe
+                            # (nonce pinning happens on the full path;
+                            # raw methods never carry descriptors)
                 else:
                     return None   # controller-tier tag: full path
                 off += ln

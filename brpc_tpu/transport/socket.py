@@ -109,9 +109,10 @@ class Socket:
         "health_check_interval_s", "connect_timeout_s",
         "_pooled_home", "correlation_id",
         "stream_map", "_stream_lock", "tag",
-        "ici_endpoint", "ici_peer_domain",
+        "ici_endpoint", "ici_peer_domain", "ici_conn_token",
         "direct_read", "_dispatch_lock", "h2_conn", "ssl_context",
         "_pending_acks", "_ack_flush_scheduled",
+        "_inflight_ids", "_inflight_lock",
     )
 
     # -- lifecycle ---------------------------------------------------------
@@ -147,6 +148,9 @@ class Socket:
         self.tag = None                   # acceptor tag ("internal" port etc.)
         self.ici_endpoint = None          # lazy IciEndpoint (device payloads)
         self.ici_peer_domain = None       # peer's fabric domain (from meta)
+        self.ici_conn_token = None        # conn nonce for descriptor binding
+                                          # (client: generated; server: pinned
+                                          # from the first frame carrying it)
         # direct-read: the socket is NOT registered with the dispatcher;
         # the synchronous caller reads its responses itself (pooled/short
         # sync fast path — saves a dispatcher wake + fiber spawn + butex
@@ -158,6 +162,13 @@ class Socket:
         self.ssl_context = None           # TLS: wrap on connect
         self._pending_acks = []           # ICI desc ids awaiting piggyback
         self._ack_flush_scheduled = False
+        # multiplexed in-flight correlation ids awaiting responses on
+        # this connection: socket death must error every one of them —
+        # without this, a request already flushed to a dying single
+        # connection learns of the failure only from its own deadline
+        # (≈ the reference's Socket id wait list, socket.cpp:927)
+        self._inflight_ids = set()
+        self._inflight_lock = threading.Lock()
 
     @staticmethod
     def create(options: SocketOptions) -> int:
@@ -268,11 +279,25 @@ class Socket:
                 pass
             self.fd = None
         idp = global_id_pool()
+        notified = set()
         for _, id_wait in pending:
-            if id_wait:
+            if id_wait and id_wait not in notified:
+                notified.add(id_wait)
                 idp.error(id_wait, int(code), text)
-        if self.correlation_id:
+        if self.correlation_id and self.correlation_id not in notified:
+            notified.add(self.correlation_id)
             idp.error(self.correlation_id, int(code), text)
+        with self._inflight_lock:
+            inflight = list(self._inflight_ids)
+            self._inflight_ids.clear()
+        for cid in inflight:
+            # exactly-once per id: queued-write ids were notified above.
+            # Finished ids are version-bumped in the pool, so erroring a
+            # stale entry is a no-op — over-notification of OLD ids is
+            # safe, double-notification of a LIVE id is not (it would
+            # double-spend the retry budget)
+            if cid not in notified:
+                idp.error(cid, int(code), text)
         with self._stream_lock:
             broken_streams = list(self.stream_map.values())
             self.stream_map.clear()
@@ -357,6 +382,19 @@ class Socket:
         frame = self._take_ack_frame()
         if frame is not None and not self._failed:
             self.write(IOBuf(frame))
+
+    def add_inflight(self, cid: int) -> None:
+        """Track a multiplexed in-flight correlation id; must be called
+        BEFORE the request write so a failure racing the flush still
+        finds the id."""
+        if cid:
+            with self._inflight_lock:
+                self._inflight_ids.add(cid)
+
+    def remove_inflight(self, cid: int) -> None:
+        if cid:
+            with self._inflight_lock:
+                self._inflight_ids.discard(cid)
 
     def write_path_idle(self) -> bool:
         """True when no queued write is pending or draining — the only

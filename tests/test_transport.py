@@ -217,3 +217,42 @@ def test_health_check_revives():
     assert _wait_until(lambda: not Socket.address(sid).failed, timeout=5.0)
     Socket.address(sid).release()
     listener.close()
+
+
+def test_inflight_call_errors_promptly_when_connection_dies():
+    """A request already flushed on a 'single' (multiplexed) connection
+    must be errored by the socket's death immediately — not discover it
+    at its own deadline (the reference's Socket id wait list shape)."""
+    import threading
+    import time as _time
+
+    from brpc_tpu.client import Channel, ChannelOptions, Controller
+    from brpc_tpu.server import Server, Service
+
+    class Slow(Service):
+        def Nap(self, cntl, request):
+            _time.sleep(3.0)       # longer than the kill below
+            return b"late"
+
+    srv = Server()
+    srv.add_service(Slow(), name="S")
+    assert srv.start("127.0.0.1:0") == 0
+    co = ChannelOptions()
+    co.timeout_ms = 10_000
+    co.max_retry = 0
+    co.connection_type = "single"
+    ch = Channel(co)
+    assert ch.init(str(srv.listen_endpoint)) == 0
+
+    cntl = Controller()
+    cntl.timeout_ms = 10_000
+    done = threading.Event()
+    ch.call_method("S.Nap", b"", cntl=cntl, done=lambda c: done.set())
+    _time.sleep(0.3)               # request is in flight server-side
+    t0 = _time.monotonic()
+    srv.stop()                     # connection dies under the call
+    assert done.wait(5.0), "in-flight call never completed"
+    took = _time.monotonic() - t0
+    assert cntl.failed
+    assert took < 4.0, f"failure took {took:.1f}s — deadline-driven, " \
+        "not socket-death-driven"
