@@ -65,7 +65,8 @@ class Controller(LazyAttachmentsMixin):
         "_live_versions", "_done", "_response_type", "_request_payload",
         "_method_full", "_remote", "_begin_us", "_ended", "_ended_flag",
         "_timeout_timer", "_backup_timer", "_sending_sid",
-        "_attempt_sids", "attempt_remotes", "_stream_to_create",
+        "_attempt_sids", "_inflight_marks", "attempt_remotes",
+        "_stream_to_create",
         "_channel", "_lb_ctx", "trace_id", "span_id", "_direct_ok",
     )
 
@@ -106,6 +107,7 @@ class Controller(LazyAttachmentsMixin):
         self._backup_timer = 0
         self._sending_sid = 0
         self._attempt_sids = []          # pooled/short sids per attempt
+        self._inflight_marks = []        # (sid, cid) to unhook at end
         self.attempt_remotes = {}        # attempt version -> EndPoint
         self._stream_to_create = None    # set by streaming.stream_create
         self._direct_ok = False
@@ -128,14 +130,11 @@ class Controller(LazyAttachmentsMixin):
         ev = self._ended
         if ev is not None:
             ev.set()
-        if self._cid_base:
-            sids = set(self._attempt_sids)
-            sids.add(self._sending_sid)
-            for sid in sids:
-                s = Socket.address(sid) if sid else None
-                if s is not None:
-                    for n in range(self._nretry + 1):
-                        s.remove_inflight(self._cid_base + n)
+        for sid, cid in self._inflight_marks:
+            s = Socket.address(sid) if sid else None
+            if s is not None:
+                s.remove_inflight(cid)
+        self._inflight_marks.clear()
 
     def _ended_event(self) -> threading.Event:
         """The completion Event, created on first wait (double-checked
@@ -415,10 +414,22 @@ class Controller(LazyAttachmentsMixin):
                 combined.append_iobuf(tail)
                 attachment = combined
         frame = pack_frame(meta, payload, attachment=attachment)
-        sock.add_inflight(attempt_id)       # socket death must error us
-        rc = sock.write(frame, id_wait=attempt_id)
-        if rc:
-            sock.remove_inflight(attempt_id)   # write already errored it
+        # exactly-once failure notification by inflight-set ownership:
+        # the id is NOT passed to write (its refused-enqueue path could
+        # double-notify an id set_failed's drain already errored); whoever
+        # claims the id from the set delivers its one outcome
+        sock.add_inflight(attempt_id)
+        self._inflight_marks.append((sid, attempt_id))
+        if self._ended_flag:
+            # the call ended while this send was mid-launch (timeout or
+            # cancel racing the issuing thread): _signal_ended's drain
+            # may have run before our append and will not run again —
+            # unhook the id ourselves or it pins the long-lived socket
+            sock.remove_inflight(attempt_id)
+        rc = sock.write(frame)
+        if rc and sock.remove_inflight(attempt_id):
+            _idp.error(attempt_id, rc,
+                       sock.error_text or f"write to {remote} failed")
 
     # -- asynchronous events (timers / socket failures / cancel) ----------
 

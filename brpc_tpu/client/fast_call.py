@@ -408,7 +408,45 @@ def _handle_response(channel, cntl, sock, sid: int, pooled: bool, buf,
                      response_type: Any) -> Tuple[bool, int, str]:
     """Decode one response frame.  Returns (done, code, text); done=False
     means a retriable failure the caller's loop should handle."""
+    def _put_back():
+        if pooled:
+            return_pooled_socket(sid)
+        else:
+            sock.release()
+
+    def _complete(raw: bytes, attachment: IOBuf) -> Tuple[bool, int, str]:
+        """Shared completion tail: parse the payload, hand the socket
+        back, finish the call (success or parse failure)."""
+        try:
+            cntl.response = parse_payload(raw, response_type)
+        except Exception as e:
+            _put_back()
+            _finish(channel, cntl, Errno.ERESPONSE,
+                    f"response parse failed: {e}")
+            return True, 0, ""
+        cntl.response_attachment = attachment
+        _put_back()
+        _finish(channel, cntl, 0, "")
+        return True, 0, ""
+
     mv = memoryview(buf)
+    scan = _scan_raw_resp(mv[:meta_size])
+    if scan is not None:
+        # success response with nothing controller-tier in the meta:
+        # skip the RpcMeta object entirely (the common echo shape)
+        rcid, natt, dom = scan
+        if rcid != cid:
+            sock.set_failed(Errno.ERESPONSE, "response cid mismatch")
+            sock.release()
+            return False, int(Errno.EFAILEDSOCKET), "cid mismatch"
+        if dom:
+            sock.ici_peer_domain = dom
+        body = mv[meta_size:]
+        attachment = IOBuf()
+        if natt and 0 < natt <= len(body):
+            attachment.append_user_data(body[len(body) - natt:])
+            body = body[:len(body) - natt]
+        return _complete(bytes(body), attachment)
     meta = RpcMeta.decode(bytes(mv[:meta_size]))
     if meta is None or meta.correlation_id != cid:
         sock.set_failed(Errno.ERESPONSE, "undecodable response meta")
@@ -418,10 +456,7 @@ def _handle_response(channel, cntl, sock, sid: int, pooled: bool, buf,
         sock.ici_peer_domain = meta.ici_domain
     if meta.error_code:
         # full frame consumed — the connection itself is healthy
-        if pooled:
-            return_pooled_socket(sid)
-        else:
-            sock.release()
+        _put_back()
         return False, meta.error_code, meta.error_text
     body = mv[meta_size:]
     attachment = IOBuf()
@@ -439,30 +474,11 @@ def _handle_response(channel, cntl, sock, sid: int, pooled: bool, buf,
         from ..protocol import compress as compress_mod
         raw = compress_mod.decompress(raw, meta.compress_type)
         if raw is None:
-            if pooled:
-                return_pooled_socket(sid)
-            else:
-                sock.release()
+            _put_back()
             _finish(channel, cntl, Errno.ERESPONSE,
                     "undecompressable response")
             return True, 0, ""
-    try:
-        cntl.response = parse_payload(raw, response_type)
-    except Exception as e:
-        if pooled:
-            return_pooled_socket(sid)
-        else:
-            sock.release()
-        _finish(channel, cntl, Errno.ERESPONSE,
-                f"response parse failed: {e}")
-        return True, 0, ""
-    cntl.response_attachment = attachment
-    if pooled:
-        return_pooled_socket(sid)
-    else:
-        sock.release()
-    _finish(channel, cntl, 0, "")
-    return True, 0, ""
+    return _complete(raw, attachment)
 
 
 def _finish(channel, cntl, code, text: str) -> None:
@@ -592,11 +608,13 @@ def _send_all(sock, frame: bytes, timeout_s: float) -> None:
 
 
 def _scan_raw_resp(data):
-    """Minimal TLV walk of a raw-lane response meta: (cid, att_size),
-    or None when any tag beyond correlation/attachment/ici-domain is
-    present (errors etc. → full RpcMeta decode)."""
+    """Minimal TLV walk of a success-response meta: returns
+    ``(cid, att_size, ici_domain_or_None)``, or None when any tag
+    beyond correlation/attachment/ici-domain is present (errors,
+    descriptors, compression → full RpcMeta decode)."""
     cid = 0
     att = 0
+    dom = None
     off, end = 0, len(data)
     try:
         while off < end:
@@ -609,12 +627,14 @@ def _scan_raw_resp(data):
                 (cid,) = struct.unpack_from("<Q", data, off)
             elif tag == 3:
                 (att,) = struct.unpack_from("<I", data, off)
-            elif tag != 15:          # ici-domain answer is harmless
+            elif tag == 15:
+                dom = bytes(data[off:off + ln])
+            else:
                 return None
             off += ln
     except (struct.error, IndexError):
         return None
-    return cid, att
+    return cid, att, dom
 
 
 _tls_raw = __import__("threading").local()
@@ -750,7 +770,7 @@ def run_raw(channel, method_full: str, payload, attachment=b"",
             raise RpcError(meta.error_code, meta.error_text)
         rcid, natt = meta.correlation_id, meta.attachment_size
     else:
-        rcid, natt = scan
+        rcid, natt, _dom = scan
         if rcid != cid:
             sock.set_failed(Errno.ERESPONSE, "response cid mismatch")
             sock.release()

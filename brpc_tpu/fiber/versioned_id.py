@@ -44,7 +44,7 @@ class _Slot:
         self.base = 1          # first valid version
         self.range = 1
         self.locked = False
-        self.pending: deque = deque()   # queued (code, text)
+        self.pending: deque = deque()   # queued (call_id, code, text)
 
 
 class IdPool:
@@ -122,7 +122,7 @@ class IdPool:
         slot, version = self._resolve(call_id)
         if slot is None:
             return
-        run: Optional[Tuple[int, str]] = None
+        run: Optional[Tuple[int, int, str]] = None
         with slot.cond:
             # a stale id must not release a lock now owned by the slot's
             # next incarnation (slot indexes are recycled)
@@ -135,8 +135,12 @@ class IdPool:
                 slot.locked = False
                 slot.cond.notify_all()
         if run is not None:
-            code, text = run
-            slot.on_error(call_id, slot.data, code, text)
+            # deliver with the id the error was RAISED for — a ranged
+            # id's version is how the handler knows WHICH attempt
+            # failed; substituting the unlocker's call_id re-errored
+            # version 0 forever (retry chain spun, call never ended)
+            qid, code, text = run
+            slot.on_error(qid, slot.data, code, text)
 
     def unlock_and_destroy(self, call_id: int) -> bool:
         slot, version = self._resolve(call_id)
@@ -168,7 +172,7 @@ class IdPool:
             if not self._valid_locked(slot, version):
                 return False
             if slot.locked:
-                slot.pending.append((error_code, error_text))
+                slot.pending.append((call_id, error_code, error_text))
                 return True
             slot.locked = True
         slot.on_error(call_id, slot.data, error_code, error_text)

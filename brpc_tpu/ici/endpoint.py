@@ -144,15 +144,24 @@ def _is_local_peer(sock) -> bool:
         in _LOOPBACK_HOSTS
 
 
+_nonce_init_lock = threading.Lock()
+
+
 def conn_nonce_of(sock) -> bytes:
     """The initiator's connection nonce: generated lazily on the client
     socket, carried in every ici-enabled request meta, pinned by the
     receiver from the first frame (first write wins — a later frame
-    cannot re-bind an established connection's identity)."""
+    cannot re-bind an established connection's identity).  The lazy
+    init is locked: two threads racing the first RPC on one shared
+    'single' connection must agree on ONE nonce, or the server's pinned
+    value desyncs from the client's for the connection's lifetime."""
     tok = sock.ici_conn_token
     if tok is None:
         import os as _os
-        tok = sock.ici_conn_token = _os.urandom(8)
+        with _nonce_init_lock:
+            tok = sock.ici_conn_token
+            if tok is None:
+                tok = sock.ici_conn_token = _os.urandom(8)
     return tok
 
 
@@ -166,7 +175,12 @@ def conn_key_of(sock):
     exact connection it was posted for — a peer on another connection
     forging ids cannot redeem them (fabric.redeem enforces equality; an
     on-path observer who could replay the nonce could also spoof the
-    address pair, so the threat model is unchanged)."""
+    address pair, so the threat model is unchanged).
+
+    Version note: both ends of this framework send/pin the nonce, so
+    descriptor exchange always keys on it; peers predating the nonce
+    TLV are not supported for device attachments (byte attachments and
+    all other traffic are unaffected)."""
     tok = sock.ici_conn_token
     if tok is not None:
         return tok

@@ -115,6 +115,17 @@ class NativeBridge:
 
     def stop(self) -> None:
         self.engine.stop()
+        # close the listen fd: the engine no longer accepts, but the
+        # KERNEL still completes handshakes into the backlog of an open
+        # listener — clients (health checks!) would "connect" to a
+        # server that never serves them and hang until their deadlines
+        ls = getattr(self, "_listen_socket", None)
+        if ls is not None:
+            try:
+                ls.close()
+            except OSError:
+                pass
+            self._listen_socket = None
         for sid in list(self._conns.values()):
             s = Socket.address(sid)
             if s is not None:

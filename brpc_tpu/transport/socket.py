@@ -391,10 +391,22 @@ class Socket:
             with self._inflight_lock:
                 self._inflight_ids.add(cid)
 
-    def remove_inflight(self, cid: int) -> None:
-        if cid:
-            with self._inflight_lock:
-                self._inflight_ids.discard(cid)
+    def remove_inflight(self, cid: int) -> bool:
+        """Remove ``cid`` from the in-flight set.  True ⇒ the caller
+        CLAIMED it and owns its one notification/completion; False ⇒
+        someone else (set_failed's drain, a response, call teardown)
+        already did — exactly-once by set ownership."""
+        if not cid:
+            return False
+        with self._inflight_lock:
+            if cid in self._inflight_ids:
+                self._inflight_ids.remove(cid)
+                return True
+            return False
+
+    @property
+    def error_text(self) -> str:
+        return self._error_text
 
     def write_path_idle(self) -> bool:
         """True when no queued write is pending or draining — the only

@@ -298,3 +298,40 @@ def test_client_channel_over_native_server(nserver):
     assert ch.call("E.Echo", b"via-channel") == b"via-channel"
     big = bytes(range(256)) * 2048          # 512KB both directions
     assert ch.call("E.Echo", big) == big
+
+
+def test_native_stop_closes_listener():
+    """After Server.stop() on a native server, new connects must be
+    REFUSED — an open listen fd would let the kernel complete
+    handshakes into the backlog of a server that never serves them
+    (health checks then 'revive' sockets into a black hole and calls
+    hang to their deadlines)."""
+    import errno
+    import socket as _s
+
+    from brpc_tpu.server import Server, ServerOptions, Service
+
+    class E(Service):
+        def Echo(self, cntl, request):
+            return request
+
+    opts = ServerOptions()
+    opts.native = True
+    srv = Server(opts)
+    srv.add_service(E(), name="E")
+    assert srv.start("127.0.0.1:0") == 0
+    ep = srv.listen_endpoint
+    srv.stop()
+    c = _s.socket()
+    c.settimeout(1.0)
+    try:
+        c.connect((str(ep.host), int(ep.port)))
+        # a connect that "succeeds" against a closed server means the
+        # backlog accepted it — the bug this test pins down
+        raise AssertionError("connect succeeded after server stop")
+    except (ConnectionRefusedError, _s.timeout, OSError) as e:
+        if isinstance(e, OSError) and getattr(e, "errno", None) not in (
+                errno.ECONNREFUSED, errno.ETIMEDOUT, None):
+            raise
+    finally:
+        c.close()

@@ -256,3 +256,41 @@ def test_inflight_call_errors_promptly_when_connection_dies():
     assert cntl.failed
     assert took < 4.0, f"failure took {took:.1f}s — deadline-driven, " \
         "not socket-death-driven"
+
+
+def test_retry_exhaustion_on_dead_single_connection_finishes():
+    """Retries against a dead server on a 'single' connection must end
+    in a terminal failure, not spin: queued id errors are delivered
+    with the ATTEMPT's call id (a re-delivery that substituted the base
+    id re-errored version 0 forever and the call never completed)."""
+    import threading
+    import time as _time
+
+    from brpc_tpu.client import Channel, ChannelOptions, Controller
+    from brpc_tpu.server import Server, Service
+
+    class Slow(Service):
+        def Nap(self, cntl, request):
+            _time.sleep(2.0)
+            return b"late"
+
+    srv = Server()
+    srv.add_service(Slow(), name="S")
+    assert srv.start("127.0.0.1:0") == 0
+    co = ChannelOptions()
+    co.timeout_ms = 8_000
+    co.max_retry = 2
+    co.connection_type = "single"
+    ch = Channel(co)
+    assert ch.init(str(srv.listen_endpoint)) == 0
+    cntl = Controller()
+    cntl.timeout_ms = 8_000
+    done = threading.Event()
+    ch.call_method("S.Nap", b"", cntl=cntl, done=lambda c: done.set())
+    _time.sleep(0.2)
+    t0 = _time.monotonic()
+    srv.stop()
+    assert done.wait(5.0), "retry chain never terminated"
+    assert cntl.failed
+    assert cntl.retried_count == 2          # budget spent, then finished
+    assert _time.monotonic() - t0 < 4.0
