@@ -356,8 +356,17 @@ class Controller(LazyAttachmentsMixin):
                 if att else None
             frame = build_request("POST", f"/{svc}/{mth}", body=body,
                                   host=str(remote), headers=headers)
-            sock.correlation_id = attempt_id
-            sock.write(frame, id_wait=attempt_id)
+            sock.correlation_id = attempt_id   # response routing (no
+            # failure-notification role: the inflight set owns that, so
+            # a set_failed racing this write cannot double-error the id)
+            sock.add_inflight(attempt_id)
+            self._inflight_marks.append((sid, attempt_id))
+            if self._ended_flag:
+                sock.remove_inflight(attempt_id)
+            rc = sock.write(frame)
+            if rc and sock.remove_inflight(attempt_id):
+                _idp.error(attempt_id, rc,
+                           sock.error_text or f"write to {remote} failed")
             return
         meta = RpcMeta()
         meta.correlation_id = attempt_id
@@ -612,6 +621,7 @@ def process_http_response(msg, sock: Socket) -> None:
     if not cid:
         return
     sock.correlation_id = 0
+    sock.remove_inflight(cid)       # response delivery claims the id
     ok, cntl = _idp.lock(cid)
     if not ok or cntl is None:
         if ok:

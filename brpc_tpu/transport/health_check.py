@@ -10,9 +10,6 @@ client layer once HTTP is available.
 
 from __future__ import annotations
 
-import socket as _socket
-from typing import Optional
-
 from ..butil.logging_util import LOG
 from ..bvar.reducer import Adder
 from ..fiber.timer_thread import global_timer_thread
@@ -32,20 +29,15 @@ def start_health_check(sid: int, interval_s: float,
         if s is None or not s.failed or s.remote_side is None:
             return                       # destroyed or already revived
         attempt["n"] += 1
-        try:
-            fd = _socket.create_connection(
-                s.remote_side.to_sockaddr(), timeout=s.connect_timeout_s)
-            fd.setsockopt(_socket.IPPROTO_TCP, _socket.TCP_NODELAY, 1)
-            # clears stale read state and re-registers read interest —
-            # a revived socket must receive responses, not just write
-            s.reset_connection(fd)
+        # one shared revival recipe (TLS wrap, dispatcher re-register,
+        # serialized against fail-fast revivers) — Socket.reconnect_now
+        if s.reconnect_now():
             _revived << 1
             return
-        except OSError:
-            if max_attempts and attempt["n"] >= max_attempts:
-                LOG.warning("health check giving up on socket %d (%s)",
-                            sid, s.remote_side)
-                return
-            global_timer_thread().schedule(check, delay_s=interval_s)
+        if max_attempts and attempt["n"] >= max_attempts:
+            LOG.warning("health check giving up on socket %d (%s)",
+                        sid, s.remote_side)
+            return
+        global_timer_thread().schedule(check, delay_s=interval_s)
 
     global_timer_thread().schedule(check, delay_s=interval_s)

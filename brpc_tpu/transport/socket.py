@@ -113,6 +113,7 @@ class Socket:
         "direct_read", "_dispatch_lock", "h2_conn", "ssl_context",
         "_pending_acks", "_ack_flush_scheduled",
         "_inflight_ids", "_inflight_lock",
+        "_reconnect_lock", "_last_reconnect_at",
     )
 
     # -- lifecycle ---------------------------------------------------------
@@ -169,6 +170,8 @@ class Socket:
         # (≈ the reference's Socket id wait list, socket.cpp:927)
         self._inflight_ids = set()
         self._inflight_lock = threading.Lock()
+        self._reconnect_lock = threading.Lock()
+        self._last_reconnect_at = 0.0
 
     @staticmethod
     def create(options: SocketOptions) -> int:
@@ -284,9 +287,10 @@ class Socket:
             if id_wait and id_wait not in notified:
                 notified.add(id_wait)
                 idp.error(id_wait, int(code), text)
-        if self.correlation_id and self.correlation_id not in notified:
-            notified.add(self.correlation_id)
-            idp.error(self.correlation_id, int(code), text)
+        # NOTE: correlation_id (the HTTP response-routing hint) is NOT
+        # separately notified — HTTP attempts register in the inflight
+        # set like everyone else; a second channel would double-error
+        # a live id and double-spend its retry budget
         with self._inflight_lock:
             inflight = list(self._inflight_ids)
             self._inflight_ids.clear()
@@ -315,6 +319,58 @@ class Socket:
             from .health_check import start_health_check
             start_health_check(self.id, self.health_check_interval_s)
         return True
+
+    def reconnect_now(self) -> bool:
+        """The revival recipe — ONE implementation shared by the health
+        checker and the fail-fast path: fresh connect, TLS wrap when
+        configured (same as connect_if_not), then reset_connection.
+        Serialized by ``_reconnect_lock``: concurrent revivers must not
+        each install an fd — the loser's would leak, still registered
+        with the dispatcher.  Returns True when the socket is usable."""
+        with self._reconnect_lock:
+            if not self._failed:
+                return True
+            if self.remote_side is None:
+                return False
+            try:
+                fd = _socket.create_connection(
+                    self.remote_side.to_sockaddr(),
+                    timeout=self.connect_timeout_s)
+                fd.setsockopt(_socket.IPPROTO_TCP, _socket.TCP_NODELAY, 1)
+                if self.ssl_context is not None:
+                    fd.settimeout(self.connect_timeout_s + 4.0)
+                    fd = self.ssl_context.wrap_socket(
+                        fd, server_hostname=str(self.remote_side.host))
+                self.reset_connection(fd)
+                return True
+            except OSError:
+                return False
+
+    def try_reconnect_now(self) -> bool:
+        """Fail-fast revival: the health checker's action without
+        waiting for its tick.  The SocketMap's shared "single"
+        connection uses this so the first retry after a server restart
+        (same address — ephemeral port reuse, a bounced production
+        server) reconnects inline instead of failing for up to a whole
+        health-check interval.  Rate-limited to one attempt per 500ms;
+        a caller that loses the lock race reports the current state
+        instead of piling up."""
+        if not self._failed:
+            return True
+        if self.remote_side is None:
+            return False
+        if not self._reconnect_lock.acquire(blocking=False):
+            return not self._failed
+        try:
+            if not self._failed:
+                return True
+            now = time.monotonic()
+            if now - self._last_reconnect_at < 0.5:
+                return False
+            self._last_reconnect_at = now
+        finally:
+            self._reconnect_lock.release()
+        return self.reconnect_now()
 
     def revive(self) -> None:
         """≈ Socket::Revive (socket.cpp:852): back in business after a

@@ -82,15 +82,22 @@ class SocketMap:
         key = (remote, ssl_context is not None)
         with self._lock:
             sid = self._map.get(key)
-            if sid is not None:
-                s = Socket.address(sid)
-                if s is not None:
-                    return sid, 0
-            sid, rc = _new_connection(remote, self._hc_interval(),
-                                      ssl_context=ssl_context)
-            if rc == 0 or Socket.address(sid) is not None:
-                self._map[key] = sid
-            return sid, rc
+            s = Socket.address(sid) if sid is not None else None
+            if s is None:
+                sid, rc = _new_connection(remote, self._hc_interval(),
+                                          ssl_context=ssl_context)
+                if rc == 0 or Socket.address(sid) is not None:
+                    self._map[key] = sid
+                return sid, rc
+        if s.failed:
+            # fail-fast revival OUTSIDE the map lock (the connect can
+            # block up to connect_timeout_s; one dead peer must not
+            # stall get_socket for every other peer): reconnect now,
+            # rate-limited, instead of failing calls until the health
+            # checker's next tick — the case is a bounced server on the
+            # same address
+            s.try_reconnect_now()
+        return sid, 0
 
     def remove(self, remote: EndPoint) -> None:
         with self._lock:

@@ -294,3 +294,48 @@ def test_retry_exhaustion_on_dead_single_connection_finishes():
     assert cntl.failed
     assert cntl.retried_count == 2          # budget spent, then finished
     assert _time.monotonic() - t0 < 4.0
+
+
+def test_single_connection_survives_server_bounce_on_same_port():
+    """A bounced server on the same address (ephemeral port reuse, a
+    production restart): the shared 'single' connection EOFs on first
+    use, and the call's RETRY must reconnect inline (fail-fast revival)
+    instead of failing until the health checker's 3s tick."""
+    import time as _time
+
+    from brpc_tpu.client import Channel, ChannelOptions, Controller
+    from brpc_tpu.server import Server, Service
+
+    class E(Service):
+        def Echo(self, cntl, request):
+            return request
+
+    srv = Server()
+    srv.add_service(E(), name="E")
+    assert srv.start("127.0.0.1:0") == 0
+    port = int(srv.listen_endpoint.port)
+    co = ChannelOptions()
+    co.timeout_ms = 3000
+    co.max_retry = 3
+    co.connection_type = "single"
+    ch = Channel(co)
+    assert ch.init(f"127.0.0.1:{port}") == 0
+    assert ch.call("E.Echo", b"warm") == b"warm"
+    srv.stop()
+    srv2 = Server()
+    srv2.add_service(E(), name="E")
+    rebound = srv2.start(f"127.0.0.1:{port}") == 0
+    if not rebound:
+        import pytest
+        pytest.skip("port not immediately rebindable on this kernel")
+    try:
+        t0 = _time.monotonic()
+        cntl = Controller()
+        cntl.timeout_ms = 3000
+        c = ch.call_method("E.Echo", b"back", cntl=cntl)
+        took = _time.monotonic() - t0
+        assert not c.failed, c.error_text
+        assert c.response == b"back"
+        assert took < 2.0, f"revival took {took:.1f}s (health-tick-bound)"
+    finally:
+        srv2.stop()
