@@ -243,61 +243,61 @@ def bench_headline_and_sweep(extra: dict) -> float:
                     pass
             extra[key] = round(n / (time.perf_counter() - t0), 1)
 
-        # 1KB sync latency distribution — best of 2 windows (the box's
-        # scheduler phases can inflate a single window's tail 2x).
-        # Primary keys measure the raw latency lane (the framework's
-        # intended path for echo-class RPCs, matching the reference's
-        # do-nothing echo handler); _cntl keys measure the full
-        # Controller path.
+        # 1KB sync latency distribution — best of 3 windows, SAME count
+        # for both lanes so the raw-vs-cntl delta stays a fair read
+        # (best-of-N p50 decreases stochastically with N).  The box's
+        # scheduler phases can inflate a single window's tail 2x; a
+        # shared section cap keeps a throttled box from eating the
+        # budget the later sections need.  Primary keys measure the raw
+        # latency lane; _cntl keys the full Controller path.
         att = bytes(1024)
-        best_p50, best_p99 = float("inf"), float("inf")
-        for _window in range(2):
-            lats = []
-            w0 = time.perf_counter()
-            for _ in range(1500):
-                t0 = time.perf_counter()
-                try:
-                    ch.call_raw("Bench.EchoRaw", b"", att,
-                                timeout_ms=10_000)
-                    lats.append((time.perf_counter() - t0) * 1e6)
-                except Exception:
-                    pass
-                if time.perf_counter() - w0 > WALL_CAP_S:
+        sect0 = time.perf_counter()
+        LAT_SECTION_CAP_S = 45.0
+
+        def lat_window(one_call):
+            best_p50, best_p99 = float("inf"), float("inf")
+            for _window in range(3):
+                if time.perf_counter() - sect0 > LAT_SECTION_CAP_S:
                     break
-            if not lats:
-                continue     # whole window failed: never index empty
-            lats.sort()
-            p50 = lats[len(lats) // 2]
-            if p50 < best_p50:
-                best_p50 = p50
-                best_p99 = lats[int(len(lats) * 0.99)]
-        if best_p50 < float("inf"):
-            extra["echo_1kb_p50_us"] = round(best_p50, 1)
-            extra["echo_1kb_p99_us"] = round(best_p99, 1)
-        best_p50, best_p99 = float("inf"), float("inf")
-        for _window in range(2):
-            lats = []
-            w0 = time.perf_counter()
-            for _ in range(1500):
-                cntl = Controller()
-                cntl.timeout_ms = 10_000
-                cntl.request_attachment = IOBuf(att)
-                t0 = time.perf_counter()
-                c = ch.call_method("Bench.Echo", b"", cntl=cntl)
-                if not c.failed:
-                    lats.append((time.perf_counter() - t0) * 1e6)
-                if time.perf_counter() - w0 > WALL_CAP_S:
-                    break
-            if not lats:
-                continue
-            lats.sort()
-            p50 = lats[len(lats) // 2]
-            if p50 < best_p50:
-                best_p50 = p50
-                best_p99 = lats[int(len(lats) * 0.99)]
-        if best_p50 < float("inf"):
-            extra["echo_1kb_cntl_p50_us"] = round(best_p50, 1)
-            extra["echo_1kb_cntl_p99_us"] = round(best_p99, 1)
+                lats = []
+                w0 = time.perf_counter()
+                for _ in range(1500):
+                    t0 = time.perf_counter()
+                    if one_call():
+                        lats.append((time.perf_counter() - t0) * 1e6)
+                    if time.perf_counter() - w0 > WALL_CAP_S:
+                        break
+                if not lats:
+                    continue     # whole window failed: never index empty
+                lats.sort()
+                p50 = lats[len(lats) // 2]
+                if p50 < best_p50:
+                    best_p50 = p50
+                    best_p99 = lats[int(len(lats) * 0.99)]
+            return best_p50, best_p99
+
+        def one_raw():
+            try:
+                ch.call_raw("Bench.EchoRaw", b"", att, timeout_ms=10_000)
+                return True
+            except Exception:
+                return False
+
+        def one_cntl():
+            cntl = Controller()
+            cntl.timeout_ms = 10_000
+            cntl.request_attachment = IOBuf(att)
+            return not ch.call_method("Bench.Echo", b"",
+                                      cntl=cntl).failed
+
+        p50, p99 = lat_window(one_raw)
+        if p50 < float("inf"):
+            extra["echo_1kb_p50_us"] = round(p50, 1)
+            extra["echo_1kb_p99_us"] = round(p99, 1)
+        p50, p99 = lat_window(one_cntl)
+        if p50 < float("inf"):
+            extra["echo_1kb_cntl_p50_us"] = round(p50, 1)
+            extra["echo_1kb_cntl_p99_us"] = round(p99, 1)
         return headline
     finally:
         srv.stop()
