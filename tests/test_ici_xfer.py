@@ -64,13 +64,15 @@ def child_server():
         stdin=subprocess.PIPE, stdout=subprocess.PIPE,
         stderr=subprocess.DEVNULL, text=True)
     port = None
-    deadline = time.time() + 60
-    while time.time() < deadline:
+    deadline = time.time() + 180     # child imports jax: slow when the
+    while time.time() < deadline:    # 1-core box is contended
+        if proc.poll() is not None:
+            break                    # child died: readline would spin
         line = proc.stdout.readline()
         if line.startswith("PORT="):
             port = int(line.strip().split("=")[1])
             break
-    assert port, "child server did not come up"
+    assert port, f"child server did not come up (rc={proc.poll()})"
     yield f"127.0.0.1:{port}"
     try:
         proc.stdin.close()
