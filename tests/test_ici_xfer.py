@@ -63,16 +63,27 @@ def child_server():
         [sys.executable, "-c", _CHILD % {"repo": REPO}],
         stdin=subprocess.PIPE, stdout=subprocess.PIPE,
         stderr=subprocess.DEVNULL, text=True)
-    port = None
-    deadline = time.time() + 180     # child imports jax: slow when the
-    while time.time() < deadline:    # 1-core box is contended
-        if proc.poll() is not None:
-            break                    # child died: readline would spin
-        line = proc.stdout.readline()
-        if line.startswith("PORT="):
-            port = int(line.strip().split("=")[1])
-            break
-    assert port, f"child server did not come up (rc={proc.poll()})"
+    # the child imports jax (slow under contention) and a bare
+    # readline() would block past any deadline; read on a thread so the
+    # wait is genuinely bounded, and kill the child if startup fails —
+    # an assert before the yield skips the fixture's normal teardown
+    got = {"port": None}
+
+    def _read_port():
+        for line in proc.stdout:
+            if line.startswith("PORT="):
+                got["port"] = int(line.strip().split("=")[1])
+                return
+
+    reader = threading.Thread(target=_read_port, daemon=True)
+    reader.start()
+    reader.join(timeout=180)
+    if got["port"] is None:
+        proc.kill()
+        proc.wait(timeout=10)
+        raise AssertionError(
+            f"child server did not come up (rc={proc.poll()})")
+    port = got["port"]
     yield f"127.0.0.1:{port}"
     try:
         proc.stdin.close()
