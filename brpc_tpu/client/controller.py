@@ -518,7 +518,16 @@ class Controller(LazyAttachmentsMixin):
                 msg.socket_id or self._sending_sid,
                 msg.meta.stream_id,
                 peer_window=msg.meta.stream_window)
-        attachment = msg.split_attachment()
+        try:
+            attachment = msg.split_attachment()
+        except ValueError as e:
+            if msg.meta.ici_desc:
+                # the malformed response still carried a posted
+                # descriptor: return the peer's window credit
+                from ..ici.endpoint import ack_unused
+                ack_unused(msg.meta, msg.socket_id)
+            self._finish_locked(int(Errno.ERESPONSE), str(e))
+            return
         if msg.meta.ici_domain:
             s = Socket.address(msg.socket_id or self._sending_sid)
             if s is not None:

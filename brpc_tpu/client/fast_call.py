@@ -139,14 +139,11 @@ def _py_sync_call(sock, frame: bytes,
         # drain everything already buffered before blocking again
         while True:
             if len(buf) >= 8 and buf[:4] == b"TICI":
-                (cnt,) = struct.unpack_from("<I", buf, 4)
-                if cnt > 1 << 20:
-                    raise ValueError("oversized ack frame")
-                total = 8 + 8 * cnt
-                if len(buf) < total:
-                    break
-                acks.extend(struct.unpack_from(f"<{cnt}Q", buf, 8))
-                del buf[:total]
+                got, off = _cut_tici_frames(buf)
+                if off == 0:
+                    break            # partial ack frame: need more bytes
+                acks.extend(got)
+                del buf[:off]
                 continue
             if len(buf) >= 12:
                 if buf[:4] != _MAGIC:
@@ -172,16 +169,11 @@ def _py_sync_call(sock, frame: bytes,
                         if avail >= 4 and buf[off:off + 4] != b"TICI":
                             raise ValueError(
                                 "unexpected trailing bytes after response")
-                        if avail >= 8:
-                            (cnt,) = struct.unpack_from("<I", buf, off + 4)
-                            if cnt > 1 << 20:
-                                raise ValueError("oversized ack frame")
-                            total = 8 + 8 * cnt
-                            if avail >= total:
-                                acks.extend(struct.unpack_from(
-                                    f"<{cnt}Q", buf, off + 8))
-                                off += total
-                                continue
+                        got, noff = _cut_tici_frames(buf, off)
+                        if noff > off:
+                            acks.extend(got)
+                            off = noff
+                            continue
                         # partial trailing ack frame: finish reading it
                         left = None if tdl is None \
                             else tdl - _time.monotonic()
@@ -299,19 +291,42 @@ def run(channel, cntl, method_full: str, request: Any,
                 # binds to it (prepare_send keys off conn_key_of)
                 _conn_nonce_of(sock)
             if cntl.request_device_attachment is not None:
-                post_timeout = 30.0 if deadline_us is None else max(
-                    0.001, (deadline_us - _mono_ns() // 1000) / 1e6)
+                # credit-return TICI frames may sit unread in THIS
+                # socket's kernel buffer (lazy redeems after the last
+                # response); the window wait below can only be satisfied
+                # by processing them, and nothing else reads this
+                # exclusively-owned fd — drain first, then post with a
+                # bounded wait and fall back to the slow path (where
+                # dispatcher reads return credit) instead of starving
+                # into EOVERCROWDED
+                _drain_acks_nonblocking(sock, deadline_us)
+                if sock.failed:
+                    # drain found EOF/garbage: the connection is dead —
+                    # do NOT post a descriptor bound to it (the credit
+                    # would strand until the TTL sweep); let the full
+                    # machinery pick a fresh socket, within the time
+                    # this attempt has already partly spent
+                    sock.release()
+                    _slow_path_remaining(channel, cntl, method_full,
+                                         request, response_type,
+                                         deadline_us, timeout_ms)
+                    return
+                post_timeout = 2.0 if deadline_us is None else max(
+                    0.001, min(2.0, (deadline_us - _mono_ns() // 1000)
+                               / 1e6))
                 m = RpcMeta()
                 try:
                     tail = _ici_prepare_send(
                         sock, m, cntl.request_device_attachment,
                         timeout_s=post_timeout)
-                except RuntimeError as e:
+                except RuntimeError:
                     if pooled:
                         return_pooled_socket(sid)
                     else:
                         sock.release()
-                    _finish(channel, cntl, Errno.EOVERCROWDED, str(e))
+                    _slow_path_remaining(channel, cntl, method_full,
+                                         request, response_type,
+                                         deadline_us, timeout_ms)
                     return
                 dev_desc = m.ici_desc
                 if tail is not None:
@@ -443,7 +458,12 @@ def _handle_response(channel, cntl, sock, sid: int, pooled: bool, buf,
             sock.ici_peer_domain = dom
         body = mv[meta_size:]
         attachment = IOBuf()
-        if natt and 0 < natt <= len(body):
+        if natt:
+            if natt > len(body):
+                sock.set_failed(Errno.ERESPONSE,
+                                "attachment size exceeds body")
+                sock.release()
+                return False, int(Errno.ERESPONSE), "malformed response"
             attachment.append_user_data(body[len(body) - natt:])
             body = body[:len(body) - natt]
         return _complete(bytes(body), attachment)
@@ -462,10 +482,18 @@ def _handle_response(channel, cntl, sock, sid: int, pooled: bool, buf,
     attachment = IOBuf()
     if meta.attachment_size:
         n = meta.attachment_size
-        if 0 < n <= len(body):
-            # zero-copy: the attachment view keeps the frame buffer alive
-            attachment.append_user_data(body[len(body) - n:])
-            body = body[:len(body) - n]
+        if n > len(body):
+            if meta.ici_desc:
+                # malformed frame still carried a posted descriptor:
+                # return the peer's window credit before bailing
+                from ..ici.endpoint import ack_unused
+                ack_unused(meta, sid)
+            sock.set_failed(Errno.ERESPONSE, "attachment size exceeds body")
+            sock.release()
+            return False, int(Errno.ERESPONSE), "malformed response"
+        # zero-copy: the attachment view keeps the frame buffer alive
+        attachment.append_user_data(body[len(body) - n:])
+        body = body[:len(body) - n]
     if meta.ici_desc:
         attachment, cntl.response_device_attachment = \
             _split_device_att(meta, attachment, sid)
@@ -495,6 +523,22 @@ def _slow_path(channel, cntl, method_full, request, response_type) -> None:
     payload = serialize_payload(request)
     cntl._launch(channel, method_full, payload, response_type, None)
     cntl._sync_wait()
+
+
+def _slow_path_remaining(channel, cntl, method_full, request,
+                         response_type, deadline_us, timeout_ms) -> None:
+    """Escape hatch taken AFTER this lane already burned wall time
+    (window waits, drains): cap the controller attempt to the original
+    deadline — _launch resets the clock, so without this a 1s-deadline
+    call could run ~2s."""
+    if deadline_us is not None:
+        left_ms = (deadline_us - _mono_ns() // 1000) // 1000
+        if left_ms <= 0:
+            _finish(channel, cntl, Errno.ERPCTIMEDOUT,
+                    f"deadline {timeout_ms}ms exceeded")
+            return
+        cntl.timeout_ms = max(1, int(left_ms))
+    _slow_path(channel, cntl, method_full, request, response_type)
 
 
 def run_scatter(branches, timeout_ms: Optional[int]) -> bool:
@@ -590,6 +634,86 @@ def run_scatter(branches, timeout_ms: Optional[int]) -> bool:
     return True
 
 
+def _cut_tici_frames(buf, off: int = 0) -> Tuple[list, int]:
+    """Cut complete TICI credit-return frames from ``buf[off:]``.
+    Returns (ack ids, new offset past the consumed frames); stops at the
+    first incomplete frame or non-TICI byte.  Raises ValueError on an
+    oversized count (protocol desync)."""
+    acks: list = []
+    while len(buf) - off >= 8 and bytes(buf[off:off + 4]) == b"TICI":
+        (cnt,) = struct.unpack_from("<I", buf, off + 4)
+        if cnt > 1 << 20:
+            raise ValueError("oversized ack frame")
+        total = 8 + 8 * cnt
+        if len(buf) - off < total:
+            break
+        acks.extend(struct.unpack_from(f"<{cnt}Q", buf, off + 8))
+        off += total
+    return acks, off
+
+
+def _drain_acks_nonblocking(sock, deadline_us: Optional[int] = None) -> None:
+    """Consume TICI credit-return frames already buffered in the kernel
+    for this exclusively-owned fd.  Between calls the only legal inbound
+    bytes are acks, so a partial frame is finished with a short blocking
+    wait (the sender wrote it atomically; completion is imminent) capped
+    by the caller's remaining RPC deadline.  On protocol desync or EOF
+    the socket is failed — callers must check ``sock.failed`` before
+    using the connection further."""
+    import time as _time
+    fd = sock.fd
+    if fd is None:
+        return
+    buf = bytearray()
+    deadline = None
+    while True:
+        try:
+            chunk = fd.recv(65536)
+        except (BlockingIOError, InterruptedError):
+            chunk = None
+        except OSError as e:
+            # ECONNRESET etc.: the connection is dead — fail the socket
+            # so the caller's guard sees it (a silent return would let a
+            # descriptor be posted onto the corpse)
+            sock.set_failed(Errno.EFAILEDSOCKET, f"drain: {e}")
+            return
+        if chunk:
+            buf += chunk
+        elif chunk == b"":
+            sock.set_failed(Errno.EFAILEDSOCKET, "closed while draining")
+            return
+        try:
+            acks, off = _cut_tici_frames(buf)
+        except ValueError:
+            sock.set_failed(Errno.ERESPONSE, "oversized ack frame")
+            return
+        if acks:
+            _ici_process_ack(acks, sock)
+        del buf[:off]
+        if not buf:
+            if chunk is None:
+                return               # kernel buffer dry, nothing partial
+            continue                 # maybe more already buffered
+        if bytes(buf[:4]) != b"TICI"[:len(buf[:4])]:
+            sock.set_failed(Errno.ERESPONSE,
+                            "unexpected bytes while idle")
+            return
+        # partial ack frame: give the in-flight bytes a moment, but
+        # never overshoot the RPC deadline the caller is living under
+        if deadline is None:
+            deadline = _time.monotonic() + 2.0
+            if deadline_us is not None:
+                deadline = min(
+                    deadline,
+                    _time.monotonic()
+                    + max(0.001, (deadline_us - _mono_ns() // 1000) / 1e6))
+        left = deadline - _time.monotonic()
+        if left <= 0:
+            sock.set_failed(Errno.ERESPONSE, "truncated ack frame")
+            return
+        _select.select([fd], [], [], left)
+
+
 def _send_all(sock, frame: bytes, timeout_s: float) -> None:
     """Blocking-with-deadline send of one frame on a non-blocking fd."""
     import time as _time
@@ -640,15 +764,53 @@ def _scan_raw_resp(data):
 _tls_raw = __import__("threading").local()
 
 
+def _unpin_all(sids_map: dict) -> None:
+    """Finalizer body: return a dead thread's pinned sockets to the pool
+    (the map outlives the wrapper; see _PinnedSocks)."""
+    for sid in list(sids_map.values()):
+        s = Socket.address(sid)
+        if s is not None and not s.failed:
+            return_pooled_socket(sid)
+    sids_map.clear()
+
+
+class _PinnedSocks(dict):
+    """Thread-pinned {remote: sid} map.  When the owning thread dies its
+    thread-locals are dropped — a plain dict would strand the checked-out
+    pooled sockets (one leaked fd per thread per remote, forever).  A
+    weakref finalizer returns them to the pool instead; it closes over a
+    plain inner mirror of the sids (the wrapper itself is unreachable by
+    the time the finalizer runs)."""
+
+    def __init__(self):
+        super().__init__()
+        import weakref
+        self._mirror: dict = {}
+        self._finalizer = weakref.finalize(self, _unpin_all, self._mirror)
+
+    def __setitem__(self, k, v):
+        super().__setitem__(k, v)
+        self._mirror[k] = v
+
+    def __delitem__(self, k):
+        super().__delitem__(k)
+        self._mirror.pop(k, None)
+
+    def pop(self, k, *default):
+        self._mirror.pop(k, None)
+        return super().pop(k, *default)
+
+
 def _raw_socket(remote, ssl_none=True):
     """The raw lane's connection: checked out of the shared pool once
     and PINNED to this thread (≈ the reference's client-in-bthread
     keeping a connection hot) — steady-state calls skip the pool's
     get/put locking entirely.  Other threads check out their own; the
-    pinned socket returns to circulation only by failing."""
+    pinned socket returns to circulation only by failing or when the
+    owning thread exits (finalizer on the per-thread map)."""
     cache = getattr(_tls_raw, "socks", None)
     if cache is None:
-        cache = _tls_raw.socks = {}
+        cache = _tls_raw.socks = _PinnedSocks()
     sid = cache.get(remote)
     if sid is not None:
         s = Socket.address(sid)
@@ -777,7 +939,12 @@ def run_raw(channel, method_full: str, payload, attachment=b"",
             raise RpcError(int(Errno.ERESPONSE), "response cid mismatch")
     body = mv[meta_size:]
     ratt = memoryview(b"")
-    if natt and 0 < natt <= len(body):
+    if natt:
+        if natt > len(body):
+            sock.set_failed(Errno.ERESPONSE, "attachment size exceeds body")
+            sock.release()
+            raise RpcError(int(Errno.ERESPONSE),
+                           "attachment size exceeds body")
         ratt = body[len(body) - natt:]
         body = body[:len(body) - natt]
     return body, ratt
@@ -882,7 +1049,13 @@ def run_batch(channel, method_full: str, requests, response_type: Any,
         if meta.error_code and first_error is None:
             first_error = (meta.error_code, meta.error_text)
         body = mv[meta_size:]
-        if meta.attachment_size and 0 < meta.attachment_size <= len(body):
+        if meta.attachment_size:
+            if meta.attachment_size > len(body):
+                sock.set_failed(Errno.ERESPONSE,
+                                "attachment size exceeds body")
+                sock.release()
+                raise RpcError(int(Errno.ERESPONSE),
+                               "attachment size exceeds body")
             body = body[:len(body) - meta.attachment_size]
         by_cid[meta.correlation_id] = bytes(body)
     return_pooled_socket(sid)

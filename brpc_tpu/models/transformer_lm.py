@@ -288,9 +288,11 @@ def make_decode(cfg: LMConfig):
                 vc, v, (0, 0, 0, 0))
             # seq-adaptive: long prompts prefill through the flash
             # kernel (O(s) memory) instead of materializing (s, s)
-            # scores per layer
+            # scores per layer — honoring the same impl override as
+            # make_forward (a config forcing dense stays dense)
             from ..ops.flash_attention import attention
-            att = attention(q, k, v, causal=cfg.causal)
+            impl = "flash" if cfg.use_flash else cfg.attn_impl
+            att = attention(q, k, v, causal=cfg.causal, impl=impl)
             x = x + qmatmul(att.reshape(b, s, cfg.dim), bp["wo"])
             x = x + mlp(bp, _rmsnorm(x, bp["ln2"]))
         return cache, unembed(params, x[:, -1])

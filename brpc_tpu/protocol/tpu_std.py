@@ -43,9 +43,12 @@ class RpcMessage:
 
     def split_attachment(self) -> IOBuf:
         """Cut the attachment tail off the payload; returns it (empty if
-        none)."""
+        none).  Raises ValueError when the declared size exceeds the
+        body — a malformed frame the dispatch layer answers EREQUEST."""
         n = self.meta.attachment_size
-        if n <= 0 or n > len(self.payload):
+        if n > len(self.payload):
+            raise ValueError("attachment size exceeds body")
+        if n <= 0:
             return IOBuf()
         body_len = len(self.payload) - n
         body = self.payload.cutn(body_len)

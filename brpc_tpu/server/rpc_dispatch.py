@@ -194,7 +194,13 @@ def process_rpc_request(msg: RpcMessage, sock: Socket, server) -> None:
         meta, sock.remote_side, sock.id,
         send_response=lambda c, r: _send_response(server, entry, c, r))
     cntl.server = server
-    cntl.request_attachment = msg.split_attachment()
+    try:
+        cntl.request_attachment = msg.split_attachment()
+    except ValueError as e:
+        entry.status.on_responded(int(Errno.EREQUEST), 0)
+        server.on_request_out()
+        _send_error(sock, cid, Errno.EREQUEST, str(e), request_meta=meta)
+        return
     if meta.ici_domain:
         # learn the peer's device-fabric domain (enables device-resident
         # response attachments from the very first exchange)

@@ -51,6 +51,13 @@ def raw_method(fn: Callable) -> Callable:
     interceptors) fall back to the full dispatch, where the handler is
     invoked with the same (payload, attachment) shape.
 
+    Deadline contract: the request's remaining-deadline TLV is accepted
+    but NOT enforced on the slim path — the handler runs immediately
+    after frame parse (no queueing between the two), so an arrival-time
+    deadline cannot have expired, and raw handlers receive no context
+    object to propagate it further.  Handlers needing deadline
+    propagation belong on the full @method path.
+
         class Echo(Service):
             @raw_method
             def Echo(self, payload, attachment):

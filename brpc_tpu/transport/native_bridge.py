@@ -285,7 +285,15 @@ class NativeBridge:
         t0 = _mono_ns()
         payload = mv[meta_size:]
         att = None
-        if na and na <= len(payload):
+        if na:
+            if na > len(payload):
+                # malformed frame: an attachment-size TLV exceeding the
+                # body must be rejected, not silently fused into payload
+                status.on_responded(int(Errno.EREQUEST), 0)
+                server.on_request_out()
+                self._raw_error(sock, cid, int(Errno.EREQUEST),
+                                "attachment size exceeds body")
+                return True
             att = payload[len(payload) - na:]
             payload = payload[:len(payload) - na]
         code = 0
