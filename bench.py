@@ -79,12 +79,14 @@ def _start_server(native: bool = True):
             cntl.response_attachment.append_iobuf(cntl.request_attachment)
             return b"ok"
 
-        @raw_method
+        @raw_method(native="echo")
         def EchoRaw(self, payload, attachment):
             # the reference's echo handler copies the attachment and
             # nothing else (example/echo_c++) — this is that handler on
-            # the latency lane
-            return b"ok", attachment
+            # the latency lane; native="echo" answers it inside the C++
+            # engine (zero Python per request), with this fn as the
+            # behavioral spec and live fallback
+            return payload, attachment
 
     opts = ServerOptions()
     opts.native = native

@@ -51,7 +51,9 @@ def get_flag(name: str, default: Any = None) -> Any:
 
 def set_flag(name: str, value: Any) -> bool:
     """Live-set; only reloadable flags accept writes, and the validator
-    must pass (≈ flags_service.cpp:135)."""
+    must pass (≈ flags_service.cpp:135).  Watchers fire after the value
+    lands (live consumers that cache derived state — e.g. the native
+    engine's dispatch switch — resync here)."""
     f = _flags.get(name)
     if f is None or not f.reloadable:
         return False
@@ -65,7 +67,22 @@ def set_flag(name: str, value: Any) -> bool:
     if not f.validator(typed):
         return False
     f.value = typed
+    for fn in _watchers.get(name, ()):  # snapshot: watchers may re-read
+        try:
+            fn(typed)
+        except Exception:               # a broken watcher must not veto
+            from .logging_util import LOG
+            LOG.exception("flag watcher for %r raised", name)
     return True
+
+
+_watchers: dict = {}
+
+
+def watch_flag(name: str, fn: Callable[[Any], None]) -> None:
+    """Call ``fn(new_value)`` after every successful live-set of
+    ``name``.  Watchers are process-lifetime (no unwatch)."""
+    _watchers.setdefault(name, []).append(fn)
 
 
 def list_flags() -> List[Flag]:

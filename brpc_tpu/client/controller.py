@@ -502,7 +502,7 @@ class Controller(LazyAttachmentsMixin):
                 # discarding a response carrying a posted descriptor:
                 # return the peer's window credit
                 from ..ici.endpoint import ack_unused
-                ack_unused(msg.meta, msg.socket_id)
+                ack_unused(msg.meta, msg.socket_id or self._sending_sid)
             _idp.unlock(self._cid_base)      # stale attempt's response
             return
         code = msg.meta.error_code
@@ -525,7 +525,7 @@ class Controller(LazyAttachmentsMixin):
                 # the malformed response still carried a posted
                 # descriptor: return the peer's window credit
                 from ..ici.endpoint import ack_unused
-                ack_unused(msg.meta, msg.socket_id)
+                ack_unused(msg.meta, msg.socket_id or self._sending_sid)
             self._finish_locked(int(Errno.ERESPONSE), str(e))
             return
         if msg.meta.ici_domain:

@@ -91,6 +91,15 @@ def _next_cid() -> int:
     return _fast_cid
 
 
+def _reserve_cids(n: int) -> int:
+    """Reserve ``n`` consecutive correlation ids; returns the first (the
+    native batch lane stamps cid_base..cid_base+n-1 itself)."""
+    global _fast_cid
+    base = _fast_cid + 1
+    _fast_cid += n
+    return base
+
+
 def method_tlv(method_full: str) -> bytes:
     """Pre-encoded service+method TLV bytes (cached on the Channel)."""
     svc, _, mth = method_full.rpartition(".")
@@ -962,6 +971,8 @@ def run_batch(channel, method_full: str, requests, response_type: Any,
     from ..protocol.tpu_std import parse_payload, serialize_payload
     from .channel import RpcError
 
+    if not requests:
+        return []                 # nothing to send; touch no socket
     if timeout_ms is None:
         timeout_ms = channel.options.timeout_ms
     remote = channel.single_server
@@ -984,8 +995,6 @@ def run_batch(channel, method_full: str, requests, response_type: Any,
         return [channel.call(method_full, r, response_type,
                              timeout_ms=timeout_ms) for r in requests]
 
-    parts = []
-    cids = []
     tmo_tlv = _TMO_TAG + struct.pack("<I", max(1, timeout_ms)) \
         if timeout_ms and timeout_ms > 0 else b""
     auth = channel.options.auth_data or b""
@@ -995,6 +1004,77 @@ def run_batch(channel, method_full: str, requests, response_type: Any,
         # once per connection)
         auth_tlv = encode_tlv(TAG_AUTH, auth)
         sock.app_data = "authed"
+    timeout_s = timeout_ms / 1e3 if timeout_ms and timeout_ms > 0 else -1.0
+    nat = _native()
+    if nat is not None and hasattr(nat, "call_batch"):
+        # fully-native lane: the C++ side builds every frame (stamping
+        # consecutive cids), writes vectored, reads and cid-matches the
+        # responses — the whole batch costs Python ONE call
+        pls = [r if isinstance(r, (bytes, bytearray, memoryview))
+               else serialize_payload(r).to_bytes() for r in requests]
+        base = _reserve_cids(len(pls))
+        ack0 = sock._take_ack_frame() if sock._pending_acks else None
+        try:
+            results, acks = nat.call_batch(
+                sock.fd.fileno(), method_tlvs + tmo_tlv, pls, timeout_s,
+                base, auth_tlv, ack0 or b"")
+        except (TimeoutError, ConnectionError, ValueError, OSError) as e:
+            sock.set_failed(Errno.EFAILEDSOCKET, str(e))
+            sock.release()
+            code = Errno.ERPCTIMEDOUT if isinstance(e, TimeoutError) \
+                else Errno.EFAILEDSOCKET
+            raise RpcError(int(code), str(e)) from None
+        if acks:
+            _ici_process_ack(acks, sock)
+        # phase 1 — socket-sensitive work only (meta decode, error
+        # classification): the connection must go back to the pool
+        # BEFORE user-level payload parsing, whose exceptions must not
+        # strand an exclusively-checked-out fd
+        raws = []
+        first_error = None
+        for item in results:
+            if type(item) is not tuple:
+                # plain success payload (the common shape); bytes() so
+                # the caller-facing type matches the classic lane
+                raws.append(bytes(item))
+                continue
+            buf, msize = item
+            mv = memoryview(buf)
+            meta = RpcMeta.decode(bytes(mv[:msize]))
+            if meta is None:
+                sock.set_failed(Errno.ERESPONSE,
+                                "undecodable batch response")
+                sock.release()
+                raise RpcError(int(Errno.ERESPONSE),
+                               "undecodable batch response")
+            if meta.ici_desc:
+                # the batch lane carries no descriptor logic: return the
+                # peer's window credit instead of silently pinning it
+                from ..ici.endpoint import ack_unused
+                ack_unused(meta, sid)
+            if meta.error_code:
+                if first_error is None:
+                    first_error = (meta.error_code, meta.error_text)
+                raws.append(None)
+                continue
+            body = mv[msize:]
+            if meta.attachment_size:
+                if meta.attachment_size > len(body):
+                    sock.set_failed(Errno.ERESPONSE,
+                                    "attachment size exceeds body")
+                    sock.release()
+                    raise RpcError(int(Errno.ERESPONSE),
+                                   "attachment size exceeds body")
+                body = body[:len(body) - meta.attachment_size]
+            raws.append(bytes(body))
+        return_pooled_socket(sid)
+        if first_error is not None:
+            raise RpcError(first_error[0], first_error[1])
+        # phase 2 — user-level parsing, socket already safe in the pool
+        return [parse_payload(r, response_type) for r in raws]
+
+    parts = []
+    cids = []
     for req in requests:
         if isinstance(req, (bytes, bytearray, memoryview)):
             pb = req

@@ -146,8 +146,13 @@ enum : int {
 };
 
 struct WriteItem {
-  Py_buffer view;   // holds a ref on the producing Python object
+  Py_buffer view;        // holds a ref on the producing Python object,
+                         // UNLESS owned/owned_str is set (view.obj is
+                         // nullptr then)
   size_t offset = 0;
+  char* owned = nullptr;           // malloc'd block freed on completion
+  std::string* owned_str = nullptr;  // or a moved-in string (native
+                                     // burst buffer — no copy)
 };
 
 struct Conn {
@@ -174,6 +179,12 @@ struct Conn {
   bool closing = false;
   bool dead = false;
   bool flush_queued = false;  // guarded by loop->mu: coalesced flush pending
+
+  // native-dispatch responses accumulated during the current read burst
+  // (loop thread only); flushed as ONE owned WriteItem before any
+  // Python dispatch on this conn and at burst end — a pipelined batch
+  // of echo responses costs one writev
+  std::string native_out;
 };
 
 struct Loop {
@@ -193,6 +204,16 @@ struct Loop {
   std::mutex decref_mu;
 };
 
+// A method the engine answers entirely in C++ (no GIL, no Python
+// dispatch) — the tpu-native analogue of the reference's C++ builtin
+// services.  Registered pre-listen; the map is read-only afterwards.
+struct NativeMethod {
+  int kind = 0;                       // 0 = echo, 1 = const
+  std::string const_data;             // kind=1 response payload
+  std::atomic<uint64_t> count{0};     // answered natively
+  std::atomic<uint64_t> errors{0};    // EREQUEST answers (malformed att)
+};
+
 struct EngineImpl {
   PyObject* dispatch = nullptr;  // callable(event, conn_id, obj, extra)
   std::vector<Loop*> loops;
@@ -204,6 +225,12 @@ struct EngineImpl {
   std::mutex cmu;
   std::unordered_map<uint64_t, Conn*> by_id;
   std::atomic<uint64_t> nmessages{0}, bytes_in{0}, bytes_out{0};
+  // native dispatch: "svc\0mth" -> handler.  Mutated only before
+  // listen(); loops read it lock-free.  The bool gates at runtime
+  // (live rpc_dump capture must see every request -> Python path).
+  std::unordered_map<std::string, NativeMethod*> native_methods;
+  std::atomic<bool> native_dispatch{false};
+  bool started = false;
 };
 
 static void flush_decrefs_locked_gil(Loop* lp) {
@@ -218,6 +245,25 @@ static void flush_decrefs_locked_gil(Loop* lp) {
 static void queue_decref(Loop* lp, Py_buffer* v) {
   std::lock_guard<std::mutex> g(lp->decref_mu);
   lp->decrefs.push_back(*v);
+}
+
+// release a completed item's backing.  Owned blocks need no GIL; Python
+// views either release inline (gil_held) or defer via the loop's queue.
+static void complete_item(Loop* lp, WriteItem& it, bool gil_held) {
+  if (it.owned) {
+    free(it.owned);
+    it.owned = nullptr;
+    return;
+  }
+  if (it.owned_str) {
+    delete it.owned_str;
+    it.owned_str = nullptr;
+    return;
+  }
+  if (gil_held)
+    PyBuffer_Release(&it.view);
+  else
+    queue_decref(lp, &it.view);
 }
 
 static void loop_wake(Loop* lp) {
@@ -263,7 +309,7 @@ static void conn_destroy(EngineImpl* eng, Loop* lp, Conn* c, bool notify) {
   PyGILState_STATE gs = PyGILState_Ensure();
   {
     std::lock_guard<std::mutex> g(c->wmu);
-    for (auto& it : c->wq) PyBuffer_Release(&it.view);
+    for (auto& it : c->wq) complete_item(lp, it, /*gil_held=*/true);
     c->wq.clear();
   }
   Py_XDECREF((PyObject*)c->msg);
@@ -307,7 +353,7 @@ static bool conn_flush(Loop* lp, Conn* c) {
       size_t avail = it.view.len - it.offset;
       if (left >= avail) {
         left -= avail;
-        queue_decref(lp, &it.view);
+        complete_item(lp, it, /*gil_held=*/false);
         c->wq.pop_front();
       } else {
         it.offset += left;
@@ -324,6 +370,204 @@ static bool conn_flush(Loop* lp, Conn* c) {
   }
   if (c->closing) return false;  // flushed everything; close now
   return true;
+}
+
+// ---------------------------------------------------------------------------
+// Native dispatch: registered echo-class methods answered entirely in
+// C++ — no GIL, no Python objects, responses coalesced per read burst.
+// The tpu-native analogue of the reference's built-in C++ services and
+// its 200-300ns handler discipline (docs/cn/benchmark.md:57).
+// ---------------------------------------------------------------------------
+
+struct MetaScan {
+  uint64_t cid = 0;
+  uint32_t att = 0;
+  const char* svc = nullptr;
+  uint32_t svc_len = 0;
+  const char* mth = nullptr;
+  uint32_t mth_len = 0;
+};
+
+// Mirror of native_bridge._scan_request_meta: collect cid/att/svc/mth,
+// tolerate timeout/ici-domain/conn-nonce (13/15/17), bail on anything
+// controller-tier (compress, errors, auth, trace, span, stream, desc).
+static bool scan_request_meta(const char* p, size_t len, MetaScan* out) {
+  size_t off = 0;
+  while (off < len) {
+    if (off + 5 > len) return false;
+    uint8_t tag = (uint8_t)p[off];
+    uint32_t ln;
+    memcpy(&ln, p + off + 1, 4);
+    off += 5;
+    if (ln > len || off + ln > len) return false;
+    switch (tag) {
+      case 1:
+        if (ln != 8) return false;
+        memcpy(&out->cid, p + off, 8);
+        break;
+      case 3:
+        if (ln != 4) return false;
+        memcpy(&out->att, p + off, 4);
+        break;
+      case 4:
+        out->svc = p + off;
+        out->svc_len = ln;
+        break;
+      case 5:
+        out->mth = p + off;
+        out->mth_len = ln;
+        break;
+      case 13:
+      case 15:
+      case 17:
+        break;              // timeout / ici-domain / conn-nonce: safe
+      default:
+        return false;       // controller-tier tag: Python path
+    }
+    off += ln;
+  }
+  return out->svc != nullptr && out->mth != nullptr;
+}
+
+static NativeMethod* find_native(EngineImpl* eng, const MetaScan& s) {
+  std::string key;           // "svc\0mth" — SSO keeps short names heapless
+  key.reserve(s.svc_len + 1 + s.mth_len);
+  key.append(s.svc, s.svc_len);
+  key.push_back('\0');
+  key.append(s.mth, s.mth_len);
+  auto it = eng->native_methods.find(key);
+  return it == eng->native_methods.end() ? nullptr : it->second;
+}
+
+// append a success-response frame head (TRPC header + cid TLV +
+// optional att TLV) for a body of plen payload bytes — the single
+// source of the response wire layout for both the buffered and the
+// zero-copy (direct-read) native paths
+static void native_append_head(std::string& out, uint64_t cid,
+                               uint32_t att, size_t plen) {
+  char meta[22];
+  uint32_t l8 = 8, l4 = 4;
+  meta[0] = 1;
+  memcpy(meta + 1, &l8, 4);
+  memcpy(meta + 5, &cid, 8);
+  uint32_t mlen = 13;
+  if (att) {
+    meta[13] = 3;
+    memcpy(meta + 14, &l4, 4);
+    memcpy(meta + 18, &att, 4);
+    mlen = 22;
+  }
+  uint32_t body = mlen + (uint32_t)plen;
+  char hdr[12];
+  memcpy(hdr, "TRPC", 4);
+  memcpy(hdr + 4, &body, 4);
+  memcpy(hdr + 8, &mlen, 4);
+  out.append(hdr, 12);
+  out.append(meta, mlen);
+}
+
+// append one native response frame (cid + optional att TLV + body bytes)
+static void native_respond(Conn* c, uint64_t cid, const char* payload,
+                           size_t plen, uint32_t att) {
+  native_append_head(c->native_out, cid, att, plen);
+  if (plen) c->native_out.append(payload, plen);
+}
+
+// native error response (cid + error code/text TLVs)
+static void native_error(Conn* c, uint64_t cid, int32_t code,
+                         const char* text) {
+  uint32_t tlen = (uint32_t)strlen(text);
+  std::string meta;
+  char b[13];
+  uint32_t l = 8;
+  b[0] = 1;
+  memcpy(b + 1, &l, 4);
+  memcpy(b + 5, &cid, 8);
+  meta.append(b, 13);
+  b[0] = 6;
+  l = 4;
+  memcpy(b + 1, &l, 4);
+  memcpy(b + 5, &code, 4);
+  meta.append(b, 9);
+  b[0] = 7;
+  memcpy(b + 1, &tlen, 4);
+  meta.append(b, 5);
+  meta.append(text, tlen);
+  uint32_t body = (uint32_t)meta.size(), mlen = body;
+  char hdr[12];
+  memcpy(hdr, "TRPC", 4);
+  memcpy(hdr + 4, &body, 4);
+  memcpy(hdr + 8, &mlen, 4);
+  c->native_out.append(hdr, 12);
+  c->native_out.append(meta);
+}
+
+// Try to answer one complete TRPC frame natively.  body = meta+payload
+// (body_len bytes), meta_size from the frame header.  True = handled,
+// response appended to c->native_out.
+static bool native_try_handle(EngineImpl* eng, Conn* c, const char* body,
+                              size_t body_len, uint32_t meta_size) {
+  if (!eng->native_dispatch.load(std::memory_order_relaxed)) return false;
+  MetaScan s;
+  if (!scan_request_meta(body, meta_size, &s)) return false;
+  NativeMethod* m = find_native(eng, s);
+  if (!m) return false;
+  const char* payload = body + meta_size;
+  size_t plen = body_len - meta_size;
+  if (s.att > plen) {
+    m->errors++;
+    native_error(c, s.cid, 1003 /* EREQUEST */,
+                 "attachment size exceeds body");
+    return true;
+  }
+  switch (m->kind) {
+    case 0:  // echo: payload + attachment unchanged
+      native_respond(c, s.cid, payload, plen, s.att);
+      break;
+    case 1:  // const: fixed payload, no attachment
+      native_respond(c, s.cid, m->const_data.data(), m->const_data.size(),
+                     0);
+      break;
+    default:
+      return false;
+  }
+  m->count++;
+  return true;
+}
+
+// Stage accumulated native responses: MOVE native_out into the write
+// queue as ONE owned WriteItem (no copy), optionally appending a
+// follow-up item UNDER THE SAME LOCK — a concurrent Engine_send from a
+// GIL-holding thread (stream writes, ack flushes) must never interleave
+// its frames between a response's header and its zero-copy body.  No
+// flush here: splitting header and body into two writevs wakes the
+// blocked peer twice, and on a shared core the first wake costs a
+// ~0.5ms scheduler round trip before the body is even written.
+static bool native_stage(Conn* c, WriteItem* follow = nullptr) {
+  std::string* s = nullptr;
+  if (!c->native_out.empty()) {
+    s = new (std::nothrow) std::string(std::move(c->native_out));
+    if (!s) return false;
+    c->native_out.clear();           // moved-from: make state definite
+  }
+  std::lock_guard<std::mutex> g(c->wmu);
+  if (s) {
+    WriteItem it;
+    memset(&it.view, 0, sizeof(it.view));
+    it.view.buf = (void*)s->data();
+    it.view.len = (Py_ssize_t)s->size();
+    it.owned_str = s;
+    c->wq.push_back(it);
+  }
+  if (follow) c->wq.push_back(*follow);
+  return true;
+}
+
+// stage + flush: the burst-end path.  False = fatal, destroy conn.
+static bool native_flush(Loop* lp, Conn* c) {
+  if (c->native_out.empty()) return true;
+  if (!native_stage(c)) return false;
+  return conn_flush(lp, c);
 }
 
 // parse as many complete frames as possible from c->inbuf / direct reads
@@ -376,11 +620,20 @@ static bool parse_frames(EngineImpl* eng, Loop* lp, Conn* c) {
     }
     size_t total = hdr + (size_t)body;
     if (avail >= total) {
+      c->in_start += total;
+      eng->nmessages++;
+      // native dispatch first: echo-class frames never leave C++ (the
+      // response rides c->native_out, coalesced across the burst)
+      if (kind == EV_MESSAGE
+          && native_try_handle(eng, c, p + hdr, body, meta)) {
+        continue;
+      }
+      // a Python-path frame mid-burst: flush queued native responses
+      // first so wire order matches arrival order
+      if (!c->native_out.empty() && !native_flush(lp, c)) return false;
       // whole frame in the buffer: ONE GIL acquisition covers the
       // NativeBuf alloc+copy and the Python dispatch (two round trips
       // here doubled the GIL-convoy exposure per message)
-      c->in_start += total;
-      eng->nmessages++;
       bool ok;
       {
         PyGILState_STATE gs = PyGILState_Ensure();
@@ -405,6 +658,12 @@ static bool parse_frames(EngineImpl* eng, Loop* lp, Conn* c) {
       NativeBuf* b;
       {
         PyGILState_STATE gs = PyGILState_Ensure();
+        // drain deferred view releases NOW: on the pure-native path
+        // this is the loop's only periodic GIL point, and the previous
+        // large request's buffer must reach the freelist before this
+        // alloc or every request pays a fresh multi-MB mmap + soft
+        // faults (measured 2x throughput loss at 1MB)
+        flush_decrefs_locked_gil(lp);
         b = nativebuf_new((Py_ssize_t)body);
         PyGILState_Release(gs);
       }
@@ -437,7 +696,8 @@ static bool conn_readable(EngineImpl* eng, Loop* lp, Conn* c) {
       ssize_t r = recv(c->fd, c->msg->data + c->msg_filled, want, 0);
       if (r == 0) return false;
       if (r < 0) {
-        if (errno == EAGAIN || errno == EWOULDBLOCK) return true;
+        if (errno == EAGAIN || errno == EWOULDBLOCK)
+          return native_flush(lp, c);       // burst over: ship responses
         if (errno == EINTR) continue;
         return false;
       }
@@ -448,6 +708,61 @@ static bool conn_readable(EngineImpl* eng, Loop* lp, Conn* c) {
         c->msg = nullptr;
         c->msg_filled = 0;
         eng->nmessages++;
+        // native echo on the large-frame path: respond zero-copy out of
+        // the received NativeBuf (header+meta owned; body is a view)
+        MetaScan s;
+        NativeMethod* m = nullptr;
+        if (c->msg_kind == EV_MESSAGE
+            && eng->native_dispatch.load(std::memory_order_relaxed)
+            && scan_request_meta(b->data, c->msg_meta, &s))
+          m = find_native(eng, s);
+        if (m) {
+          size_t plen = (size_t)b->size - c->msg_meta;
+          if (s.att > plen) {
+            m->errors++;
+            native_error(c, s.cid, 1003, "attachment size exceeds body");
+            PyGILState_STATE gs = PyGILState_Ensure();
+            Py_DECREF(b);
+            PyGILState_Release(gs);
+          } else if (m->kind == 1) {
+            native_respond(c, s.cid, m->const_data.data(),
+                           m->const_data.size(), 0);
+            m->count++;
+            PyGILState_STATE gs = PyGILState_Ensure();
+            Py_DECREF(b);
+            PyGILState_Release(gs);
+          } else {
+            // echo: append header+meta to native_out, then queue the
+            // received buffer itself (offset past the request meta) —
+            // the megabyte body is never copied
+            native_append_head(c->native_out, s.cid, s.att, plen);
+            WriteItem it;
+            bool got = false;
+            {
+              PyGILState_STATE gs = PyGILState_Ensure();
+              flush_decrefs_locked_gil(lp);
+              got = PyObject_GetBuffer((PyObject*)b, &it.view,
+                                       PyBUF_SIMPLE) == 0;
+              Py_DECREF(b);   // the view (if any) holds its own ref
+              PyGILState_Release(gs);
+            }
+            if (!got) return false;
+            it.offset = c->msg_meta;   // skip the request meta bytes
+            // stage header+meta and the body view ATOMICALLY (one wmu
+            // hold — no foreign frame can land between them), flush
+            // once: a single writev, a single peer wakeup
+            if (!native_stage(c, &it)) {
+              PyGILState_STATE gs = PyGILState_Ensure();
+              PyBuffer_Release(&it.view);
+              PyGILState_Release(gs);
+              return false;
+            }
+            if (!conn_flush(lp, c)) return false;
+            m->count++;
+          }
+          continue;
+        }
+        if (!c->native_out.empty() && !native_flush(lp, c)) return false;
         call_dispatch(eng, lp, c->msg_kind, c->id, (PyObject*)b,
                       (long)c->msg_meta);
       }
@@ -464,7 +779,8 @@ static bool conn_readable(EngineImpl* eng, Loop* lp, Conn* c) {
     ssize_t r = recv(c->fd, c->inbuf + c->in_end, room, 0);
     if (r <= 0) {
       if (r == 0) return false;
-      if (errno == EAGAIN || errno == EWOULDBLOCK) return true;
+      if (errno == EAGAIN || errno == EWOULDBLOCK)
+        return native_flush(lp, c);         // burst over: ship responses
       if (errno == EINTR) continue;
       return false;
     }
@@ -667,10 +983,98 @@ static PyObject* Engine_listen(EngineObj* self, PyObject* args) {
     return nullptr;
   }
   // start threads on first listen
+  eng->started = true;
   for (Loop* lp : eng->loops) {
     if (!lp->thr.joinable()) lp->thr = std::thread(loop_run, lp);
   }
   Py_RETURN_NONE;
+}
+
+// register_native_method(svc, mth, kind, data=b"") — pre-listen only.
+// kind 0 = echo (payload+attachment back unchanged), 1 = const(data).
+static PyObject* Engine_register_native_method(EngineObj* self,
+                                               PyObject* args) {
+  const char* svc;
+  const char* mth;
+  int kind;
+  Py_buffer data = {};
+  if (!PyArg_ParseTuple(args, "ssi|y*", &svc, &mth, &kind, &data))
+    return nullptr;
+  EngineImpl* eng = self->eng;
+  if (eng->started) {
+    if (data.obj) PyBuffer_Release(&data);
+    PyErr_SetString(PyExc_RuntimeError,
+                    "native methods must be registered before listen()");
+    return nullptr;
+  }
+  if (kind != 0 && kind != 1) {
+    if (data.obj) PyBuffer_Release(&data);
+    PyErr_SetString(PyExc_ValueError, "unknown native method kind");
+    return nullptr;
+  }
+  std::string key(svc);
+  key.push_back('\0');
+  key.append(mth);
+  auto it = eng->native_methods.find(key);
+  NativeMethod* m = it != eng->native_methods.end() ? it->second
+                                                    : new NativeMethod();
+  m->kind = kind;
+  if (data.obj) {
+    m->const_data.assign((const char*)data.buf, (size_t)data.len);
+    PyBuffer_Release(&data);
+  } else {
+    m->const_data.clear();
+  }
+  eng->native_methods[key] = m;
+  Py_RETURN_NONE;
+}
+
+static PyObject* Engine_set_native_dispatch(EngineObj* self,
+                                            PyObject* args) {
+  int on;
+  if (!PyArg_ParseTuple(args, "p", &on)) return nullptr;
+  self->eng->native_dispatch.store(on != 0, std::memory_order_relaxed);
+  Py_RETURN_NONE;
+}
+
+// native_stats() -> {"svc.mth": (answered, errors)}, or
+// native_stats(svc, mth) -> (answered, errors) — counters of natively-
+// dispatched requests (they never reach Python's MethodStatus; bvar
+// PassiveStatus readers surface these; the two-arg form avoids
+// materializing the whole map per metric read)
+static PyObject* Engine_native_stats(EngineObj* self, PyObject* args) {
+  EngineImpl* eng = self->eng;
+  const char* svc = nullptr;
+  const char* mth = nullptr;
+  if (!PyArg_ParseTuple(args, "|ss", &svc, &mth)) return nullptr;
+  if (svc != nullptr && mth != nullptr) {
+    std::string key(svc);
+    key.push_back('\0');
+    key.append(mth);
+    auto it = eng->native_methods.find(key);
+    if (it == eng->native_methods.end())
+      return Py_BuildValue("(KK)", 0ULL, 0ULL);
+    return Py_BuildValue("(KK)",
+                         (unsigned long long)it->second->count.load(),
+                         (unsigned long long)it->second->errors.load());
+  }
+  PyObject* d = PyDict_New();
+  if (!d) return nullptr;
+  for (auto& kv : eng->native_methods) {
+    std::string name = kv.first;
+    size_t z = name.find('\0');
+    if (z != std::string::npos) name[z] = '.';
+    PyObject* t = Py_BuildValue(
+        "(KK)", (unsigned long long)kv.second->count.load(),
+        (unsigned long long)kv.second->errors.load());
+    if (!t || PyDict_SetItemString(d, name.c_str(), t) != 0) {
+      Py_XDECREF(t);
+      Py_DECREF(d);
+      return nullptr;
+    }
+    Py_DECREF(t);
+  }
+  return d;
 }
 
 static PyObject* Engine_send(EngineObj* self, PyObject* args) {
@@ -741,7 +1145,7 @@ static PyObject* Engine_send(EngineObj* self, PyObject* args) {
           size_t avail = it3.view.len - it3.offset;
           if (left >= avail) {
             left -= avail;
-            PyBuffer_Release(&it3.view);   // GIL held here
+            complete_item(c->loop, it3, /*gil_held=*/true);
             c->wq.pop_front();
           } else {
             it3.offset += left;
@@ -830,6 +1234,7 @@ static void Engine_dealloc(EngineObj* self) {
       close(lp->wakefd);
       delete lp;
     }
+    for (auto& kv : self->eng->native_methods) delete kv.second;
     Py_XDECREF(self->eng->dispatch);
     delete self->eng;
   }
@@ -844,6 +1249,15 @@ static PyMethodDef Engine_methods[] = {
     {"close_conn", (PyCFunction)Engine_close_conn, METH_VARARGS, nullptr},
     {"stop", (PyCFunction)Engine_stop, METH_NOARGS, nullptr},
     {"stats", (PyCFunction)Engine_stats, METH_NOARGS, nullptr},
+    {"register_native_method", (PyCFunction)Engine_register_native_method,
+     METH_VARARGS,
+     "register_native_method(svc, mth, kind, data=b'') — answer the "
+     "method in C++ (kind 0=echo, 1=const); pre-listen only"},
+    {"set_native_dispatch", (PyCFunction)Engine_set_native_dispatch,
+     METH_VARARGS, "enable/disable GIL-free native dispatch at runtime"},
+    {"native_stats", (PyCFunction)Engine_native_stats, METH_VARARGS,
+     "native_stats([svc, mth]) — per-method (answered, errors) counters "
+     "for native dispatch; no args returns the whole map"},
     {nullptr, nullptr, 0, nullptr},
 };
 
@@ -1383,6 +1797,430 @@ fail:
   return nullptr;
 }
 
+// call_batch(fd, tail, payloads, timeout_s, cid_base, first_extra, lead)
+//   -> (results, acks)
+//
+// The fully-native pipelined batch lane: frames are BUILT here (header +
+// cid TLV + tail per payload, cids stamped cid_base..cid_base+n-1),
+// written vectored, and the responses' metas are parsed here too — the
+// whole batch costs Python ONE call.  tail = method/timeout TLVs shared
+// by every frame; first_extra rides only frame 0's meta (auth);
+// lead = raw bytes written before frame 0 (pending TICI ack flush).
+//
+// results[i] (matched by cid, so out-of-order servers are fine):
+//   NativeBuf                — plain success payload, no attachment
+//   (NativeBuf, meta_size)   — anything else (errors, attachments,
+//                              descriptors): full frame body for
+//                              Python's RpcMeta decode
+static PyObject* call_batch(PyObject*, PyObject* args) {
+  int fd;
+  Py_buffer tail = {}, first_extra = {}, lead = {};
+  PyObject* payloads;
+  double timeout_s = -1.0;
+  unsigned long long cid_base;
+  if (!PyArg_ParseTuple(args, "iy*OdK|y*y*", &fd, &tail, &payloads,
+                        &timeout_s, &cid_base, &first_extra, &lead)) {
+    if (tail.obj) PyBuffer_Release(&tail);
+    return nullptr;
+  }
+  PyObject* seq = PySequence_Fast(payloads, "payloads must be a sequence");
+  if (!seq) {
+    PyBuffer_Release(&tail);
+    if (first_extra.obj) PyBuffer_Release(&first_extra);
+    if (lead.obj) PyBuffer_Release(&lead);
+    return nullptr;
+  }
+  Py_ssize_t n = PySequence_Fast_GET_SIZE(seq);
+  auto cleanup_args = [&](std::vector<Py_buffer>& views) {
+    for (auto& v : views) PyBuffer_Release(&v);
+    PyBuffer_Release(&tail);
+    if (first_extra.obj) PyBuffer_Release(&first_extra);
+    if (lead.obj) PyBuffer_Release(&lead);
+    Py_DECREF(seq);
+  };
+  std::vector<Py_buffer> views((size_t)n);
+  for (Py_ssize_t i = 0; i < n; i++) {
+    PyObject* item = PySequence_Fast_GET_ITEM(seq, i);
+    if (PyObject_GetBuffer(item, &views[(size_t)i], PyBUF_SIMPLE) != 0) {
+      views.resize((size_t)i);
+      cleanup_args(views);
+      return nullptr;
+    }
+    if ((size_t)views[(size_t)i].len > (size_t)kMaxBody) {
+      // fail fast with a precise error instead of truncating the u32
+      // header length and desyncing the stream (server would reject
+      // anything past kMaxBody anyway)
+      views.resize((size_t)i + 1);
+      cleanup_args(views);
+      PyErr_SetString(PyExc_ValueError, "batch payload exceeds max body");
+      return nullptr;
+    }
+  }
+  if (n == 0) {
+    // still write `lead` (pending TICI acks the caller already dequeued
+    // from its socket — dropping them would leak peer window credit)
+    int lerr = 0;
+    if (lead.obj && lead.len > 0) {
+      Py_BEGIN_ALLOW_THREADS;
+      const char* lp = (const char*)lead.buf;
+      size_t left = (size_t)lead.len;
+      int64_t dl = timeout_s >= 0 ? now_ms() + (int64_t)(timeout_s * 1000)
+                                  : -1;
+      while (left > 0 && !lerr) {
+        ssize_t w = send(fd, lp, left, 0);
+        if (w > 0) {
+          lp += w;
+          left -= (size_t)w;
+          continue;
+        }
+        if (w < 0 && (errno == EAGAIN || errno == EWOULDBLOCK)) {
+          if (wait_fd(fd, POLLOUT, dl) <= 0) lerr = 1;
+          continue;
+        }
+        if (w < 0 && errno == EINTR) continue;
+        lerr = 2;
+      }
+      Py_END_ALLOW_THREADS;
+    }
+    cleanup_args(views);
+    if (lerr) {
+      PyErr_SetString(lerr == 1 ? PyExc_TimeoutError : PyExc_ConnectionError,
+                      "failed to flush pending acks");
+      return nullptr;
+    }
+    return Py_BuildValue("(NN)", PyList_New(0), PyList_New(0));
+  }
+  if (n > (1 << 20)) {
+    cleanup_args(views);
+    PyErr_SetString(PyExc_ValueError, "batch too large");
+    return nullptr;
+  }
+
+  int64_t deadline = timeout_s >= 0 ? now_ms() + (int64_t)(timeout_s * 1000)
+                                    : -1;
+  int err = 0;
+  char errbuf[96] = {0};
+  size_t tail_len = (size_t)tail.len;
+  size_t extra_len = first_extra.obj ? (size_t)first_extra.len : 0;
+  // per-frame arena chunk: 12B header + 13B cid TLV + tail (+extra on 0)
+  const size_t kChunk = 25;
+  std::vector<char> arena(n * (kChunk + tail_len) + extra_len);
+  std::vector<struct iovec> iov;
+  iov.reserve(2 * (size_t)n + 1);
+  if (lead.obj && lead.len > 0)
+    iov.push_back({lead.buf, (size_t)lead.len});
+  std::vector<char> acc;                // response accumulator
+  std::vector<size_t> offs((size_t)n, SIZE_MAX);  // body offset by index
+  std::vector<uint32_t> osize((size_t)n, 0), ometa((size_t)n, 0);
+  std::vector<uint64_t> batch_acks;
+
+  Py_BEGIN_ALLOW_THREADS;
+  // ---- build + write ----
+  char* w = arena.data();
+  for (Py_ssize_t i = 0; i < n; i++) {
+    size_t ex = i == 0 ? extra_len : 0;
+    uint32_t mlen = (uint32_t)(13 + ex + tail_len);
+    uint32_t body = mlen + (uint32_t)views[(size_t)i].len;
+    char* frame = w;
+    memcpy(w, "TRPC", 4);
+    memcpy(w + 4, &body, 4);
+    memcpy(w + 8, &mlen, 4);
+    w += 12;
+    uint64_t cid = cid_base + (uint64_t)i;
+    *w = 1;
+    uint32_t l8 = 8;
+    memcpy(w + 1, &l8, 4);
+    memcpy(w + 5, &cid, 8);
+    w += 13;
+    if (ex) {
+      memcpy(w, first_extra.buf, ex);
+      w += ex;
+    }
+    if (tail_len) {
+      memcpy(w, tail.buf, tail_len);
+      w += tail_len;
+    }
+    iov.push_back({frame, (size_t)(w - frame)});
+    if (views[(size_t)i].len > 0)
+      iov.push_back({views[(size_t)i].buf, (size_t)views[(size_t)i].len});
+  }
+  size_t first = 0;
+  while (first < iov.size() && !err) {
+    size_t cnt = iov.size() - first;
+    if (cnt > 64) cnt = 64;
+    ssize_t wr = writev(fd, iov.data() + first, (int)cnt);
+    if (wr < 0) {
+      if (errno == EAGAIN || errno == EWOULDBLOCK) {
+        int r = wait_fd(fd, POLLOUT, deadline);
+        if (r == 0) err = 1;
+        else if (r < 0) {
+          err = 2;
+          snprintf(errbuf, sizeof errbuf, "poll: %s", strerror(errno));
+        }
+        continue;
+      }
+      if (errno == EINTR) continue;
+      err = 2;
+      snprintf(errbuf, sizeof errbuf, "write: %s", strerror(errno));
+      break;
+    }
+    size_t left = (size_t)wr;
+    while (left > 0 && first < iov.size()) {
+      if (left >= iov[first].iov_len) {
+        left -= iov[first].iov_len;
+        first++;
+      } else {
+        iov[first].iov_base = (char*)iov[first].iov_base + left;
+        iov[first].iov_len -= left;
+        left = 0;
+      }
+    }
+  }
+
+  // ---- read + scan n responses (TICI interleaves collected) ----
+  if (!err) {
+    acc.reserve(1 << 20);
+    size_t scanned = 0;
+    Py_ssize_t found = 0;
+    while (found < n && !err) {
+      for (;;) {
+        size_t avail = acc.size() - scanned;
+        if (avail < 8) break;
+        const char* p = acc.data() + scanned;
+        if (memcmp(p, "TICI", 4) == 0) {
+          uint32_t cnt = 0;
+          memcpy(&cnt, p + 4, 4);
+          size_t total = 8 + 8ul * cnt;
+          if (cnt > 8000) {
+            err = 3;
+            snprintf(errbuf, sizeof errbuf, "oversized ack frame");
+            break;
+          }
+          if (avail < total) break;
+          for (uint32_t i = 0; i < cnt; i++) {
+            uint64_t id;
+            memcpy(&id, p + 8 + 8ul * i, 8);
+            batch_acks.push_back(id);
+          }
+          scanned += total;
+          continue;
+        }
+        if (avail < kHeaderSize) break;
+        if (memcmp(p, "TRPC", 4) != 0) {
+          err = 3;
+          snprintf(errbuf, sizeof errbuf, "unexpected magic in batch read");
+          break;
+        }
+        uint32_t body = 0, meta = 0;
+        memcpy(&body, p + 4, 4);
+        memcpy(&meta, p + 8, 4);
+        if (body > kMaxBody || meta > body) {
+          err = 3;
+          snprintf(errbuf, sizeof errbuf, "bad frame sizes");
+          break;
+        }
+        if (avail < kHeaderSize + (size_t)body) break;
+        // place by cid (servers running handlers on fibers may answer
+        // out of order)
+        uint64_t rcid = 0;
+        {
+          // response metas reuse the TLV walk; only cid placement needs
+          // to succeed here — full decode stays in Python when unusual
+          size_t off2 = 0;
+          bool got_cid = false;
+          const char* mp = p + kHeaderSize;
+          while (off2 + 5 <= meta) {
+            uint8_t tag = (uint8_t)mp[off2];
+            uint32_t ln;
+            memcpy(&ln, mp + off2 + 1, 4);
+            off2 += 5;
+            if (off2 + ln > meta) break;
+            if (tag == 1 && ln == 8) {
+              memcpy(&rcid, mp + off2, 8);
+              got_cid = true;
+            }
+            off2 += ln;
+          }
+          if (!got_cid) {
+            err = 3;
+            snprintf(errbuf, sizeof errbuf,
+                     "batch response missing correlation id");
+            break;
+          }
+        }
+        if (rcid < cid_base || rcid >= cid_base + (uint64_t)n
+            || offs[(size_t)(rcid - cid_base)] != SIZE_MAX) {
+          err = 3;
+          snprintf(errbuf, sizeof errbuf,
+                   "batch response cid out of range");
+          break;
+        }
+        size_t idx = (size_t)(rcid - cid_base);
+        offs[idx] = scanned + kHeaderSize;
+        osize[idx] = body;
+        ometa[idx] = meta;
+        scanned += kHeaderSize + body;
+        found++;
+      }
+      if (err || found >= n) break;
+      char tmp[65536];
+      ssize_t r = recv(fd, tmp, sizeof tmp, 0);
+      if (r < 0 && (errno == EAGAIN || errno == EWOULDBLOCK)) {
+        int pr = wait_fd(fd, POLLIN, deadline);
+        if (pr == 0) err = 1;
+        else if (pr < 0) {
+          err = 2;
+          snprintf(errbuf, sizeof errbuf, "poll: %s", strerror(errno));
+        }
+        continue;
+      }
+      if (r == 0) {
+        err = 2;
+        snprintf(errbuf, sizeof errbuf, "connection closed by peer");
+        continue;
+      }
+      if (r < 0) {
+        if (errno == EINTR) continue;
+        err = 2;
+        snprintf(errbuf, sizeof errbuf, "read: %s", strerror(errno));
+        continue;
+      }
+      acc.insert(acc.end(), tmp, tmp + r);
+    }
+    // drain trailing TICI frames to a boundary (grace past deadline:
+    // every response is already in hand)
+    int64_t tdl = deadline;
+    if (tdl >= 0) {
+      int64_t grace = now_ms() + 2000;
+      if (tdl < grace) tdl = grace;
+    }
+    while (!err && scanned < acc.size()) {
+      size_t avail = acc.size() - scanned;
+      const char* p = acc.data() + scanned;
+      if (avail >= 4 && memcmp(p, "TICI", 4) != 0) {
+        err = 3;
+        snprintf(errbuf, sizeof errbuf,
+                 "unexpected trailing bytes in batch read");
+        break;
+      }
+      if (avail >= 8) {
+        uint32_t cnt = 0;
+        memcpy(&cnt, p + 4, 4);
+        if (cnt > 8000) {
+          err = 3;
+          snprintf(errbuf, sizeof errbuf, "oversized ack frame");
+          break;
+        }
+        size_t total = 8 + 8ul * cnt;
+        if (avail >= total) {
+          for (uint32_t i = 0; i < cnt; i++) {
+            uint64_t id;
+            memcpy(&id, p + 8 + 8ul * i, 8);
+            batch_acks.push_back(id);
+          }
+          scanned += total;
+          continue;
+        }
+      }
+      char tmp2[4096];
+      ssize_t r = recv(fd, tmp2, sizeof tmp2, 0);
+      if (r > 0) {
+        acc.insert(acc.end(), tmp2, tmp2 + r);
+        continue;
+      }
+      if (r == 0) {
+        err = 2;
+        snprintf(errbuf, sizeof errbuf, "connection closed mid-ack");
+        break;
+      }
+      if (errno == EAGAIN || errno == EWOULDBLOCK) {
+        int pr = wait_fd(fd, POLLIN, tdl);
+        if (pr == 0) err = 1;
+        else if (pr < 0) {
+          err = 2;
+          snprintf(errbuf, sizeof errbuf, "poll: %s", strerror(errno));
+        }
+        continue;
+      }
+      if (errno == EINTR) continue;
+      err = 2;
+      snprintf(errbuf, sizeof errbuf, "read: %s", strerror(errno));
+    }
+  }
+  Py_END_ALLOW_THREADS;
+
+  cleanup_args(views);
+  if (err) {
+    if (err == 1)
+      PyErr_SetString(PyExc_TimeoutError, "rpc deadline exceeded");
+    else if (err == 2)
+      PyErr_SetString(PyExc_ConnectionError, errbuf);
+    else
+      PyErr_SetString(PyExc_ValueError, errbuf);
+    return nullptr;
+  }
+
+  // ---- materialize results (GIL held) ----
+  PyObject* out_list = PyList_New(n);
+  if (!out_list) return nullptr;
+  for (Py_ssize_t k = 0; k < n; k++) {
+    const char* bp = acc.data() + offs[(size_t)k];
+    uint32_t body = osize[(size_t)k], meta = ometa[(size_t)k];
+    // classify: plain success (only cid/att/domain tags, att==0) gets a
+    // bare payload buffer; everything else goes back whole for RpcMeta
+    bool plain = true;
+    uint32_t att = 0;
+    {
+      size_t off2 = 0;
+      while (off2 + 5 <= meta) {
+        uint8_t tag = (uint8_t)bp[off2];
+        uint32_t ln;
+        memcpy(&ln, bp + off2 + 1, 4);
+        off2 += 5;
+        if (off2 + ln > meta) {
+          plain = false;
+          break;
+        }
+        if (tag == 3 && ln == 4) memcpy(&att, bp + off2, 4);
+        else if (tag != 1 && tag != 15) plain = false;
+        off2 += ln;
+      }
+    }
+    PyObject* item;
+    if (plain && att == 0) {
+      NativeBuf* b = nativebuf_new((Py_ssize_t)(body - meta));
+      if (!b) {
+        Py_DECREF(out_list);
+        return nullptr;
+      }
+      memcpy(b->data, bp + meta, body - meta);
+      item = (PyObject*)b;
+    } else {
+      NativeBuf* b = nativebuf_new((Py_ssize_t)body);
+      if (!b) {
+        Py_DECREF(out_list);
+        return nullptr;
+      }
+      memcpy(b->data, bp, body);
+      item = Py_BuildValue("(Nk)", (PyObject*)b, (unsigned long)meta);
+      if (!item) {
+        Py_DECREF(out_list);
+        return nullptr;
+      }
+    }
+    PyList_SET_ITEM(out_list, k, item);
+  }
+  PyObject* acks = PyList_New((Py_ssize_t)batch_acks.size());
+  if (!acks) {
+    Py_DECREF(out_list);
+    return nullptr;
+  }
+  for (size_t i = 0; i < batch_acks.size(); i++)
+    PyList_SET_ITEM(acks, (Py_ssize_t)i,
+                    PyLong_FromUnsignedLongLong(batch_acks[i]));
+  return Py_BuildValue("(NN)", out_list, acks);
+}
+
 static PyMethodDef module_methods[] = {
     {"sync_call", (PyCFunction)sync_call, METH_VARARGS,
      "sync_call(fd, parts, timeout_s) -> (buf, meta_size): write request "
@@ -1390,6 +2228,10 @@ static PyMethodDef module_methods[] = {
     {"sync_call_many", (PyCFunction)sync_call_many, METH_VARARGS,
      "sync_call_many(fd, parts, expect, timeout_s) -> [(buf, meta_size)]: "
      "pipelined batch — write all frames, read expect responses"},
+    {"call_batch", (PyCFunction)call_batch, METH_VARARGS,
+     "call_batch(fd, tail, payloads, timeout_s, cid_base, first_extra, "
+     "lead) -> (results, acks): build/write/read a whole pipelined batch "
+     "natively; results matched by correlation id"},
     {nullptr, nullptr, 0, nullptr},
 };
 

@@ -77,10 +77,10 @@ class ServerOptions:
 
 class _MethodEntry:
     __slots__ = ("fn", "request_type", "status", "service", "method_name",
-                 "grpc_streaming", "raw_fn")
+                 "grpc_streaming", "raw_fn", "native_kind")
 
     def __init__(self, fn, request_type, status, service, method_name,
-                 grpc_streaming=False, raw_fn=None):
+                 grpc_streaming=False, raw_fn=None, native_kind=None):
         self.fn = fn
         self.request_type = request_type
         self.grpc_streaming = grpc_streaming
@@ -88,6 +88,7 @@ class _MethodEntry:
         self.service = service
         self.method_name = method_name
         self.raw_fn = raw_fn     # bytes-in/bytes-out latency-lane handler
+        self.native_kind = native_kind   # C++ semantic ("echo"/"const")
 
 
 class Server:
@@ -156,6 +157,7 @@ class Server:
                 method_name=mname,
                 grpc_streaming=getattr(fn, "_grpc_streaming", False),
                 raw_fn=fn if getattr(fn, "_rpc_raw", False) else None,
+                native_kind=getattr(fn, "_rpc_native", None),
             )
             self._methods[(sname, mname)] = entry
         return 0

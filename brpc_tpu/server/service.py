@@ -31,7 +31,7 @@ def method(request_type: Any = None, response_compress: int = 0):
     return mark
 
 
-def raw_method(fn: Callable) -> Callable:
+def raw_method(fn: Callable = None, *, native: str = None) -> Callable:
     """Declare a RAW method — the latency lane's server half.
 
     Signature: ``(payload, attachment) -> response`` where payload and
@@ -58,13 +58,29 @@ def raw_method(fn: Callable) -> Callable:
     object to propagate it further.  Handlers needing deadline
     propagation belong on the full @method path.
 
+    ``native=``: name a C++ built-in semantic and the native engine
+    answers the method entirely GIL-free — zero Python per request, the
+    analogue of the reference's built-in C++ services.  The Python
+    ``fn`` is the behavioral spec AND the live fallback (Python
+    transport, live rpc_dump capture, concurrency limits, controller-
+    tier request features); it must implement exactly the declared
+    semantic:
+
+      - ``"echo"``: respond with the request payload and attachment
+        unchanged
+      - ``"const"``: respond with the fixed bytes the handler returns
+        when called with (b"", None) — captured once at server start
+
         class Echo(Service):
-            @raw_method
+            @raw_method(native="echo")
             def Echo(self, payload, attachment):
-                return b"ok", attachment
+                return payload, attachment
     """
-    fn._rpc_raw = True
-    return fn
+    def mark(f: Callable) -> Callable:
+        f._rpc_raw = True
+        f._rpc_native = native
+        return f
+    return mark(fn) if fn is not None else mark
 
 
 def grpc_streaming(fn: Callable) -> Callable:

@@ -98,12 +98,83 @@ class NativeSocket(Socket):
             return int(Errno.EFAILEDSOCKET)
 
 
+_NATIVE_KINDS = {"echo": 0, "const": 1}
+
+# live bridges with native dispatch configured — the rpc_dump flag
+# watcher flips their engines' dispatch switch (capture must see every
+# request, so natively-answered methods fall back to Python while on)
+import weakref as _weakref
+
+_native_bridges: "_weakref.WeakSet" = _weakref.WeakSet()
+_watcher_installed = False
+
+
+def _install_dump_watcher() -> None:
+    global _watcher_installed
+    if _watcher_installed:
+        return
+    _watcher_installed = True
+    from ..butil.flags import watch_flag
+
+    def _on_dump_flip(enabled) -> None:
+        for bridge in list(_native_bridges):
+            bridge.engine.set_native_dispatch(
+                bridge._native_ok and not bool(enabled))
+
+    watch_flag("rpc_dump", _on_dump_flip)
+
+
 class NativeBridge:
     def __init__(self, server, engine_module, loops: int = 2):
         self._server = server
         self._m = engine_module
         self.engine = engine_module.Engine(self._dispatch, loops=loops)
         self._conns: Dict[int, int] = {}      # engine conn_id -> socket id
+        self._native_ok = False
+        self._native_vars = []                # PassiveStatus keep-alives
+
+    def _register_native_methods(self) -> None:
+        """Hand echo-class @raw_method(native=...) handlers to the C++
+        engine so they are answered GIL-free.  Only when nothing needs
+        to observe requests per-call from Python: inline usercode, no
+        auth/interceptor, no server-level concurrency limit, and no
+        per-method limiter on the method itself.  Counters surface as
+        PassiveStatus bvars (rpc_server_<m>_native_{requests,errors}) —
+        native requests never touch Python's MethodStatus."""
+        opts = self._server.options
+        if not opts.usercode_inline or opts.auth is not None \
+                or opts.interceptor is not None \
+                or getattr(opts, "max_concurrency", 0):
+            return
+        from ..bvar.passive_status import PassiveStatus
+        from ..tools.rpc_dump import dump_enabled
+        registered = False
+        for (svc, mth), entry in self._server._methods.items():
+            kind = _NATIVE_KINDS.get(entry.native_kind or "")
+            if kind is None or entry.raw_fn is None:
+                continue
+            if entry.status.max_concurrency or entry.status.limiter:
+                continue          # admission must stay in Python
+            data = b""
+            if kind == 1:
+                # capture the const response once (behavioral spec)
+                out = entry.raw_fn(b"", None)
+                data = bytes(out[0] if type(out) is tuple else out)
+            self.engine.register_native_method(svc, mth, kind, data)
+            safe = f"{svc}_{mth}".lower()
+            eng = self.engine
+            self._native_vars.append(PassiveStatus(
+                lambda s=svc, m=mth, e=eng: e.native_stats(s, m)[0],
+                name=f"rpc_server_{safe}_native_requests"))
+            self._native_vars.append(PassiveStatus(
+                lambda s=svc, m=mth, e=eng: e.native_stats(s, m)[1],
+                name=f"rpc_server_{safe}_native_errors"))
+            registered = True
+        if registered:
+            self._native_ok = True
+            _native_bridges.add(self)
+            _install_dump_watcher()
+            self.engine.set_native_dispatch(not dump_enabled())
 
     def listen(self, listen_socket) -> None:
         listen_socket.setblocking(False)
@@ -111,9 +182,14 @@ class NativeBridge:
         self._listen_socket = listen_socket
         name = listen_socket.getsockname()
         self._local_ep = EndPoint(host=name[0], port=name[1])
+        self._register_native_methods()
         self.engine.listen(listen_socket.fileno())
 
     def stop(self) -> None:
+        for v in self._native_vars:
+            v.hide()
+        self._native_vars.clear()
+        _native_bridges.discard(self)
         self.engine.stop()
         # close the listen fd: the engine no longer accepts, but the
         # KERNEL still completes handshakes into the backlog of an open
