@@ -1010,8 +1010,17 @@ def run_batch(channel, method_full: str, requests, response_type: Any,
         # fully-native lane: the C++ side builds every frame (stamping
         # consecutive cids), writes vectored, reads and cid-matches the
         # responses — the whole batch costs Python ONE call
-        pls = [r if isinstance(r, (bytes, bytearray, memoryview))
-               else serialize_payload(r).to_bytes() for r in requests]
+        try:
+            pls = [r if isinstance(r, (bytes, bytearray, memoryview))
+                   else serialize_payload(r).to_bytes() for r in requests]
+        except Exception:
+            # unserializable request: hand the healthy socket back
+            # before surfacing the caller's error — un-marking the auth
+            # state this call claimed but never transmitted
+            if auth_tlv:
+                sock.app_data = None
+            return_pooled_socket(sid)
+            raise
         base = _reserve_cids(len(pls))
         ack0 = sock._take_ack_frame() if sock._pending_acks else None
         try:
@@ -1075,19 +1084,27 @@ def run_batch(channel, method_full: str, requests, response_type: Any,
 
     parts = []
     cids = []
-    for req in requests:
-        if isinstance(req, (bytes, bytearray, memoryview)):
-            pb = req
-        else:
-            pb = serialize_payload(req).to_bytes()
-        cid = _next_cid()
-        cids.append(cid)
-        mb = _CID_TAG + struct.pack("<Q", cid) + method_tlvs \
-            + auth_tlv + tmo_tlv
-        auth_tlv = b""                       # first message only
-        parts.append(_MAGIC + struct.pack("<II", len(mb) + len(pb), len(mb))
-                     + mb)
-        parts.append(pb)
+    marked_auth = bool(auth_tlv)
+    try:
+        for req in requests:
+            if isinstance(req, (bytes, bytearray, memoryview)):
+                pb = req
+            else:
+                pb = serialize_payload(req).to_bytes()
+            cid = _next_cid()
+            cids.append(cid)
+            mb = _CID_TAG + struct.pack("<Q", cid) + method_tlvs \
+                + auth_tlv + tmo_tlv
+            auth_tlv = b""                   # first message only
+            parts.append(_MAGIC
+                         + struct.pack("<II", len(mb) + len(pb), len(mb))
+                         + mb)
+            parts.append(pb)
+    except Exception:
+        if marked_auth:
+            sock.app_data = None             # auth never hit the wire
+        return_pooled_socket(sid)            # socket untouched: re-pool
+        raise
     timeout_s = timeout_ms / 1e3 if timeout_ms and timeout_ms > 0 else -1.0
     nat = _native()
     ack0 = sock._take_ack_frame() if sock._pending_acks else None

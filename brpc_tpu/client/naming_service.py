@@ -137,6 +137,7 @@ def naming_registry():
 
 def create_naming_service(url: str) -> Optional[NamingService]:
     """``scheme://rest`` → a STARTED NamingService instance."""
+    from ..policy import naming as _builtin   # registers the schemes
     if "://" not in url:
         return None
     scheme, rest = url.split("://", 1)

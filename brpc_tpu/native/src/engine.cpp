@@ -147,12 +147,11 @@ enum : int {
 
 struct WriteItem {
   Py_buffer view;        // holds a ref on the producing Python object,
-                         // UNLESS owned/owned_str is set (view.obj is
-                         // nullptr then)
+                         // UNLESS owned_str is set (view.obj is nullptr
+                         // then)
   size_t offset = 0;
-  char* owned = nullptr;           // malloc'd block freed on completion
-  std::string* owned_str = nullptr;  // or a moved-in string (native
-                                     // burst buffer — no copy)
+  std::string* owned_str = nullptr;  // moved-in native burst buffer —
+                                     // deleted on completion, no copy
 };
 
 struct Conn {
@@ -250,11 +249,6 @@ static void queue_decref(Loop* lp, Py_buffer* v) {
 // release a completed item's backing.  Owned blocks need no GIL; Python
 // views either release inline (gil_held) or defer via the loop's queue.
 static void complete_item(Loop* lp, WriteItem& it, bool gil_held) {
-  if (it.owned) {
-    free(it.owned);
-    it.owned = nullptr;
-    return;
-  }
   if (it.owned_str) {
     delete it.owned_str;
     it.owned_str = nullptr;

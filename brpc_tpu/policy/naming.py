@@ -133,3 +133,7 @@ naming_registry().register("list", ListNamingService)
 naming_registry().register("file", FileNamingService)
 naming_registry().register("dns", DnsNamingService)
 naming_registry().register("mesh", MeshNamingService)
+
+# watch:// — long-poll remote membership (fleet controller); its own
+# module: it owns a thread and degrade-to-file machinery
+from . import remote_naming as _remote_naming          # noqa: E402,F401

@@ -26,6 +26,13 @@ define_flag("rpcz_keep_spans", 2048, "max spans kept in memory",
 define_flag("rpcz_max_samples_per_second", 1000,
             "rpcz sampling budget (traced calls always record)",
             lambda v: int(v) >= 0)
+define_flag("rpcz_dir", "",
+            "also persist spans to sqlite files here (one per process) "
+            "— post-mortem time-range browsing survives the process; "
+            "'' = in-memory only", any_value)
+define_flag("rpcz_db_max_spans", 200_000,
+            "per-process cap on persisted spans (oldest trimmed)",
+            lambda v: int(v) > 0)
 
 _span_seq = itertools.count(1)
 
@@ -34,10 +41,13 @@ class Span(Collected):
     __slots__ = ("trace_id", "span_id", "parent_span_id", "full_method",
                  "remote_side", "received_us", "start_us", "end_us",
                  "error_code", "request_size", "response_size",
-                 "annotations", "is_server")
+                 "annotations", "is_server", "forced")
 
     def __init__(self, full_method: str, trace_id: int = 0,
                  parent_span_id: int = 0, is_server: bool = True):
+        # an explicit trace context means someone is following THIS
+        # call: it must never be sampled out, whatever the budget
+        self.forced = bool(trace_id)
         self.trace_id = trace_id or fast_rand()
         self.span_id = next(_span_seq)
         self.parent_span_id = parent_span_id
@@ -84,16 +94,19 @@ class Span(Collected):
 
 
 class SpanStore:
-    """Bounded recent-span store, indexed by trace id."""
+    """Bounded recent-span store, indexed by trace id; optionally
+    mirrored to a per-process sqlite file for post-mortem browsing."""
 
     def __init__(self):
         self._lock = threading.Lock()
         self._spans: Deque[Span] = deque()
         # rate limiter: at most ~1000 spans/s retained (collector.h role)
         self._collector = Collector()
+        self._pending: List[Span] = []      # awaiting the disk flusher
+        self._flusher: Optional[threading.Thread] = None
 
     def add(self, span: Span) -> None:
-        if not self._collector.submit(span):
+        if not span.forced and not self._collector.submit(span):
             return                        # over the rate budget: sampled out
         self._collector.drain()           # used purely as a rate limiter
         keep = get_flag("rpcz_keep_spans", 2048)
@@ -101,6 +114,18 @@ class SpanStore:
             self._spans.append(span)
             while len(self._spans) > keep:
                 self._spans.popleft()
+            if get_flag("rpcz_dir", ""):
+                self._pending.append(span)
+                if self._flusher is None:
+                    self._flusher = threading.Thread(
+                        target=_flush_loop, args=(self,),
+                        name="rpcz-flush", daemon=True)
+                    self._flusher.start()
+
+    def take_pending(self) -> List[Span]:
+        with self._lock:
+            out, self._pending = self._pending, []
+            return out
 
     def recent(self, limit: int = 100) -> List[Span]:
         with self._lock:
@@ -110,9 +135,174 @@ class SpanStore:
         with self._lock:
             return [s for s in self._spans if s.trace_id == trace_id]
 
+    def flush_now(self) -> None:
+        """Synchronously persist anything pending (tests, shutdown)."""
+        _flush_pending(self)
+
     def clear(self) -> None:
         with self._lock:
             self._spans.clear()
+            self._pending.clear()
+
+
+# -- persistence (≈ span.cpp:306-319's leveldb pair: the reference keys
+# spans by time in one db and by id in another; sqlite gives both
+# indexes in one file, and a dead rank's file stays browsable) ---------
+
+_FLUSH_PERIOD_S = 1.0
+
+
+def _db_path() -> Optional[str]:
+    import os
+    d = str(get_flag("rpcz_dir", "") or "")
+    if not d:
+        return None
+    os.makedirs(d, exist_ok=True)
+    return f"{d}/rpcz.{os.getpid()}.db"
+
+
+def _open_db(path: str):
+    import sqlite3
+    # check_same_thread=False: the flusher thread owns steady-state
+    # writes, but flush_now() (portal requests, shutdown) flushes from
+    # other threads — _db_lock serializes all access
+    db = sqlite3.connect(path, timeout=5.0, check_same_thread=False)
+    db.execute("""CREATE TABLE IF NOT EXISTS spans (
+        received_us INTEGER, trace_id INTEGER, span_id INTEGER,
+        parent_span_id INTEGER, method TEXT, remote TEXT,
+        latency_us INTEGER, error_code INTEGER, request_size INTEGER,
+        response_size INTEGER, side TEXT, annotations TEXT)""")
+    db.execute("CREATE INDEX IF NOT EXISTS idx_time "
+               "ON spans (received_us)")
+    db.execute("CREATE INDEX IF NOT EXISTS idx_trace ON spans (trace_id)")
+    return db
+
+
+# cached writer connection: reopening + CREATE + COUNT(*) per 1s flush
+# is pure overhead — keep the handle and track the row count
+# incrementally (COUNT runs once per open)
+_db_lock = threading.Lock()
+_db_conn = None
+_db_conn_path: Optional[str] = None
+_db_rows = 0
+
+
+def _flush_pending(store: "SpanStore") -> None:
+    """Persist pending spans.  Never raises and never kills the caller:
+    a broken rpcz_dir drops the batch (logged) instead of growing
+    _pending forever."""
+    global _db_conn, _db_conn_path, _db_rows
+    import json as _json
+    try:
+        path = _db_path()
+    except OSError:
+        from .butil.logging_util import LOG
+        LOG.exception("rpcz_dir unusable; dropping pending spans")
+        store.take_pending()
+        return
+    if path is None:
+        store.take_pending()      # dir cleared while spans were pending
+        return
+    spans = store.take_pending()
+    if not spans:
+        return
+    try:
+        with _db_lock:
+            if _db_conn is None or _db_conn_path != path:
+                if _db_conn is not None:
+                    _db_conn.close()
+                _db_conn = _open_db(path)
+                _db_conn_path = path
+                (_db_rows,) = _db_conn.execute(
+                    "SELECT COUNT(*) FROM spans").fetchone()
+            db = _db_conn
+            with db:
+                db.executemany(
+                    "INSERT INTO spans VALUES (?,?,?,?,?,?,?,?,?,?,?,?)",
+                    [(s.received_us, s.trace_id, s.span_id,
+                      s.parent_span_id, s.full_method, s.remote_side,
+                      s.latency_us, s.error_code, s.request_size,
+                      s.response_size,
+                      "server" if s.is_server else "client",
+                      _json.dumps(s.annotations)) for s in spans])
+                _db_rows += len(spans)
+                cap = int(get_flag("rpcz_db_max_spans", 200_000))
+                if _db_rows > cap:
+                    db.execute(
+                        "DELETE FROM spans WHERE rowid IN (SELECT rowid "
+                        "FROM spans ORDER BY received_us LIMIT ?)",
+                        (_db_rows - cap,))
+                    _db_rows = cap
+    except Exception:                      # persistence must never take
+        from .butil.logging_util import LOG  # down the serving path
+        LOG.exception("rpcz flush failed")
+        with _db_lock:
+            if _db_conn is not None:
+                try:
+                    _db_conn.close()
+                except Exception:
+                    pass
+            _db_conn = None
+            _db_conn_path = None
+
+
+def _flush_loop(store: "SpanStore") -> None:
+    while True:
+        time.sleep(_FLUSH_PERIOD_S)
+        try:
+            _flush_pending(store)
+        except Exception:          # belt-and-braces: the flusher thread
+            pass                   # must survive anything
+
+
+def browse_persisted(start_us: int = 0, end_us: int = 0,
+                     limit: int = 100, trace_id: int = 0,
+                     rpcz_dir: str = "") -> List[Dict]:
+    """Time-range browse across every rpcz db in the directory —
+    including files left by DEAD processes (the post-mortem story the
+    in-memory store cannot tell).  Results newest-first."""
+    import glob
+    import json as _json
+    import os
+    import sqlite3
+    d = str(rpcz_dir or get_flag("rpcz_dir", "") or "")
+    if not d or not os.path.isdir(d):
+        return []
+    where, args = [], []
+    if start_us:
+        where.append("received_us >= ?")
+        args.append(int(start_us))
+    if end_us:
+        where.append("received_us <= ?")
+        args.append(int(end_us))
+    if trace_id:
+        where.append("trace_id = ?")
+        args.append(int(trace_id))
+    q = "SELECT * FROM spans"
+    if where:
+        q += " WHERE " + " AND ".join(where)
+    q += " ORDER BY received_us DESC LIMIT ?"
+    out: List[Dict] = []
+    for path in sorted(glob.glob(os.path.join(d, "rpcz.*.db"))):
+        try:
+            db = sqlite3.connect(path, timeout=5.0)
+            db.row_factory = sqlite3.Row
+            for row in db.execute(q, args + [int(limit)]):
+                rec = dict(row)
+                rec["trace_id"] = f"{rec['trace_id']:x}"
+                try:
+                    rec["annotations"] = [
+                        {"us": ts, "text": txt}
+                        for ts, txt in _json.loads(rec["annotations"])]
+                except (ValueError, TypeError):
+                    rec["annotations"] = []
+                rec["source_db"] = os.path.basename(path)
+                out.append(rec)
+            db.close()
+        except sqlite3.Error:
+            continue                       # unreadable/corrupt db: skip
+    out.sort(key=lambda r: r["received_us"], reverse=True)
+    return out[:limit]
 
 
 _store: Optional[SpanStore] = None

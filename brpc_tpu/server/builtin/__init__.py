@@ -177,22 +177,37 @@ def _list_vars(server, msg, rest):
 
 
 def _rpcz(server, msg, rest):
-    from ...rpcz import global_span_store, rpcz_enabled
+    from ...rpcz import (browse_persisted, global_span_store,
+                         rpcz_enabled)
 
     store = global_span_store()
     q = msg.query()
+    try:
+        limit = max(1, int(q.get("limit", "100")))
+    except ValueError:
+        return 400, "text/plain", "bad limit (integer)\n"
+    tid = 0
     if "trace_id" in q:
         try:
             tid = int(q["trace_id"], 16)
         except ValueError:
             return 400, "text/plain", "bad trace_id (hex)\n"
-        spans = store.by_trace(tid)
-    else:
+    if "start_us" in q or "end_us" in q or "persisted" in q:
+        # time-range browse over the sqlite mirrors (rpcz_dir) — covers
+        # spans of DEAD processes too (≈ the reference's leveldb-backed
+        # time browsing, span.cpp:306-319)
         try:
-            limit = max(1, int(q.get("limit", "100")))
+            start_us = int(q.get("start_us", "0"))
+            end_us = int(q.get("end_us", "0"))
         except ValueError:
-            return 400, "text/plain", "bad limit (integer)\n"
-        spans = store.recent(limit)
+            return 400, "text/plain", "bad start_us/end_us (integer)\n"
+        store.flush_now()          # what's pending is browsable now
+        return 200, "application/json", json.dumps({
+            "enabled": rpcz_enabled(),
+            "persisted": True,
+            "spans": browse_persisted(start_us, end_us, limit, tid),
+        }, indent=1)
+    spans = store.by_trace(tid) if tid else store.recent(limit)
     return 200, "application/json", json.dumps({
         "enabled": rpcz_enabled(),
         "spans": [s.describe() for s in reversed(spans)],

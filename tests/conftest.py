@@ -55,3 +55,9 @@ def server_options(native_mode):
     opts = ServerOptions()
     opts.native = native_mode
     return opts
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "soak: long mixed-workload soak (duration via SOAK_SECONDS env)")

@@ -124,11 +124,18 @@ class StandInXfer:
         self._posted = {}
         self._lock = threading.Lock()
         self.pulls = 0
+        self._next = 1000
 
     def post(self, array, nbytes, on_release=None, socket_id=0,
              conn_key=None):
         with self._lock:
-            uuid = len(self._posted) + 1000
+            # monotonic like the real fabrics (fabric.py _next_id): a
+            # len()-based id COLLIDES when a release lands between two
+            # posts — the overwritten entry's on_release never fires and
+            # its window credit leaks into every later ici test (this
+            # was the round-3/4 order-dependent suite flake)
+            uuid = self._next
+            self._next += 1
             self._posted[uuid] = (array, nbytes, on_release, socket_id)
         return uuid
 
