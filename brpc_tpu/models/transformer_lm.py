@@ -334,6 +334,51 @@ def make_decode(cfg: LMConfig):
     return prefill, decode_step
 
 
+def empty_cache(cfg: LMConfig, batch: int, start_len: int = 1):
+    """A fresh KV cache in the layout make_decode's steps expect — the
+    model owns this structure; callers (benches, servers pre-allocating
+    serving slots) must not hand-roll it."""
+    import jax.numpy as jnp
+    hd = cfg.dim // cfg.heads
+    cache = {"len": jnp.int32(start_len)}
+    for i in range(cfg.depth):
+        cache[f"k{i}"] = jnp.zeros((batch, cfg.max_seq, cfg.heads, hd),
+                                   jnp.float32)
+        cache[f"v{i}"] = jnp.zeros((batch, cfg.max_seq, cfg.heads, hd),
+                                   jnp.float32)
+    return cache
+
+
+def make_decode_loop(cfg: LMConfig, steps: int):
+    """Greedy generation as ONE compiled program: ``lax.scan`` feeds the
+    argmax token back through ``decode_step`` for ``steps`` tokens, so a
+    whole generation burst costs a single device dispatch.  This is the
+    serving shape for dispatch-dominated runtimes (a per-token program
+    pays the host/tunnel round trip per TOKEN; the scan pays it per
+    BURST) and the honest harness for weight-streaming measurements —
+    per-token time becomes pure device time.
+
+    Returns (prefill, loop) where loop(params, cache, token) ->
+    (cache, tokens (steps, b))."""
+    import jax
+    import jax.numpy as jnp
+
+    prefill, decode_step = make_decode(cfg)
+
+    def loop(params, cache, token):
+        def body(carry, _):
+            cache, tok = carry
+            cache, logits = decode_step(params, cache, tok)
+            nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            return (cache, nxt), nxt
+
+        (cache, _), toks = jax.lax.scan(body, (cache, token), None,
+                                        length=steps)
+        return cache, toks
+
+    return prefill, loop
+
+
 def make_generator(cfg: LMConfig, params):
     """Build a ``gen(prompt_ids, max_new, temperature=0.0, rng=None)``
     closure with the prefill and decode-step programs jitted ONCE —
@@ -468,8 +513,15 @@ def generate(params, cfg: LMConfig, prompt_ids, max_new: int):
     return make_generator(cfg, params)(prompt_ids, max_new)
 
 
-def make_train_step(cfg: LMConfig, mesh=None, sp_axis=None):
-    """(params, ids, labels) -> (new_params, loss); plain SGD."""
+def make_train_step(cfg: LMConfig, mesh=None, sp_axis=None,
+                    accum: int = 1):
+    """(params, ids, labels) -> (new_params, loss); plain SGD.
+
+    ``accum`` > 1 turns on gradient accumulation: the leading batch dim
+    must be ``accum * microbatch`` and one optimizer step scans the
+    microbatches inside the jit (``lax.scan`` — compiler-friendly
+    control flow, ONE compiled body), so a chip-filling tokens/step is
+    reachable with the HBM footprint of a single microbatch."""
     import jax
     import jax.numpy as jnp
 
@@ -483,7 +535,30 @@ def make_train_step(cfg: LMConfig, mesh=None, sp_axis=None):
         return nll.mean() + aux
 
     def train_step(params, ids, labels, lr: float = cfg.lr):
-        loss, grads = jax.value_and_grad(loss_fn)(params, ids, labels)
+        if accum <= 1:
+            loss, grads = jax.value_and_grad(loss_fn)(params, ids, labels)
+        else:
+            if ids.shape[0] % accum != 0:
+                raise ValueError(
+                    f"batch {ids.shape[0]} not divisible by "
+                    f"accum={accum} — trailing examples would be "
+                    "silently dropped")
+            b = ids.shape[0] // accum
+            mids = ids.reshape(accum, b, *ids.shape[1:])
+            mlbl = labels.reshape(accum, b, *labels.shape[1:])
+
+            def body(carry, mb):
+                loss_sum, gacc = carry
+                l, g = jax.value_and_grad(loss_fn)(params, *mb)
+                gacc = jax.tree_util.tree_map(jnp.add, gacc, g)
+                return (loss_sum + l, gacc), None
+
+            zeros = jax.tree_util.tree_map(
+                lambda p: jnp.zeros_like(p), params)
+            (loss_sum, grads), _ = jax.lax.scan(
+                body, (jnp.float32(0.0), zeros), (mids, mlbl))
+            loss = loss_sum / accum
+            grads = jax.tree_util.tree_map(lambda g: g / accum, grads)
         new_params = jax.tree_util.tree_map(
             lambda p, g: p - lr * g, params, grads)
         return new_params, loss

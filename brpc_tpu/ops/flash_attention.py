@@ -43,15 +43,25 @@ def _ceil_to(x: int, m: int) -> int:
 
 # -- forward ----------------------------------------------------------------
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr,
-                acc_scr, *, scale: float, causal: bool, bq: int, bk: int,
+def _fwd_kernel(*refs, scale: float, causal: bool, bq: int, bk: int,
                 seq_len: int):
     import jax
     import jax.numpy as jnp
     from jax.experimental import pallas as pl
 
-    ik = pl.program_id(3)
-    nk = pl.num_programs(3)
+    if causal:
+        # triangular causal grid: prefetched arrays carry the
+        # linearized (iq, ik<=iq) pair per step
+        (iq_ref, ik_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
+         m_scr, l_scr, acc_scr) = refs
+        t = pl.program_id(2)
+        iq, ik = iq_ref[t], ik_ref[t]
+        is_last = ik == iq
+    else:
+        (q_ref, k_ref, v_ref, o_ref, lse_ref,
+         m_scr, l_scr, acc_scr) = refs
+        iq, ik = pl.program_id(2), pl.program_id(3)
+        is_last = ik == pl.num_programs(3) - 1
 
     @pl.when(ik == 0)
     def _init():
@@ -59,20 +69,21 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr,
         l_scr[:] = jnp.zeros_like(l_scr)
         acc_scr[:] = jnp.zeros_like(acc_scr)
 
-    iq = pl.program_id(2)
     q0 = iq * bq
     k0 = ik * bk
-    # causal: skip k blocks strictly above the diagonal; always skip
-    # blocks entirely in the padded tail
+    # the causal grid is triangular — blocks above the diagonal are
+    # statically absent; only the padded k tail needs skipping (and on
+    # the triangular grid k0 <= q0 < seq_len always holds)
     live = k0 < seq_len
-    if causal:
-        live = jnp.logical_and(live, k0 <= q0 + bq - 1)
 
     @pl.when(live)
     def _step():
-        q = q_ref[0, 0].astype(jnp.float32)
-        k = k_ref[0, 0].astype(jnp.float32)
-        v = v_ref[0, 0].astype(jnp.float32)
+        # matmuls keep the INPUT dtype (bf16 stays bf16 — upcasting to
+        # f32 first starves the MXU; measured ~1.7x on the whole
+        # kernel) and accumulate in f32
+        q = q_ref[0, 0]
+        k = k_ref[0, 0]
+        v = v_ref[0, 0]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale        # (bq, bk)
@@ -88,11 +99,13 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr,
         p = jnp.exp(s - m_new)
         l_scr[:] = l_scr[:] * corr + p.sum(axis=-1, keepdims=True)
         m_scr[:] = m_new
+        # p rides the MXU in the value dtype (the flash-standard bf16
+        # cast; exact when v is f32)
         acc_scr[:] = acc_scr[:] * corr + jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())),
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)                # (bq, d)
 
-    @pl.when(ik == nk - 1)
+    @pl.when(is_last)
     def _finalize():
         l = jnp.maximum(l_scr[:], 1e-30)
         o_ref[0, 0] = (acc_scr[:] / l).astype(o_ref.dtype)
@@ -130,17 +143,64 @@ _IXQ2 = lambda ib, ih, ik, iq: (ib, ih, iq, 0)      # noqa: E731
 _IXK2 = lambda ib, ih, ik, iq: (ib, ih, ik, 0)      # noqa: E731
 
 
-def _block_geometry(s: int, d: int, block_q, block_k):
+# -- causal triangular grid -------------------------------------------------
+#
+# A rectangular (iq, ik) grid wastes HALF the machine on causal
+# attention: blocks strictly above the diagonal are masked to nothing,
+# but the grid still streams their K/V blocks and burns their MXU
+# issue slots (measured: causal was SLOWER than non-causal at 16k).
+# Instead the causal kernels linearize only the valid lower-triangle
+# pairs into one grid axis; the (iq, ik) pair per step rides in as
+# SCALAR-PREFETCHED index arrays so the pipeline can still compute the
+# next step's DMAs ahead of time (computing them with arithmetic inside
+# the index maps measured 2.2x slower per step — the prefetcher
+# couldn't run ahead).  q-major order keeps each q block's k sweep
+# contiguous, so the VMEM scratch carries across it exactly as in the
+# rectangular schedule.
+
+def _tri_arrays(nq: int):
+    """q-major lower-triangle enumeration: (iq_arr, ik_arr), len T."""
+    import numpy as np
+    idx = np.arange(nq)
+    iq = np.repeat(idx, idx + 1)
+    ik = np.concatenate([np.arange(i + 1) for i in idx]) if nq else idx
+    return iq.astype(np.int32), ik.astype(np.int32)
+
+
+def _tri_arrays_rev(nq: int):
+    """k-major enumeration for the dk/dv sweep: for each ik the valid
+    iq >= ik ascend contiguously."""
+    import numpy as np
+    idx = np.arange(nq)
+    ik = np.repeat(idx, nq - idx)
+    iq = np.concatenate([np.arange(i, nq) for i in idx]) if nq else idx
+    return iq.astype(np.int32), ik.astype(np.int32)
+
+
+# index maps for the prefetched triangular grid: block row from the
+# prefetched arrays, everything else straight through
+_TRIQ = lambda ib, ih, t, iqr, ikr: (ib, ih, iqr[t], 0)     # noqa: E731
+_TRIK = lambda ib, ih, t, iqr, ikr: (ib, ih, ikr[t], 0)     # noqa: E731
+
+
+def _block_geometry(s: int, d: int, block_q, block_k,
+                    causal: bool = False):
     d_pad = _ceil_to(max(d, 1), 128)
     if block_q is None or block_k is None:
-        # measured on v5e: 256 wins at short context, 512 from ~4k up
-        # (bigger blocks amortize the per-block scratch round trips;
-        # 1024+ overflows the 16MB VMEM with fp32 scores)
-        auto = 512 if s >= 4096 else 256
+        # measured on v5e: 256 wins at short context; from ~4k up
+        # bigger blocks amortize the per-block scratch round trips
+        # (1024/1024 measured fastest at 16k; fp32 scores stay within
+        # the 16MB VMEM at 1024^2)
+        auto = 1024 if s >= 8192 else (512 if s >= 4096 else 256)
         block_q = auto if block_q is None else block_q
         block_k = auto if block_k is None else block_k
     bq = min(block_q, _ceil_to(s, 8))
     bk = min(block_k, _ceil_to(s, 8))
+    if causal:
+        # the triangular grid linearizes (iq, ik<=iq) pairs — that
+        # needs a SQUARE block lattice (forward and backward recompute
+        # this geometry independently; keep it a pure function)
+        bq = bk = min(bq, bk)
     # pad to a common multiple: padding only to max(bq, bk) would
     # floor-truncate the other grid dimension and silently drop keys
     s_pad = _ceil_to(s, math.lcm(bq, bk))
@@ -160,13 +220,49 @@ def _pallas_forward(q, k, v, causal: bool, block_q: Optional[int],
 
     b, s, h, d = q.shape
     interpret = _resolve_interpret(interpret)
-    d_pad, bq, bk, s_pad = _block_geometry(s, d, block_q, block_k)
+    d_pad, bq, bk, s_pad = _block_geometry(s, d, block_q, block_k,
+                                           causal)
     nq, nk = s_pad // bq, s_pad // bk
-    prep = _make_prep(s_pad, d_pad, s, d)
-    qp, kp, vp = prep(q), prep(k), prep(v)
     kernel = functools.partial(
         _fwd_kernel, scale=1.0 / (d ** 0.5), causal=causal,
         bq=bq, bk=bk, seq_len=s)
+    prep = _make_prep(s_pad, d_pad, s, d)
+    qp, kp, vp = prep(q), prep(k), prep(v)
+    out_shape = [
+        jax.ShapeDtypeStruct((b, h, s_pad, d_pad), q.dtype),
+        jax.ShapeDtypeStruct((b, h, s_pad, 1), jnp.float32),
+    ]
+    scratch = [
+        pltpu.VMEM((bq, 1), jnp.float32),       # running max
+        pltpu.VMEM((bq, 1), jnp.float32),       # running denom
+        pltpu.VMEM((bq, d_pad), jnp.float32),   # accumulator
+    ]
+    if causal:
+        iq_arr, ik_arr = _tri_arrays(nq)
+        grid_spec = pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(b, h, int(iq_arr.size)),
+            in_specs=[
+                pl.BlockSpec((1, 1, bq, d_pad), _TRIQ),
+                pl.BlockSpec((1, 1, bk, d_pad), _TRIK),
+                pl.BlockSpec((1, 1, bk, d_pad), _TRIK),
+            ],
+            out_specs=[
+                pl.BlockSpec((1, 1, bq, d_pad), _TRIQ),
+                pl.BlockSpec((1, 1, bq, 1), _TRIQ),
+            ],
+            scratch_shapes=scratch,
+        )
+        out, lse = pl.pallas_call(
+            kernel,
+            grid_spec=grid_spec,
+            out_shape=out_shape,
+            compiler_params=pltpu.CompilerParams(
+                dimension_semantics=("parallel", "parallel",
+                                     "arbitrary")),
+            interpret=interpret,
+        )(jnp.asarray(iq_arr), jnp.asarray(ik_arr), qp, kp, vp)
+        return jnp.moveaxis(out[:, :, :s, :d], 1, 2), lse
     qblk, kblk, rowblk = _IXQ, _IXK, _IXQ
     out, lse = pl.pallas_call(
         kernel,
@@ -185,15 +281,8 @@ def _pallas_forward(q, k, v, causal: bool, block_q: Optional[int],
             pl.BlockSpec((1, 1, bq, 1), rowblk,
                          memory_space=pltpu.VMEM),
         ],
-        out_shape=[
-            jax.ShapeDtypeStruct((b, h, s_pad, d_pad), q.dtype),
-            jax.ShapeDtypeStruct((b, h, s_pad, 1), jnp.float32),
-        ],
-        scratch_shapes=[
-            pltpu.VMEM((bq, 1), jnp.float32),       # running max
-            pltpu.VMEM((bq, 1), jnp.float32),       # running denom
-            pltpu.VMEM((bq, d_pad), jnp.float32),   # accumulator
-        ],
+        out_shape=out_shape,
+        scratch_shapes=scratch,
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "parallel",
                                  "arbitrary")),
@@ -220,90 +309,106 @@ def _masked_p(q, k, lse, scale, causal, q0, k0, bq, bk, seq_len):
     return jnp.exp(s - lse)                       # (bq, bk)
 
 
-def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dd_ref, dq_ref,
-               acc_scr, *, scale: float, causal: bool, bq: int, bk: int,
+def _dq_kernel(*refs, scale: float, causal: bool, bq: int, bk: int,
                seq_len: int):
     import jax
     import jax.numpy as jnp
     from jax.experimental import pallas as pl
 
-    ik = pl.program_id(3)
-    nk = pl.num_programs(3)
+    if causal:
+        (iq_ref, ik_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, dd_ref,
+         dq_ref, acc_scr) = refs
+        t = pl.program_id(2)
+        iq, ik = iq_ref[t], ik_ref[t]
+        is_last = ik == iq
+    else:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, dd_ref,
+         dq_ref, acc_scr) = refs
+        iq, ik = pl.program_id(2), pl.program_id(3)
+        is_last = ik == pl.num_programs(3) - 1
 
     @pl.when(ik == 0)
     def _init():
         acc_scr[:] = jnp.zeros_like(acc_scr)
 
-    iq = pl.program_id(2)
     q0 = iq * bq
     k0 = ik * bk
-    live = k0 < seq_len
-    if causal:
-        live = jnp.logical_and(live, k0 <= q0 + bq - 1)
+    live = k0 < seq_len          # triangular grid when causal
 
     @pl.when(live)
     def _step():
-        q = q_ref[0, 0].astype(jnp.float32)
-        k = k_ref[0, 0].astype(jnp.float32)
-        v = v_ref[0, 0].astype(jnp.float32)
-        do = do_ref[0, 0].astype(jnp.float32)
+        # native-dtype MXU inputs, f32 accumulation (see _fwd_kernel)
+        q = q_ref[0, 0]
+        k = k_ref[0, 0]
+        v = v_ref[0, 0]
+        do = do_ref[0, 0]
         lse = lse_ref[0, 0]                       # (bq, 1)
         dd = dd_ref[0, 0]                         # D = rowsum(do * o)
         p = _masked_p(q, k, lse, scale, causal, q0, k0, bq, bk, seq_len)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
-        ds = p * (dp - dd)                        # (bq, bk)
+        ds = p * (dp - dd)                        # (bq, bk) f32
         acc_scr[:] = acc_scr[:] + jax.lax.dot_general(
-            ds, k, (((1,), (0,)), ((), ())),
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32) * scale
 
-    @pl.when(ik == nk - 1)
+    @pl.when(is_last)
     def _finalize():
         dq_ref[0, 0] = acc_scr[:].astype(dq_ref.dtype)
 
 
-def _dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dd_ref, dk_ref,
-                 dv_ref, dk_scr, dv_scr, *, scale: float, causal: bool,
-                 bq: int, bk: int, seq_len: int):
+def _dkdv_kernel(*refs, scale: float, causal: bool,
+                 bq: int, bk: int, seq_len: int, tri_nq: int = 0):
     import jax
     import jax.numpy as jnp
     from jax.experimental import pallas as pl
 
-    iq = pl.program_id(3)                  # q innermost: sweep per k blk
-    nq = pl.num_programs(3)
+    if causal:
+        # k-major triangle: for each ik, sweep the valid iq >= ik
+        (iq_ref, ik_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, dd_ref,
+         dk_ref, dv_ref, dk_scr, dv_scr) = refs
+        t = pl.program_id(2)
+        iq, ikb = iq_ref[t], ik_ref[t]
+        is_first = iq == ikb
+        is_last = iq == tri_nq - 1
+    else:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, dd_ref,
+         dk_ref, dv_ref, dk_scr, dv_scr) = refs
+        ikb = pl.program_id(2)
+        iq = pl.program_id(3)              # q innermost: sweep per k blk
+        is_first = iq == 0
+        is_last = iq == pl.num_programs(3) - 1
 
-    @pl.when(iq == 0)
+    @pl.when(is_first)
     def _init():
         dk_scr[:] = jnp.zeros_like(dk_scr)
         dv_scr[:] = jnp.zeros_like(dv_scr)
 
-    ikb = pl.program_id(2)
     k0 = ikb * bk
     q0 = iq * bq
-    live = k0 < seq_len
-    if causal:
-        live = jnp.logical_and(live, k0 <= q0 + bq - 1)
+    live = k0 < seq_len          # triangular grid when causal
 
     @pl.when(live)
     def _step():
-        q = q_ref[0, 0].astype(jnp.float32)
-        k = k_ref[0, 0].astype(jnp.float32)
-        v = v_ref[0, 0].astype(jnp.float32)
-        do = do_ref[0, 0].astype(jnp.float32)
+        # native-dtype MXU inputs, f32 accumulation (see _fwd_kernel)
+        q = q_ref[0, 0]
+        k = k_ref[0, 0]
+        v = v_ref[0, 0]
+        do = do_ref[0, 0]
         lse = lse_ref[0, 0]                       # (bq, 1)
         dd = dd_ref[0, 0]
         p = _masked_p(q, k, lse, scale, causal, q0, k0, bq, bk, seq_len)
         dv_scr[:] = dv_scr[:] + jax.lax.dot_general(
-            p, do, (((0,), (0,)), ((), ())),
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)   # (bk, d)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
         ds = p * (dp - dd)
         dk_scr[:] = dk_scr[:] + jax.lax.dot_general(
-            ds, q, (((0,), (0,)), ((), ())),
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32) * scale
 
-    @pl.when(iq == nq - 1)
+    @pl.when(is_last)
     def _finalize():
         dk_ref[0, 0] = dk_scr[:].astype(dk_ref.dtype)
         dv_ref[0, 0] = dv_scr[:].astype(dv_ref.dtype)
@@ -319,8 +424,10 @@ def _pallas_backward(q, k, v, o, lse, g, causal: bool,
 
     b, s, h, d = q.shape
     interpret = _resolve_interpret(interpret)
-    d_pad, bq, bk, s_pad = _block_geometry(s, d, block_q, block_k)
+    d_pad, bq, bk, s_pad = _block_geometry(s, d, block_q, block_k,
+                                           causal)
     nq, nk = s_pad // bq, s_pad // bk
+    tri_T = nq * (nq + 1) // 2 if causal else 0
     scale = 1.0 / (d ** 0.5)
     prep = _make_prep(s_pad, d_pad, s, d)
     qp, kp, vp, op, dop = prep(q), prep(k), prep(v), prep(o), prep(g)
@@ -332,63 +439,135 @@ def _pallas_backward(q, k, v, o, lse, g, causal: bool,
     dd = jnp.sum(dop.astype(jnp.float32) * op.astype(jnp.float32),
                  axis=-1, keepdims=True)           # (b, h, s_pad, 1)
 
-    qblk, kblk, qrow = _IXQ, _IXK, _IXQ
-    # dq: sweep k blocks per q block
-    dq = pl.pallas_call(
-        functools.partial(_dq_kernel, scale=scale, causal=causal,
-                          bq=bq, bk=bk, seq_len=s),
-        grid=(b, h, nq, nk),
-        in_specs=[
-            pl.BlockSpec((1, 1, bq, d_pad), qblk, memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, 1, bk, d_pad), kblk, memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, 1, bk, d_pad), kblk, memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, 1, bq, d_pad), qblk, memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, 1, bq, 1), qrow, memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, 1, bq, 1), qrow, memory_space=pltpu.VMEM),
-        ],
-        out_specs=pl.BlockSpec((1, 1, bq, d_pad), qblk,
-                               memory_space=pltpu.VMEM),
-        out_shape=jax.ShapeDtypeStruct((b, h, s_pad, d_pad), q.dtype),
-        scratch_shapes=[pltpu.VMEM((bq, d_pad), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("parallel", "parallel", "parallel",
-                                 "arbitrary")),
-        interpret=interpret,
-    )(qp, kp, vp, dop, lsep, dd)
+    dq_kernel = functools.partial(_dq_kernel, scale=scale, causal=causal,
+                                  bq=bq, bk=bk, seq_len=s)
+    dq_shape = jax.ShapeDtypeStruct((b, h, s_pad, d_pad), q.dtype)
+    dq_scratch = [pltpu.VMEM((bq, d_pad), jnp.float32)]
+    if causal:
+        iq_arr, ik_arr = _tri_arrays(nq)
+        # dq: sweep k blocks per q block over the lower triangle
+        dq = pl.pallas_call(
+            dq_kernel,
+            grid_spec=pltpu.PrefetchScalarGridSpec(
+                num_scalar_prefetch=2,
+                grid=(b, h, int(iq_arr.size)),
+                in_specs=[
+                    pl.BlockSpec((1, 1, bq, d_pad), _TRIQ),
+                    pl.BlockSpec((1, 1, bk, d_pad), _TRIK),
+                    pl.BlockSpec((1, 1, bk, d_pad), _TRIK),
+                    pl.BlockSpec((1, 1, bq, d_pad), _TRIQ),
+                    pl.BlockSpec((1, 1, bq, 1), _TRIQ),
+                    pl.BlockSpec((1, 1, bq, 1), _TRIQ),
+                ],
+                out_specs=pl.BlockSpec((1, 1, bq, d_pad), _TRIQ),
+                scratch_shapes=dq_scratch,
+            ),
+            out_shape=dq_shape,
+            compiler_params=pltpu.CompilerParams(
+                dimension_semantics=("parallel", "parallel",
+                                     "arbitrary")),
+            interpret=interpret,
+        )(jnp.asarray(iq_arr), jnp.asarray(ik_arr),
+          qp, kp, vp, dop, lsep, dd)
+    else:
+        qblk, kblk, qrow = _IXQ, _IXK, _IXQ
+        dq = pl.pallas_call(
+            dq_kernel,
+            grid=(b, h, nq, nk),
+            in_specs=[
+                pl.BlockSpec((1, 1, bq, d_pad), qblk,
+                             memory_space=pltpu.VMEM),
+                pl.BlockSpec((1, 1, bk, d_pad), kblk,
+                             memory_space=pltpu.VMEM),
+                pl.BlockSpec((1, 1, bk, d_pad), kblk,
+                             memory_space=pltpu.VMEM),
+                pl.BlockSpec((1, 1, bq, d_pad), qblk,
+                             memory_space=pltpu.VMEM),
+                pl.BlockSpec((1, 1, bq, 1), qrow,
+                             memory_space=pltpu.VMEM),
+                pl.BlockSpec((1, 1, bq, 1), qrow,
+                             memory_space=pltpu.VMEM),
+            ],
+            out_specs=pl.BlockSpec((1, 1, bq, d_pad), qblk,
+                                   memory_space=pltpu.VMEM),
+            out_shape=dq_shape,
+            scratch_shapes=dq_scratch,
+            compiler_params=pltpu.CompilerParams(
+                dimension_semantics=("parallel", "parallel", "parallel",
+                                     "arbitrary")),
+            interpret=interpret,
+        )(qp, kp, vp, dop, lsep, dd)
 
-    # dk/dv: sweep q blocks per k block (q is the innermost grid dim)
-    kblk2, qblk2, qrow2 = _IXK2, _IXQ2, _IXQ2
-    dk, dv = pl.pallas_call(
-        functools.partial(_dkdv_kernel, scale=scale, causal=causal,
-                          bq=bq, bk=bk, seq_len=s),
-        grid=(b, h, nk, nq),
-        in_specs=[
-            pl.BlockSpec((1, 1, bq, d_pad), qblk2, memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, 1, bk, d_pad), kblk2, memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, 1, bk, d_pad), kblk2, memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, 1, bq, d_pad), qblk2, memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, 1, bq, 1), qrow2,
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, 1, bq, 1), qrow2,
-                         memory_space=pltpu.VMEM),
-        ],
-        out_specs=[
-            pl.BlockSpec((1, 1, bk, d_pad), kblk2,
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, 1, bk, d_pad), kblk2,
-                         memory_space=pltpu.VMEM),
-        ],
-        out_shape=[
-            jax.ShapeDtypeStruct((b, h, s_pad, d_pad), k.dtype),
-            jax.ShapeDtypeStruct((b, h, s_pad, d_pad), v.dtype),
-        ],
-        scratch_shapes=[pltpu.VMEM((bk, d_pad), jnp.float32),
-                        pltpu.VMEM((bk, d_pad), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("parallel", "parallel", "parallel",
-                                 "arbitrary")),
-        interpret=interpret,
-    )(qp, kp, vp, dop, lsep, dd)
+    # dk/dv: sweep q blocks per k block (q is the innermost dimension)
+    kv_kernel = functools.partial(_dkdv_kernel, scale=scale,
+                                  causal=causal, bq=bq, bk=bk, seq_len=s,
+                                  tri_nq=nq)
+    kv_shape = [
+        jax.ShapeDtypeStruct((b, h, s_pad, d_pad), k.dtype),
+        jax.ShapeDtypeStruct((b, h, s_pad, d_pad), v.dtype),
+    ]
+    kv_scratch = [pltpu.VMEM((bk, d_pad), jnp.float32),
+                  pltpu.VMEM((bk, d_pad), jnp.float32)]
+    if causal:
+        iq_arr2, ik_arr2 = _tri_arrays_rev(nq)
+        dk, dv = pl.pallas_call(
+            kv_kernel,
+            grid_spec=pltpu.PrefetchScalarGridSpec(
+                num_scalar_prefetch=2,
+                grid=(b, h, int(iq_arr2.size)),
+                in_specs=[
+                    pl.BlockSpec((1, 1, bq, d_pad), _TRIQ),
+                    pl.BlockSpec((1, 1, bk, d_pad), _TRIK),
+                    pl.BlockSpec((1, 1, bk, d_pad), _TRIK),
+                    pl.BlockSpec((1, 1, bq, d_pad), _TRIQ),
+                    pl.BlockSpec((1, 1, bq, 1), _TRIQ),
+                    pl.BlockSpec((1, 1, bq, 1), _TRIQ),
+                ],
+                out_specs=[
+                    pl.BlockSpec((1, 1, bk, d_pad), _TRIK),
+                    pl.BlockSpec((1, 1, bk, d_pad), _TRIK),
+                ],
+                scratch_shapes=kv_scratch,
+            ),
+            out_shape=kv_shape,
+            compiler_params=pltpu.CompilerParams(
+                dimension_semantics=("parallel", "parallel",
+                                     "arbitrary")),
+            interpret=interpret,
+        )(jnp.asarray(iq_arr2), jnp.asarray(ik_arr2),
+          qp, kp, vp, dop, lsep, dd)
+    else:
+        kblk2, qblk2, qrow2 = _IXK2, _IXQ2, _IXQ2
+        dk, dv = pl.pallas_call(
+            kv_kernel,
+            grid=(b, h, nk, nq),
+            in_specs=[
+                pl.BlockSpec((1, 1, bq, d_pad), qblk2,
+                             memory_space=pltpu.VMEM),
+                pl.BlockSpec((1, 1, bk, d_pad), kblk2,
+                             memory_space=pltpu.VMEM),
+                pl.BlockSpec((1, 1, bk, d_pad), kblk2,
+                             memory_space=pltpu.VMEM),
+                pl.BlockSpec((1, 1, bq, d_pad), qblk2,
+                             memory_space=pltpu.VMEM),
+                pl.BlockSpec((1, 1, bq, 1), qrow2,
+                             memory_space=pltpu.VMEM),
+                pl.BlockSpec((1, 1, bq, 1), qrow2,
+                             memory_space=pltpu.VMEM),
+            ],
+            out_specs=[
+                pl.BlockSpec((1, 1, bk, d_pad), kblk2,
+                             memory_space=pltpu.VMEM),
+                pl.BlockSpec((1, 1, bk, d_pad), kblk2,
+                             memory_space=pltpu.VMEM),
+            ],
+            out_shape=kv_shape,
+            scratch_shapes=kv_scratch,
+            compiler_params=pltpu.CompilerParams(
+                dimension_semantics=("parallel", "parallel", "parallel",
+                                     "arbitrary")),
+            interpret=interpret,
+        )(qp, kp, vp, dop, lsep, dd)
 
     unprep = lambda x: jnp.moveaxis(x[:, :, :s, :d], 1, 2)  # noqa: E731
     return unprep(dq), unprep(dk), unprep(dv)

@@ -152,6 +152,18 @@ class SpanStore:
 _FLUSH_PERIOD_S = 1.0
 
 
+def _to_i64(v: int) -> int:
+    """uint64 ids (fast_rand trace ids) -> sqlite's signed INTEGER.
+    Without this, ~half of all random trace ids overflow the bind and
+    the whole flush batch rolls back."""
+    v &= (1 << 64) - 1
+    return v - (1 << 64) if v >= (1 << 63) else v
+
+
+def _from_i64(v: int) -> int:
+    return v + (1 << 64) if v < 0 else v
+
+
 def _db_path() -> Optional[str]:
     import os
     d = str(get_flag("rpcz_dir", "") or "")
@@ -219,8 +231,9 @@ def _flush_pending(store: "SpanStore") -> None:
             with db:
                 db.executemany(
                     "INSERT INTO spans VALUES (?,?,?,?,?,?,?,?,?,?,?,?)",
-                    [(s.received_us, s.trace_id, s.span_id,
-                      s.parent_span_id, s.full_method, s.remote_side,
+                    [(s.received_us, _to_i64(s.trace_id),
+                      _to_i64(s.span_id), _to_i64(s.parent_span_id),
+                      s.full_method, s.remote_side,
                       s.latency_us, s.error_code, s.request_size,
                       s.response_size,
                       "server" if s.is_server else "client",
@@ -277,7 +290,7 @@ def browse_persisted(start_us: int = 0, end_us: int = 0,
         args.append(int(end_us))
     if trace_id:
         where.append("trace_id = ?")
-        args.append(int(trace_id))
+        args.append(_to_i64(int(trace_id)))
     q = "SELECT * FROM spans"
     if where:
         q += " WHERE " + " AND ".join(where)
@@ -289,7 +302,9 @@ def browse_persisted(start_us: int = 0, end_us: int = 0,
             db.row_factory = sqlite3.Row
             for row in db.execute(q, args + [int(limit)]):
                 rec = dict(row)
-                rec["trace_id"] = f"{rec['trace_id']:x}"
+                rec["trace_id"] = f"{_from_i64(rec['trace_id']):x}"
+                rec["span_id"] = _from_i64(rec["span_id"])
+                rec["parent_span_id"] = _from_i64(rec["parent_span_id"])
                 try:
                     rec["annotations"] = [
                         {"us": ts, "text": txt}

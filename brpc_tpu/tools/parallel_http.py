@@ -73,8 +73,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     servers = list(args.servers)
     if args.file:
         with open(args.file) as f:
-            servers += [ln.strip() for ln in f
-                        if ln.strip() and not ln.startswith("#")]
+            stripped = (ln.strip() for ln in f)
+            servers += [s for s in stripped
+                        if s and not s.startswith("#")]
     if not servers:
         ap.error("no servers given")
     results = parallel_fetch(servers, args.path,

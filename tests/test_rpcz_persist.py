@@ -95,3 +95,24 @@ def test_rpcz_page_time_range(rpcz_dir):
         assert any(s["method"] == "T.Ping" for s in doc["spans"]), doc
     finally:
         srv.stop()
+
+
+def test_uint64_trace_ids_persist(rpcz_dir):
+    """fast_rand() trace ids are uniform uint64 — ~half exceed sqlite's
+    signed INTEGER range; they must round-trip (signed-bridge encoding),
+    not roll back the whole flush batch (review r4 finding)."""
+    big = (1 << 63) + 12345
+    s1 = Span("Big.Id", trace_id=big)
+    s1.finish()
+    s2 = Span("Small.Id", trace_id=0x42)
+    s2.finish()
+    store = global_span_store()
+    store.flush_now()
+    spans = browse_persisted(limit=10)
+    methods = {r["method"] for r in spans}
+    assert {"Big.Id", "Small.Id"} <= methods, methods
+    (rec,) = [r for r in spans if r["method"] == "Big.Id"]
+    assert int(rec["trace_id"], 16) == big
+    # and trace-id filtered browsing finds it
+    only = browse_persisted(limit=10, trace_id=big)
+    assert [r["method"] for r in only] == ["Big.Id"]
