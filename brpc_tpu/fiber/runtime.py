@@ -18,6 +18,18 @@ start.  Design differences, deliberate:
   path (syscalls + framing) is handled by the optional native C++ IO
   engine under ``brpc_tpu/native`` when built, which releases the GIL
   around its epoll/read/write loops.
+
+Wake-up discipline (≈ ParkingLot, parking_lot.h): every COOPERATIVE
+path is event-driven — spawn() notifies a parked worker the moment an
+item lands (shared queue or a local queue another worker can steal),
+and butex/join/socket waits announce themselves via begin_blocking()
+so a replacement starts immediately when runnable work would starve.
+The only poll in the design is the 50ms starvation monitor, and it
+exists for the one case no event can cover: arbitrary user code
+blocking a worker WITHOUT telling anyone (third-party sleeps, raw
+syscalls) — the same hole the reference plugs with its
+usercode_in_pthread backup pool.  The monitor runs only while work is
+queued and retires itself when traffic stops.
 """
 
 from __future__ import annotations

@@ -187,11 +187,12 @@ def _block_geometry(s: int, d: int, block_q, block_k,
                     causal: bool = False):
     d_pad = _ceil_to(max(d, 1), 128)
     if block_q is None or block_k is None:
-        # measured on v5e: 256 wins at short context; from ~4k up
-        # bigger blocks amortize the per-block scratch round trips
-        # (1024/1024 measured fastest at 16k; fp32 scores stay within
-        # the 16MB VMEM at 1024^2)
-        auto = 1024 if s >= 8192 else (512 if s >= 4096 else 256)
+        # measured on v5e (post bf16-MXU-input rework): 1024/1024 is
+        # fastest everywhere the kernel is actually dispatched (the
+        # auto impl uses dense below 2k) — bigger blocks amortize the
+        # per-block scratch round trips, and fp32 scores stay within
+        # the 16MB VMEM at 1024^2
+        auto = 1024 if s >= 2048 else 256
         block_q = auto if block_q is None else block_q
         block_k = auto if block_k is None else block_k
     bq = min(block_q, _ceil_to(s, 8))
