@@ -121,7 +121,7 @@ def main() -> int:
         r, _ = ch.call_raw("M.Infer", b"req-%d" % i, timeout_ms=5_000)
         assert bytes(r) == b"req-%d" % i
     direct = Channel()
-    direct.init(addrs[0])
+    assert direct.init(addrs[0]) == 0
     out = direct.call_batch("M.Infer", [b"b%03d" % i for i in range(256)],
                             timeout_ms=10_000)
     assert len(out) == 256 and bytes(out[7]) == b"b007"

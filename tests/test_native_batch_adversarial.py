@@ -1,0 +1,193 @@
+"""Adversarial wire tests for the native call_batch lane.
+
+The C++ batch reader (engine.cpp call_batch) parses frames, matches
+correlation ids, and drains TICI interleaves with the GIL released —
+exactly the code a malicious or desynced peer talks to.  These tests
+drive it over a socketpair with handcrafted bytes, mirroring the
+reference's raw-wire protocol unittests (SURVEY §4)."""
+
+import socket
+import struct
+import threading
+
+import pytest
+
+from conftest import require_native
+
+
+def _native():
+    require_native()
+    from brpc_tpu.native import load
+    nat = load()
+    if nat is None or not hasattr(nat, "call_batch"):
+        pytest.skip("native call_batch unavailable")
+    return nat
+
+
+def _tlv(tag, data):
+    return bytes([tag]) + struct.pack("<I", len(data)) + data
+
+
+def _resp_frame(cid, payload=b"ok", extra_meta=b""):
+    meta = _tlv(1, struct.pack("<Q", cid)) + extra_meta
+    return (b"TRPC" + struct.pack("<II", len(meta) + len(payload),
+                                  len(meta)) + meta + payload)
+
+
+TAIL = _tlv(4, b"S") + _tlv(5, b"M")      # service/method TLVs
+
+
+def _complete_frames(data: bytes, want: int) -> bool:
+    """True when ``data`` holds ``want`` whole TRPC frames."""
+    off = count = 0
+    while count < want:
+        if len(data) - off < 12 or data[off:off + 4] != b"TRPC":
+            return False
+        (body,) = struct.unpack_from("<I", data, off + 4)
+        if len(data) - off < 12 + body:
+            return False
+        off += 12 + body
+        count += 1
+    return True
+
+
+def _run(nat, responder, n=2, timeout=5.0, base=1000):
+    """call_batch over a socketpair; ``responder(data) -> bytes`` maps
+    the request bytes to the peer's scripted reply.  The peer reads
+    until all n request FRAMES are in hand (parsing headers, not an
+    idle heuristic — a descheduled writer must not race the script)."""
+    a, b = socket.socketpair()
+    a.setblocking(False)
+
+    def peer():
+        b.settimeout(10)
+        buf = b""
+        try:
+            while not _complete_frames(buf, n):
+                c = b.recv(65536)
+                if not c:
+                    break
+                buf += c
+        except socket.timeout:
+            pass
+        reply = responder(buf)
+        if reply:
+            b.sendall(reply)
+
+    t = threading.Thread(target=peer)
+    t.start()
+    try:
+        payloads = [b"p%d" % i for i in range(n)]
+        return nat.call_batch(a.fileno(), TAIL, payloads, timeout, base,
+                              b"", b"")
+    finally:
+        t.join(15)
+        a.close()
+        b.close()
+
+
+def test_happy_path_out_of_order():
+    """Responses arriving in reverse order must still land by cid."""
+    nat = _native()
+    results, acks = _run(
+        nat, lambda req: _resp_frame(1001, b"second")
+        + _resp_frame(1000, b"first"))
+    assert bytes(results[0]) == b"first"
+    assert bytes(results[1]) == b"second"
+    assert acks == []
+
+
+def test_duplicate_cid_rejected():
+    nat = _native()
+    with pytest.raises(ValueError, match="cid"):
+        _run(nat, lambda req: _resp_frame(1000) + _resp_frame(1000))
+
+
+def test_cid_out_of_range_rejected():
+    nat = _native()
+    with pytest.raises(ValueError, match="cid"):
+        _run(nat, lambda req: _resp_frame(9999) + _resp_frame(1000))
+
+
+def test_bad_magic_rejected():
+    nat = _native()
+    with pytest.raises(ValueError, match="magic"):
+        _run(nat, lambda req: b"JUNKJUNKJUNKJUNK" * 4)
+
+
+def test_truncated_stream_times_out():
+    """A peer that answers one of two responses then goes silent must
+    produce a timeout, not a hang."""
+    nat = _native()
+    with pytest.raises(TimeoutError):
+        _run(nat, lambda req: _resp_frame(1000), timeout=0.5)
+
+
+def test_tici_interleave_collected():
+    """TICI credit-return frames between responses come back as acks."""
+    nat = _native()
+    tici = b"TICI" + struct.pack("<I", 2) + struct.pack("<QQ", 7, 8)
+    results, acks = _run(
+        nat, lambda req: _resp_frame(1000) + tici + _resp_frame(1001))
+    assert bytes(results[0]) == b"p0"[:0] + b"ok"
+    assert sorted(acks) == [7, 8]
+
+
+def test_oversized_ack_count_rejected():
+    nat = _native()
+    evil = b"TICI" + struct.pack("<I", 1 << 20)
+    with pytest.raises(ValueError, match="ack"):
+        _run(nat, lambda req: evil + _resp_frame(1000) + _resp_frame(1001))
+
+
+def test_error_response_returned_whole_for_python_decode():
+    """A response with controller-tier tags (error code) must come back
+    as (frame_body, meta_size) for RpcMeta decoding, not a bare buf."""
+    nat = _native()
+    err_meta = _tlv(6, struct.pack("<i", 1003)) + _tlv(7, b"nope")
+    results, acks = _run(
+        nat, lambda req: _resp_frame(1000, b"", extra_meta=err_meta)
+        + _resp_frame(1001))
+    assert type(results[0]) is tuple
+    body, msize = results[0]
+    from brpc_tpu.protocol.meta import RpcMeta
+    meta = RpcMeta.decode(bytes(memoryview(body)[:msize]))
+    assert meta.error_code == 1003 and meta.error_text == "nope"
+    assert type(results[1]) is not tuple
+
+
+def test_attachment_response_returned_whole():
+    """attachment-size TLV makes the item non-plain: full frame back."""
+    nat = _native()
+    att_meta = _tlv(3, struct.pack("<I", 2))
+    results, _ = _run(
+        nat, lambda req: _resp_frame(1000, b"bodyAT", extra_meta=att_meta)
+        + _resp_frame(1001))
+    assert type(results[0]) is tuple
+
+
+def test_request_frames_well_formed():
+    """What the lane WRITES must parse as the server's cut loop would:
+    header sizes consistent, cids consecutive from the base."""
+    nat = _native()
+    seen = {}
+
+    def capture(req):
+        seen["req"] = req
+        return _resp_frame(1000) + _resp_frame(1001)
+
+    _run(nat, capture)
+    req = seen["req"]
+    cids = []
+    off = 0
+    while off < len(req):
+        assert req[off:off + 4] == b"TRPC"
+        body, msize = struct.unpack_from("<II", req, off + 4)
+        assert msize <= body
+        meta = req[off + 12:off + 12 + msize]
+        # first TLV is the cid
+        assert meta[0] == 1
+        (cid,) = struct.unpack_from("<Q", meta, 5)
+        cids.append(cid)
+        off += 12 + body
+    assert cids == [1000, 1001]

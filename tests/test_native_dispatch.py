@@ -264,3 +264,39 @@ def test_native_dispatch_concurrent_callers(native_server):
     assert not errors
     assert svc.python_hits == 0
     assert _native_count(srv, "N.Echo")[0] == 200
+
+
+def test_malformed_meta_never_crashes_engine(native_server):
+    """Fuzz-shaped metas against the native scanner: truncated TLV
+    lengths, zero-length names, lengths past the body — the engine must
+    answer something sane or drop the conn, never wedge the server."""
+    import socket as pysock
+    import struct
+
+    srv, svc = native_server
+    ep = srv.listen_endpoint
+
+    def frame(meta, payload=b"x"):
+        return (b"TRPC" + struct.pack("<II", len(meta) + len(payload),
+                                      len(meta)) + meta + payload)
+
+    evil_metas = [
+        b"\x01\xff\xff\xff\xff",              # TLV len 4GB, no data
+        b"\x01\x08\x00\x00\x00" + b"\x01",    # cid TLV truncated
+        b"\x04\x00\x00\x00\x00\x05\x00\x00\x00\x00",  # empty svc+mth
+        b"\x63\x04\x00\x00\x00abcd",          # unknown tag 0x63
+        b"",                                   # empty meta
+    ]
+    for meta in evil_metas:
+        with pysock.create_connection((str(ep.host), ep.port),
+                                      timeout=5) as c:
+            c.sendall(frame(meta))
+            c.settimeout(2)
+            try:
+                c.recv(4096)       # error frame or EOF — both fine
+            except (TimeoutError, ConnectionError, OSError):
+                pass
+    # the server is still fully alive for well-formed traffic
+    ch = _ch(srv)
+    resp, _ = ch.call_raw("N.Echo", b"alive", timeout_ms=5_000)
+    assert bytes(resp) == b"alive"
