@@ -243,8 +243,11 @@ def make_decode(cfg: LMConfig):
     import jax
     import jax.numpy as jnp
 
-    assert not cfg.scan_layers, "decode supports unrolled layers"
     hd = cfg.dim // cfg.heads
+    if cfg.scan_layers and cfg.moe_experts > 0:
+        raise NotImplementedError(
+            "scanned decode does not support MoE blocks — use "
+            "scan_layers=False for MoE serving")
     if cfg.moe_experts > 0:
         from .moe import forward_grouped as moe_forward
         moe_cfg = cfg.moe_cfg()
@@ -265,69 +268,98 @@ def make_decode(cfg: LMConfig):
     def unembed(params, x_last):
         return qmatmul(x_last, params["unembed"])
 
+    def prefill_layer(bp, x, sin, cos):
+        """One block of prompt processing; returns (x, k, v) with k/v
+        written into fresh max_seq caches."""
+        b, s = x.shape[0], x.shape[1]
+        h = _rmsnorm(x, bp["ln1"])
+        qkv = qmatmul(h, bp["wqkv"])
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        shp = (b, s, cfg.heads, hd)
+        q, k = (_rope(t.reshape(shp), sin, cos) for t in (q, k))
+        v = v.reshape(shp)
+        kc = jnp.zeros((b, cfg.max_seq, cfg.heads, hd), jnp.float32)
+        vc = jnp.zeros((b, cfg.max_seq, cfg.heads, hd), jnp.float32)
+        kc = jax.lax.dynamic_update_slice(kc, k, (0, 0, 0, 0))
+        vc = jax.lax.dynamic_update_slice(vc, v, (0, 0, 0, 0))
+        # seq-adaptive: long prompts prefill through the flash kernel
+        # (O(s) memory) instead of materializing (s, s) scores per
+        # layer — honoring the same impl override as make_forward
+        from ..ops.flash_attention import attention
+        impl = "flash" if cfg.use_flash else cfg.attn_impl
+        att = attention(q, k, v, causal=cfg.causal, impl=impl)
+        x = x + qmatmul(att.reshape(b, s, cfg.dim), bp["wo"])
+        x = x + mlp(bp, _rmsnorm(x, bp["ln2"]))
+        return x, kc, vc
+
+    def decode_layer(bp, x, kc, vc, pos):
+        """One block of single-token decode; returns (x, kc, vc) with
+        this token's k/v written at ``pos``."""
+        b = x.shape[0]
+        h = _rmsnorm(x, bp["ln1"])
+        qkv = qmatmul(h, bp["wqkv"])
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        shp = (b, 1, cfg.heads, hd)
+        q = _rope_at(q.reshape(shp), pos, hd)
+        k = _rope_at(k.reshape(shp), pos, hd)
+        v = v.reshape(shp)
+        kc = jax.lax.dynamic_update_slice(kc, k, (0, pos, 0, 0))
+        vc = jax.lax.dynamic_update_slice(vc, v, (0, pos, 0, 0))
+        # attend the single query over the cached prefix
+        s_mat = jnp.einsum("bqhd,bkhd->bhqk", q, kc,
+                           preferred_element_type=jnp.float32
+                           ) / (hd ** 0.5)
+        live = jnp.arange(cfg.max_seq) <= pos        # prefix + self
+        s_mat = jnp.where(live[None, None, None, :], s_mat, -1e30)
+        p = jax.nn.softmax(s_mat, axis=-1)
+        att = jnp.einsum("bhqk,bkhd->bqhd", p, vc,
+                         preferred_element_type=jnp.float32)
+        x = x + qmatmul(att.reshape(b, 1, cfg.dim), bp["wo"])
+        x = x + mlp(bp, _rmsnorm(x, bp["ln2"]))
+        return x, kc, vc
+
     def prefill(params, ids):
         b, s = ids.shape
         assert s <= cfg.max_seq
-        fwd_x = params["embed"][ids]
+        x = params["embed"][ids]
         sin, cos = _rope_tables(s, hd)
+        if cfg.scan_layers:
+            # one compiled layer body regardless of depth — the serving
+            # answer to compile-time scaling (the train path's story,
+            # make_forward): caches come back stacked (depth, ...)
+            def body(x, bp):
+                x, kc, vc = prefill_layer(bp, x, sin, cos)
+                return x, (kc, vc)
+
+            x, (kcs, vcs) = jax.lax.scan(body, x, params["blocks"])
+            cache = {"len": jnp.int32(s), "k": kcs, "v": vcs}
+            return cache, unembed(params, x[:, -1])
         cache = {"len": jnp.int32(s)}
-        x = fwd_x
         for i in range(cfg.depth):
-            bp = params[f"blk{i}"]
-            h = _rmsnorm(x, bp["ln1"])
-            qkv = qmatmul(h, bp["wqkv"])
-            q, k, v = jnp.split(qkv, 3, axis=-1)
-            shp = (b, s, cfg.heads, hd)
-            q, k = (_rope(t.reshape(shp), sin, cos) for t in (q, k))
-            v = v.reshape(shp)
-            kc = jnp.zeros((b, cfg.max_seq, cfg.heads, hd), jnp.float32)
-            vc = jnp.zeros((b, cfg.max_seq, cfg.heads, hd), jnp.float32)
-            cache[f"k{i}"] = jax.lax.dynamic_update_slice(
-                kc, k, (0, 0, 0, 0))
-            cache[f"v{i}"] = jax.lax.dynamic_update_slice(
-                vc, v, (0, 0, 0, 0))
-            # seq-adaptive: long prompts prefill through the flash
-            # kernel (O(s) memory) instead of materializing (s, s)
-            # scores per layer — honoring the same impl override as
-            # make_forward (a config forcing dense stays dense)
-            from ..ops.flash_attention import attention
-            impl = "flash" if cfg.use_flash else cfg.attn_impl
-            att = attention(q, k, v, causal=cfg.causal, impl=impl)
-            x = x + qmatmul(att.reshape(b, s, cfg.dim), bp["wo"])
-            x = x + mlp(bp, _rmsnorm(x, bp["ln2"]))
+            x, kc, vc = prefill_layer(params[f"blk{i}"], x, sin, cos)
+            cache[f"k{i}"], cache[f"v{i}"] = kc, vc
         return cache, unembed(params, x[:, -1])
 
     def decode_step(params, cache, token):
         cache = dict(cache)      # never mutate the caller's dict (an
                                  # eager caller may fork it — beam/retry)
-        b = token.shape[0]
         pos = cache["len"]                           # traced scalar
         x = params["embed"][token][:, None, :]       # (b, 1, d)
+        if cfg.scan_layers:
+            def body(x, layer):
+                bp, kc, vc = layer
+                x, kc, vc = decode_layer(bp, x, kc, vc, pos)
+                return x, (kc, vc)
+
+            x, (kcs, vcs) = jax.lax.scan(
+                body, x, (params["blocks"], cache["k"], cache["v"]))
+            cache["k"], cache["v"] = kcs, vcs
+            cache["len"] = pos + 1
+            return cache, unembed(params, x[:, 0])
         for i in range(cfg.depth):
-            bp = params[f"blk{i}"]
-            h = _rmsnorm(x, bp["ln1"])
-            qkv = qmatmul(h, bp["wqkv"])
-            q, k, v = jnp.split(qkv, 3, axis=-1)
-            shp = (b, 1, cfg.heads, hd)
-            q = _rope_at(q.reshape(shp), pos, hd)
-            k = _rope_at(k.reshape(shp), pos, hd)
-            v = v.reshape(shp)
-            kc = jax.lax.dynamic_update_slice(
-                cache[f"k{i}"], k, (0, pos, 0, 0))
-            vc = jax.lax.dynamic_update_slice(
-                cache[f"v{i}"], v, (0, pos, 0, 0))
+            x, kc, vc = decode_layer(params[f"blk{i}"], x,
+                                     cache[f"k{i}"], cache[f"v{i}"], pos)
             cache[f"k{i}"], cache[f"v{i}"] = kc, vc
-            # attend the single query over the cached prefix
-            s_mat = jnp.einsum("bqhd,bkhd->bhqk", q, kc,
-                               preferred_element_type=jnp.float32
-                               ) / (hd ** 0.5)
-            live = jnp.arange(cfg.max_seq) <= pos    # prefix + self
-            s_mat = jnp.where(live[None, None, None, :], s_mat, -1e30)
-            p = jax.nn.softmax(s_mat, axis=-1)
-            att = jnp.einsum("bhqk,bkhd->bqhd", p, vc,
-                             preferred_element_type=jnp.float32)
-            x = x + qmatmul(att.reshape(b, 1, cfg.dim), bp["wo"])
-            x = x + mlp(bp, _rmsnorm(x, bp["ln2"]))
         cache["len"] = pos + 1
         return cache, unembed(params, x[:, 0])
 
@@ -337,10 +369,18 @@ def make_decode(cfg: LMConfig):
 def empty_cache(cfg: LMConfig, batch: int, start_len: int = 1):
     """A fresh KV cache in the layout make_decode's steps expect — the
     model owns this structure; callers (benches, servers pre-allocating
-    serving slots) must not hand-roll it."""
+    serving slots) must not hand-roll it.  ``scan_layers`` configs use
+    stacked (depth, ...) caches matching the scanned decode."""
     import jax.numpy as jnp
     hd = cfg.dim // cfg.heads
     cache = {"len": jnp.int32(start_len)}
+    if cfg.scan_layers:
+        shape = (cfg.depth, batch, cfg.max_seq, cfg.heads, hd)
+        # two DISTINCT buffers: donating a cache that aliases k and v
+        # to one array is a double-donation error on TPU
+        cache["k"] = jnp.zeros(shape, jnp.float32)
+        cache["v"] = jnp.zeros(shape, jnp.float32)
+        return cache
     for i in range(cfg.depth):
         cache[f"k{i}"] = jnp.zeros((batch, cfg.max_seq, cfg.heads, hd),
                                    jnp.float32)

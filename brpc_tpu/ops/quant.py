@@ -86,18 +86,20 @@ def quantize_lm_params(params: dict) -> dict:
     matmul), layernorm gains, and MoE trees stay as-is.  Returns a new
     tree; the original is untouched.
 
-    Serving-path feature: decode requires unrolled layers, so stacked
-    ``scan_layers`` trees are rejected rather than silently returned
-    mostly-unquantized."""
-    if "blocks" in params:
-        raise ValueError(
-            "quantize_lm_params needs an unrolled-layer tree (the "
-            "decode path's form); scan_layers trees are for training — "
-            "re-init with LMConfig(scan_layers=False) for serving")
+    Both layer layouts are served: unrolled ``blk{i}`` trees and
+    stacked ``scan_layers`` trees (weights (depth, in, out) quantize
+    with the contraction on axis 1, giving per-(layer, out-channel)
+    scales — ``lax.scan`` then slices each layer's QuantTensor off the
+    leading axis)."""
     out: dict = {}
     for key, val in params.items():
         if key == "unembed":
             out[key] = quantize_int8(val)
+        elif key == "blocks" and isinstance(val, dict):
+            out[key] = {
+                bk: (quantize_int8(bv, contract_axis=1)
+                     if bk in _LM_QUANT_KEYS else bv)
+                for bk, bv in val.items()}
         elif key.startswith("blk") and isinstance(val, dict):
             blk = {}
             for bk, bv in val.items():

@@ -111,9 +111,14 @@ def test_lm_service_generates_over_rpc():
         srv.stop()
 
 
-def test_decode_rejects_scan_layers():
-    cfg, params, prompt = _setup(scan_layers=True)
-    with pytest.raises(AssertionError):
+def test_decode_scan_layers_moe_rejected():
+    """Scanned decode supports dense blocks (see
+    test_scanned_decode_matches_unrolled); the MoE combination is the
+    one explicitly unsupported shape and must say so loudly."""
+    from brpc_tpu.models.transformer_lm import LMConfig
+    cfg = LMConfig(vocab=64, dim=32, heads=2, depth=2, max_seq=16,
+                   scan_layers=True, moe_experts=2)
+    with pytest.raises(NotImplementedError, match="MoE"):
         make_decode(cfg)
 
 
@@ -157,3 +162,83 @@ def test_scan_generator_sampling_contract():
     assert a.shape == (1, 6)
     with pytest.raises(ValueError, match="max_seq"):
         gen(prompt, 64)
+
+
+def test_scanned_decode_matches_unrolled():
+    """cfg.scan_layers decode (one compiled layer body, stacked caches)
+    must produce the same logits/tokens as the unrolled path given the
+    same weights — the compile-time answer for deep serving models."""
+    import functools as ft
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from brpc_tpu.models.transformer_lm import (LMConfig, empty_cache,
+                                                init_params, make_decode)
+
+    kw = dict(vocab=64, dim=32, heads=2, depth=3, max_seq=16, mlp_mult=2,
+              remat=False, attn_impl="dense")
+    cfg_u = LMConfig(**kw)
+    cfg_s = LMConfig(**kw, scan_layers=True)
+    pu = init_params(jax.random.PRNGKey(0), cfg_u)
+    # same weights, stacked layout
+    ps = {k: v for k, v in pu.items() if not k.startswith("blk")}
+    blks = [pu[f"blk{i}"] for i in range(cfg_u.depth)]
+    ps["blocks"] = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs),
+                                          *blks)
+
+    prompt = jax.random.randint(jax.random.PRNGKey(1), (2, 5), 0,
+                                cfg_u.vocab, jnp.int32)
+    pre_u, step_u = make_decode(cfg_u)
+    pre_s, step_s = make_decode(cfg_s)
+    cu, lu = jax.jit(ft.partial(pre_u, pu))(prompt)
+    cs, ls = jax.jit(ft.partial(pre_s, ps))(prompt)
+    np.testing.assert_allclose(np.asarray(lu), np.asarray(ls),
+                               atol=2e-2, rtol=2e-2)
+    tok = jnp.argmax(lu, axis=-1).astype(jnp.int32)
+    su = jax.jit(ft.partial(step_u, pu))
+    ss = jax.jit(ft.partial(step_s, ps))
+    for _ in range(4):
+        cu, lu = su(cu, tok)
+        cs, ls = ss(cs, tok)
+        np.testing.assert_allclose(np.asarray(lu), np.asarray(ls),
+                                   atol=2e-2, rtol=2e-2)
+        tok = jnp.argmax(lu, axis=-1).astype(jnp.int32)
+    # stacked empty_cache matches the scanned layout
+    ec = empty_cache(cfg_s, 2)
+    assert ec["k"].shape == (3, 2, 16, 2, 16)
+
+
+def test_scanned_decode_int8():
+    """Stacked scan_layers trees quantize (per-layer,out-channel
+    scales) and the scanned decode streams them."""
+    import functools as ft
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from brpc_tpu.models.transformer_lm import (LMConfig, init_params,
+                                                make_decode)
+    from brpc_tpu.ops.quant import QuantTensor, quantize_lm_params
+
+    cfg = LMConfig(vocab=64, dim=32, heads=2, depth=2, max_seq=16,
+                   mlp_mult=2, remat=False, attn_impl="dense",
+                   scan_layers=True)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    qp = quantize_lm_params(params)
+    assert isinstance(qp["blocks"]["wqkv"], QuantTensor)
+    assert qp["blocks"]["wqkv"].s.shape == (2, 3 * 32)
+    prompt = jax.random.randint(jax.random.PRNGKey(1), (2, 4), 0,
+                                cfg.vocab, jnp.int32)
+    pre, step = make_decode(cfg)
+    cf, lf = jax.jit(ft.partial(pre, params))(prompt)
+    cq, lq = jax.jit(ft.partial(pre, qp))(prompt)
+    # int8 is an approximation: same argmax is the serving contract
+    tok = jnp.argmax(lf, axis=-1).astype(jnp.int32)
+    cq, lq2 = jax.jit(ft.partial(step, qp))(cq, tok)
+    cf, lf2 = jax.jit(ft.partial(step, params))(cf, tok)
+    corr = np.corrcoef(np.asarray(lf2).ravel(),
+                       np.asarray(lq2).ravel())[0, 1]
+    assert corr > 0.99, corr

@@ -109,14 +109,22 @@ def test_quantized_lm_service_over_rpc(server_options):
         srv.stop()
 
 
-def test_quantize_rejects_scan_layers_tree():
+def test_quantize_scan_layers_tree():
+    """Stacked trees quantize with per-(layer, out-channel) scales —
+    round-4 upgrade from the old reject-with-ValueError behavior (the
+    scanned decode consumes these, test_lm_decode)."""
     from brpc_tpu.models.transformer_lm import LMConfig, init_params
-    import pytest as _pytest
+    from brpc_tpu.ops.quant import QuantTensor
     cfg = LMConfig(vocab=64, dim=32, heads=4, depth=2, max_seq=32,
                    scan_layers=True)
     params = init_params(jax.random.PRNGKey(0), cfg)
-    with _pytest.raises(ValueError, match="scan_layers"):
-        quantize_lm_params(params)
+    qp = quantize_lm_params(params)
+    w = qp["blocks"]["wqkv"]
+    assert isinstance(w, QuantTensor)
+    assert w.q.shape == (2, 32, 3 * 32) and w.q.dtype.name == "int8"
+    assert w.s.shape == (2, 3 * 32)
+    # layernorm gains stay full precision
+    assert not isinstance(qp["blocks"]["ln1"], QuantTensor)
 
 
 def test_quantize_is_idempotent():
