@@ -242,3 +242,36 @@ def test_scanned_decode_int8():
     corr = np.corrcoef(np.asarray(lf2).ravel(),
                        np.asarray(lq2).ravel())[0, 1]
     assert corr > 0.99, corr
+
+
+def test_lm_service_scan_layers_quantized():
+    """LMService over RPC with a scan_layers + int8 config: the serving
+    stack (scan generator, quantized stacked tree) composes end-to-end."""
+    import numpy as np
+
+    from brpc_tpu.client import Channel, Controller
+    from brpc_tpu.models.lm_service import (LMService,
+                                            pack_generate_request,
+                                            unpack_generated)
+    from brpc_tpu.models.transformer_lm import LMConfig
+    from brpc_tpu.server import Server
+
+    cfg = LMConfig(vocab=128, dim=32, heads=2, depth=2, max_seq=64,
+                   remat=False, scan_layers=True, attn_impl="dense")
+    srv = Server()
+    srv.add_service(LMService(cfg=cfg, quantize=True), name="LM")
+    assert srv.start("127.0.0.1:0") == 0
+    try:
+        ch = Channel()
+        ch.init(str(srv.listen_endpoint))
+        cntl = Controller()
+        cntl.timeout_ms = 120_000        # first compile pays its way
+        prompt = np.array([[1, 2, 3]], dtype=np.int32)
+        c = ch.call_method("LM.Generate",
+                           pack_generate_request(prompt, 4), cntl=cntl)
+        assert not c.failed, c.error_text
+        out = unpack_generated(bytes(c.response))
+        assert out.shape == (1, 4)
+        assert (out >= 0).all() and (out < cfg.vocab).all()
+    finally:
+        srv.stop()
