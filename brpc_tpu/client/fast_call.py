@@ -891,8 +891,67 @@ def run_raw(channel, method_full: str, payload, attachment=b"",
         return_pooled_socket(sid)
         return _full_path()
 
-    na = len(attachment) if attachment is not None else 0
+    nat = _native()
     cid = _next_cid()
+    if nat is not None and hasattr(nat, "raw_call") \
+            and not (opts.auth_data
+                     and getattr(sock, "app_data", None) is None):
+        # fully-native round trip: the C++ side builds the frame,
+        # writes, reads, and scans the response meta — Python's
+        # per-call work is one counter bump and one tuple unpack.
+        # (The rare first-call-with-auth case keeps the classic build.)
+        ack0 = sock._take_ack_frame() if sock._pending_acks else None
+        try:
+            ok, buf, nval, dom, acks = nat.raw_call(
+                sock.fd.fileno(), tlv, payload,
+                attachment if attachment is not None
+                and len(attachment) else None,
+                int(timeout_ms) if timeout_ms and timeout_ms > 0 else 0,
+                cid, ack0)
+        except TimeoutError:
+            sock.set_failed(Errno.ERPCTIMEDOUT, "rpc timeout")
+            sock.release()
+            raise RpcError(int(Errno.ERPCTIMEDOUT),
+                           f"deadline {timeout_ms}ms exceeded") from None
+        except (ConnectionError, ValueError, OSError) as e:
+            sock.set_failed(Errno.EFAILEDSOCKET, str(e))
+            sock.release()
+            raise RpcError(int(Errno.EFAILEDSOCKET), str(e)) from None
+        if acks:
+            _ici_process_ack(acks, sock)
+        if ok:
+            if dom is not None:
+                sock.ici_peer_domain = dom
+            body = memoryview(buf)
+            if nval:
+                return body[:len(body) - nval], body[len(body) - nval:]
+            return body, memoryview(b"")
+        # unusual response: full decode (errors, controller-tier tags)
+        mv = memoryview(buf)
+        meta = RpcMeta.decode(bytes(mv[:nval]))
+        if meta is None or meta.correlation_id != cid:
+            sock.set_failed(Errno.ERESPONSE, "undecodable response meta")
+            sock.release()
+            raise RpcError(int(Errno.ERESPONSE), "undecodable response")
+        if meta.error_code:
+            raise RpcError(meta.error_code, meta.error_text)
+        natt = meta.attachment_size
+        if meta.ici_domain:
+            sock.ici_peer_domain = meta.ici_domain
+        body = mv[nval:]
+        ratt = memoryview(b"")
+        if natt:
+            if natt > len(body):
+                sock.set_failed(Errno.ERESPONSE,
+                                "attachment size exceeds body")
+                sock.release()
+                raise RpcError(int(Errno.ERESPONSE),
+                               "attachment size exceeds body")
+            ratt = body[len(body) - natt:]
+            body = body[:len(body) - natt]
+        return body, ratt
+
+    na = len(attachment) if attachment is not None else 0
     mb = _CID_TAG + struct.pack("<Q", cid)
     if na:
         mb += _ATT_TAG + struct.pack("<I", na)
@@ -910,7 +969,6 @@ def run_raw(channel, method_full: str, payload, attachment=b"",
         else (head, mb, payload, attachment)
     if ack0 is not None:
         parts = (ack0,) + parts
-    nat = _native()
     try:
         if nat is not None:
             res = nat.sync_call(sock.fd.fileno(), parts, timeout_s)
@@ -946,6 +1004,11 @@ def run_raw(channel, method_full: str, payload, attachment=b"",
             sock.set_failed(Errno.ERESPONSE, "response cid mismatch")
             sock.release()
             raise RpcError(int(Errno.ERESPONSE), "response cid mismatch")
+        if _dom:
+            # learn the peer's device-fabric domain on the classic lane
+            # too — otherwise a pure-Python install never enables the
+            # descriptor path from raw responses
+            sock.ici_peer_domain = _dom
     body = mv[meta_size:]
     ratt = memoryview(b"")
     if natt:

@@ -1319,6 +1319,206 @@ static int wait_fd(int fd, short events, int64_t deadline_ms) {
   }
 }
 
+// Write an iovec array fully (poll on EAGAIN, resume partials) with
+// the GIL released by the CALLER.  Shared by sync_call and raw_call.
+// Returns the shared error code convention.
+static int write_all_iov(int fd, struct iovec* iov, int n,
+                         int64_t deadline, char* errbuf, size_t errcap) {
+  int err = 0;
+  int first = 0;
+  while (first < n && !err) {
+    ssize_t w = writev(fd, iov + first, n - first);
+    if (w < 0) {
+      if (errno == EAGAIN || errno == EWOULDBLOCK) {
+        int r = wait_fd(fd, POLLOUT, deadline);
+        if (r == 0) err = 1;
+        else if (r < 0) {
+          err = 2;
+          snprintf(errbuf, errcap, "poll: %s", strerror(errno));
+        }
+        continue;
+      }
+      if (errno == EINTR) continue;
+      err = 2;
+      snprintf(errbuf, errcap, "write: %s", strerror(errno));
+      break;
+    }
+    size_t left = (size_t)w;
+    while (left > 0 && first < n) {
+      if (left >= iov[first].iov_len) {
+        left -= iov[first].iov_len;
+        first++;
+      } else {
+        iov[first].iov_base = (char*)iov[first].iov_base + left;
+        iov[first].iov_len -= left;
+        left = 0;
+      }
+    }
+  }
+  return err;
+}
+
+
+// Read exactly one TRPC response frame off an exclusively-owned fd,
+// consuming TICI credit-return frames anywhere around it (leading:
+// in-handler redeems piggyback in front of the response; trailing:
+// lazy redeems ride behind — both must drain to a frame boundary or
+// the connection desyncs).  Called WITH the GIL held; IO runs with it
+// released.  On success *out_buf is a fresh NativeBuf holding the
+// frame body and *out_meta its meta size.  Returns the shared error
+// code convention (0 ok, 1 timeout, 2 conn error, 3 bad frame).
+//
+// NOTE: the TICI parse appears twice below (leading drain interleaved
+// with the header hunt, trailing drain after the body) — the two
+// loops share the frame format and the cnt>8000 bound; a change to
+// either MUST be mirrored in the other (and in call_batch's drains).
+static int read_one_response(int fd, int64_t deadline, NativeBuf** out_buf,
+                             uint32_t* out_meta,
+                             std::vector<uint64_t>& ack_vec,
+                             char* errbuf, size_t errcap) {
+  int err = 0;
+  char scratch[65536];       // greedy-read landing zone (header + body)
+  size_t got = 0;
+  uint32_t body = 0, meta = 0;
+  *out_buf = nullptr;
+
+  Py_BEGIN_ALLOW_THREADS;
+  while (!err) {
+    while (!err && got < 8)
+      err = recv_more(fd, scratch, &got, sizeof scratch, deadline,
+                      errbuf, errcap);
+    if (err) break;
+    if (memcmp(scratch, "TICI", 4) == 0) {
+      uint32_t cnt = 0;
+      memcpy(&cnt, scratch + 4, 4);
+      size_t total = 8 + 8ul * cnt;
+      if (cnt > 8000 || total > sizeof scratch) {
+        err = 3;
+        snprintf(errbuf, errcap, "oversized ack frame cnt=%u", cnt);
+        break;
+      }
+      while (!err && got < total)
+        err = recv_more(fd, scratch, &got, sizeof scratch, deadline,
+                        errbuf, errcap);
+      if (err) break;
+      for (uint32_t i = 0; i < cnt; i++) {
+        uint64_t id;
+        memcpy(&id, scratch + 8 + 8ul * i, 8);
+        ack_vec.push_back(id);
+      }
+      memmove(scratch, scratch + total, got - total);
+      got -= total;
+      continue;
+    }
+    while (!err && got < kHeaderSize)
+      err = recv_more(fd, scratch, &got, sizeof scratch, deadline,
+                      errbuf, errcap);
+    if (err) break;
+    if (memcmp(scratch, "TRPC", 4) != 0) {
+      err = 3;
+      snprintf(errbuf, errcap, "unexpected magic on fast-path read");
+    } else {
+      memcpy(&body, scratch + 4, 4);
+      memcpy(&meta, scratch + 8, 4);
+      if (body > kMaxBody || meta > body) {
+        err = 3;
+        snprintf(errbuf, errcap, "bad frame sizes body=%u meta=%u",
+                 body, meta);
+      }
+    }
+    break;
+  }
+  Py_END_ALLOW_THREADS;
+  if (err) return err;
+
+  NativeBuf* out = nativebuf_new((Py_ssize_t)body);   // GIL held again
+  if (!out) {
+    snprintf(errbuf, errcap, "out of memory");
+    return 2;
+  }
+  size_t have = got - kHeaderSize;           // surplus from the greedy read
+  if (have > (size_t)body) have = body;
+  if (have) memcpy(out->data, scratch + kHeaderSize, have);
+  Py_BEGIN_ALLOW_THREADS;
+  size_t filled = have;
+  while (filled < body && !err) {
+    ssize_t r = recv(fd, out->data + filled, body - filled, 0);
+    if (r == 0) {
+      err = 2;
+      snprintf(errbuf, errcap, "connection closed mid-frame");
+      break;
+    }
+    if (r < 0) {
+      if (errno == EAGAIN || errno == EWOULDBLOCK) {
+        int pr = wait_fd(fd, POLLIN, deadline);
+        if (pr == 0) err = 1;
+        else if (pr < 0) {
+          err = 2;
+          snprintf(errbuf, errcap, "poll: %s", strerror(errno));
+        }
+        continue;
+      }
+      if (errno == EINTR) continue;
+      err = 2;
+      snprintf(errbuf, errcap, "read: %s", strerror(errno));
+      break;
+    }
+    filled += (size_t)r;
+  }
+  // trailing TICI frames the greedy read pulled in past the response:
+  // the response is already complete, so a nearly-expired deadline must
+  // not fail the call over bytes already in flight — small grace window
+  size_t tail_off = kHeaderSize + (size_t)body;
+  if (!err && got > tail_off) {
+    int64_t tdl = deadline;
+    if (tdl >= 0) {
+      int64_t grace = now_ms() + 2000;
+      if (tdl < grace) tdl = grace;
+    }
+    size_t tgot = got - tail_off;
+    memmove(scratch, scratch + tail_off, tgot);
+    while (!err && tgot > 0) {
+      while (!err && tgot < 8)
+        err = recv_more(fd, scratch, &tgot, sizeof scratch, tdl,
+                        errbuf, errcap);
+      if (err) break;
+      if (memcmp(scratch, "TICI", 4) != 0) {
+        err = 3;
+        snprintf(errbuf, errcap, "unexpected trailing bytes after response");
+        break;
+      }
+      uint32_t cnt = 0;
+      memcpy(&cnt, scratch + 4, 4);
+      size_t total = 8 + 8ul * cnt;
+      if (cnt > 8000 || total > sizeof scratch) {
+        err = 3;
+        snprintf(errbuf, errcap, "oversized ack frame cnt=%u", cnt);
+        break;
+      }
+      while (!err && tgot < total)
+        err = recv_more(fd, scratch, &tgot, sizeof scratch, tdl,
+                        errbuf, errcap);
+      if (err) break;
+      for (uint32_t i = 0; i < cnt; i++) {
+        uint64_t id;
+        memcpy(&id, scratch + 8 + 8ul * i, 8);
+        ack_vec.push_back(id);
+      }
+      memmove(scratch, scratch + total, tgot - total);
+      tgot -= total;
+    }
+  }
+  Py_END_ALLOW_THREADS;
+  if (err) {
+    Py_DECREF((PyObject*)out);
+    return err;
+  }
+  *out_buf = out;
+  *out_meta = meta;
+  return 0;
+}
+
+
 static PyObject* sync_call(PyObject*, PyObject* args) {
   int fd;
   PyObject* parts;
@@ -1350,10 +1550,7 @@ static PyObject* sync_call(PyObject*, PyObject* args) {
   // phase 1: write all parts (vectored, poll on EAGAIN)
   int err = 0;               // 0 ok, 1 timeout, 2 conn error, 3 bad frame
   char errbuf[96] = {0};
-  char header[kHeaderSize];
-  char scratch[65536];       // greedy-read landing zone (header + body)
-  size_t got = 0;
-  uint32_t body = 0, meta = 0;
+  uint32_t meta = 0;
   NativeBuf* out = nullptr;
   std::vector<uint64_t> ack_vec;  // TICI credit-returns around the response
 
@@ -1365,165 +1562,14 @@ static PyObject* sync_call(PyObject*, PyObject* args) {
     iov[n].iov_len = views[i].len;
     n++;
   }
-  int first = 0;
-  while (first < n && !err) {
-    ssize_t w = writev(fd, iov + first, n - first);
-    if (w < 0) {
-      if (errno == EAGAIN || errno == EWOULDBLOCK) {
-        int r = wait_fd(fd, POLLOUT, deadline);
-        if (r == 0) err = 1;
-        else if (r < 0) { err = 2; snprintf(errbuf, sizeof errbuf, "poll: %s", strerror(errno)); }
-        continue;
-      }
-      if (errno == EINTR) continue;
-      err = 2;
-      snprintf(errbuf, sizeof errbuf, "write: %s", strerror(errno));
-      break;
-    }
-    size_t left = (size_t)w;
-    while (left > 0 && first < n) {
-      if (left >= iov[first].iov_len) {
-        left -= iov[first].iov_len;
-        first++;
-      } else {
-        iov[first].iov_base = (char*)iov[first].iov_base + left;
-        iov[first].iov_len -= left;
-        left = 0;
-      }
-    }
-  }
-  // phase 2: greedy read — header + (usually the whole small frame) land
-  // in one recv into the scratch buffer.  Safe on this exclusive
-  // connection: exactly one response is outstanding; the only frames
-  // that may precede it are TICI credit-returns for device descriptors
-  // this request carried (the server redeems in-handler, so its ack
-  // piggybacks in front of the response) — consume those and hand the
-  // ids back to Python for window release.
-  while (!err) {
-    while (!err && got < 8)
-      err = recv_more(fd, scratch, &got, sizeof scratch, deadline,
-                      errbuf, sizeof errbuf);
-    if (err) break;
-    if (memcmp(scratch, "TICI", 4) == 0) {
-      uint32_t cnt = 0;
-      memcpy(&cnt, scratch + 4, 4);
-      size_t total = 8 + 8ul * cnt;
-      if (cnt > 8000 || total > sizeof scratch) {
-        err = 3;
-        snprintf(errbuf, sizeof errbuf, "oversized ack frame cnt=%u", cnt);
-        break;
-      }
-      while (!err && got < total)
-        err = recv_more(fd, scratch, &got, sizeof scratch, deadline,
-                        errbuf, sizeof errbuf);
-      if (err) break;
-      for (uint32_t i = 0; i < cnt; i++) {
-        uint64_t id;
-        memcpy(&id, scratch + 8 + 8ul * i, 8);
-        ack_vec.push_back(id);
-      }
-      memmove(scratch, scratch + total, got - total);
-      got -= total;
-      continue;
-    }
-    while (!err && got < kHeaderSize)
-      err = recv_more(fd, scratch, &got, sizeof scratch, deadline,
-                      errbuf, sizeof errbuf);
-    if (err) break;
-    memcpy(header, scratch, kHeaderSize);
-    if (memcmp(header, "TRPC", 4) != 0) {
-      err = 3;
-      snprintf(errbuf, sizeof errbuf, "unexpected magic on fast-path read");
-    } else {
-      memcpy(&body, header + 4, 4);
-      memcpy(&meta, header + 8, 4);
-      if (body > kMaxBody || meta > body) {
-        err = 3;
-        snprintf(errbuf, sizeof errbuf, "bad frame sizes body=%u meta=%u", body, meta);
-      }
-    }
-    break;
-  }
+  err = write_all_iov(fd, iov, n, deadline, errbuf, sizeof errbuf);
   Py_END_ALLOW_THREADS;
-
-  if (!err) {
-    out = nativebuf_new((Py_ssize_t)body);   // GIL held again
-    if (!out) {
-      for (Py_ssize_t j = 0; j < nviews; j++) PyBuffer_Release(&views[j]);
-      Py_DECREF(seq);
-      return nullptr;
-    }
-    size_t have = got - kHeaderSize;         // surplus from the greedy read
-    if (have > (size_t)body) have = body;
-    if (have) memcpy(out->data, scratch + kHeaderSize, have);
-    Py_BEGIN_ALLOW_THREADS;
-    size_t filled = have;
-    while (filled < body && !err) {
-      ssize_t r = recv(fd, out->data + filled, body - filled, 0);
-      if (r == 0) { err = 2; snprintf(errbuf, sizeof errbuf, "connection closed mid-frame"); break; }
-      if (r < 0) {
-        if (errno == EAGAIN || errno == EWOULDBLOCK) {
-          int pr = wait_fd(fd, POLLIN, deadline);
-          if (pr == 0) err = 1;
-          else if (pr < 0) { err = 2; snprintf(errbuf, sizeof errbuf, "poll: %s", strerror(errno)); }
-          continue;
-        }
-        if (errno == EINTR) continue;
-        err = 2;
-        snprintf(errbuf, sizeof errbuf, "read: %s", strerror(errno));
-        break;
-      }
-      filled += (size_t)r;
-    }
-    // trailing TICI frames the greedy read pulled in past the response
-    // (acks from a lazy redeem): drain to a frame boundary — silently
-    // dropping them would leak window credit AND desync the next call.
-    // The response is already complete here, so a nearly-expired RPC
-    // deadline must not fail the call over bytes already in flight:
-    // allow a small grace window to finish a partial ack frame.
-    size_t tail_off = kHeaderSize + (size_t)body;
-    if (!err && got > tail_off) {
-      int64_t tdl = deadline;
-      if (tdl >= 0) {
-        int64_t grace = now_ms() + 2000;
-        if (tdl < grace) tdl = grace;
-      }
-      size_t tgot = got - tail_off;
-      memmove(scratch, scratch + tail_off, tgot);
-      while (!err && tgot > 0) {
-        while (!err && tgot < 8)
-          err = recv_more(fd, scratch, &tgot, sizeof scratch, tdl,
-                          errbuf, sizeof errbuf);
-        if (err) break;
-        if (memcmp(scratch, "TICI", 4) != 0) {
-          err = 3;
-          snprintf(errbuf, sizeof errbuf,
-                   "unexpected trailing bytes after response");
-          break;
-        }
-        uint32_t cnt = 0;
-        memcpy(&cnt, scratch + 4, 4);
-        size_t total = 8 + 8ul * cnt;
-        if (cnt > 8000 || total > sizeof scratch) {
-          err = 3;
-          snprintf(errbuf, sizeof errbuf, "oversized ack frame cnt=%u", cnt);
-          break;
-        }
-        while (!err && tgot < total)
-          err = recv_more(fd, scratch, &tgot, sizeof scratch, tdl,
-                          errbuf, sizeof errbuf);
-        if (err) break;
-        for (uint32_t i = 0; i < cnt; i++) {
-          uint64_t id;
-          memcpy(&id, scratch + 8 + 8ul * i, 8);
-          ack_vec.push_back(id);
-        }
-        memmove(scratch, scratch + total, tgot - total);
-        tgot -= total;
-      }
-    }
-    Py_END_ALLOW_THREADS;
-  }
+  // phase 2+3: one response frame + surrounding TICI drains (shared
+  // with raw_call — read_one_response owns the discipline; GIL held at
+  // entry, released around its IO)
+  if (!err)
+    err = read_one_response(fd, deadline, &out, &meta, ack_vec,
+                            errbuf, sizeof errbuf);
 
   for (Py_ssize_t j = 0; j < nviews; j++) PyBuffer_Release(&views[j]);
   Py_DECREF(seq);
@@ -1548,6 +1594,186 @@ static PyObject* sync_call(PyObject*, PyObject* args) {
   PyObject* tup = Py_BuildValue("(Nk)", (PyObject*)out, (unsigned long)meta);
   return tup;
 }
+
+// raw_call(fd, tail, payload, attachment, timeout_ms, cid, lead)
+//   -> (ok, a, b, dom, acks)
+//
+// The client half of the raw latency lane, fully native: builds the
+// request frame (cid TLV + optional attachment TLV + the channel's
+// cached tail + optional remaining-deadline TLV), writes it vectored,
+// reads the response, and scans its meta — Python's per-call work
+// drops to generating a cid and unpacking one tuple.
+//
+//   ok=True : a = NativeBuf(payload+attachment), b = attachment size,
+//             dom = peer ici-domain bytes or None
+//   ok=False: a = NativeBuf(whole frame body), b = meta size (full
+//             RpcMeta decode in Python — errors etc.), dom = None
+//   acks    : TICI credit-return ids consumed around the response, or
+//             None
+static PyObject* raw_call(PyObject*, PyObject* args) {
+  int fd;
+  Py_buffer tail = {}, payload = {}, att = {}, lead = {};
+  int timeout_ms;
+  unsigned long long cid;
+  PyObject* att_obj;
+  PyObject* lead_obj = Py_None;
+  if (!PyArg_ParseTuple(args, "iy*y*OiK|O", &fd, &tail, &payload,
+                        &att_obj, &timeout_ms, &cid, &lead_obj)) {
+    if (tail.obj) PyBuffer_Release(&tail);
+    if (payload.obj) PyBuffer_Release(&payload);
+    return nullptr;
+  }
+  auto release_all = [&]() {
+    PyBuffer_Release(&tail);
+    PyBuffer_Release(&payload);
+    if (att.obj) PyBuffer_Release(&att);
+    if (lead.obj) PyBuffer_Release(&lead);
+  };
+  if (att_obj != Py_None
+      && PyObject_GetBuffer(att_obj, &att, PyBUF_SIMPLE) != 0) {
+    PyBuffer_Release(&tail);
+    PyBuffer_Release(&payload);
+    return nullptr;
+  }
+  if (lead_obj != Py_None
+      && PyObject_GetBuffer(lead_obj, &lead, PyBUF_SIMPLE) != 0) {
+    release_all();
+    return nullptr;
+  }
+  size_t alen = att.obj ? (size_t)att.len : 0;
+  if ((size_t)payload.len > (size_t)kMaxBody
+      || alen > (size_t)kMaxBody) {
+    release_all();
+    PyErr_SetString(PyExc_ValueError, "payload exceeds max body");
+    return nullptr;
+  }
+
+  // head block: TRPC header + cid TLV + [att TLV] + tail + [tmo TLV]
+  char head[22 + 9 + 96];
+  char* w = head + kHeaderSize;
+  *w = 1;                                        // cid TLV
+  uint32_t l8 = 8, l4 = 4;
+  memcpy(w + 1, &l8, 4);
+  memcpy(w + 5, &cid, 8);
+  w += 13;
+  if (alen) {
+    *w = 3;                                      // attachment-size TLV
+    memcpy(w + 1, &l4, 4);
+    uint32_t a32 = (uint32_t)alen;
+    memcpy(w + 5, &a32, 4);
+    w += 9;
+  }
+  char tmo[9];
+  size_t tmo_len = 0;
+  if (timeout_ms > 0) {
+    tmo[0] = 13;                                 // remaining-deadline TLV
+    memcpy(tmo + 1, &l4, 4);
+    uint32_t t32 = (uint32_t)timeout_ms;
+    memcpy(tmo + 5, &t32, 4);
+    tmo_len = 9;
+  }
+  uint32_t mlen = (uint32_t)((w - head - kHeaderSize) + tail.len
+                             + tmo_len);
+  uint32_t body = mlen + (uint32_t)payload.len + (uint32_t)alen;
+  memcpy(head, "TRPC", 4);
+  memcpy(head + 4, &body, 4);
+  memcpy(head + 8, &mlen, 4);
+
+  int64_t deadline = timeout_ms > 0 ? now_ms() + timeout_ms : -1;
+  int err = 0;
+  char errbuf[96] = {0};
+  uint32_t meta = 0;
+  NativeBuf* out = nullptr;
+  std::vector<uint64_t> ack_vec;
+
+  Py_BEGIN_ALLOW_THREADS;
+  struct iovec iov[6];
+  int n = 0;
+  if (lead.obj && lead.len > 0) iov[n++] = {lead.buf, (size_t)lead.len};
+  iov[n++] = {head, (size_t)(w - head)};
+  if (tail.len > 0) iov[n++] = {tail.buf, (size_t)tail.len};
+  if (tmo_len) iov[n++] = {tmo, tmo_len};
+  if (payload.len > 0) iov[n++] = {payload.buf, (size_t)payload.len};
+  if (alen) iov[n++] = {att.buf, (size_t)att.len};
+  err = write_all_iov(fd, iov, n, deadline, errbuf, sizeof errbuf);
+  Py_END_ALLOW_THREADS;
+
+  if (!err)
+    err = read_one_response(fd, deadline, &out, &meta, ack_vec,
+                            errbuf, sizeof errbuf);
+  release_all();
+  if (err) {
+    Py_XDECREF((PyObject*)out);
+    if (err == 1)
+      PyErr_SetString(PyExc_TimeoutError, "rpc deadline exceeded");
+    else if (err == 2)
+      PyErr_SetString(PyExc_ConnectionError, errbuf);
+    else
+      PyErr_SetString(PyExc_ValueError, errbuf);
+    return nullptr;
+  }
+
+  // scan the response meta: plain success (cid/att/domain only, cid
+  // matching) unpacks here; anything else goes back whole for RpcMeta
+  uint64_t rcid = 0;
+  uint32_t ratt = 0;
+  const char* dom = nullptr;
+  uint32_t dom_len = 0;
+  bool plain = true;
+  {
+    const char* p = out->data;
+    size_t off = 0, end = meta;
+    while (off < end) {
+      if (off + 5 > end) { plain = false; break; }
+      uint8_t tag = (uint8_t)p[off];
+      uint32_t ln;
+      memcpy(&ln, p + off + 1, 4);
+      off += 5;
+      if (ln > end || off + ln > end) { plain = false; break; }
+      if (tag == 1 && ln == 8) memcpy(&rcid, p + off, 8);
+      else if (tag == 3 && ln == 4) memcpy(&ratt, p + off, 4);
+      else if (tag == 15) { dom = p + off; dom_len = ln; }
+      else plain = false;
+      off += ln;
+    }
+  }
+  PyObject* acks = Py_None;
+  if (!ack_vec.empty()) {
+    acks = PyList_New((Py_ssize_t)ack_vec.size());
+    if (!acks) { Py_DECREF((PyObject*)out); return nullptr; }
+    for (size_t i = 0; i < ack_vec.size(); i++)
+      PyList_SET_ITEM(acks, (Py_ssize_t)i,
+                      PyLong_FromUnsignedLongLong(ack_vec[i]));
+  } else {
+    Py_INCREF(Py_None);
+  }
+  size_t blen = (size_t)out->size - meta;
+  if (plain && rcid == cid && ratt <= blen) {
+    // the domain bytes live in the meta region — materialize them
+    // BEFORE the body is shifted over it
+    PyObject* dom_obj;
+    if (dom_len) {
+      dom_obj = PyBytes_FromStringAndSize(dom, (Py_ssize_t)dom_len);
+      if (!dom_obj) {
+        Py_DECREF((PyObject*)out);
+        Py_DECREF(acks);
+        return nullptr;
+      }
+    } else {
+      dom_obj = Py_None;
+      Py_INCREF(Py_None);
+    }
+    // shift the body down in place: the payload view Python receives
+    // must start at offset 0 (NativeBuf has no offset concept)
+    memmove(out->data, out->data + meta, blen);
+    out->size = (Py_ssize_t)blen;
+    return Py_BuildValue("(ONkNN)", Py_True, (PyObject*)out,
+                         (unsigned long)ratt, dom_obj, acks);
+  }
+  return Py_BuildValue("(ONkON)", Py_False, (PyObject*)out,
+                       (unsigned long)meta, Py_None, acks);
+}
+
 
 // sync_call_many(fd, parts, n, timeout_s) -> [(buf, meta_size), ...]
 // Pipelined variant: write all parts (a batch of frames), then read
@@ -2226,6 +2452,10 @@ static PyMethodDef module_methods[] = {
      "call_batch(fd, tail, payloads, timeout_s, cid_base, first_extra, "
      "lead) -> (results, acks): build/write/read a whole pipelined batch "
      "natively; results matched by correlation id"},
+    {"raw_call", (PyCFunction)raw_call, METH_VARARGS,
+     "raw_call(fd, tail, payload, attachment, timeout_ms, cid, lead) -> "
+     "(ok, buf, n, dom, acks): one raw-lane round trip fully native — "
+     "frame built, written, read and meta-scanned in C++"},
     {nullptr, nullptr, 0, nullptr},
 };
 

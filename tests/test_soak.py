@@ -157,6 +157,12 @@ def test_full_mixed_soak():
     proxy = FaultyTransport(str(ep.host), ep.port)
 
     set_flag("stall_watchdog_s", 8.0)
+    # earlier suites may deliberately strand descriptor credit (timeout
+    # tests rely on the 120s TTL sweep); the soak asserts ITS OWN
+    # workload's hygiene: per-endpoint baselines with STRONG refs (an
+    # id()-keyed set could alias a GC'd endpoint to a new allocation
+    # and mask a genuine soak leak)
+    baseline = {e: e.outstanding_bytes for e in live_endpoints()}
     stop_at = time.time() + soak_s
     errors = []
     counts = {}
@@ -277,12 +283,13 @@ def test_full_mixed_soak():
         # zero leaked ICI window credit (descriptors all settled)
         deadline = time.time() + 10
         def drained():
-            return all(e.outstanding_bytes == 0 for e in live_endpoints())
+            return all(e.outstanding_bytes <= baseline.get(e, 0)
+                       for e in live_endpoints())
         while not drained() and time.time() < deadline:
             time.sleep(0.05)
         assert drained(), [
             (e.socket_id, e.outstanding_bytes) for e in live_endpoints()
-            if e.outstanding_bytes]
+            if e.outstanding_bytes > baseline.get(e, 0)]
         # zero stuck fibers
         assert check_stalls() == 0
         # p99 stability: second half no worse than 5x first half
