@@ -258,7 +258,7 @@ def bench_headline_and_sweep(extra: dict) -> float:
 
         def lat_window(one_call):
             best_p50, best_p99 = float("inf"), float("inf")
-            for _window in range(3):
+            for _window in range(5):
                 if time.perf_counter() - sect0 > LAT_SECTION_CAP_S:
                     break
                 lats = []
