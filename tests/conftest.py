@@ -61,3 +61,33 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers",
         "soak: long mixed-workload soak (duration via SOAK_SECONDS env)")
+
+
+# -- shared wire-format helpers for the native adversarial suites --------
+# (one home for TRPC/TLV byte building: a framing change must not be
+# mirrorable into only one of the raw/batch test files)
+
+def wire_tlv(tag: int, data: bytes) -> bytes:
+    import struct
+    return bytes([tag]) + struct.pack("<I", len(data)) + data
+
+
+def wire_resp_frame(cid: int, payload: bytes = b"ok",
+                    extra_meta: bytes = b"") -> bytes:
+    import struct
+    meta = wire_tlv(1, struct.pack("<Q", cid)) + extra_meta
+    return (b"TRPC" + struct.pack("<II", len(meta) + len(payload),
+                                  len(meta)) + meta + payload)
+
+
+WIRE_TAIL = wire_tlv(4, b"S") + wire_tlv(5, b"M")   # service/method TLVs
+
+
+def load_native_or_skip(attr: str):
+    """The loaded native module, skipping unless ``attr`` exists."""
+    require_native()
+    from brpc_tpu.native import load
+    nat = load()
+    if nat is None or not hasattr(nat, attr):
+        pytest.skip(f"native {attr} unavailable")
+    return nat

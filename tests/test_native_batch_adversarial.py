@@ -12,29 +12,19 @@ import threading
 
 import pytest
 
-from conftest import require_native
+from conftest import (WIRE_TAIL, load_native_or_skip, wire_resp_frame,
+                      wire_tlv)
 
 
 def _native():
-    require_native()
-    from brpc_tpu.native import load
-    nat = load()
-    if nat is None or not hasattr(nat, "call_batch"):
-        pytest.skip("native call_batch unavailable")
-    return nat
+    return load_native_or_skip("call_batch")
 
 
-def _tlv(tag, data):
-    return bytes([tag]) + struct.pack("<I", len(data)) + data
+_tlv = wire_tlv
 
 
-def _resp_frame(cid, payload=b"ok", extra_meta=b""):
-    meta = _tlv(1, struct.pack("<Q", cid)) + extra_meta
-    return (b"TRPC" + struct.pack("<II", len(meta) + len(payload),
-                                  len(meta)) + meta + payload)
-
-
-TAIL = _tlv(4, b"S") + _tlv(5, b"M")      # service/method TLVs
+_resp_frame = wire_resp_frame
+TAIL = WIRE_TAIL
 
 
 def _complete_frames(data: bytes, want: int) -> bool:

@@ -1,0 +1,171 @@
+"""Adversarial wire tests for the native single raw call (raw_call).
+
+Same discipline as test_native_batch_adversarial: a scripted peer over
+a socketpair drives engine.cpp raw_call/read_one_response through its
+framing, TICI-drain, fallback, and failure paths byte by byte."""
+
+import socket
+import struct
+import threading
+
+import pytest
+
+from conftest import (WIRE_TAIL, load_native_or_skip, wire_resp_frame,
+                      wire_tlv)
+
+
+def _native():
+    return load_native_or_skip("raw_call")
+
+
+_tlv = wire_tlv
+
+
+_resp = wire_resp_frame
+TAIL = WIRE_TAIL
+CID = 42
+
+
+def _run(nat, responder, payload=b"pay", attachment=None,
+         timeout_ms=5000, lead=None):
+    a, b = socket.socketpair()
+    a.setblocking(False)
+    seen = {}
+
+    def peer():
+        b.settimeout(10)
+        buf = b""
+        try:
+            # one whole request frame (and any lead bytes before it)
+            while True:
+                off = buf.find(b"TRPC")
+                if off >= 0 and len(buf) >= off + 12:
+                    (body,) = struct.unpack_from("<I", buf, off + 4)
+                    if len(buf) >= off + 12 + body:
+                        break
+                c = b.recv(65536)
+                if not c:
+                    break
+                buf += c
+        except socket.timeout:
+            pass
+        seen["req"] = buf
+        reply = responder(buf)
+        if reply:
+            b.sendall(reply)
+
+    t = threading.Thread(target=peer)
+    t.start()
+    try:
+        return nat.raw_call(a.fileno(), TAIL, payload, attachment,
+                            timeout_ms, CID, lead), seen
+    finally:
+        t.join(15)
+        a.close()
+        b.close()
+
+
+def test_plain_success_payload_only():
+    nat = _native()
+    (ok, buf, n, dom, acks), _ = _run(nat, lambda req: _resp(CID, b"hi"))
+    assert ok is True and bytes(buf) == b"hi" and n == 0
+    assert dom is None and acks is None
+
+
+def test_attachment_request_and_response():
+    nat = _native()
+    att_meta = _tlv(3, struct.pack("<I", 3))
+    (ok, buf, n, dom, acks), seen = _run(
+        nat, lambda req: _resp(CID, b"bodyXYZ", extra_meta=att_meta),
+        attachment=b"reqatt")
+    assert ok is True and n == 3
+    assert bytes(buf) == b"bodyXYZ"          # payload+att fused; n splits
+    # the REQUEST carried an attachment TLV of the right size
+    req = seen["req"]
+    off = req.find(b"TRPC")
+    (body, msize) = struct.unpack_from("<II", req, off + 4)
+    meta = req[off + 12:off + 12 + msize]
+    assert meta[13] == 3                     # att TLV follows the cid TLV
+    (asz,) = struct.unpack_from("<I", meta, 18)
+    assert asz == 6
+    assert req.endswith(b"reqatt")
+
+
+def test_peer_domain_learned():
+    nat = _native()
+    dom_meta = _tlv(15, b"domtoken@addr:1")
+    (ok, buf, n, dom, acks), _ = _run(
+        nat, lambda req: _resp(CID, b"p", extra_meta=dom_meta))
+    assert ok is True and bytes(dom) == b"domtoken@addr:1"
+    assert bytes(buf) == b"p"
+
+
+def test_error_response_falls_back_whole():
+    nat = _native()
+    err = _tlv(6, struct.pack("<i", 1003)) + _tlv(7, b"bad")
+    (ok, buf, msize, dom, acks), _ = _run(
+        nat, lambda req: _resp(CID, b"", extra_meta=err))
+    assert ok is False
+    from brpc_tpu.protocol.meta import RpcMeta
+    meta = RpcMeta.decode(bytes(memoryview(buf)[:msize]))
+    assert meta.error_code == 1003 and meta.error_text == "bad"
+
+
+def test_cid_mismatch_falls_back_whole():
+    nat = _native()
+    (ok, buf, msize, dom, acks), _ = _run(nat, lambda req: _resp(CID + 9))
+    assert ok is False        # Python's RpcMeta path decides what to do
+
+
+def test_tici_around_response_collected():
+    nat = _native()
+    tici = b"TICI" + struct.pack("<I", 1) + struct.pack("<Q", 77)
+    (ok, buf, n, dom, acks), _ = _run(
+        nat, lambda req: tici + _resp(CID, b"x")
+        + b"TICI" + struct.pack("<I", 1) + struct.pack("<Q", 88))
+    assert ok is True and bytes(buf) == b"x"
+    assert sorted(acks) == [77, 88]
+
+
+def test_lead_bytes_written_first():
+    nat = _native()
+    lead = b"TICI" + struct.pack("<I", 1) + struct.pack("<Q", 5)
+    (ok, _, _, _, _), seen = _run(nat, lambda req: _resp(CID),
+                                  lead=lead)
+    assert ok is True
+    assert seen["req"].startswith(lead)
+
+
+def test_silent_peer_times_out():
+    nat = _native()
+    with pytest.raises(TimeoutError):
+        _run(nat, lambda req: b"", timeout_ms=300)
+
+
+def test_garbage_reply_rejected():
+    nat = _native()
+    with pytest.raises(ValueError):
+        _run(nat, lambda req: b"NOTAFRAMEATALL!!" * 8)
+
+
+def test_request_frame_layout():
+    """The frame raw_call writes must carry cid TLV first, then the
+    tail, then the deadline TLV, with header sizes consistent."""
+    nat = _native()
+    (ok, *_), seen = _run(nat, lambda req: _resp(CID),
+                          payload=b"PP", timeout_ms=1234)
+    req = seen["req"]
+    off = req.find(b"TRPC")
+    assert off == 0
+    body, msize = struct.unpack_from("<II", req, 4)
+    assert len(req) == 12 + body
+    meta = req[12:12 + msize]
+    assert meta[0] == 1
+    (cid,) = struct.unpack_from("<Q", meta, 5)
+    assert cid == CID
+    assert meta[13:13 + len(TAIL)] == TAIL       # tail right after cid
+    tmo = meta[13 + len(TAIL):]
+    assert tmo[0] == 13
+    (ms,) = struct.unpack_from("<I", tmo, 5)
+    assert ms == 1234
+    assert req[12 + msize:12 + body] == b"PP"
