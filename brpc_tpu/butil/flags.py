@@ -67,7 +67,8 @@ def set_flag(name: str, value: Any) -> bool:
     if not f.validator(typed):
         return False
     f.value = typed
-    for fn in _watchers.get(name, ()):  # snapshot: watchers may re-read
+    for fn in tuple(_watchers.get(name, ())):  # snapshot: a concurrent
+        # watch_flag() must not mutate the list we iterate
         try:
             fn(typed)
         except Exception:               # a broken watcher must not veto

@@ -49,6 +49,8 @@ from ..ici.endpoint import (_process_ack as _ici_process_ack,
                             split_device_attachment as _split_device_att)
 
 _MAGIC = b"TRPC"
+_MAX_BODY = 512 * 1024 * 1024   # keep in sync with engine.cpp kMaxBody
+
 _CID_TAG = TLV_CORRELATION
 _ATT_TAG = TLV_ATTACHMENT
 _TMO_TAG = TLV_TIMEOUT
@@ -773,14 +775,47 @@ def _scan_raw_resp(data):
 _tls_raw = __import__("threading").local()
 
 
+_unpin_pending: "deque" = __import__("collections").deque()
+
+
 def _unpin_all(sids_map: dict) -> None:
-    """Finalizer body: return a dead thread's pinned sockets to the pool
-    (the map outlives the wrapper; see _PinnedSocks)."""
-    for sid in list(sids_map.values()):
+    """Finalizer body: park a dead thread's pinned sockets for later
+    return (the map outlives the wrapper; see _PinnedSocks).
+
+    Runs from a weakref finalizer, i.e. potentially mid-GC at an
+    arbitrary allocation point — possibly while THIS thread already
+    holds the socket pool's non-reentrant lock.  So it must not call
+    back into the pool; it only enqueues the sids, and the next
+    _raw_socket call drains them outside GC context."""
+    _unpin_pending.extend(sids_map.values())
+    sids_map.clear()
+
+
+def _drain_unpinned() -> None:
+    while True:
+        try:
+            sid = _unpin_pending.popleft()
+        except IndexError:
+            return
         s = Socket.address(sid)
         if s is not None and not s.failed:
             return_pooled_socket(sid)
-    sids_map.clear()
+
+
+# The raw lane may go quiet after worker threads die (process switches
+# to the full path, or idles) — without a periodic drain their parked
+# sockets would stay checked out of the pool forever.
+_drain_task = None
+_drain_task_lock = __import__("threading").Lock()
+
+
+def _ensure_drain_task() -> None:
+    global _drain_task
+    if _drain_task is None:
+        with _drain_task_lock:
+            if _drain_task is None:
+                from ..butil.periodic_task import PeriodicTask
+                _drain_task = PeriodicTask(5.0, _drain_unpinned)
 
 
 class _PinnedSocks(dict):
@@ -796,6 +831,7 @@ class _PinnedSocks(dict):
         import weakref
         self._mirror: dict = {}
         self._finalizer = weakref.finalize(self, _unpin_all, self._mirror)
+        _ensure_drain_task()
 
     def __setitem__(self, k, v):
         super().__setitem__(k, v)
@@ -817,6 +853,8 @@ def _raw_socket(remote, ssl_none=True):
     get/put locking entirely.  Other threads check out their own; the
     pinned socket returns to circulation only by failing or when the
     owning thread exits (finalizer on the per-thread map)."""
+    if _unpin_pending:
+        _drain_unpinned()
     cache = getattr(_tls_raw, "socks", None)
     if cache is None:
         cache = _tls_raw.socks = _PinnedSocks()
@@ -850,6 +888,15 @@ def run_raw(channel, method_full: str, payload, attachment=b"",
     retries/backup: this is the perf lane; resilience needs call_method.
     Single-server channels only (no LB selection in the path)."""
     from .channel import RpcError
+
+    # pre-flight size check IN PYTHON: an oversized argument must raise
+    # a precise client-side error without touching the pinned socket
+    # (the engine's own kMaxBody check raises ValueError, which the
+    # transport-error handler below would misread as a socket failure)
+    na0 = len(attachment) if attachment is not None else 0
+    if len(payload) + na0 + 96 > _MAX_BODY:
+        raise RpcError(int(Errno.EREQUEST),
+                       "payload + attachment exceeds max body")
 
     opts = channel.options
     if timeout_ms is None:

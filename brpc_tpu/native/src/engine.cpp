@@ -1641,10 +1641,15 @@ static PyObject* raw_call(PyObject*, PyObject* args) {
     return nullptr;
   }
   size_t alen = att.obj ? (size_t)att.len : 0;
-  if ((size_t)payload.len > (size_t)kMaxBody
-      || alen > (size_t)kMaxBody) {
+  // Bound the WHOLE body (meta TLVs + tail + payload + attachment), not
+  // the parts individually: a 400MB payload + 400MB attachment would
+  // otherwise build a frame the server rejects, failing the pinned
+  // connection instead of raising here (call_batch's fail-fast rule).
+  if ((size_t)payload.len + alen + (size_t)tail.len + 31
+      > (size_t)kMaxBody) {
     release_all();
-    PyErr_SetString(PyExc_ValueError, "payload exceeds max body");
+    PyErr_SetString(PyExc_ValueError,
+                    "payload + attachment exceeds max body");
     return nullptr;
   }
 

@@ -86,6 +86,16 @@ class WatchNamingService(NamingService):
         # url_path is everything after "watch://"
         if not url_path or "/" not in url_path and ":" not in url_path:
             return -1
+        if "/" not in url_path.split("?", 1)[0]:
+            # bare host:port — without a path the long-poll selector
+            # would be "?index=..." (no leading '/'), a malformed
+            # origin-form that strict servers reject; poll the root.
+            # The slash goes BEFORE any query string.
+            if "?" in url_path:
+                host, q = url_path.split("?", 1)
+                url_path = host + "/?" + q
+            else:
+                url_path += "/"
         self._url = "http://" + url_path
         self._thread = threading.Thread(
             target=self._watch_loop, name=f"ns-watch {url_path}",

@@ -297,6 +297,7 @@ def browse_persisted(start_us: int = 0, end_us: int = 0,
     q += " ORDER BY received_us DESC LIMIT ?"
     out: List[Dict] = []
     for path in sorted(glob.glob(os.path.join(d, "rpcz.*.db"))):
+        db = None
         try:
             db = sqlite3.connect(path, timeout=5.0)
             db.row_factory = sqlite3.Row
@@ -313,9 +314,11 @@ def browse_persisted(start_us: int = 0, end_us: int = 0,
                     rec["annotations"] = []
                 rec["source_db"] = os.path.basename(path)
                 out.append(rec)
-            db.close()
         except sqlite3.Error:
             continue                       # unreadable/corrupt db: skip
+        finally:
+            if db is not None:             # close even when a mid-query
+                db.close()                 # error skips to the except
     out.sort(key=lambda r: r["received_us"], reverse=True)
     return out[:limit]
 

@@ -230,7 +230,11 @@ def test_malformed_attachment_size_rejected(server):
 def test_thread_death_returns_pinned_socket(server):
     """call_raw pins a pooled connection to the calling thread; when the
     thread exits the pin must dissolve back into the pool instead of
-    leaking the checked-out socket (ADVICE r3 medium)."""
+    leaking the checked-out socket (ADVICE r3 medium).  The finalizer
+    itself only PARKS the sids (running pool code from GC context could
+    deadlock on the pool's non-reentrant lock — ADVICE r4); the actual
+    return happens on the next raw call or the 5s periodic drain, which
+    this test triggers directly."""
     import gc
     import threading
 
@@ -251,6 +255,9 @@ def test_thread_death_returns_pinned_socket(server):
     t.join()
     assert seen, "worker thread pinned no socket"
     gc.collect()
+    from brpc_tpu.client import fast_call
+    fast_call._drain_unpinned()      # what the periodic task does
+    assert not fast_call._unpin_pending, "drain left sockets parked"
     (sid,) = seen.values()
     s = Socket.address(sid)
     assert s is not None and not s.failed, "pinned socket was dropped"
