@@ -51,6 +51,11 @@ from ..ici.endpoint import (_process_ack as _ici_process_ack,
 _MAGIC = b"TRPC"
 _MAX_BODY = 512 * 1024 * 1024   # keep in sync with engine.cpp kMaxBody
 
+
+def _noop() -> None:
+    """put_back stand-in for the pinned-socket lane: the socket stays
+    pinned to this thread instead of returning to the pool."""
+
 _CID_TAG = TLV_CORRELATION
 _ATT_TAG = TLV_ATTACHMENT
 _TMO_TAG = TLV_TIMEOUT
@@ -59,8 +64,11 @@ _native_mod: Optional[object] = None
 _native_tried = False
 
 
+_HAS_RAW_CALL = False
+
+
 def _native():
-    global _native_mod, _native_tried
+    global _native_mod, _native_tried, _HAS_RAW_CALL
     if not _native_tried:
         _native_tried = True
         try:
@@ -68,6 +76,7 @@ def _native():
             _native_mod = load()
         except Exception:
             _native_mod = None
+        _HAS_RAW_CALL = hasattr(_native_mod, "raw_call")
     return _native_mod
 
 
@@ -254,9 +263,34 @@ def run(channel, cntl, method_full: str, request: Any,
     domain = _local_domain_id() if _ici_enabled() else b""
     auth = opts.auth_data or b""
 
+    # pre-flight size check (mirrors run_raw): an oversized request must
+    # raise a precise client-side EREQUEST, not burn healthy connections
+    # on the engine's fail-fast ValueError
+    if len(payload_b) + att_len + 96 > _MAX_BODY:
+        _finish(channel, cntl, Errno.EREQUEST,
+                "payload + attachment exceeds max body")
+        return
+
     nat = _native()
     pooled = cntl.connection_type == "pooled"
     nretry = 0
+
+    def _retry_or_finish(code: int, text: str) -> bool:
+        """Shared retry tail (≈ Controller._retry_locked): True = the
+        caller should retry the loop, False = the call is finished."""
+        nonlocal nretry
+        cntl.excluded_servers.add(remote)
+        if cntl.retry_policy(cntl, code) and nretry < cntl.max_retry:
+            nretry += 1
+            cntl.retried_count = nretry
+            if deadline_us is not None \
+                    and _mono_ns() // 1000 >= deadline_us:
+                _finish(channel, cntl, Errno.ERPCTIMEDOUT,
+                        f"deadline {timeout_ms}ms exceeded")
+                return False
+            return True
+        _finish(channel, cntl, code, text)
+        return False
 
     while True:
         # -- target selection (mirrors Controller._select_remote) --
@@ -269,6 +303,109 @@ def run(channel, cntl, method_full: str, request: Any,
             return
         cntl.remote_side = remote
         cntl.attempt_remotes[nretry] = remote
+
+        # -- pinned native round trip (the controller lane's fast sub-
+        # path): when nothing per-call needs Python-built meta (no
+        # device attachment, no ici domain, no trace/span, auth already
+        # on the wire), the whole frame build + write + read + response
+        # scan runs in C via nat.raw_call on the thread-pinned pooled
+        # socket — the same engine call the raw lane uses, carrying the
+        # controller's retry/backup-excluded bookkeeping around it.
+        if (pooled and nat is not None and _HAS_RAW_CALL
+                and cntl.request_device_attachment is None
+                and not cntl.trace_id and not cntl.span_id):
+            psid, psock = _raw_socket(remote)
+            if psock is not None and (
+                    not psock.direct_read or not psock.read_portal.empty()
+                    or not psock.write_path_idle()
+                    or (auth and getattr(psock, "app_data", None) is None)):
+                # converted/busy, or auth must ride this call: un-pin
+                # and take the classic build below
+                _unpin(remote, psid)
+            elif psock is None:
+                if _retry_or_finish(int(Errno.EFAILEDSOCKET),
+                                    f"connect to {remote} failed"):
+                    continue
+                return
+            else:
+                # the tail carries method TLVs plus (when ici is on)
+                # this process's domain and the socket's conn nonce —
+                # identical wire content to the classic build below,
+                # cached per socket+method so steady-state calls reuse
+                # the encoded bytes
+                tails = getattr(psock, "_cntl_tails", None)
+                tail = tails.get(method_full) if tails is not None \
+                    else None
+                if tail is None:
+                    tail = method_tlvs
+                    if domain:
+                        tail = (tail + _domain_tlv(domain)
+                                + encode_tlv(TAG_ICI_CONN,
+                                             _conn_nonce_of(psock)))
+                    if tails is None:
+                        tails = psock._cntl_tails = {}
+                    tails[method_full] = tail
+                if att_len and len(att_parts) > 1:
+                    att_buf = att.to_bytes()
+                elif att_len:
+                    att_buf = att_parts[0]
+                else:
+                    att_buf = None
+                left_ms = 0
+                if deadline_us is not None:
+                    left_ms = max(1, (deadline_us - _mono_ns() // 1000)
+                                  // 1000)
+                cid = _next_cid()
+                ack0 = psock._take_ack_frame() if psock._pending_acks \
+                    else None
+                try:
+                    ok, buf, nval, dom, acks = nat.raw_call(
+                        psock.fd.fileno(), tail, payload_b,
+                        att_buf, int(left_ms), cid, ack0)
+                except TimeoutError:
+                    psock.set_failed(Errno.ERPCTIMEDOUT, "rpc timeout")
+                    psock.release()
+                    _finish(channel, cntl, Errno.ERPCTIMEDOUT,
+                            f"deadline {timeout_ms}ms exceeded")
+                    return
+                except (ConnectionError, ValueError, OSError) as e:
+                    psock.set_failed(Errno.EFAILEDSOCKET, str(e))
+                    psock.release()
+                    code = int(Errno.EFAILEDSOCKET)
+                    text = str(e)
+                else:
+                    if acks:
+                        _ici_process_ack(acks, psock)
+                    if ok:
+                        if dom:
+                            psock.ici_peer_domain = dom
+                        body = memoryview(buf)
+                        attachment = IOBuf()
+                        if nval:
+                            attachment.append_user_data(
+                                body[len(body) - nval:])
+                            body = body[:len(body) - nval]
+                        try:
+                            cntl.response = parse_payload(bytes(body),
+                                                          response_type)
+                        except Exception as e:
+                            _finish(channel, cntl, Errno.ERESPONSE,
+                                    f"response parse failed: {e}")
+                            return
+                        cntl.response_attachment = attachment
+                        _finish(channel, cntl, 0, "")
+                        return
+                    # unusual response (error / controller-tier tags):
+                    # full decode; socket stays pinned (healthy frames
+                    # leave the connection usable)
+                    done, code, text = _handle_response(
+                        channel, cntl, psock, psid, pooled, buf, nval,
+                        cid, response_type, put_back=_noop)
+                    if done:
+                        return
+                if _retry_or_finish(code, text):
+                    continue
+                return
 
         sid, rc = pooled_socket(remote) if pooled else short_socket(remote)
         sock = Socket.address(sid)
@@ -415,30 +552,27 @@ def run(channel, cntl, method_full: str, request: Any,
             if done:
                 return
 
-        # -- retriable failure: mirror Controller._retry_locked --
-        cntl.excluded_servers.add(remote)
-        if cntl.retry_policy(cntl, code) and nretry < cntl.max_retry:
-            nretry += 1
-            cntl.retried_count = nretry
-            if deadline_us is not None and _mono_ns() // 1000 >= deadline_us:
-                _finish(channel, cntl, Errno.ERPCTIMEDOUT,
-                        f"deadline {timeout_ms}ms exceeded")
-                return
+        # -- retriable failure: shared tail --
+        if _retry_or_finish(code, text):
             continue
-        _finish(channel, cntl, code, text)
         return
 
 
 def _handle_response(channel, cntl, sock, sid: int, pooled: bool, buf,
-                     meta_size: int, cid: int,
-                     response_type: Any) -> Tuple[bool, int, str]:
+                     meta_size: int, cid: int, response_type: Any,
+                     put_back=None) -> Tuple[bool, int, str]:
     """Decode one response frame.  Returns (done, code, text); done=False
-    means a retriable failure the caller's loop should handle."""
-    def _put_back():
-        if pooled:
-            return_pooled_socket(sid)
-        else:
-            sock.release()
+    means a retriable failure the caller's loop should handle.
+    ``put_back`` overrides how a healthy socket is handed back (the
+    pinned-socket lane passes a no-op: the pin IS the checkout)."""
+    if put_back is not None:
+        _put_back = put_back
+    else:
+        def _put_back():
+            if pooled:
+                return_pooled_socket(sid)
+            else:
+                sock.release()
 
     def _complete(raw: bytes, attachment: IOBuf) -> Tuple[bool, int, str]:
         """Shared completion tail: parse the payload, hand the socket
@@ -791,6 +925,15 @@ def _unpin_all(sids_map: dict) -> None:
     sids_map.clear()
 
 
+def _unpin(remote, sid: int) -> None:
+    """Dissolve this thread's pin on ``sid`` and hand the socket back to
+    the pool (the single place the pin/un-pin discipline lives)."""
+    cache = getattr(_tls_raw, "socks", None)
+    if cache is not None and cache.get(remote) == sid:
+        del cache[remote]
+    return_pooled_socket(sid)
+
+
 def _drain_unpinned() -> None:
     while True:
         try:
@@ -932,10 +1075,7 @@ def run_raw(channel, method_full: str, payload, attachment=b"",
         # connection converted/busy: un-pin it (back to the pool) so
         # the next call can pin a fresh direct-read connection, and run
         # through the full machinery this time
-        cache = getattr(_tls_raw, "socks", None)
-        if cache is not None and cache.get(remote) == sid:
-            del cache[remote]
-        return_pooled_socket(sid)
+        _unpin(remote, sid)
         return _full_path()
 
     nat = _native()

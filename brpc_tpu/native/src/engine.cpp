@@ -207,10 +207,22 @@ struct Loop {
 // dispatch) — the tpu-native analogue of the reference's C++ builtin
 // services.  Registered pre-listen; the map is read-only afterwards.
 struct NativeMethod {
-  int kind = 0;                       // 0 = echo, 1 = const
+  int kind = 0;                       // 0 = echo, 1 = const, 2 = py raw
   std::string const_data;             // kind=1 response payload
+  PyObject* handler = nullptr;        // kind=2 @raw_method callable
   std::atomic<uint64_t> count{0};     // answered natively
   std::atomic<uint64_t> errors{0};    // EREQUEST answers (malformed att)
+};
+
+// One buffered-path request bound for a kind=2 Python handler.  The
+// payload pointer aims into the connection's inbuf and is valid only
+// until parse_frames returns — every exit path flushes the batch first.
+struct PyRawItem {
+  NativeMethod* m;
+  uint64_t cid;
+  const char* payload;   // body past the meta (payload ++ attachment)
+  size_t plen;           // total body-after-meta length
+  uint32_t att;          // attachment tail size
 };
 
 struct EngineImpl {
@@ -230,6 +242,12 @@ struct EngineImpl {
   std::unordered_map<std::string, NativeMethod*> native_methods;
   std::atomic<bool> native_dispatch{false};
   bool started = false;
+  // true = the loops run on Python-created threads (bridge calls
+  // run_loop from threading.Thread).  A thread whose datastack
+  // carries a resident Python frame never munmaps its chunk, so the
+  // per-wake Python dispatch skips the mmap + page-fault (~14us on
+  // this box) that a frameless C thread pays on EVERY cold eval entry.
+  bool external_loops = false;
 };
 
 static void flush_decrefs_locked_gil(Loop* lp) {
@@ -496,11 +514,100 @@ static void native_error(Conn* c, uint64_t cid, int32_t code,
   c->native_out.append(meta);
 }
 
+// Run a burst's worth of kind=2 Python raw handlers under ONE GIL
+// acquisition and append their responses to c->native_out (shipped by
+// the burst-end native_flush as one writev).  This is the amortized
+// GIL crossing of the reference's message-batch pattern
+// (input_messenger.cpp:374-394: one bthread per batch + flush): a
+// pipelined client pays one Python entry per read burst, not one per
+// message.  Payload/attachment reach the handler as bytes copies —
+// the source bytes live in the transient inbuf, and a handler that
+// retains its argument must never observe them changing.
+static void flush_py_batch(Loop* lp, Conn* c,
+                           std::vector<PyRawItem>& batch) {
+  if (batch.empty()) return;
+  PyGILState_STATE gs = PyGILState_Ensure();
+  flush_decrefs_locked_gil(lp);
+  for (PyRawItem& it : batch) {
+    size_t plen = it.plen - it.att;
+    // the @raw_method contract hands the handler MEMORYVIEWS (the
+    // large-frame Python lane does too — same types either route);
+    // they view private bytes copies, so a handler retaining its
+    // argument can never observe the transient inbuf changing
+    PyObject* pb = PyBytes_FromStringAndSize(it.payload, plen);
+    PyObject* pv = pb ? PyMemoryView_FromObject(pb) : nullptr;
+    Py_XDECREF(pb);                      // the view keeps its own ref
+    PyObject* av = nullptr;
+    if (pv && it.att) {
+      PyObject* ab = PyBytes_FromStringAndSize(it.payload + plen,
+                                               it.att);
+      av = ab ? PyMemoryView_FromObject(ab) : nullptr;
+      Py_XDECREF(ab);
+    }
+    PyObject* r = nullptr;
+    if (pv && (it.att == 0 || av))
+      r = PyObject_CallFunctionObjArgs(it.m->handler, pv,
+                                       av ? av : Py_None, nullptr);
+    Py_XDECREF(pv);
+    Py_XDECREF(av);
+    if (!r) {
+      // handler raised (or OOM building args): answer EINTERNAL with
+      // the exception text, like the Python raw lane does
+      char msg[160] = "raw handler failed";
+      PyObject *t, *v, *tb;
+      PyErr_Fetch(&t, &v, &tb);
+      if (v) {
+        PyObject* s = PyObject_Str(v);
+        if (s) {
+          const char* u = PyUnicode_AsUTF8(s);
+          if (u) snprintf(msg, sizeof msg, "%.*s", 150, u);
+          Py_DECREF(s);
+        }
+      }
+      PyErr_Clear();
+      Py_XDECREF(t); Py_XDECREF(v); Py_XDECREF(tb);
+      it.m->errors++;
+      native_error(c, it.cid, 2001 /* EINTERNAL */, msg);
+      continue;
+    }
+    PyObject* resp = r;
+    PyObject* ratt = nullptr;
+    if (PyTuple_Check(r) && PyTuple_GET_SIZE(r) == 2) {
+      resp = PyTuple_GET_ITEM(r, 0);
+      ratt = PyTuple_GET_ITEM(r, 1);
+      if (ratt == Py_None) ratt = nullptr;
+    }
+    Py_buffer rb = {}, ab = {};
+    if (PyObject_GetBuffer(resp, &rb, PyBUF_SIMPLE) != 0
+        || (ratt && PyObject_GetBuffer(ratt, &ab, PyBUF_SIMPLE) != 0)) {
+      PyErr_Clear();
+      if (rb.obj) PyBuffer_Release(&rb);
+      Py_DECREF(r);
+      it.m->errors++;
+      native_error(c, it.cid, 2001,
+                   "raw method returned non-bytes");
+      continue;
+    }
+    size_t ralen = ab.obj ? (size_t)ab.len : 0;
+    native_append_head(c->native_out, it.cid, (uint32_t)ralen,
+                       (size_t)rb.len + ralen);
+    if (rb.len) c->native_out.append((const char*)rb.buf, rb.len);
+    if (ralen) c->native_out.append((const char*)ab.buf, ralen);
+    PyBuffer_Release(&rb);
+    if (ab.obj) PyBuffer_Release(&ab);
+    Py_DECREF(r);
+    it.m->count++;
+  }
+  PyGILState_Release(gs);
+  batch.clear();
+}
+
 // Try to answer one complete TRPC frame natively.  body = meta+payload
 // (body_len bytes), meta_size from the frame header.  True = handled,
 // response appended to c->native_out.
 static bool native_try_handle(EngineImpl* eng, Conn* c, const char* body,
-                              size_t body_len, uint32_t meta_size) {
+                              size_t body_len, uint32_t meta_size,
+                              std::vector<PyRawItem>* batch = nullptr) {
   if (!eng->native_dispatch.load(std::memory_order_relaxed)) return false;
   MetaScan s;
   if (!scan_request_meta(body, meta_size, &s)) return false;
@@ -522,10 +629,14 @@ static bool native_try_handle(EngineImpl* eng, Conn* c, const char* body,
       native_respond(c, s.cid, m->const_data.data(), m->const_data.size(),
                      0);
       break;
+    case 2:  // Python raw handler: batch for one GIL entry per burst
+      if (!batch) return false;   // direct-read path: full Python route
+      batch->push_back({m, s.cid, payload, plen, s.att});
+      break;
     default:
       return false;
   }
-  m->count++;
+  if (m->kind != 2) m->count++;   // kind 2 counts at batch flush
   return true;
 }
 
@@ -565,7 +676,8 @@ static bool native_flush(Loop* lp, Conn* c) {
 }
 
 // parse as many complete frames as possible from c->inbuf / direct reads
-static bool parse_frames(EngineImpl* eng, Loop* lp, Conn* c) {
+static bool parse_frames_inner(EngineImpl* eng, Loop* lp, Conn* c,
+                               std::vector<PyRawItem>& batch) {
   for (;;) {
     size_t avail = c->in_end - c->in_start;
     const char* p = c->inbuf + c->in_start;
@@ -617,9 +729,10 @@ static bool parse_frames(EngineImpl* eng, Loop* lp, Conn* c) {
       c->in_start += total;
       eng->nmessages++;
       // native dispatch first: echo-class frames never leave C++ (the
-      // response rides c->native_out, coalesced across the burst)
+      // response rides c->native_out, coalesced across the burst);
+      // kind=2 Python raw handlers are BATCHED into one GIL entry
       if (kind == EV_MESSAGE
-          && native_try_handle(eng, c, p + hdr, body, meta)) {
+          && native_try_handle(eng, c, p + hdr, body, meta, &batch)) {
         continue;
       }
       // a Python-path frame mid-burst: flush queued native responses
@@ -674,12 +787,24 @@ static bool parse_frames(EngineImpl* eng, Loop* lp, Conn* c) {
     }
     // small frame, wait for more bytes; compact if consumed prefix is big
     if (c->in_start > 0) {
+      // batched kind=2 items point into the consumed prefix this
+      // memmove is about to overwrite — run them first
+      flush_py_batch(lp, c, batch);
       memmove(c->inbuf, c->inbuf + c->in_start, avail);
       c->in_end = avail;
       c->in_start = 0;
     }
     return true;
   }
+}
+
+static bool parse_frames(EngineImpl* eng, Loop* lp, Conn* c) {
+  std::vector<PyRawItem> batch;
+  bool ok = parse_frames_inner(eng, lp, c, batch);
+  // requests already complete on the wire get processed even when a
+  // later frame kills the connection (same order the Python path gives)
+  flush_py_batch(lp, c, batch);
+  return ok;
 }
 
 static bool conn_readable(EngineImpl* eng, Loop* lp, Conn* c) {
@@ -710,6 +835,9 @@ static bool conn_readable(EngineImpl* eng, Loop* lp, Conn* c) {
             && eng->native_dispatch.load(std::memory_order_relaxed)
             && scan_request_meta(b->data, c->msg_meta, &s))
           m = find_native(eng, s);
+        if (m && m->kind == 2)
+          m = nullptr;   // large-frame Python raw: the bridge's
+                         // zero-copy NativeBuf path beats a batch copy
         if (m) {
           size_t plen = (size_t)b->size - c->msg_meta;
           if (s.att > plen) {
@@ -933,9 +1061,11 @@ static PyObject* Engine_new(PyTypeObject* type, PyObject* args,
                             PyObject* kwds) {
   PyObject* dispatch;
   int nloops = 1;
-  static const char* kwlist[] = {"dispatch", "loops", nullptr};
-  if (!PyArg_ParseTupleAndKeywords(args, kwds, "O|i", (char**)kwlist,
-                                   &dispatch, &nloops))
+  int external = 0;
+  static const char* kwlist[] = {"dispatch", "loops", "external_loops",
+                                 nullptr};
+  if (!PyArg_ParseTupleAndKeywords(args, kwds, "O|ip", (char**)kwlist,
+                                   &dispatch, &nloops, &external))
     return nullptr;
   if (!PyCallable_Check(dispatch)) {
     PyErr_SetString(PyExc_TypeError, "dispatch must be callable");
@@ -946,6 +1076,7 @@ static PyObject* Engine_new(PyTypeObject* type, PyObject* args,
   EngineObj* self = (EngineObj*)type->tp_alloc(type, 0);
   if (!self) return nullptr;
   self->eng = new EngineImpl();
+  self->eng->external_loops = external != 0;
   Py_INCREF(dispatch);
   self->eng->dispatch = dispatch;
   for (int i = 0; i < nloops; i++) {
@@ -976,23 +1107,49 @@ static PyObject* Engine_listen(EngineObj* self, PyObject* args) {
     PyErr_SetFromErrno(PyExc_OSError);
     return nullptr;
   }
-  // start threads on first listen
+  // start threads on first listen (external mode: the bridge runs the
+  // loops on Python threads via run_loop — see EngineImpl comment)
   eng->started = true;
-  for (Loop* lp : eng->loops) {
-    if (!lp->thr.joinable()) lp->thr = std::thread(loop_run, lp);
+  if (!eng->external_loops) {
+    for (Loop* lp : eng->loops) {
+      if (!lp->thr.joinable()) lp->thr = std::thread(loop_run, lp);
+    }
   }
   Py_RETURN_NONE;
 }
 
-// register_native_method(svc, mth, kind, data=b"") — pre-listen only.
-// kind 0 = echo (payload+attachment back unchanged), 1 = const(data).
+// run_loop(index) — the body of one event loop, called from a Python
+// thread in external_loops mode.  Blocks (GIL released) until stop().
+// The calling thread's resident Python frames keep the datastack
+// chunk mapped, so per-burst handler dispatch avoids mmap churn.
+static PyObject* Engine_run_loop(EngineObj* self, PyObject* args) {
+  int idx;
+  if (!PyArg_ParseTuple(args, "i", &idx)) return nullptr;
+  EngineImpl* eng = self->eng;
+  if (idx < 0 || (size_t)idx >= eng->loops.size()) {
+    PyErr_SetString(PyExc_IndexError, "loop index out of range");
+    return nullptr;
+  }
+  Loop* lp = eng->loops[idx];
+  Py_BEGIN_ALLOW_THREADS;
+  loop_run(lp);
+  Py_END_ALLOW_THREADS;
+  Py_RETURN_NONE;
+}
+
+// register_native_method(svc, mth, kind, data=b"", handler=None) —
+// pre-listen only.  kind 0 = echo (payload+attachment back unchanged),
+// 1 = const(data), 2 = Python @raw_method handler called from the
+// engine loop (burst-batched; one GIL entry per read burst).
 static PyObject* Engine_register_native_method(EngineObj* self,
                                                PyObject* args) {
   const char* svc;
   const char* mth;
   int kind;
   Py_buffer data = {};
-  if (!PyArg_ParseTuple(args, "ssi|y*", &svc, &mth, &kind, &data))
+  PyObject* handler = nullptr;
+  if (!PyArg_ParseTuple(args, "ssi|y*O", &svc, &mth, &kind, &data,
+                        &handler))
     return nullptr;
   EngineImpl* eng = self->eng;
   if (eng->started) {
@@ -1001,9 +1158,16 @@ static PyObject* Engine_register_native_method(EngineObj* self,
                     "native methods must be registered before listen()");
     return nullptr;
   }
-  if (kind != 0 && kind != 1) {
+  if (kind != 0 && kind != 1 && kind != 2) {
     if (data.obj) PyBuffer_Release(&data);
     PyErr_SetString(PyExc_ValueError, "unknown native method kind");
+    return nullptr;
+  }
+  if (kind == 2 && (handler == nullptr || handler == Py_None
+                    || !PyCallable_Check(handler))) {
+    if (data.obj) PyBuffer_Release(&data);
+    PyErr_SetString(PyExc_TypeError,
+                    "kind 2 requires a callable handler");
     return nullptr;
   }
   std::string key(svc);
@@ -1018,6 +1182,12 @@ static PyObject* Engine_register_native_method(EngineObj* self,
     PyBuffer_Release(&data);
   } else {
     m->const_data.clear();
+  }
+  Py_XDECREF(m->handler);
+  m->handler = nullptr;
+  if (kind == 2) {
+    Py_INCREF(handler);
+    m->handler = handler;
   }
   eng->native_methods[key] = m;
   Py_RETURN_NONE;
@@ -1228,7 +1398,10 @@ static void Engine_dealloc(EngineObj* self) {
       close(lp->wakefd);
       delete lp;
     }
-    for (auto& kv : self->eng->native_methods) delete kv.second;
+    for (auto& kv : self->eng->native_methods) {
+      Py_XDECREF(kv.second->handler);
+      delete kv.second;
+    }
     Py_XDECREF(self->eng->dispatch);
     delete self->eng;
   }
@@ -1238,6 +1411,8 @@ static void Engine_dealloc(EngineObj* self) {
 static PyMethodDef Engine_methods[] = {
     {"listen", (PyCFunction)Engine_listen, METH_VARARGS,
      "adopt a bound+listening fd"},
+    {"run_loop", (PyCFunction)Engine_run_loop, METH_VARARGS,
+     "run one event loop on the calling (Python) thread until stop()"},
     {"send", (PyCFunction)Engine_send, METH_VARARGS,
      "queue buffers for vectored write on a connection"},
     {"close_conn", (PyCFunction)Engine_close_conn, METH_VARARGS, nullptr},

@@ -7,6 +7,16 @@ stage parameters live stacked on the ``pp`` axis, activations hop to the
 next stage via ppermute each tick, and the loop runs
 ``n_micro + n_stages - 1`` ticks (bubble included). XLA overlaps the
 ppermute with the next tick's compute where the schedule allows.
+
+Training (:func:`make_pipeline_train`): the conveyor is written as a
+``lax.scan`` so reverse-mode AD is defined through it — differentiating
+the forward conveyor yields the BACKWARD conveyor automatically (the
+transpose of ``ppermute`` is the ppermute of the inverted ring, so
+cotangents hop stage-to-stage in reverse order tick by tick), and the
+scan's cotangent accumulation over ticks IS GPipe's microbatch gradient
+accumulation.  One program, forward + backward, no hand-scheduled
+bubbles; loss and grads match the unpipelined model exactly (same
+arithmetic, reordered).
 """
 
 from __future__ import annotations
@@ -73,3 +83,70 @@ def make_pipeline(mesh, stage_fn: Callable, axis: str = "pp"):
         local, mesh=mesh,
         in_specs=(P(axis), P()),
         out_specs=P()))
+
+
+def make_pipeline_train(mesh, stage_fn: Callable, loss_fn: Callable,
+                        axis: str = "pp"):
+    """Build ``step(stacked_params, xs, ys) -> (loss, grads)`` — a
+    GPipe training step as ONE differentiated shard_map program.
+
+    - ``stacked_params``: pytree, leaves with leading dim ``n_stages``
+      (sharded over ``axis``); ``grads`` comes back in the same layout
+      (each device holds exactly its stage's gradient slice).
+    - ``xs``/``ys``: (n_micro, mb, ...) replicated microbatches/targets.
+    - ``loss_fn(outputs, ys) -> scalar`` over all microbatches; the
+      returned loss is the same scalar the unpipelined model produces.
+
+    The forward conveyor is a ``lax.scan`` over
+    ``n_micro + n_stages - 1`` ticks; reverse-mode AD through it runs
+    the cotangent conveyor backwards (ppermute transposes to the
+    inverted ring) and accumulates each stage's parameter cotangent
+    across its microbatches — GPipe's backward schedule, derived rather
+    than hand-written.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    n = mesh.shape[axis]
+    fwd = [(i, (i + 1) % n) for i in range(n)]
+
+    def local_loss(params, xs, ys):
+        my_params = jax.tree_util.tree_map(lambda p: p[0], params)
+        idx = jax.lax.axis_index(axis)
+        n_micro = xs.shape[0]
+        ticks = n_micro + n - 1
+        state0 = jnp.zeros_like(xs[0])
+        outputs0 = jnp.zeros_like(xs)
+
+        def tick(carry, t):
+            state, outputs = carry
+            inject = jax.lax.dynamic_index_in_dim(
+                xs, jnp.minimum(t, n_micro - 1), 0, keepdims=False)
+            inp = jnp.where(idx == 0, inject, state)
+            out = stage_fn(my_params, inp)
+            state_next = jax.lax.ppermute(out, axis, fwd)
+            done_idx = t - (n - 1)
+            # emit on the last stage once the first microbatch has
+            # traversed every stage; jnp.where keeps it differentiable
+            emit = jnp.logical_and(idx == n - 1, done_idx >= 0)
+            upd = jax.lax.dynamic_update_slice(
+                outputs, out[None],
+                (jnp.maximum(done_idx, 0),) + (0,) * (outputs.ndim - 1))
+            outputs = jnp.where(emit, upd, outputs)
+            return (state_next, outputs), None
+
+        (_, outputs), _ = jax.lax.scan(
+            tick, (state0, outputs0), jnp.arange(ticks))
+        # real outputs live on the last stage; replicate for the loss
+        outputs = jax.lax.psum(
+            jnp.where(idx == n - 1, outputs, jnp.zeros_like(outputs)),
+            axis)
+        return loss_fn(outputs, ys)
+
+    pipe_loss = _shard_map(jax)(
+        local_loss, mesh=mesh,
+        in_specs=(P(axis), P(), P()),
+        out_specs=P())
+
+    return jax.jit(jax.value_and_grad(pipe_loss))

@@ -128,7 +128,15 @@ class NativeBridge:
     def __init__(self, server, engine_module, loops: int = 2):
         self._server = server
         self._m = engine_module
-        self.engine = engine_module.Engine(self._dispatch, loops=loops)
+        # external_loops: the event loops run on Python-created threads
+        # (run_loop below).  A C-created thread pays an mmap + page
+        # fault on EVERY cold eval entry (CPython frees the datastack
+        # chunk when the last frame pops — measured ~14us/dispatch on
+        # this box); a Python thread's resident frames pin the chunk.
+        self.engine = engine_module.Engine(self._dispatch, loops=loops,
+                                           external_loops=True)
+        self._nloops = loops
+        self._loop_threads: list = []
         self._conns: Dict[int, int] = {}      # engine conn_id -> socket id
         self._native_ok = False
         self._native_vars = []                # PassiveStatus keep-alives
@@ -150,9 +158,17 @@ class NativeBridge:
         from ..tools.rpc_dump import dump_enabled
         registered = False
         for (svc, mth), entry in self._server._methods.items():
-            kind = _NATIVE_KINDS.get(entry.native_kind or "")
-            if kind is None or entry.raw_fn is None:
+            if entry.raw_fn is None:
                 continue
+            kind = _NATIVE_KINDS.get(entry.native_kind or "")
+            if kind is None:
+                if entry.native_kind:
+                    continue      # unknown native= tag: Python path
+                # plain @raw_method: the engine calls the handler
+                # directly (kind 2) — burst-batched GIL entry, response
+                # frame built natively.  Same lane contract as kind
+                # 0/1: counters ride the native bvars, not MethodStatus
+                kind = 2
             if entry.status.max_concurrency or entry.status.limiter:
                 continue          # admission must stay in Python
             data = b""
@@ -160,7 +176,29 @@ class NativeBridge:
                 # capture the const response once (behavioral spec)
                 out = entry.raw_fn(b"", None)
                 data = bytes(out[0] if type(out) is tuple else out)
-            self.engine.register_native_method(svc, mth, kind, data)
+            if kind == 2:
+                # accounting shim: the Python raw lane keeps its FULL
+                # MethodStatus observability (request/error counts,
+                # inflight gauge, latency recorder) — @raw_method
+                # promises "per-method stats still apply", and a
+                # latency series moving while qps reads zero would be
+                # a split-brain metrics shape.  ~2us on a warm frame.
+                def _observed(payload, att, _fn=entry.raw_fn,
+                              _st=entry.status, _ns=_mono_ns):
+                    _st.on_requested()
+                    t0 = _ns()
+                    code = 0
+                    try:
+                        return _fn(payload, att)
+                    except BaseException:
+                        code = int(Errno.EINTERNAL)
+                        raise
+                    finally:
+                        _st.on_responded(code, (_ns() - t0) // 1000)
+                self.engine.register_native_method(svc, mth, 2, b"",
+                                                   _observed)
+            else:
+                self.engine.register_native_method(svc, mth, kind, data)
             safe = f"{svc}_{mth}".lower()
             eng = self.engine
             self._native_vars.append(PassiveStatus(
@@ -184,6 +222,12 @@ class NativeBridge:
         self._local_ep = EndPoint(host=name[0], port=name[1])
         self._register_native_methods()
         self.engine.listen(listen_socket.fileno())
+        import threading
+        for i in range(self._nloops):
+            t = threading.Thread(target=self.engine.run_loop, args=(i,),
+                                 name=f"native-loop-{i}", daemon=True)
+            t.start()
+            self._loop_threads.append(t)
 
     def stop(self) -> None:
         for v in self._native_vars:
@@ -191,6 +235,9 @@ class NativeBridge:
         self._native_vars.clear()
         _native_bridges.discard(self)
         self.engine.stop()
+        for t in self._loop_threads:
+            t.join(timeout=5.0)
+        self._loop_threads.clear()
         # close the listen fd: the engine no longer accepts, but the
         # KERNEL still completes handshakes into the backlog of an open
         # listener — clients (health checks!) would "connect" to a
@@ -259,14 +306,17 @@ class NativeBridge:
     @staticmethod
     def _scan_request_meta(data):
         """Minimal TLV walk for the raw lane: (cid, service, method,
-        att_size) — or None when the meta carries any controller-tier
-        tag (compress=2, error=6/7, auth=8, trace=9, span=10/11,
-        stream=12/14, ici desc=16) or is malformed, meaning the full
-        RpcMeta path must run.  ~3x cheaper than RpcMeta.decode for the
-        echo-class frame, and skips building the object entirely."""
+        att_size, timeout_ms, ici_domain, ici_conn) — or None when the
+        meta carries any controller-tier tag (compress=2, error=6/7,
+        auth=8, trace=9, span=10/11, stream=12/14, ici desc=16) or is
+        malformed, meaning the full RpcMeta path must run.  ~3x cheaper
+        than RpcMeta.decode for the echo-class frame; a successful scan
+        also lets the FULL method path build its RpcMeta from these
+        fields without re-walking (slim-meta path in _on_message)."""
         cid = 0
         svc = mth = None
-        att = 0
+        att = tmo = 0
+        dom = nonce = b""
         off, end = 0, len(data)
         try:
             while off < end:
@@ -283,10 +333,12 @@ class NativeBridge:
                     mth = _bytes(data[off:off + ln]).decode()
                 elif tag == 3:
                     (att,) = _struct_unpack_from("<I", data, off)
-                elif tag in (13, 15, 17):
-                    pass    # timeout / ici-domain / conn-nonce: safe
-                            # (nonce pinning happens on the full path;
-                            # raw methods never carry descriptors)
+                elif tag == 13:
+                    (tmo,) = _struct_unpack_from("<I", data, off)
+                elif tag == 15:
+                    dom = _bytes(data[off:off + ln])
+                elif tag == 17:
+                    nonce = _bytes(data[off:off + ln])
                 else:
                     return None   # controller-tier tag: full path
                 off += ln
@@ -294,7 +346,7 @@ class NativeBridge:
             return None
         if svc is None or mth is None:
             return None
-        return cid, svc, mth, att
+        return cid, svc, mth, att, tmo, dom, nonce
 
     def _on_message(self, conn_id: int, buf, meta_size: int) -> None:
         sock = self._sock(conn_id)
@@ -302,6 +354,7 @@ class NativeBridge:
             return
         mv = memoryview(buf)
         server = self._server
+        scan = None
         if server.options.usercode_inline \
                 and server.options.auth is None \
                 and server.options.interceptor is None:
@@ -316,7 +369,15 @@ class NativeBridge:
                         and self._raw_dispatch(scan[0], scan[3], mv,
                                                meta_size, sock, entry):
                     return
-        meta = RpcMeta.decode(bytes(mv[:meta_size]))
+        if scan is not None:
+            # slim-meta path: the scan proved no controller-tier tags —
+            # build the RpcMeta from its fields, skip the full decode
+            meta = RpcMeta()
+            (meta.correlation_id, meta.service_name, meta.method_name,
+             meta.attachment_size, meta.timeout_ms, meta.ici_domain,
+             meta.ici_conn) = scan
+        else:
+            meta = RpcMeta.decode(bytes(mv[:meta_size]))
         if meta is None:
             self.engine.close_conn(conn_id)
             return

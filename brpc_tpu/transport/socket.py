@@ -114,6 +114,7 @@ class Socket:
         "_pending_acks", "_ack_flush_scheduled",
         "_inflight_ids", "_inflight_lock",
         "_reconnect_lock", "_last_reconnect_at",
+        "_cntl_tails",
     )
 
     # -- lifecycle ---------------------------------------------------------

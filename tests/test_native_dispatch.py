@@ -99,12 +99,16 @@ def test_native_const(native_server):
     assert _native_count(srv, "N.Ping")[0] == 1
 
 
-def test_unregistered_raw_method_still_python(native_server):
+def test_plain_raw_method_rides_engine_kind2(native_server):
+    """A plain @raw_method (no native= tag) is registered as kind 2:
+    the engine calls the Python handler from the loop thread (burst-
+    batched GIL entry) and builds the response frame natively.  The
+    handler still runs — and the call is counted on the native lane."""
     srv, svc = native_server
     ch = _ch(srv)
     resp, _ = ch.call_raw("N.PyOnly", b"abc", timeout_ms=5_000)
     assert bytes(resp) == b"cba"
-    assert _native_count(srv, "N.PyOnly") == (0, 0)
+    assert _native_count(srv, "N.PyOnly") == (1, 0)
 
 
 def test_native_large_attachment_zero_copy_path(native_server):
