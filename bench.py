@@ -88,6 +88,15 @@ def _start_server(native: bool = True):
             # behavioral spec and live fallback
             return payload, attachment
 
+        @raw_method()
+        def EchoPyRaw(self, payload, attachment):
+            # a REAL Python handler on the raw lane (kind-2 dispatch:
+            # the engine batches the burst, calls this under one GIL
+            # entry, builds the response natively) — what a user's own
+            # service actually pays, measured honestly alongside the
+            # all-C++ number
+            return payload, attachment
+
     opts = ServerOptions()
     opts.native = native
     opts.native_loops = 1          # 1-core box: extra loops only add contention
@@ -292,10 +301,22 @@ def bench_headline_and_sweep(extra: dict) -> float:
             return not ch.call_method("Bench.Echo", b"",
                                       cntl=cntl).failed
 
+        def one_pyraw():
+            try:
+                ch.call_raw("Bench.EchoPyRaw", b"", att,
+                            timeout_ms=10_000)
+                return True
+            except Exception:
+                return False
+
         p50, p99 = lat_window(one_raw)
         if p50 < float("inf"):
             extra["echo_1kb_p50_us"] = round(p50, 1)
             extra["echo_1kb_p99_us"] = round(p99, 1)
+        p50, p99 = lat_window(one_pyraw)
+        if p50 < float("inf"):
+            extra["echo_1kb_pyhandler_p50_us"] = round(p50, 1)
+            extra["echo_1kb_pyhandler_p99_us"] = round(p99, 1)
         p50, p99 = lat_window(one_cntl)
         if p50 < float("inf"):
             extra["echo_1kb_cntl_p50_us"] = round(p50, 1)
@@ -406,6 +427,137 @@ def bench_fanout(extra: dict) -> None:
     extra["fanout_subcalls_qps"] = round(3 * qps, 1)
     qps = run(native=False)
     extra["fanout_cntl_qps"] = round(qps, 1)
+
+
+def bench_http(extra: dict) -> None:
+    """HTTP/1.1 keep-alive 1KB echo on the Python transport (the
+    reference routes every protocol through its C++ core; our HTTP lane
+    is Python — this records what that lane actually does under load,
+    VERDICT r4 #7).  stdlib http.client is the peer (a real HTTP
+    implementation we didn't write)."""
+    import http.client
+
+    from brpc_tpu.server import Server, Service
+
+    class HttpEcho(Service):
+        def Echo(self, cntl, request):
+            return request
+
+    srv = Server()
+    srv.add_service(HttpEcho(), name="H")
+    assert srv.start("127.0.0.1:0") == 0
+    try:
+        ep = srv.listen_endpoint
+        conn = http.client.HTTPConnection(ep.host, ep.port, timeout=10)
+        body = bytes(1024)
+
+        def one():
+            conn.request("POST", "/H/Echo", body=body)
+            r = conn.getresponse()
+            return len(r.read()) == 1024 and r.status == 200
+
+        for _ in range(20):
+            one()
+        lats = []
+        t0 = time.perf_counter()
+        n = 0
+        while time.perf_counter() - t0 < 3.0:
+            c0 = time.perf_counter()
+            if one():
+                n += 1
+                lats.append((time.perf_counter() - c0) * 1e6)
+        dt = time.perf_counter() - t0
+        extra["http_1kb_qps"] = round(n / dt, 1)
+        if lats:
+            lats.sort()
+            extra["http_1kb_p50_us"] = round(lats[len(lats) // 2], 1)
+            extra["http_1kb_p99_us"] = round(
+                lats[int(len(lats) * 0.99)], 1)
+        conn.close()
+    finally:
+        srv.stop()
+
+
+def bench_grpc(extra: dict) -> None:
+    """gRPC unary 1KB echo: a real grpcio client against our h2 server,
+    with grpcio-client -> grpcio-server loopback on the SAME box as the
+    oracle baseline (VERDICT r4 #7: beat grpcio-loopback)."""
+    try:
+        import grpc
+    except Exception:
+        extra["grpc_bench_skipped"] = "grpcio not importable"
+        return
+
+    from brpc_tpu.server import Server, Service
+
+    _ident = lambda b: b  # noqa: E731
+
+    class GEcho(Service):
+        def Echo(self, cntl, request):
+            return request
+
+    def measure(addr: str) -> tuple:
+        body = bytes(1024)
+        with grpc.insecure_channel(addr) as ch:
+            fn = ch.unary_unary("/GEcho/Echo",
+                                request_serializer=_ident,
+                                response_deserializer=_ident)
+            for _ in range(20):
+                fn(body, timeout=10)
+            lats = []
+            t0 = time.perf_counter()
+            n = 0
+            while time.perf_counter() - t0 < 3.0:
+                c0 = time.perf_counter()
+                if len(fn(body, timeout=10)) == 1024:
+                    n += 1
+                    lats.append((time.perf_counter() - c0) * 1e6)
+            dt = time.perf_counter() - t0
+            lats.sort()
+            return (round(n / dt, 1),
+                    round(lats[int(len(lats) * 0.99)], 1) if lats
+                    else None)
+
+    srv = Server()
+    srv.add_service(GEcho(), name="GEcho")
+    assert srv.start("127.0.0.1:0") == 0
+    try:
+        qps, p99 = measure(str(srv.listen_endpoint))
+        extra["grpc_unary_qps"] = qps
+        if p99 is not None:
+            extra["grpc_unary_p99_us"] = p99
+    finally:
+        srv.stop()
+
+    # oracle: grpcio server answering the same shape on the same box
+    try:
+        from concurrent import futures
+
+        class _Handler(grpc.GenericRpcHandler):
+            def service(self, details):
+                if details.method == "/GEcho/Echo":
+                    return grpc.unary_unary_rpc_method_handler(
+                        lambda req, ctx: req,
+                        request_deserializer=_ident,
+                        response_serializer=_ident)
+                return None
+
+        gsrv = grpc.server(futures.ThreadPoolExecutor(max_workers=2))
+        gsrv.add_generic_rpc_handlers((_Handler(),))
+        port = gsrv.add_insecure_port("127.0.0.1:0")
+        gsrv.start()
+        try:
+            oq, op99 = measure(f"127.0.0.1:{port}")
+            extra["grpc_unary_grpcio_oracle_qps"] = oq
+            if op99 is not None:
+                extra["grpc_unary_grpcio_oracle_p99_us"] = op99
+            if oq:
+                extra["grpc_vs_grpcio_oracle"] = round(
+                    extra["grpc_unary_qps"] / oq, 2)
+        finally:
+            gsrv.stop(0)
+    except Exception as e:
+        extra["grpc_oracle_error"] = f"{type(e).__name__}: {e}"[:120]
 
 
 def bench_device_echo(extra: dict) -> None:
@@ -643,6 +795,90 @@ def bench_device_compute(extra: dict) -> None:
     ratios.sort()
     extra["lm_decode_int8_speedup"] = round(ratios[len(ratios) // 2], 2)
 
+    # op-level weight-streaming int8 measurement (VERDICT r4 #4): the
+    # decode PROGRAM can't demonstrate the HBM win on this rig, so
+    # measure the op the claim is about — stream N DISTINCT stacked
+    # weight matrices (256MB bf16 vs 128MB int8, far beyond VMEM)
+    # through a matmul chain: lax.scan over the weight axis (XLA
+    # prefetches scan inputs) inside one program, weights passed as jit
+    # ARGUMENTS (closure constants ride the compile request and blow
+    # the remote compiler's size limit), interleaved bf16/int8 windows.
+    # Two probes anchor interpretation: raw elementwise HBM bandwidth
+    # and the fixed per-program floor — on this tunneled chip the floor
+    # is ~70ms and marginal bandwidth ~20GB/s (vs 819GB/s on real v5e
+    # HBM), so if the ratio reads ~1.0 the rig, not the quantization,
+    # is the limit (PERF.md §3 carries the analysis).
+    try:
+        D, NW, ROUNDS = 2048, 32, 8     # 256MB bf16 streamed per round
+        kw = jax.random.PRNGKey(3)
+        Wb = (jax.random.normal(kw, (NW, D, D), jnp.bfloat16) * 0.05)
+        scale = jnp.max(jnp.abs(Wb), axis=(1, 2), keepdims=True) \
+            .astype(jnp.float32) / 127.0
+        Wq = jnp.clip(jnp.round(Wb.astype(jnp.float32) / scale),
+                      -127, 127).astype(jnp.int8)
+        sc_b = scale.astype(jnp.bfloat16)
+        x0 = jax.random.normal(jax.random.PRNGKey(4), (64, D),
+                               jnp.bfloat16)
+
+        def chain_bf16(W, x):
+            def one_pass(r, acc):
+                y, _ = jax.lax.scan(
+                    lambda a, w: (jnp.tanh(a @ w), None), acc, W)
+                return y
+            return jax.lax.fori_loop(0, ROUNDS, one_pass, x)
+
+        def chain_int8(Q, S, x):
+            def one_pass(r, acc):
+                def body(a, qs):
+                    q, s = qs
+                    # dequantize fuses into the dot operand read: HBM
+                    # traffic is the int8 bytes
+                    return jnp.tanh((a @ q.astype(jnp.bfloat16)) * s), \
+                        None
+                y, _ = jax.lax.scan(body, acc, (Q, S))
+                return y
+            return jax.lax.fori_loop(0, ROUNDS, one_pass, x)
+
+        fb = jax.jit(lambda W, x: jnp.sum(chain_bf16(W, x)))
+        fq = jax.jit(lambda Q, S, x: jnp.sum(chain_int8(Q, S, x)))
+        float(fb(Wb, x0)); float(fq(Wq, sc_b, x0))    # compile + warm
+        sratios, tb_best = [], float("inf")
+        for _ in range(4):
+            t0 = _t.perf_counter(); float(fb(Wb, x0))
+            tb = _t.perf_counter() - t0
+            t0 = _t.perf_counter(); float(fq(Wq, sc_b, x0))
+            tq = _t.perf_counter() - t0
+            sratios.append(tb / tq)
+            tb_best = min(tb_best, tb)
+        sratios.sort()
+        extra["int8_stream_matmul_speedup"] = round(
+            sratios[len(sratios) // 2], 2)
+        streamed = NW * ROUNDS * D * D * 2          # bf16 bytes
+        extra["int8_stream_bf16_gbs"] = round(
+            streamed / tb_best / 1e9, 1)
+
+        # interpretation anchors, same window: elementwise HBM probe at
+        # two sizes — equal times = fixed per-program floor, and the
+        # marginal rate is the usable bandwidth
+        times = {}
+        for mb in (256, 1024):
+            n = mb * 1024 * 1024 // 2
+            xp = jnp.ones((n,), jnp.bfloat16)
+            fp = jax.jit(lambda x: x * 1.0001 + 0.5)
+            float(fp(xp)[0])
+            best = float("inf")
+            for _ in range(3):
+                t0 = _t.perf_counter()
+                float(fp(xp)[0])
+                best = min(best, _t.perf_counter() - t0)
+            times[mb] = best
+        extra["device_program_floor_ms"] = round(times[256] * 1e3, 1)
+        marg = (1024 - 256) * 2 / 1024 / max(
+            times[1024] - times[256], 1e-9)        # GB/s read+write
+        extra["hbm_marginal_gbs"] = round(min(marg, 99999.0), 1)
+    except Exception as e:
+        extra["int8_stream_error"] = f"{type(e).__name__}: {e}"[:120]
+
 
 def bench_device_mfu(extra: dict) -> None:
     """The chip-filling train step: dim 2048, depth 8, 0.5M tokens per
@@ -769,7 +1005,9 @@ def main() -> None:
     except Exception as e:                          # the JSON still prints
         extra["headline_error"] = f"{type(e).__name__}: {e}"[:160]
     for name, fn in (("streaming", bench_streaming),
-                     ("fanout", bench_fanout)):
+                     ("fanout", bench_fanout),
+                     ("http", bench_http),
+                     ("grpc", bench_grpc)):
         if not budget_left():
             extra[f"{name}_skipped"] = "bench budget spent"
             continue
