@@ -430,52 +430,68 @@ def bench_fanout(extra: dict) -> None:
 
 
 def bench_http(extra: dict) -> None:
-    """HTTP/1.1 keep-alive 1KB echo on the Python transport (the
-    reference routes every protocol through its C++ core; our HTTP lane
-    is Python — this records what that lane actually does under load,
-    VERDICT r4 #7).  stdlib http.client is the peer (a real HTTP
-    implementation we didn't write)."""
+    """HTTP/1.1 keep-alive 1KB echo (VERDICT r4 #7).  Primary keys
+    measure the NATIVE port (the engine cuts complete HTTP messages in
+    C++, Python parses + dispatches — the reference's every-protocol-
+    through-the-C++-core shape); `_pytransport` keys keep the pure-
+    Python lane visible.  stdlib http.client is the peer."""
     import http.client
 
-    from brpc_tpu.server import Server, Service
+    from brpc_tpu.server import Server, ServerOptions, Service
 
     class HttpEcho(Service):
         def Echo(self, cntl, request):
             return request
 
-    srv = Server()
-    srv.add_service(HttpEcho(), name="H")
-    assert srv.start("127.0.0.1:0") == 0
-    try:
-        ep = srv.listen_endpoint
-        conn = http.client.HTTPConnection(ep.host, ep.port, timeout=10)
-        body = bytes(1024)
+    def measure(native: bool):
+        opts = ServerOptions()
+        if native:
+            opts.native = True
+            opts.native_loops = 1
+            opts.usercode_inline = True
+        srv = Server(opts)
+        srv.add_service(HttpEcho(), name="H")
+        assert srv.start("127.0.0.1:0") == 0
+        try:
+            ep = srv.listen_endpoint
+            conn = http.client.HTTPConnection(ep.host, ep.port,
+                                              timeout=10)
+            body = bytes(1024)
 
-        def one():
-            conn.request("POST", "/H/Echo", body=body)
-            r = conn.getresponse()
-            return len(r.read()) == 1024 and r.status == 200
+            def one():
+                conn.request("POST", "/H/Echo", body=body)
+                r = conn.getresponse()
+                return len(r.read()) == 1024 and r.status == 200
 
-        for _ in range(20):
-            one()
-        lats = []
-        t0 = time.perf_counter()
-        n = 0
-        while time.perf_counter() - t0 < 3.0:
-            c0 = time.perf_counter()
-            if one():
-                n += 1
-                lats.append((time.perf_counter() - c0) * 1e6)
-        dt = time.perf_counter() - t0
-        extra["http_1kb_qps"] = round(n / dt, 1)
-        if lats:
+            for _ in range(20):
+                one()
+            lats = []
+            t0 = time.perf_counter()
+            n = 0
+            while time.perf_counter() - t0 < 3.0:
+                c0 = time.perf_counter()
+                if one():
+                    n += 1
+                    lats.append((time.perf_counter() - c0) * 1e6)
+            dt = time.perf_counter() - t0
+            conn.close()
             lats.sort()
-            extra["http_1kb_p50_us"] = round(lats[len(lats) // 2], 1)
-            extra["http_1kb_p99_us"] = round(
-                lats[int(len(lats) * 0.99)], 1)
-        conn.close()
-    finally:
-        srv.stop()
+            return (round(n / dt, 1),
+                    round(lats[len(lats) // 2], 1) if lats else None,
+                    round(lats[int(len(lats) * 0.99)], 1) if lats
+                    else None)
+        finally:
+            srv.stop()
+
+    qps, p50, p99 = measure(native=True)
+    extra["http_1kb_qps"] = qps
+    if p50 is not None:
+        extra["http_1kb_p50_us"] = p50
+        extra["http_1kb_p99_us"] = p99
+    qps, p50, p99 = measure(native=False)
+    extra["http_1kb_pytransport_qps"] = qps
+    if p99 is not None:
+        extra["http_1kb_pytransport_p99_us"] = p99
 
 
 def bench_grpc(extra: dict) -> None:

@@ -143,6 +143,8 @@ enum : int {
   EV_UNKNOWN = 3,   // obj = NativeBuf(first bytes); conn will be closed
   EV_CLOSE = 4,
   EV_STREAM = 5,    // TSTR frame: obj = NativeBuf(flags+dest+len+payload)
+  EV_HTTP = 6,      // one COMPLETE raw HTTP/1.x message (headers+body
+                    // as received); Python parses + dispatches
 };
 
 struct WriteItem {
@@ -248,6 +250,9 @@ struct EngineImpl {
   // per-wake Python dispatch skips the mmap + page-fault (~14us on
   // this box) that a frameless C thread pays on EVERY cold eval entry.
   bool external_loops = false;
+  // HTTP body limit (mirrors protocol/http.py max_body_size; the
+  // bridge syncs it at listen time and on live flag flips)
+  std::atomic<size_t> http_max_body{64u * 1024u * 1024u};
 };
 
 static void flush_decrefs_locked_gil(Loop* lp) {
@@ -675,6 +680,149 @@ static bool native_flush(Loop* lp, Conn* c) {
   return conn_flush(lp, c);
 }
 
+// ---------------------------------------------------------------------------
+// HTTP/1.x cutting — the native engine's multi-protocol ingestion step
+// (≈ the reference routing every protocol through one C++ cut loop,
+// input_messenger.cpp:329).  The engine only CUTS a complete message
+// (request line + headers + body, Content-Length or chunked); header
+// parsing and dispatch stay in Python (protocol/http.py +
+// server/http_dispatch.py) via EV_HTTP.
+// ---------------------------------------------------------------------------
+
+constexpr size_t kMaxHttpHeader = 64 * 1024;
+
+// does the buffer start like an HTTP/1.x message?  avail>=4 guaranteed.
+static bool http_sniff(const char* p) {
+  static const char* kStarts[] = {"GET ",  "POST", "PUT ", "DELE",
+                                  "HEAD", "OPTI", "PATC", "CONN",
+                                  "TRAC", "HTTP"};
+  for (const char* s : kStarts)
+    if (memcmp(p, s, 4) == 0) return true;
+  return false;
+}
+
+// case-insensitive search for a header NAME at line starts inside the
+// header block [p, p+len); returns pointer past "name:" or nullptr
+static const char* http_find_header(const char* p, size_t len,
+                                    const char* name, size_t name_len) {
+  const char* end = p + len;
+  const char* line = p;
+  while (line < end) {
+    const char* eol = (const char*)memchr(line, '\n', end - line);
+    size_t ll = eol ? (size_t)(eol - line) : (size_t)(end - line);
+    if (ll > name_len && line[name_len] == ':'
+        && strncasecmp(line, name, name_len) == 0)
+      return line + name_len + 1;
+    if (!eol) break;
+    line = eol + 1;
+  }
+  return nullptr;
+}
+
+// does the header VALUE starting at v (runs to end of line within the
+// block ending at blk_end) contain the token, case-insensitively?
+static bool http_value_contains(const char* v, const char* blk_end,
+                                const char* token, size_t token_len) {
+  const char* eol = (const char*)memchr(v, '\n', blk_end - v);
+  size_t vlen = (eol ? (size_t)(eol - v) : (size_t)(blk_end - v));
+  if (vlen < token_len) return false;
+  for (size_t i = 0; i + token_len <= vlen; i++)
+    if (strncasecmp(v + i, token, token_len) == 0) return true;
+  return false;
+}
+
+// walk a chunked body starting at p (first chunk-size line).
+// returns consumed length through the terminal CRLF after trailers,
+// 0 = need more bytes, -1 = malformed
+static ssize_t http_walk_chunks(const char* p, size_t avail) {
+  size_t off = 0;
+  for (;;) {
+    const char* nl = (const char*)memchr(p + off, '\n', avail - off);
+    if (!nl) return avail - off > 32 ? -1 : 0;   // size line is short
+    size_t line_end = (size_t)(nl - p);
+    char* endp = nullptr;
+    long sz = strtol(p + off, &endp, 16);
+    if (endp == p + off || sz < 0) return -1;
+    off = line_end + 1;
+    if (sz == 0) {
+      // trailers: zero or more header lines, then a blank line
+      for (;;) {
+        if (off >= avail) return 0;
+        const char* tnl = (const char*)memchr(p + off, '\n',
+                                              avail - off);
+        if (!tnl) return 0;
+        size_t tl = (size_t)(tnl - p) - off;
+        off = (size_t)(tnl - p) + 1;
+        if (tl == 0 || (tl == 1 && p[off - 2] == '\r'))
+          return (ssize_t)off;                   // blank line: done
+      }
+    }
+    if (off + (size_t)sz + 2 > avail) return 0;
+    off += (size_t)sz;
+    if (p[off] != '\r' || p[off + 1] != '\n') return -1;
+    off += 2;
+  }
+}
+
+// try to cut one complete HTTP message at p.  Returns total length,
+// 0 = need more bytes, -1 = not/never HTTP or malformed (close),
+// -2 = Content-Length body too large for the inbuf: *cl_total carries
+// the full message size for the direct-read path,
+// -3 = body exceeds max_body (answer 413, then close)
+static ssize_t http_cut(const char* p, size_t avail, size_t max_body,
+                        size_t* cl_total) {
+  if (!http_sniff(p)) return -1;
+  size_t cap = avail < kMaxHttpHeader ? avail : kMaxHttpHeader;
+  const char* he = nullptr;
+  for (size_t i = 3; i + 1 <= cap; i++) {
+    if (p[i] == '\n' && p[i - 1] == '\r' && p[i - 2] == '\n'
+        && p[i - 3] == '\r') {
+      he = p + i + 1;
+      break;
+    }
+  }
+  if (!he) return avail >= kMaxHttpHeader ? -1 : 0;
+  size_t hlen = (size_t)(he - p);
+  const char* te = http_find_header(p, hlen, "transfer-encoding", 17);
+  if (te != nullptr && http_value_contains(te, he, "chunked", 7)) {
+    // chunked framing (any other Transfer-Encoding value keeps CL
+    // framing below, matching protocol/http.py's '"chunked" in te')
+    ssize_t consumed = http_walk_chunks(he, avail - hlen);
+    if (consumed < 0) return -1;
+    if (consumed == 0) {
+      // total unknown up front: the accumulating message must fit the
+      // inbuf; a stream outgrowing it gets a clean 413 (the Python-
+      // transport port accepts chunked up to max_body — documented
+      // native-port limit)
+      return avail + kMaxHttpHeader >= kInbufCap ? -3 : 0;
+    }
+    if ((size_t)consumed > max_body) return -3;
+    return (ssize_t)(hlen + (size_t)consumed);
+  }
+  const char* cl = http_find_header(p, hlen, "content-length", 14);
+  long clen = 0;
+  if (cl != nullptr) {
+    char* endp = nullptr;
+    clen = strtol(cl, &endp, 10);
+    if (endp == cl || clen < 0) return -1;
+    // reject from the HEADERS, before buffering a byte of body — an
+    // oversized Content-Length must not pin a giant NativeBuf and eat
+    // the upload (Python's parse enforces the same max_body limit)
+    if ((size_t)clen > max_body) return -3;
+  }
+  size_t total = hlen + (size_t)clen;
+  if (avail >= total) return (ssize_t)total;   // fully buffered: deliver
+  if (total > kInbufCap / 2) {
+    *cl_total = total;                         // switch to direct read
+    return -2;
+  }
+  return 0;
+}
+
+static const char k413[] =
+    "HTTP/1.1 413 Payload Too Large\r\n"
+    "Content-Length: 0\r\nConnection: close\r\n\r\n";
+
 // parse as many complete frames as possible from c->inbuf / direct reads
 static bool parse_frames_inner(EngineImpl* eng, Loop* lp, Conn* c,
                                std::vector<PyRawItem>& batch) {
@@ -713,7 +861,75 @@ static bool parse_frames_inner(EngineImpl* eng, Loop* lp, Conn* c,
       kind = EV_STREAM;
       hdr = 4;
     } else {
-      // unknown protocol: hand the readable prefix to Python, then die
+      // not a framed protocol: HTTP/1.x is cut natively and handed to
+      // Python whole (EV_HTTP); anything else is EV_UNKNOWN + close
+      size_t cl_total = 0;
+      ssize_t hr = http_cut(
+          p, avail, eng->http_max_body.load(std::memory_order_relaxed),
+          &cl_total);
+      if (hr == -3) {
+        // body over the limit: answer 413 cleanly, then close
+        flush_py_batch(lp, c, batch);
+        c->native_out.append(k413, sizeof(k413) - 1);
+        native_flush(lp, c);
+        return false;
+      }
+      if (hr > 0) {
+        // one complete HTTP message
+        flush_py_batch(lp, c, batch);   // wire order vs earlier frames
+        if (!c->native_out.empty() && !native_flush(lp, c)) return false;
+        c->in_start += (size_t)hr;
+        eng->nmessages++;
+        bool ok;
+        {
+          PyGILState_STATE gs = PyGILState_Ensure();
+          flush_decrefs_locked_gil(lp);
+          NativeBuf* b = nativebuf_new((Py_ssize_t)hr);
+          ok = (b != nullptr);
+          if (ok) {
+            memcpy(b->data, p, (size_t)hr);
+            PyObject* r = PyObject_CallFunction(
+                eng->dispatch, "iKNl", EV_HTTP,
+                (unsigned long long)c->id, (PyObject*)b, 0L);
+            if (!r) PyErr_WriteUnraisable(eng->dispatch);
+            else Py_DECREF(r);
+          }
+          PyGILState_Release(gs);
+        }
+        if (!ok) return false;
+        continue;
+      }
+      if (hr == 0) {
+        // incomplete HTTP message: wait for more bytes
+        if (c->in_start > 0) {
+          flush_py_batch(lp, c, batch);
+          memmove(c->inbuf, c->inbuf + c->in_start, avail);
+          c->in_end = avail;
+          c->in_start = 0;
+        }
+        return true;
+      }
+      if (hr == -2) {
+        // large Content-Length body: direct-into-buffer reads, same
+        // machinery as large tpu_std frames (msg_kind = EV_HTTP)
+        flush_py_batch(lp, c, batch);
+        NativeBuf* b;
+        {
+          PyGILState_STATE gs = PyGILState_Ensure();
+          flush_decrefs_locked_gil(lp);
+          b = nativebuf_new((Py_ssize_t)cl_total);
+          PyGILState_Release(gs);
+        }
+        if (!b) return false;
+        memcpy(b->data, p, avail);
+        c->msg = b;
+        c->msg_filled = avail;
+        c->msg_meta = 0;
+        c->msg_kind = EV_HTTP;
+        c->in_start = c->in_end = 0;
+        return true;
+      }
+      // hr == -1: hand the readable prefix to Python, then die
       NativeBuf* b;
       {
         PyGILState_STATE gs = PyGILState_Ensure();
@@ -1201,6 +1417,15 @@ static PyObject* Engine_set_native_dispatch(EngineObj* self,
   Py_RETURN_NONE;
 }
 
+static PyObject* Engine_set_http_max_body(EngineObj* self,
+                                          PyObject* args) {
+  unsigned long long n;
+  if (!PyArg_ParseTuple(args, "K", &n)) return nullptr;
+  if (n > (unsigned long long)kMaxBody) n = kMaxBody;
+  self->eng->http_max_body.store((size_t)n, std::memory_order_relaxed);
+  Py_RETURN_NONE;
+}
+
 // native_stats() -> {"svc.mth": (answered, errors)}, or
 // native_stats(svc, mth) -> (answered, errors) — counters of natively-
 // dispatched requests (they never reach Python's MethodStatus; bvar
@@ -1413,6 +1638,8 @@ static PyMethodDef Engine_methods[] = {
      "adopt a bound+listening fd"},
     {"run_loop", (PyCFunction)Engine_run_loop, METH_VARARGS,
      "run one event loop on the calling (Python) thread until stop()"},
+    {"set_http_max_body", (PyCFunction)Engine_set_http_max_body,
+     METH_VARARGS, "cap HTTP request bodies (mirrors max_body_size)"},
     {"send", (PyCFunction)Engine_send, METH_VARARGS,
      "queue buffers for vectored write on a connection"},
     {"close_conn", (PyCFunction)Engine_close_conn, METH_VARARGS, nullptr},
@@ -2678,5 +2905,6 @@ PyMODINIT_FUNC PyInit__native(void) {
   PyModule_AddIntConstant(m, "EV_UNKNOWN", EV_UNKNOWN);
   PyModule_AddIntConstant(m, "EV_CLOSE", EV_CLOSE);
   PyModule_AddIntConstant(m, "EV_STREAM", EV_STREAM);
+  PyModule_AddIntConstant(m, "EV_HTTP", EV_HTTP);
   return m;
 }

@@ -9,9 +9,10 @@ events into the existing dispatch layers:
     EV_MESSAGE -> server.rpc_dispatch.process_rpc_request (on a fiber)
     EV_ACK     -> ici fabric release (descriptor ownership enforced)
     EV_STREAM  -> protocol.streaming dispatch (socket-binding checked)
-    EV_UNKNOWN -> connection failed (native ports speak the framed
-                  protocols; the full multi-protocol port — HTTP portal
-                  etc. — is the Python path / the internal port)
+    EV_HTTP    -> one complete HTTP/1.x message cut by the engine;
+                  protocol.http parses, server dispatch routes (RPC
+                  bridge + restful + builtin portal on the native port)
+    EV_UNKNOWN -> connection failed (not a protocol this port speaks)
 
 Zero-copy discipline: a message's payload IOBuf wraps the engine's
 NativeBuf (buffer protocol) — no Python-side copy on ingest; responses
@@ -221,6 +222,8 @@ class NativeBridge:
         name = listen_socket.getsockname()
         self._local_ep = EndPoint(host=name[0], port=name[1])
         self._register_native_methods()
+        from ..protocol.base import max_body_size
+        self.engine.set_http_max_body(int(max_body_size()))
         self.engine.listen(listen_socket.fileno())
         import threading
         for i in range(self._nloops):
@@ -270,14 +273,17 @@ class NativeBridge:
                 self._on_ack(conn_id, obj, extra)
             elif event == m.EV_STREAM:
                 self._on_stream(conn_id, obj)
+            elif event == getattr(m, "EV_HTTP", -1):
+                self._on_http(conn_id, obj)
             elif event == m.EV_OPEN:
                 self._on_open(conn_id, obj, extra)
             elif event == m.EV_CLOSE:
                 self._on_close(conn_id)
             elif event == m.EV_UNKNOWN:
-                LOG.warning("non-framed bytes on native port from conn %d "
-                            "(%d bytes); closing — use the Python/internal "
-                            "port for HTTP", conn_id, len(obj))
+                LOG.warning("unrecognized bytes on native port from conn "
+                            "%d (%d bytes); closing (the native port "
+                            "speaks tpu_std/stream/ici-ack and HTTP/1.x)",
+                            conn_id, len(obj))
         except Exception:
             LOG.exception("native dispatch raised (event=%d)", event)
 
@@ -477,6 +483,33 @@ class NativeBridge:
                                                     len(body)), body))
         except ConnectionError:
             pass
+
+    def _on_http(self, conn_id: int, buf) -> None:
+        """One COMPLETE raw HTTP/1.x message cut by the engine: parse
+        headers in Python (protocol/http.py — the single source of HTTP
+        semantics) and route through the normal server dispatch
+        (RPC bridge, restful routes, builtin portal).  This is the
+        native port serving every protocol, like the reference's C++
+        core does (input_messenger.cpp:329).
+
+        Always processed ON the loop thread (even for non-inline
+        servers): HTTP/1.1 has no correlation id — pipelined responses
+        MUST leave in request order, and per-connection arrival order
+        is exactly what this thread provides (the Python transport
+        dispatches HTTP synchronously per connection too)."""
+        sock = self._sock(conn_id)
+        if sock is None:
+            return
+        from ..protocol import http as http_mod
+
+        source = IOBuf()
+        source.append_user_data(memoryview(buf))
+        res = http_mod.parse(source, sock, False, None)
+        if not res.ok or res.message is None \
+                or not res.message.is_request:
+            self.engine.close_conn(conn_id)
+            return
+        http_mod._process_request(res.message, sock, self._server)
 
     def _on_ack(self, conn_id: int, buf, count: int) -> None:
         sock = self._sock(conn_id)

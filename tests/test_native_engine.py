@@ -138,10 +138,19 @@ def test_large_body_direct_read(nserver):
 
 
 def test_unknown_protocol_closes_conn(nserver):
+    # HTTP is a protocol the native port SPEAKS now (EV_HTTP): a GET
+    # gets a real response, not a close
     s = _connect(nserver)
     try:
         s.sendall(b"GET / HTTP/1.1\r\nHost: x\r\n\r\n")
-        assert s.recv(4096) == b""          # engine hands to EV_UNKNOWN, closes
+        assert s.recv(4096).startswith(b"HTTP/1.1 200")
+    finally:
+        s.close()
+    # genuinely unknown bytes still hand to EV_UNKNOWN and close
+    s = _connect(nserver)
+    try:
+        s.sendall(b"\x7f\x02unframed garbage bytes")
+        assert s.recv(4096) == b""
     finally:
         s.close()
     # server still serves new connections afterwards

@@ -1,0 +1,241 @@
+"""HTTP/1.x served by the NATIVE engine port.
+
+The engine cuts complete HTTP messages in C++ (request line + headers +
+body — Content-Length or chunked; `engine.cpp http_cut`) and hands each
+whole message to Python (EV_HTTP), where protocol/http.py parses it and
+the normal server dispatch routes it — RPC bridge, restful, builtin
+portal.  This is the reference's one-C++-ingestion-loop-for-every-
+protocol shape (input_messenger.cpp:329) on the native port; stdlib
+http.client is the interop peer."""
+
+import http.client
+import json
+
+import pytest
+
+from brpc_tpu.client import Channel
+from brpc_tpu.server import Server, ServerOptions, Service
+from brpc_tpu.server.service import raw_method
+
+
+class Calc(Service):
+    def Add(self, cntl, request):
+        data = json.loads(request or b"{}")
+        return {"sum": int(data.get("a", 0)) + int(data.get("b", 0))}
+
+    def Echo(self, cntl, request):
+        return request
+
+    @raw_method(native="echo")
+    def EchoRaw(self, payload, attachment):
+        return payload, attachment
+
+
+@pytest.fixture(scope="module")
+def server():
+    opts = ServerOptions()
+    opts.native = True
+    opts.native_loops = 1
+    opts.usercode_inline = True
+    srv = Server(opts)
+    srv.add_service(Calc(), name="Calc")
+    assert srv.start("127.0.0.1:0") == 0
+    yield srv
+    srv.stop()
+
+
+def _conn(server):
+    ep = server.listen_endpoint
+    return http.client.HTTPConnection(ep.host, ep.port, timeout=10)
+
+
+def test_builtin_portal_on_native_port(server):
+    c = _conn(server)
+    c.request("GET", "/")
+    r = c.getresponse()
+    body = r.read()
+    assert r.status == 200 and b"/Calc/Add" in body
+    c.close()
+
+
+def test_rpc_bridge_keep_alive(server):
+    c = _conn(server)
+    c.request("POST", "/Calc/Add", body=json.dumps({"a": 20, "b": 22}),
+              headers={"Content-Type": "application/json"})
+    r = c.getresponse()
+    assert r.status == 200 and json.loads(r.read()) == {"sum": 42}
+    # keep-alive: SAME connection serves the next request
+    c.request("POST", "/Calc/Echo", body=b"raw-bytes")
+    r = c.getresponse()
+    assert r.status == 200 and r.read() == b"raw-bytes"
+    c.close()
+
+
+def test_chunked_request_body(server):
+    c = _conn(server)
+    c.putrequest("POST", "/Calc/Echo")
+    c.putheader("Transfer-Encoding", "chunked")
+    c.endheaders()
+    for chunk in (b"hello ", b"chunked ", b"world"):
+        c.send(("%x\r\n" % len(chunk)).encode() + chunk + b"\r\n")
+    c.send(b"0\r\n\r\n")
+    r = c.getresponse()
+    assert r.status == 200 and r.read() == b"hello chunked world"
+    c.close()
+
+
+def test_large_body_direct_read(server):
+    # > half the engine inbuf: exercises the direct-into-buffer path
+    big = bytes(range(256)) * 1200            # 307200 bytes
+    c = _conn(server)
+    c.request("POST", "/Calc/Echo", body=big)
+    r = c.getresponse()
+    assert r.status == 200 and r.read() == big
+    # connection still healthy afterwards
+    c.request("GET", "/Calc/Add?a=1&b=2")
+    r = c.getresponse()
+    assert r.status == 200 and json.loads(r.read()) == {"sum": 3}
+    c.close()
+
+
+def test_404_and_get_query(server):
+    c = _conn(server)
+    c.request("GET", "/no/such/route/here")
+    r = c.getresponse()
+    assert r.status == 404
+    r.read()
+    c.request("GET", "/Calc/Add?a=5&b=6")
+    r = c.getresponse()
+    assert r.status == 200 and json.loads(r.read()) == {"sum": 11}
+    c.close()
+
+
+def test_pipelined_requests_one_write(server):
+    """Two requests in one TCP segment: the cut loop must deliver both
+    (responses come back in order on the same connection)."""
+    import socket as s
+
+    ep = server.listen_endpoint
+    sk = s.create_connection((ep.host, ep.port), timeout=10)
+    req = (b"POST /Calc/Echo HTTP/1.1\r\nHost: x\r\n"
+           b"Content-Length: 3\r\n\r\nabc")
+    sk.sendall(req + req)
+    data = b""
+    while data.count(b"\r\n\r\n") < 2:
+        part = sk.recv(65536)
+        assert part, f"peer closed early; got {data!r}"
+        data = data + part
+    assert data.count(b"200") >= 2 and data.count(b"abc") == 2
+    sk.close()
+
+
+def test_tpu_std_and_http_share_the_native_port(server):
+    ch = Channel()
+    ch.init(str(server.listen_endpoint))
+    resp, _ = ch.call_raw("Calc.EchoRaw", b"mixed", timeout_ms=5_000)
+    assert bytes(resp) == b"mixed"
+    c = _conn(server)
+    c.request("POST", "/Calc/Echo", body=b"still http")
+    r = c.getresponse()
+    assert r.status == 200 and r.read() == b"still http"
+    c.close()
+
+
+def test_pipelined_ordered_on_noninline_server():
+    """HTTP has no correlation id: pipelined responses MUST come back
+    in request order even on a fiber-pool (non-inline) server — the
+    bridge processes EV_HTTP on the loop thread for exactly this."""
+    import socket as s
+
+    opts = ServerOptions()
+    opts.native = True
+    opts.native_loops = 1          # usercode_inline stays False
+    srv = Server(opts)
+    srv.add_service(Calc(), name="Calc")
+    assert srv.start("127.0.0.1:0") == 0
+    try:
+        ep = srv.listen_endpoint
+        sk = s.create_connection((ep.host, ep.port), timeout=10)
+        reqs = b"".join(
+            b"POST /Calc/Echo HTTP/1.1\r\nHost: x\r\n"
+            b"Content-Length: 2\r\n\r\n" + b"%02d" % i
+            for i in range(10))
+        sk.sendall(reqs)
+        data = b""
+        while data.count(b"\r\n\r\n") < 10:
+            part = sk.recv(65536)
+            assert part, f"peer closed early; got {data!r}"
+            data += part
+        bodies = [data[m.end():m.end() + 2] for m in
+                  __import__("re").finditer(rb"\r\n\r\n", data)]
+        assert bodies == [b"%02d" % i for i in range(10)], bodies
+        sk.close()
+    finally:
+        srv.stop()
+
+
+def test_oversized_content_length_rejected_from_headers(server):
+    """A Content-Length beyond max_body_size must be refused with 413
+    BEFORE the body is buffered (no giant NativeBuf, no wasted read)."""
+    import socket as s
+
+    ep = server.listen_endpoint
+    sk = s.create_connection((ep.host, ep.port), timeout=10)
+    sk.sendall(b"POST /Calc/Echo HTTP/1.1\r\nHost: x\r\n"
+               b"Content-Length: 104857600\r\n\r\n")   # 100MB, no body
+    sk.settimeout(5)
+    data = sk.recv(4096)
+    assert data.startswith(b"HTTP/1.1 413"), data
+    sk.close()
+
+
+def test_oversized_chunked_stream_gets_413(server):
+    """Chunked bodies on the native port must fit the engine inbuf;
+    an outgrowing stream gets a clean 413, not a TCP reset."""
+    import socket as s
+
+    ep = server.listen_endpoint
+    sk = s.create_connection((ep.host, ep.port), timeout=10)
+    sk.sendall(b"POST /Calc/Echo HTTP/1.1\r\nHost: x\r\n"
+               b"Transfer-Encoding: chunked\r\n\r\n")
+    blob = bytes(8192)
+    got = b""
+    sk.settimeout(10)
+    try:
+        for _ in range(40):                    # ~320KB of chunks
+            sk.sendall(b"2000\r\n" + blob + b"\r\n")
+    except (BrokenPipeError, ConnectionResetError):
+        pass                                   # server answered early
+    try:
+        got = sk.recv(4096)
+    except (ConnectionResetError, s.timeout):
+        got = b""
+    assert got.startswith(b"HTTP/1.1 413"), got
+    sk.close()
+
+
+def test_transfer_encoding_identity_uses_content_length(server):
+    """TE present but NOT chunked: Content-Length framing applies
+    (matching protocol/http.py's '\"chunked\" in te' check)."""
+    import socket as s
+
+    ep = server.listen_endpoint
+    sk = s.create_connection((ep.host, ep.port), timeout=10)
+    sk.sendall(b"POST /Calc/Echo HTTP/1.1\r\nHost: x\r\n"
+               b"Transfer-Encoding: identity\r\n"
+               b"Content-Length: 5\r\n\r\nhello")
+    sk.settimeout(5)
+    data = sk.recv(65536)
+    assert data.startswith(b"HTTP/1.1 200") and data.endswith(b"hello")
+    sk.close()
+
+
+def test_garbage_still_closes(server):
+    import socket as s
+
+    ep = server.listen_endpoint
+    sk = s.create_connection((ep.host, ep.port), timeout=10)
+    sk.sendall(b"\x00\x01\x02\x03 utter nonsense\r\n\r\n")
+    sk.settimeout(5)
+    assert sk.recv(4096) == b""               # engine closed the conn
+    sk.close()
