@@ -1232,7 +1232,13 @@ static void loop_run(Loop* lp) {
       }
       for (uint64_t id : closes) {
         auto it = lp->conns.find(id);
-        if (it != lp->conns.end()) conn_destroy(eng, lp, it->second, true);
+        if (it != lp->conns.end()) {
+          // best-effort drain before teardown: a close requested right
+          // after a response (HTTP/1.0 Connection: close) must not cut
+          // off bytes still in the write queue
+          conn_flush(lp, it->second);
+          conn_destroy(eng, lp, it->second, true);
+        }
       }
     }
     for (int i = 0; i < n; i++) {

@@ -510,6 +510,11 @@ class NativeBridge:
             self.engine.close_conn(conn_id)
             return
         http_mod._process_request(res.message, sock, self._server)
+        if not res.message.keep_alive:
+            # HTTP/1.0 (or explicit Connection: close): the SERVER ends
+            # the connection after the response — 1.0 clients may wait
+            # for EOF as the message delimiter
+            self.engine.close_conn(conn_id)
 
     def _on_ack(self, conn_id: int, buf, count: int) -> None:
         sock = self._sock(conn_id)

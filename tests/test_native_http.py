@@ -230,6 +230,59 @@ def test_transfer_encoding_identity_uses_content_length(server):
     sk.close()
 
 
+def test_http10_connection_close(server):
+    import socket as s
+
+    ep = server.listen_endpoint
+    sk = s.create_connection((ep.host, ep.port), timeout=10)
+    sk.sendall(b"GET /Calc/Add?a=2&b=3 HTTP/1.0\r\n\r\n")
+    sk.settimeout(5)
+    data = b""
+    while True:
+        part = sk.recv(65536)
+        if not part:
+            break
+        data += part
+    assert data.startswith(b"HTTP/1.1 200") and b'"sum": 5' in data
+    assert b"connection: close" in data.lower()
+    sk.close()
+
+
+def test_internal_port_gates_portal_on_native_port():
+    """With an internal port configured, builtin pages on the native
+    MAIN port must answer 403 (liveness stays public); the RPC bridge
+    keeps working."""
+    opts = ServerOptions()
+    opts.native = True
+    opts.native_loops = 1
+    opts.usercode_inline = True
+    opts.internal_port = 0          # pick a free one
+    srv = Server(opts)
+    srv.add_service(Calc(), name="Calc")
+    assert srv.start("127.0.0.1:0") == 0
+    try:
+        ep = srv.listen_endpoint
+        c = http.client.HTTPConnection(ep.host, ep.port, timeout=10)
+        c.request("GET", "/flags")
+        r = c.getresponse()
+        assert r.status == 403, r.status
+        r.read()
+        c.request("POST", "/Calc/Add", body=json.dumps({"a": 1, "b": 1}))
+        r = c.getresponse()
+        assert r.status == 200 and json.loads(r.read()) == {"sum": 2}
+        c.close()
+        # the internal port serves the page
+        iep = srv.internal_endpoint
+        ic = http.client.HTTPConnection(iep.host, iep.port, timeout=10)
+        ic.request("GET", "/flags")
+        r = ic.getresponse()
+        assert r.status == 200
+        r.read()
+        ic.close()
+    finally:
+        srv.stop()
+
+
 def test_garbage_still_closes(server):
     import socket as s
 

@@ -141,6 +141,12 @@ def test_full_mixed_soak():
         def Echo(self, payload, attachment):
             return payload, attachment
 
+        @raw_method()
+        def EchoPy(self, payload, attachment):
+            # kind-2 lane: the engine calls this Python handler from
+            # the loop thread (burst-batched GIL entry)
+            return payload, attachment
+
     opts = ServerOptions()
     opts.native = True
     opts.usercode_inline = True
@@ -205,6 +211,28 @@ def test_full_mixed_soak():
                             timeout_ms=10_000)
         lat.append((time.time(), (time.perf_counter() - t0) * 1e6))
 
+    prch = Channel(co); prch.init(addr)
+    def pyraw_lane():
+        r, _ = prch.call_raw("R.EchoPy", b"k2", b"p" * 256,
+                             timeout_ms=10_000)
+        assert bytes(r) == b"k2"
+
+    import http.client as _hc
+    hconn = [None]
+    def native_http():
+        if hconn[0] is None:
+            hconn[0] = _hc.HTTPConnection(ep.host, ep.port, timeout=10)
+        try:
+            hconn[0].request("POST", "/E/Echo", body=b"h" * 256)
+            resp = hconn[0].getresponse()
+            assert resp.status == 200 and len(resp.read()) == 256
+        except Exception:
+            try:
+                hconn[0].close()
+            finally:
+                hconn[0] = None
+            raise
+
     bch = Channel(co); bch.init(addr)
     reqs = [b"b" * 64] * 64
     def batch():
@@ -263,6 +291,8 @@ def test_full_mixed_soak():
     threads = [lane("unary_pooled", unary_pooled),
                lane("unary_short", unary_short),
                lane("raw", raw_lane),
+               lane("pyraw", pyraw_lane),
+               lane("http", native_http),
                lane("batch", batch),
                lane("stream", stream),
                lane("device", device),
@@ -275,8 +305,8 @@ def test_full_mixed_soak():
         t.join(soak_s + 60)
     try:
         assert not errors, errors[:4]
-        for name in ("unary_pooled", "unary_short", "raw", "batch",
-                     "stream", "device"):
+        for name in ("unary_pooled", "unary_short", "raw", "pyraw",
+                     "http", "batch", "stream", "device"):
             assert counts.get(name, 0) > 5, counts
         # the partitioned lane recovered after heal
         assert partition_recovered[0] > 0, counts
