@@ -145,6 +145,9 @@ enum : int {
   EV_STREAM = 5,    // TSTR frame: obj = NativeBuf(flags+dest+len+payload)
   EV_HTTP = 6,      // one COMPLETE raw HTTP/1.x message (headers+body
                     // as received); Python parses + dispatches
+  EV_BYTES = 7,     // passthrough gulp for protocols the engine does
+                    // not cut (h2/gRPC, redis, thrift, ...): Python's
+                    // InputMessenger registry cuts + dispatches
 };
 
 struct WriteItem {
@@ -172,6 +175,9 @@ struct Conn {
   size_t msg_filled = 0;
   uint32_t msg_meta = 0;
   int msg_kind = EV_MESSAGE;
+  // first bytes matched no natively-cut protocol: every subsequent
+  // gulp goes to Python whole (EV_BYTES) for the protocol registry
+  bool passthrough = false;
 
   // write state (mutex: send() is called from arbitrary Python threads)
   std::mutex wmu;
@@ -826,6 +832,29 @@ static const char k413[] =
 // parse as many complete frames as possible from c->inbuf / direct reads
 static bool parse_frames_inner(EngineImpl* eng, Loop* lp, Conn* c,
                                std::vector<PyRawItem>& batch) {
+  if (c->passthrough) {
+    // deliver the whole gulp; Python's registry owns this connection
+    size_t avail = c->in_end - c->in_start;
+    if (avail == 0) return true;
+    bool ok;
+    {
+      PyGILState_STATE gs = PyGILState_Ensure();
+      flush_decrefs_locked_gil(lp);
+      NativeBuf* b = nativebuf_new((Py_ssize_t)avail);
+      ok = (b != nullptr);
+      if (ok) {
+        memcpy(b->data, c->inbuf + c->in_start, avail);
+        PyObject* r = PyObject_CallFunction(
+            eng->dispatch, "iKNl", EV_BYTES,
+            (unsigned long long)c->id, (PyObject*)b, 0L);
+        if (!r) PyErr_WriteUnraisable(eng->dispatch);
+        else Py_DECREF(r);
+      }
+      PyGILState_Release(gs);
+    }
+    c->in_start = c->in_end = 0;
+    return ok;
+  }
   for (;;) {
     size_t avail = c->in_end - c->in_start;
     const char* p = c->inbuf + c->in_start;
@@ -861,8 +890,20 @@ static bool parse_frames_inner(EngineImpl* eng, Loop* lp, Conn* c,
       kind = EV_STREAM;
       hdr = 4;
     } else {
-      // not a framed protocol: HTTP/1.x is cut natively and handed to
-      // Python whole (EV_HTTP); anything else is EV_UNKNOWN + close
+      // not a natively-framed protocol.  HTTP/1.x is cut natively and
+      // handed to Python whole (EV_HTTP); anything else that isn't
+      // even HTTP-shaped flips the connection to PASSTHROUGH — the
+      // Python protocol registry (h2/gRPC, redis, thrift, streams)
+      // cuts and dispatches it, so the native port speaks every
+      // protocol the Python transport does.  Malformed HTTP (sniffed
+      // as HTTP but uncuttable) stays a close.
+      if (!http_sniff(p)) {
+        flush_py_batch(lp, c, batch);
+        if (!c->native_out.empty() && !native_flush(lp, c)) return false;
+        c->passthrough = true;
+        // re-enter: the passthrough head delivers the buffered bytes
+        return parse_frames_inner(eng, lp, c, batch);
+      }
       size_t cl_total = 0;
       ssize_t hr = http_cut(
           p, avail, eng->http_max_body.load(std::memory_order_relaxed),
@@ -2912,5 +2953,6 @@ PyMODINIT_FUNC PyInit__native(void) {
   PyModule_AddIntConstant(m, "EV_CLOSE", EV_CLOSE);
   PyModule_AddIntConstant(m, "EV_STREAM", EV_STREAM);
   PyModule_AddIntConstant(m, "EV_HTTP", EV_HTTP);
+  PyModule_AddIntConstant(m, "EV_BYTES", EV_BYTES);
   return m;
 }

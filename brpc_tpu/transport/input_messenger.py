@@ -55,6 +55,13 @@ class InputMessenger:
                 return
             self._cut_and_process(sock)
 
+    def process_buffered(self, sock: Socket) -> None:
+        """Cut + dispatch whatever is already in ``sock.read_portal``.
+        The native bridge's passthrough lane feeds gulps the C++ engine
+        does not cut (h2/gRPC, redis, thrift, ...) through the same
+        registry the Python transport uses."""
+        self._cut_and_process(sock)
+
     def _cut_and_process(self, sock: Socket) -> None:
         source = sock.read_portal
         pending = []

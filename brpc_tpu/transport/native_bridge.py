@@ -275,6 +275,8 @@ class NativeBridge:
                 self._on_stream(conn_id, obj)
             elif event == getattr(m, "EV_HTTP", -1):
                 self._on_http(conn_id, obj)
+            elif event == getattr(m, "EV_BYTES", -1):
+                self._on_bytes(conn_id, obj)
             elif event == m.EV_OPEN:
                 self._on_open(conn_id, obj, extra)
             elif event == m.EV_CLOSE:
@@ -514,6 +516,30 @@ class NativeBridge:
             # HTTP/1.0 (or explicit Connection: close): the SERVER ends
             # the connection after the response — 1.0 clients may wait
             # for EOF as the message delimiter
+            self.engine.close_conn(conn_id)
+
+    def _on_bytes(self, conn_id: int, buf) -> None:
+        """Passthrough gulp: the engine recognized none of its natively-
+        cut protocols on this connection, so every read lands here whole
+        and the server's InputMessenger registry (h2/gRPC, redis,
+        thrift, streams — the same table the Python transport uses)
+        cuts and dispatches it.  This makes the native port speak EVERY
+        registered protocol (≈ input_messenger.cpp:329's all-protocols
+        loop), with tpu_std and HTTP/1.x still cut in C++."""
+        sock = self._sock(conn_id)
+        if sock is None:
+            return
+        messenger = getattr(self._server, "_messenger", None)
+        if messenger is None:
+            self.engine.close_conn(conn_id)
+            return
+        sock.read_portal.append_user_data(memoryview(buf))
+        try:
+            messenger.process_buffered(sock)
+        except Exception:
+            LOG.exception("passthrough processing failed")
+            sock.set_failed(Errno.EREQUEST, "passthrough dispatch error")
+        if sock.failed:
             self.engine.close_conn(conn_id)
 
     def _on_ack(self, conn_id: int, buf, count: int) -> None:
