@@ -12,7 +12,11 @@ events into the existing dispatch layers:
     EV_HTTP    -> one complete HTTP/1.x message cut by the engine;
                   protocol.http parses, server dispatch routes (RPC
                   bridge + restful + builtin portal on the native port)
-    EV_UNKNOWN -> connection failed (not a protocol this port speaks)
+    EV_BYTES   -> passthrough gulp for protocols the engine does not
+                  cut (h2/gRPC, redis, thrift, ...); the server's
+                  InputMessenger registry cuts + dispatches
+    EV_UNKNOWN -> connection failed (malformed sniffed-HTTP — every
+                  well-formed registered protocol is served)
 
 Zero-copy discipline: a message's payload IOBuf wraps the engine's
 NativeBuf (buffer protocol) — no Python-side copy on ingest; responses
@@ -139,6 +143,7 @@ class NativeBridge:
         self._nloops = loops
         self._loop_threads: list = []
         self._conns: Dict[int, int] = {}      # engine conn_id -> socket id
+        self._pt_queues: Dict[int, Any] = {}  # passthrough serializers
         self._native_ok = False
         self._native_vars = []                # PassiveStatus keep-alives
 
@@ -282,9 +287,9 @@ class NativeBridge:
             elif event == m.EV_CLOSE:
                 self._on_close(conn_id)
             elif event == m.EV_UNKNOWN:
-                LOG.warning("unrecognized bytes on native port from conn "
-                            "%d (%d bytes); closing (the native port "
-                            "speaks tpu_std/stream/ici-ack and HTTP/1.x)",
+                LOG.warning("malformed HTTP on native port from conn %d "
+                            "(%d bytes); closing (well-formed requests of "
+                            "any registered protocol are served here)",
                             conn_id, len(obj))
         except Exception:
             LOG.exception("native dispatch raised (event=%d)", event)
@@ -300,6 +305,9 @@ class NativeBridge:
         self._conns[conn_id] = sid
 
     def _on_close(self, conn_id: int) -> None:
+        q = self._pt_queues.pop(conn_id, None)
+        if q is not None:
+            q.stop()
         sid = self._conns.pop(conn_id, None)
         if sid is None:
             return
@@ -525,7 +533,13 @@ class NativeBridge:
         thrift, streams — the same table the Python transport uses)
         cuts and dispatches it.  This makes the native port speak EVERY
         registered protocol (≈ input_messenger.cpp:329's all-protocols
-        loop), with tpu_std and HTTP/1.x still cut in C++."""
+        loop), with tpu_std and HTTP/1.x still cut in C++.
+
+        Inline servers process on the loop thread (the usercode_inline
+        contract: handlers never block).  Otherwise the gulps run on a
+        per-connection ExecutionQueue — service code stays OFF the IO
+        loop (the bridge's EV_MESSAGE contract) while gulp order and
+        the portal's single-consumer discipline are preserved."""
         sock = self._sock(conn_id)
         if sock is None:
             return
@@ -533,7 +547,26 @@ class NativeBridge:
         if messenger is None:
             self.engine.close_conn(conn_id)
             return
-        sock.read_portal.append_user_data(memoryview(buf))
+        if self._server.options.usercode_inline:
+            sock.read_portal.append_user_data(memoryview(buf))
+            self._pump_passthrough(conn_id, sock, messenger)
+            return
+        q = self._pt_queues.get(conn_id)
+        if q is None:
+            from ..fiber.execution_queue import ExecutionQueue
+
+            def executor(it, _cid=conn_id, _sock=sock, _msgr=messenger):
+                for chunk in it:
+                    _sock.read_portal.append_user_data(memoryview(chunk))
+                    self._pump_passthrough(_cid, _sock, _msgr)
+                    if _sock.failed:
+                        break
+
+            q = self._pt_queues[conn_id] = ExecutionQueue(
+                executor, name=f"native_pt_{conn_id}")
+        q.execute(buf)
+
+    def _pump_passthrough(self, conn_id: int, sock, messenger) -> None:
         try:
             messenger.process_buffered(sock)
         except Exception:

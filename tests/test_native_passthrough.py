@@ -110,6 +110,53 @@ def test_thrift_client_against_native_port():
         srv.stop()
 
 
+def test_passthrough_off_loop_on_noninline_server():
+    """usercode_inline=False: passthrough handlers run on the fiber
+    pool (per-connection ExecutionQueue), so a handler that blocks must
+    not stall the engine loop — natively-dispatched tpu_std traffic
+    keeps flowing while a gRPC handler sleeps."""
+    import time as _time
+
+    grpc = pytest.importorskip("grpc")
+    opts = ServerOptions()
+    opts.native = True
+    opts.native_loops = 1          # usercode_inline stays False
+    srv = Server(opts)
+
+    class Slow(Service):
+        def Echo(self, cntl, request):
+            _time.sleep(0.5)       # blocking handler
+            return request
+
+        @raw_method(native="echo")
+        def EchoRaw(self, payload, attachment):
+            return payload, attachment
+
+    srv.add_service(Slow(), name="Slow")
+    assert srv.start("127.0.0.1:0") == 0
+    try:
+        ep = srv.listen_endpoint
+        ident = lambda b: b  # noqa: E731
+        gch = grpc.insecure_channel(f"{ep.host}:{ep.port}")
+        fn = gch.unary_unary("/Slow/Echo", request_serializer=ident,
+                             response_deserializer=ident)
+        fut = fn.future(b"slow-one", timeout=30)
+        _time.sleep(0.1)           # the handler is now sleeping
+        # the loop must still answer native traffic promptly
+        ch = Channel()
+        ch.init(str(ep))
+        t0 = _time.perf_counter()
+        resp, _ = ch.call_raw("Slow.EchoRaw", b"fast", timeout_ms=5_000)
+        dt = _time.perf_counter() - t0
+        assert bytes(resp) == b"fast"
+        assert dt < 0.4, f"native lane stalled {dt:.2f}s behind a " \
+                         "blocking passthrough handler"
+        assert fut.result(timeout=30) == b"slow-one"
+        gch.close()
+    finally:
+        srv.stop()
+
+
 def test_all_protocols_one_native_port(server):
     """tpu_std (native cut) + HTTP (native cut) + gRPC (passthrough) +
     redis (passthrough), interleaved against one listener."""
