@@ -1,0 +1,101 @@
+"""One native listener, every protocol.
+
+The C++ engine cuts tpu_std frames and HTTP/1.x natively; everything
+else (gRPC-over-h2, redis RESP, thrift) rides the passthrough lane into
+the protocol registry.  This example starts ONE server and talks to it
+with four different clients.
+
+Run:  python examples/multi_protocol_port.py
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import http.client
+import json
+
+from brpc_tpu.client import Channel
+from brpc_tpu.client.redis_client import RedisClient
+from brpc_tpu.server import Server, ServerOptions, Service
+from brpc_tpu.server.service import raw_method
+
+
+class Calc(Service):
+    def Add(self, cntl, request):
+        data = json.loads(request or b"{}")
+        return {"sum": int(data.get("a", 0)) + int(data.get("b", 0))}
+
+    def Echo(self, cntl, request):
+        return request
+
+    @raw_method(native="echo")
+    def EchoRaw(self, payload, attachment):
+        # answered inside the C++ engine — zero Python per request
+        return payload, attachment
+
+
+class MiniRedis:
+    def __init__(self):
+        self.store = {}
+
+    def on_command(self, args):
+        cmd = args[0].upper()
+        if cmd == b"PING":
+            return "PONG"
+        if cmd == b"SET":
+            self.store[args[1]] = args[2]
+            return "OK"
+        if cmd == b"GET":
+            return self.store.get(args[1])
+        from brpc_tpu.protocol.resp import RedisError
+        raise RedisError(f"unknown command {cmd.decode()}")
+
+
+def main() -> None:
+    opts = ServerOptions()
+    opts.native = True             # the C++ engine owns the listener
+    opts.usercode_inline = True    # echo-class handlers never block
+    srv = Server(opts)
+    srv.add_service(Calc(), name="Calc")
+    srv.add_service(MiniRedis(), name="redis")
+    assert srv.start("127.0.0.1:0") == 0
+    ep = srv.listen_endpoint
+    print(f"one native listener at {ep}\n")
+
+    # 1. tpu_std raw lane (C++-answered echo)
+    ch = Channel()
+    ch.init(str(ep))
+    resp, _ = ch.call_raw("Calc.EchoRaw", b"tpu_std bytes")
+    print("tpu_std  ->", bytes(resp))
+
+    # 2. HTTP/1.1 (C++-cut, Python-dispatched; also serves the portal)
+    hc = http.client.HTTPConnection(ep.host, ep.port, timeout=10)
+    hc.request("POST", "/Calc/Add", body=json.dumps({"a": 20, "b": 22}),
+               headers={"Content-Type": "application/json"})
+    print("http     ->", hc.getresponse().read().decode().strip())
+    hc.close()
+
+    # 3. gRPC over h2 (passthrough lane), with a real grpcio client
+    try:
+        import grpc
+        ident = lambda b: b  # noqa: E731
+        with grpc.insecure_channel(f"{ep.host}:{ep.port}") as gch:
+            fn = gch.unary_unary("/Calc/Echo", request_serializer=ident,
+                                 response_deserializer=ident)
+            print("grpc     ->", fn(b"unary over h2", timeout=10))
+    except ImportError:
+        print("grpc     -> (grpcio not installed, skipped)")
+
+    # 4. redis RESP (passthrough lane)
+    r = RedisClient(str(ep))
+    r.set("greeting", b"hello from RESP")
+    print("redis    ->", r.get("greeting"))
+    r.close()
+
+    srv.stop()
+
+
+if __name__ == "__main__":
+    main()
