@@ -21,12 +21,18 @@ import numpy as np
 
 def main() -> None:
     import jax
+
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        # the axon sitecustomize pins the platform to the 1-chip TPU;
+        # honor the caller's explicit request for virtual CPU devices
+        jax.config.update("jax_platforms", "cpu")
     import jax.numpy as jnp
     from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
     from brpc_tpu.parallel.pipeline import make_pipeline_train
 
     n = jax.device_count()
+    print(f"{n} devices on {jax.default_backend()}")
     mesh = Mesh(np.array(jax.devices()), ("pp",))
     width, n_micro, mb = 32, 8, 4
 
