@@ -355,7 +355,10 @@ class Socket:
         server) reconnects inline instead of failing for up to a whole
         health-check interval.  Rate-limited to one attempt per 500ms;
         a caller that loses the lock race reports the current state
-        instead of piling up."""
+        instead of piling up.  Deliberately NON-blocking: this path
+        runs inline on the global timer thread for backup-request
+        dispatch (controller._on_id_error -> _issue_rpc), where any
+        wait would delay every scheduled deadline in the process."""
         if not self._failed:
             return True
         if self.remote_side is None:

