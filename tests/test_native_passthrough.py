@@ -142,11 +142,27 @@ def test_passthrough_off_loop_on_noninline_server():
                              response_deserializer=ident)
         fut = fn.future(b"slow-one", timeout=30)
         _time.sleep(0.1)           # the handler is now sleeping
-        # the loop must still answer native traffic promptly
+        # the loop must still answer native traffic promptly.  One
+        # bounded retry on a CONNECTION-level error: under full-suite
+        # load a transient conn failure was observed once (~1/6 runs,
+        # order-dependent); the property under test is the TIMING of a
+        # successful call — a genuinely blocked loop fails the dt
+        # assert on every attempt, never with a socket error.
+        from brpc_tpu.client.channel import RpcError
+
         ch = Channel()
         ch.init(str(ep))
-        t0 = _time.perf_counter()
-        resp, _ = ch.call_raw("Slow.EchoRaw", b"fast", timeout_ms=5_000)
+        for attempt in range(2):
+            t0 = _time.perf_counter()
+            try:
+                resp, _ = ch.call_raw("Slow.EchoRaw", b"fast",
+                                      timeout_ms=5_000)
+                break
+            except RpcError as e:
+                if attempt:
+                    raise AssertionError(
+                        f"raw lane failed twice: [{e.code}] {e}") \
+                        from e
         dt = _time.perf_counter() - t0
         assert bytes(resp) == b"fast"
         assert dt < 0.4, f"native lane stalled {dt:.2f}s behind a " \
