@@ -86,14 +86,17 @@ def make_pipeline(mesh, stage_fn: Callable, axis: str = "pp"):
 
 
 def make_pipeline_train(mesh, stage_fn: Callable, loss_fn: Callable,
-                        axis: str = "pp"):
+                        axis: str = "pp", dp_axis: str = None):
     """Build ``step(stacked_params, xs, ys) -> (loss, grads)`` — a
     GPipe training step as ONE differentiated shard_map program.
 
     - ``stacked_params``: pytree, leaves with leading dim ``n_stages``
       (sharded over ``axis``); ``grads`` comes back in the same layout
       (each device holds exactly its stage's gradient slice).
-    - ``xs``/``ys``: (n_micro, mb, ...) replicated microbatches/targets.
+    - ``xs``/``ys``: (n_micro, mb, ...) replicated microbatches/targets
+      — or, with ``dp_axis`` set, sharded over that axis on the
+      microbatch dim (each dp group runs the conveyor on its share and
+      grads are pmean'd across dp: dp×pp composition in one program).
     - ``loss_fn(outputs, ys) -> scalar`` over all microbatches; the
       returned loss is the same scalar the unpipelined model produces.
 
@@ -142,11 +145,24 @@ def make_pipeline_train(mesh, stage_fn: Callable, loss_fn: Callable,
         outputs = jax.lax.psum(
             jnp.where(idx == n - 1, outputs, jnp.zeros_like(outputs)),
             axis)
-        return loss_fn(outputs, ys)
+        loss = loss_fn(outputs, ys)
+        if dp_axis is not None:
+            # dp×pp: each dp group pipelined its own batch share —
+            # average the loss (AD's transpose of pmean then averages
+            # the parameter cotangents across dp, i.e. data-parallel
+            # gradient sync)
+            loss = jax.lax.pmean(loss, dp_axis)
+        return loss
 
+    if dp_axis is None:
+        in_specs = (P(axis), P(), P())
+    else:
+        # stage params sharded over pp (replicated across dp); the
+        # microbatch dim of xs/ys sharded over dp
+        in_specs = (P(axis), P(None, dp_axis), P(None, dp_axis))
     pipe_loss = _shard_map(jax)(
         local_loss, mesh=mesh,
-        in_specs=(P(axis), P(), P()),
+        in_specs=in_specs,
         out_specs=P())
 
     return jax.jit(jax.value_and_grad(pipe_loss))
