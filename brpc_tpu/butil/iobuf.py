@@ -22,6 +22,7 @@ Fresh design notes (not a port):
 from __future__ import annotations
 
 import errno as _errno_mod
+import sys
 import threading
 import weakref
 from collections import deque
@@ -50,17 +51,29 @@ class Block:
     def left_space(self) -> int:
         return self.capacity - self.size
 
-    def __buffer__(self, flags: int) -> memoryview:
-        # PEP 688: the Block itself is the buffer exporter, so every view
-        # handed out keeps the BLOCK (not just its bytearray) alive — the
-        # recycling finalizer cannot fire while zero-copy views exist
-        # anywhere (write queues, the native engine's pinned Py_buffers).
-        return memoryview(self.data)
+    if sys.version_info >= (3, 12):
+        def __buffer__(self, flags: int) -> memoryview:
+            # PEP 688: the Block itself is the buffer exporter, so every
+            # view handed out keeps the BLOCK (not just its bytearray)
+            # alive — the recycling finalizer cannot fire while
+            # zero-copy views exist anywhere (write queues, the native
+            # engine's pinned Py_buffers).
+            return memoryview(self.data)
 
-    def view(self, offset: int, length: int) -> memoryview:
-        # no caching: a Block-held memoryview(self) would be a reference
-        # cycle, deferring recycling to the cycle collector
-        return memoryview(self)[offset : offset + length]
+        def view(self, offset: int, length: int) -> memoryview:
+            # no caching: a Block-held memoryview(self) would be a
+            # reference cycle, deferring recycling to the cycle
+            # collector
+            return memoryview(self)[offset : offset + length]
+    else:
+        def view(self, offset: int, length: int) -> memoryview:
+            # pre-PEP-688 interpreters cannot export a buffer from a
+            # plain class: views alias the storage directly.  A view's
+            # chain then keeps only the bytearray alive, NOT the Block
+            # — so storage recycling is disabled on these interpreters
+            # (HostBlockPool.allocate) to keep the no-aliasing
+            # invariant; only performance degrades.
+            return memoryview(self.data)[offset : offset + length]
 
 
 class BlockPool:
@@ -118,7 +131,10 @@ class HostBlockPool(BlockPool):
             self.allocated += 1
             data = bytearray(capacity)
         blk = Block(data, 0, self)
-        if capacity >= self.block_size:
+        if capacity >= self.block_size and sys.version_info >= (3, 12):
+            # recycling is safe only when views export the BLOCK's
+            # buffer (PEP 688, Block.view): otherwise a recycled slab
+            # could be rewritten while an old view still aliases it
             weakref.finalize(blk, self._recycle, data)
         return blk
 

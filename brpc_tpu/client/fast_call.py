@@ -695,7 +695,12 @@ def run_scatter(branches, timeout_ms: Optional[int]) -> bool:
     response_type).  Returns False (nothing sent) when any branch is
     ineligible — the caller falls back to the async path.  On True,
     every branch cntl is completed (success or failure; no retries —
-    ParallelChannel's fail_limit is the recovery story here)."""
+    ParallelChannel's fail_limit is the recovery story here).
+
+    Two sub-lanes: the PINNED NATIVE scatter (engine scatter_call —
+    frames built/written/read in C on thread-pinned sockets, the whole
+    fan-out costing Python one call; VERDICT r5 Next #7) when every
+    branch fits its shape, else the classic per-branch build below."""
     for channel, cntl, _m, request, _r in branches:
         if not eligible(channel, cntl) or channel.load_balancer is not None:
             return False
@@ -703,8 +708,11 @@ def run_scatter(branches, timeout_ms: Optional[int]) -> bool:
             return False      # scatter frames carry no descriptor logic
         if not isinstance(request, (bytes, bytearray, memoryview)):
             return False
-    inflight = []      # (channel, cntl, sock, sid, cid, response_type)
     nat = _native()
+    if nat is not None and hasattr(nat, "scatter_call") \
+            and _scatter_native(branches, timeout_ms, nat):
+        return True
+    inflight = []      # (channel, cntl, sock, sid, cid, response_type)
     for channel, cntl, method_full, request, response_type in branches:
         opts = channel.options
         if cntl.timeout_ms is None:
@@ -774,6 +782,137 @@ def run_scatter(branches, timeout_ms: Optional[int]) -> bool:
         done, code, text = _handle_response(channel, cntl, sock, sid,
                                             pooled, buf, meta_size, cid,
                                             response_type)
+        if not done:
+            _finish(channel, cntl, code, text)
+    return True
+
+
+_SC_ERRNO = {1: Errno.ERPCTIMEDOUT, 2: Errno.EFAILEDSOCKET,
+             3: Errno.ERESPONSE}
+
+
+def _scatter_native(branches, timeout_ms: Optional[int], nat) -> bool:
+    """Pinned-socket native scatter-gather: sub-call frames are built,
+    written and read by the engine's scatter_call on the raw lane's
+    thread-pinned connections — no pool checkout/return per call, no
+    Python frame build per branch, and all branch servers work
+    concurrently (every request is on the wire before the first
+    response is read).  Returns False when this call's shape needs the
+    classic per-branch path (busy/converted sockets, first-call auth,
+    a repeated remote — pinning is per (thread, remote) so two
+    branches to one server need two pooled checkouts); nothing has
+    been written or completed by then.  On True every branch cntl is
+    completed."""
+    screened = []      # (channel, cntl, sock, sid, method_full,
+    #                     request, response_type)
+    seen_fds = set()
+    timeouts = set()
+    for channel, cntl, method_full, request, response_type in branches:
+        opts = channel.options
+        if opts.auth_data:
+            return False      # verify-on-first rides the classic build
+        if len(request) + 96 > _MAX_BODY:
+            return False      # oversized: classic path owns the error
+        if cntl.timeout_ms is None:
+            cntl.timeout_ms = timeout_ms or opts.timeout_ms
+        # one shared deadline covers the scatter read loop: branches
+        # with DIFFERING per-branch deadlines keep the classic path,
+        # which enforces each branch's own remaining time
+        timeouts.add(cntl.timeout_ms)
+        if len(timeouts) > 1:
+            return False
+        cntl.connection_type = cntl.connection_type or opts.connection_type
+        cntl._begin_us = monotonic_us()
+        remote = channel.single_server
+        if remote is None:
+            return False      # classic path reports the missing server
+        cntl.remote_side = remote
+        sid, sock = _raw_socket(remote)
+        if sock is None:
+            return False      # classic path reports the connect failure
+        if not sock.direct_read or not sock.read_portal.empty() \
+                or not sock.write_path_idle():
+            _unpin(remote, sid)
+            return False
+        fd = sock.fd.fileno()
+        if fd in seen_fds:
+            return False
+        seen_fds.add(fd)
+        screened.append((channel, cntl, sock, sid, method_full, request,
+                         response_type))
+    # commit point: build items (cids, cached tails, pending-ack leads)
+    domain = _local_domain_id() if _ici_enabled() else b""
+    prep = []
+    items = []
+    timeout_s = 0.001
+    for channel, cntl, sock, sid, method_full, request, rtype in screened:
+        tails = getattr(sock, "_cntl_tails", None)
+        tail = tails.get(method_full) if tails is not None else None
+        if tail is None:
+            tail = channel._method_tlvs.get(method_full)
+            if tail is None:
+                tail = channel._method_tlvs[method_full] = \
+                    method_tlv(method_full)
+            if domain:
+                tail = (tail + _domain_tlv(domain)
+                        + encode_tlv(TAG_ICI_CONN, _conn_nonce_of(sock)))
+            if tails is None:
+                tails = sock._cntl_tails = {}
+            tails[method_full] = tail
+        cid = _next_cid()
+        ack0 = sock._take_ack_frame() if sock._pending_acks else None
+        items.append((sock.fd.fileno(), tail, request, None, cid, ack0))
+        prep.append((channel, cntl, sock, sid, cid, rtype))
+        timeout_s = max(timeout_s, (cntl.timeout_ms or 1000) / 1e3)
+    try:
+        results = nat.scatter_call(items, timeout_s)
+    except Exception as e:
+        # argument-level failure after frames may be partially written:
+        # the pinned connections cannot be trusted — fail every branch
+        for channel, cntl, sock, sid, cid, rtype in prep:
+            sock.set_failed(Errno.EFAILEDSOCKET, str(e))
+            sock.release()
+            _finish(channel, cntl, Errno.EFAILEDSOCKET, str(e))
+        return True
+    for (channel, cntl, sock, sid, cid, rtype), res in zip(prep, results):
+        ok = res[0]
+        if ok is None:
+            errkind, text = res[1], res[2]
+            code = _SC_ERRNO.get(errkind, Errno.EFAILEDSOCKET)
+            sock.set_failed(code, text)
+            sock.release()
+            if errkind == 1:
+                _finish(channel, cntl, Errno.ERPCTIMEDOUT,
+                        f"deadline {cntl.timeout_ms}ms exceeded")
+            else:
+                _finish(channel, cntl, code, text)
+            continue
+        acks = res[4]
+        if acks:
+            _ici_process_ack(acks, sock)
+        if ok:
+            buf, natt, dom = res[1], res[2], res[3]
+            if dom:
+                sock.ici_peer_domain = dom
+            body = memoryview(buf)
+            attachment = IOBuf()
+            if natt:
+                attachment.append_user_data(body[len(body) - natt:])
+                body = body[:len(body) - natt]
+            try:
+                cntl.response = parse_payload(bytes(body), rtype)
+            except Exception as e:
+                _finish(channel, cntl, Errno.ERESPONSE,
+                        f"response parse failed: {e}")
+                continue
+            cntl.response_attachment = attachment
+            _finish(channel, cntl, 0, "")
+            continue
+        # unusual response (errors / controller-tier tags): full decode;
+        # a healthy frame leaves the connection pinned (put_back no-op)
+        done, code, text = _handle_response(channel, cntl, sock, sid,
+                                            True, res[1], res[2], cid,
+                                            rtype, put_back=_noop)
         if not done:
             _finish(channel, cntl, code, text)
     return True

@@ -134,6 +134,11 @@ constexpr uint32_t kHeaderSize = 12;  // "TRPC" + u32 body + u32 meta
 constexpr uint32_t kAckHeader = 8;    // "TICI" + u32 count
 constexpr size_t kInbufCap = 128 * 1024;
 constexpr uint32_t kMaxBody = 512u * 1024u * 1024u;
+// slim-lane attachment threshold: requests carrying more attachment
+// bytes than this take the classic Python dispatch (the documented
+// "attachments over threshold" fallback; large frames already fall
+// back via the direct-read path)
+constexpr uint32_t kSlimAttCap = 16 * 1024;
 
 // dispatch event codes (Python side mirrors these)
 enum : int {
@@ -165,6 +170,10 @@ struct Conn {
   struct Loop* loop = nullptr;
   std::string peer_ip;
   int peer_port = 0;
+  // close-after-flush: when closing is set the conn lingers until the
+  // write queue drains (EPOLLOUT-armed) or this deadline passes —
+  // short writev/EAGAIN must not truncate a final response
+  int64_t close_deadline = 0;
 
   // read state: fixed buffer, no zero-fill churn (vector::resize would
   // memset 64KB per recv)
@@ -206,6 +215,8 @@ struct Loop {
   std::mutex mu;
   std::vector<uint64_t> pending_out;    // conns needing EPOLLOUT attention
   std::vector<uint64_t> pending_close;
+  // conns in close-after-flush linger (owned-loop state, no lock)
+  std::vector<uint64_t> lingering;
   // Py_buffer releases deferred until we hold the GIL anyway
   std::vector<Py_buffer> decrefs;
   std::mutex decref_mu;
@@ -214,23 +225,39 @@ struct Loop {
 // A method the engine answers entirely in C++ (no GIL, no Python
 // dispatch) — the tpu-native analogue of the reference's C++ builtin
 // services.  Registered pre-listen; the map is read-only afterwards.
+//
+// kind 3 is the SLIM SERVER LANE for full (cntl, request) methods: the
+// engine scans the meta, batches eligible requests, and enters Python
+// ONCE per read burst calling
+// handler(payload, att, cid, conn_id, dom, nonce) — admission,
+// MethodStatus accounting and rpcz span sampling live in that shim
+// (server/slim_dispatch.py).  A buffer return is framed
+// natively; None means the shim escalated to the classic Python
+// completion (async methods, sampled spans, compressed/streamed
+// responses) and the response leaves via Engine_send instead.
 struct NativeMethod {
-  int kind = 0;                       // 0 = echo, 1 = const, 2 = py raw
+  int kind = 0;                  // 0 = echo, 1 = const, 2 = py raw,
+                                 // 3 = slim full-method dispatch
   std::string const_data;             // kind=1 response payload
-  PyObject* handler = nullptr;        // kind=2 @raw_method callable
+  PyObject* handler = nullptr;        // kind=2/3 Python callable
   std::atomic<uint64_t> count{0};     // answered natively
   std::atomic<uint64_t> errors{0};    // EREQUEST answers (malformed att)
 };
 
-// One buffered-path request bound for a kind=2 Python handler.  The
-// payload pointer aims into the connection's inbuf and is valid only
-// until parse_frames returns — every exit path flushes the batch first.
+// One buffered-path request bound for a kind=2/3 Python handler.  The
+// payload/dom/conn pointers aim into the connection's inbuf and are
+// valid only until parse_frames returns — every exit path flushes the
+// batch first.
 struct PyRawItem {
   NativeMethod* m;
   uint64_t cid;
   const char* payload;   // body past the meta (payload ++ attachment)
   size_t plen;           // total body-after-meta length
   uint32_t att;          // attachment tail size
+  const char* dom = nullptr;    // kind 3: request's ici-domain bytes
+  uint32_t dom_len = 0;
+  const char* conn = nullptr;   // kind 3: request's conn-nonce bytes
+  uint32_t conn_len = 0;
 };
 
 struct EngineImpl {
@@ -249,6 +276,11 @@ struct EngineImpl {
   // (live rpc_dump capture must see every request -> Python path).
   std::unordered_map<std::string, NativeMethod*> native_methods;
   std::atomic<bool> native_dispatch{false};
+  // pre-encoded local ici-domain TLV (empty when ici is off): kind-3
+  // responses answer a request's domain exchange with it, exactly like
+  // rpc_dispatch._domain_tlv on the classic fast path.  Set by the
+  // bridge before listen(); read-only afterwards.
+  std::string domain_tlv;
   bool started = false;
   // true = the loops run on Python-created threads (bridge calls
   // run_loop from threading.Thread).  A thread whose datastack
@@ -260,6 +292,17 @@ struct EngineImpl {
   // bridge syncs it at listen time and on live flag flips)
   std::atomic<size_t> http_max_body{64u * 1024u * 1024u};
 };
+
+static int64_t now_ms() {
+  struct timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  return (int64_t)ts.tv_sec * 1000 + ts.tv_nsec / 1000000;
+}
+
+// close-after-flush bound: a conn that cannot drain its write queue to
+// a slow reader within this window is torn down anyway (≈ the
+// reference's lingering close)
+constexpr int64_t kCloseLingerMs = 5000;
 
 static void flush_decrefs_locked_gil(Loop* lp) {
   std::vector<Py_buffer> local;
@@ -360,7 +403,10 @@ static bool conn_flush(Loop* lp, Conn* c) {
         if (!c->want_out) {
           c->want_out = true;
           struct epoll_event ev;
-          ev.events = EPOLLIN | EPOLLOUT;
+          // a lingering (close-after-flush) conn stops reading: new
+          // requests after close are ignored and a level-triggered
+          // EPOLLIN on unread peer bytes would spin the loop
+          ev.events = (c->closing ? 0u : (uint32_t)EPOLLIN) | EPOLLOUT;
           ev.data.u64 = c->id;
           epoll_ctl(lp->epfd, EPOLL_CTL_MOD, c->fd, &ev);
         }
@@ -409,6 +455,14 @@ struct MetaScan {
   uint32_t svc_len = 0;
   const char* mth = nullptr;
   uint32_t mth_len = 0;
+  // tag 15/17 (ici domain / conn nonce): the raw kinds ignore them
+  // (lane contract); the SLIM lane (kind 3) forwards them to the shim
+  // (peer-domain learning / nonce pinning) and answers the domain
+  // exchange with the engine's cached local-domain TLV
+  const char* dom = nullptr;
+  uint32_t dom_len = 0;
+  const char* conn = nullptr;
+  uint32_t conn_len = 0;
 };
 
 // Mirror of native_bridge._scan_request_meta: collect cid/att/svc/mth,
@@ -441,9 +495,15 @@ static bool scan_request_meta(const char* p, size_t len, MetaScan* out) {
         out->mth_len = ln;
         break;
       case 13:
+        break;              // remaining-deadline: safe for every lane
       case 15:
+        out->dom = p + off;
+        out->dom_len = ln;
+        break;
       case 17:
-        break;              // timeout / ici-domain / conn-nonce: safe
+        out->conn = p + off;
+        out->conn_len = ln;
+        break;
       default:
         return false;       // controller-tier tag: Python path
     }
@@ -463,11 +523,15 @@ static NativeMethod* find_native(EngineImpl* eng, const MetaScan& s) {
 }
 
 // append a success-response frame head (TRPC header + cid TLV +
-// optional att TLV) for a body of plen payload bytes — the single
-// source of the response wire layout for both the buffered and the
-// zero-copy (direct-read) native paths
+// optional att TLV + optional extra pre-encoded meta TLVs) for a body
+// of plen payload bytes — the single source of the response wire
+// layout for both the buffered and the zero-copy (direct-read) native
+// paths.  ``extra`` carries the kind-3 domain-exchange answer (the
+// cached local ici-domain TLV), appended after the att TLV exactly
+// like the classic fast path orders its meta.
 static void native_append_head(std::string& out, uint64_t cid,
-                               uint32_t att, size_t plen) {
+                               uint32_t att, size_t plen,
+                               const std::string* extra = nullptr) {
   char meta[22];
   uint32_t l8 = 8, l4 = 4;
   meta[0] = 1;
@@ -480,13 +544,19 @@ static void native_append_head(std::string& out, uint64_t cid,
     memcpy(meta + 18, &att, 4);
     mlen = 22;
   }
-  uint32_t body = mlen + (uint32_t)plen;
+  uint32_t xlen = extra ? (uint32_t)extra->size() : 0;
+  uint32_t body = mlen + xlen + (uint32_t)plen;
   char hdr[12];
   memcpy(hdr, "TRPC", 4);
   memcpy(hdr + 4, &body, 4);
   memcpy(hdr + 8, &mlen, 4);
+  if (xlen) {
+    uint32_t full = mlen + xlen;
+    memcpy(hdr + 8, &full, 4);
+  }
   out.append(hdr, 12);
   out.append(meta, mlen);
+  if (xlen) out.append(*extra);
 }
 
 // append one native response frame (cid + optional att TLV + body bytes)
@@ -541,26 +611,64 @@ static void flush_py_batch(Loop* lp, Conn* c,
   flush_decrefs_locked_gil(lp);
   for (PyRawItem& it : batch) {
     size_t plen = it.plen - it.att;
-    // the @raw_method contract hands the handler MEMORYVIEWS (the
-    // large-frame Python lane does too — same types either route);
-    // they view private bytes copies, so a handler retaining its
-    // argument can never observe the transient inbuf changing
-    PyObject* pb = PyBytes_FromStringAndSize(it.payload, plen);
-    PyObject* pv = pb ? PyMemoryView_FromObject(pb) : nullptr;
-    Py_XDECREF(pb);                      // the view keeps its own ref
-    PyObject* av = nullptr;
-    if (pv && it.att) {
-      PyObject* ab = PyBytes_FromStringAndSize(it.payload + plen,
-                                               it.att);
-      av = ab ? PyMemoryView_FromObject(ab) : nullptr;
-      Py_XDECREF(ab);
-    }
     PyObject* r = nullptr;
-    if (pv && (it.att == 0 || av))
-      r = PyObject_CallFunctionObjArgs(it.m->handler, pv,
-                                       av ? av : Py_None, nullptr);
-    Py_XDECREF(pv);
-    Py_XDECREF(av);
+    if (it.m->kind == 3) {
+      // slim full-method dispatch: the shim gets BYTES (the classic
+      // path hands parse_payload bytes too — handlers may .decode()),
+      // plus cid and conn id so escalations can complete classically,
+      // plus the request's ici domain/nonce bytes (peer-domain
+      // learning / conn-nonce pinning, classic-path semantics)
+      PyObject* pb = PyBytes_FromStringAndSize(it.payload, plen);
+      PyObject* ab = nullptr;
+      if (pb && it.att)
+        ab = PyBytes_FromStringAndSize(it.payload + plen, it.att);
+      PyObject* cid = pb ? PyLong_FromUnsignedLongLong(it.cid) : nullptr;
+      PyObject* conn = cid ? PyLong_FromUnsignedLongLong(c->id) : nullptr;
+      PyObject* dom = it.dom_len
+          ? PyBytes_FromStringAndSize(it.dom, it.dom_len) : nullptr;
+      PyObject* nonce = it.conn_len
+          ? PyBytes_FromStringAndSize(it.conn, it.conn_len) : nullptr;
+      if (pb && (it.att == 0 || ab) && cid && conn
+          && (it.dom_len == 0 || dom) && (it.conn_len == 0 || nonce))
+        r = PyObject_CallFunctionObjArgs(it.m->handler, pb,
+                                         ab ? ab : Py_None, cid, conn,
+                                         dom ? dom : Py_None,
+                                         nonce ? nonce : Py_None,
+                                         nullptr);
+      Py_XDECREF(pb);
+      Py_XDECREF(ab);
+      Py_XDECREF(cid);
+      Py_XDECREF(conn);
+      Py_XDECREF(dom);
+      Py_XDECREF(nonce);
+      if (r == Py_None) {
+        // handled out-of-band: the shim completed (or will complete)
+        // the RPC through the classic Python send path
+        Py_DECREF(r);
+        it.m->count++;
+        continue;
+      }
+    } else {
+      // the @raw_method contract hands the handler MEMORYVIEWS (the
+      // large-frame Python lane does too — same types either route);
+      // they view private bytes copies, so a handler retaining its
+      // argument can never observe the transient inbuf changing
+      PyObject* pb = PyBytes_FromStringAndSize(it.payload, plen);
+      PyObject* pv = pb ? PyMemoryView_FromObject(pb) : nullptr;
+      Py_XDECREF(pb);                    // the view keeps its own ref
+      PyObject* av = nullptr;
+      if (pv && it.att) {
+        PyObject* ab = PyBytes_FromStringAndSize(it.payload + plen,
+                                                 it.att);
+        av = ab ? PyMemoryView_FromObject(ab) : nullptr;
+        Py_XDECREF(ab);
+      }
+      if (pv && (it.att == 0 || av))
+        r = PyObject_CallFunctionObjArgs(it.m->handler, pv,
+                                         av ? av : Py_None, nullptr);
+      Py_XDECREF(pv);
+      Py_XDECREF(av);
+    }
     if (!r) {
       // handler raised (or OOM building args): answer EINTERNAL with
       // the exception text, like the Python raw lane does
@@ -600,8 +708,15 @@ static void flush_py_batch(Loop* lp, Conn* c,
       continue;
     }
     size_t ralen = ab.obj ? (size_t)ab.len : 0;
+    // kind 3: a request that carried the ici-domain TLV gets the local
+    // domain TLV back in the response meta (the classic fast path's
+    // domain-exchange answer, rpc_dispatch._send_response)
+    const std::string* extra =
+        (it.m->kind == 3 && it.dom_len
+         && !lp->eng->domain_tlv.empty())
+            ? &lp->eng->domain_tlv : nullptr;
     native_append_head(c->native_out, it.cid, (uint32_t)ralen,
-                       (size_t)rb.len + ralen);
+                       (size_t)rb.len + ralen, extra);
     if (rb.len) c->native_out.append((const char*)rb.buf, rb.len);
     if (ralen) c->native_out.append((const char*)ab.buf, ralen);
     PyBuffer_Release(&rb);
@@ -644,10 +759,18 @@ static bool native_try_handle(EngineImpl* eng, Conn* c, const char* body,
       if (!batch) return false;   // direct-read path: full Python route
       batch->push_back({m, s.cid, payload, plen, s.att});
       break;
+    case 3:  // slim full-method dispatch: batched like kind 2; over-
+             // threshold attachments take the byte-identical Python
+             // route (large frames already fall back via direct read)
+      if (!batch) return false;   // direct-read path: full Python route
+      if (s.att > kSlimAttCap) return false;
+      batch->push_back({m, s.cid, payload, plen, s.att,
+                        s.dom, s.dom_len, s.conn, s.conn_len});
+      break;
     default:
       return false;
   }
-  if (m->kind != 2) m->count++;   // kind 2 counts at batch flush
+  if (m->kind < 2) m->count++;   // kinds 2/3 count at batch flush
   return true;
 }
 
@@ -1092,9 +1215,11 @@ static bool conn_readable(EngineImpl* eng, Loop* lp, Conn* c) {
             && eng->native_dispatch.load(std::memory_order_relaxed)
             && scan_request_meta(b->data, c->msg_meta, &s))
           m = find_native(eng, s);
-        if (m && m->kind == 2)
-          m = nullptr;   // large-frame Python raw: the bridge's
+        if (m && (m->kind == 2 || m->kind == 3))
+          m = nullptr;   // large-frame Python raw/slim: the bridge's
                          // zero-copy NativeBuf path beats a batch copy
+                         // (for slim this IS the big-attachment
+                         // fallback to the classic dispatch)
         if (m) {
           size_t plen = (size_t)b->size - c->msg_meta;
           if (s.att > plen) {
@@ -1273,13 +1398,26 @@ static void loop_run(Loop* lp) {
       }
       for (uint64_t id : closes) {
         auto it = lp->conns.find(id);
-        if (it != lp->conns.end()) {
-          // best-effort drain before teardown: a close requested right
-          // after a response (HTTP/1.0 Connection: close) must not cut
-          // off bytes still in the write queue
-          conn_flush(lp, it->second);
-          conn_destroy(eng, lp, it->second, true);
+        if (it == lp->conns.end()) continue;
+        Conn* c = it->second;
+        if (c->closing) continue;        // already lingering
+        // close-after-flush: drain what the kernel will take now; if
+        // the queue still holds bytes (short writev / EAGAIN — exactly
+        // the Connection: close responses this path serves), keep the
+        // conn EPOLLOUT-armed and destroy when the queue empties,
+        // bounded by a linger deadline.  conn_flush returns false once
+        // a closing conn is fully drained (or on a fatal error).
+        c->closing = true;
+        if (!conn_flush(lp, c)) {
+          conn_destroy(eng, lp, c, true);
+          continue;
         }
+        c->close_deadline = now_ms() + kCloseLingerMs;
+        lp->lingering.push_back(id);
+        struct epoll_event ev;
+        ev.events = EPOLLOUT;            // stop reading; write-drain only
+        ev.data.u64 = id;
+        epoll_ctl(lp->epfd, EPOLL_CTL_MOD, c->fd, &ev);
       }
     }
     for (int i = 0; i < n; i++) {
@@ -1302,8 +1440,25 @@ static void loop_run(Loop* lp) {
       bool ok = true;
       if (evs[i].events & (EPOLLHUP | EPOLLERR)) ok = false;
       if (ok && (evs[i].events & EPOLLOUT)) ok = conn_flush(lp, c);
-      if (ok && (evs[i].events & EPOLLIN)) ok = conn_readable(eng, lp, c);
+      if (ok && (evs[i].events & EPOLLIN) && !c->closing)
+        ok = conn_readable(eng, lp, c);
       if (!ok) conn_destroy(eng, lp, c, true);
+    }
+    // linger sweep: closing conns that could not drain within the
+    // deadline are torn down (destroyed conns are simply absent)
+    if (!lp->lingering.empty()) {
+      int64_t now = now_ms();
+      std::vector<uint64_t> keep;
+      for (uint64_t id : lp->lingering) {
+        auto it = lp->conns.find(id);
+        if (it == lp->conns.end()) continue;
+        Conn* c = it->second;
+        if (now >= c->close_deadline)
+          conn_destroy(eng, lp, c, true);
+        else
+          keep.push_back(id);
+      }
+      lp->lingering.swap(keep);
     }
   }
   // teardown: close all conns owned by this loop
@@ -1403,7 +1558,10 @@ static PyObject* Engine_run_loop(EngineObj* self, PyObject* args) {
 // register_native_method(svc, mth, kind, data=b"", handler=None) —
 // pre-listen only.  kind 0 = echo (payload+attachment back unchanged),
 // 1 = const(data), 2 = Python @raw_method handler called from the
-// engine loop (burst-batched; one GIL entry per read burst).
+// engine loop (burst-batched; one GIL entry per read burst),
+// 3 = slim full-method dispatch shim (burst-batched like 2; called as
+// handler(payload, att, cid, conn_id, dom, nonce), None return =
+// out-of-band).
 static PyObject* Engine_register_native_method(EngineObj* self,
                                                PyObject* args) {
   const char* svc;
@@ -1421,16 +1579,16 @@ static PyObject* Engine_register_native_method(EngineObj* self,
                     "native methods must be registered before listen()");
     return nullptr;
   }
-  if (kind != 0 && kind != 1 && kind != 2) {
+  if (kind < 0 || kind > 3) {
     if (data.obj) PyBuffer_Release(&data);
     PyErr_SetString(PyExc_ValueError, "unknown native method kind");
     return nullptr;
   }
-  if (kind == 2 && (handler == nullptr || handler == Py_None
+  if (kind >= 2 && (handler == nullptr || handler == Py_None
                     || !PyCallable_Check(handler))) {
     if (data.obj) PyBuffer_Release(&data);
     PyErr_SetString(PyExc_TypeError,
-                    "kind 2 requires a callable handler");
+                    "kind 2/3 requires a callable handler");
     return nullptr;
   }
   std::string key(svc);
@@ -1448,7 +1606,7 @@ static PyObject* Engine_register_native_method(EngineObj* self,
   }
   Py_XDECREF(m->handler);
   m->handler = nullptr;
-  if (kind == 2) {
+  if (kind >= 2) {
     Py_INCREF(handler);
     m->handler = handler;
   }
@@ -1461,6 +1619,20 @@ static PyObject* Engine_set_native_dispatch(EngineObj* self,
   int on;
   if (!PyArg_ParseTuple(args, "p", &on)) return nullptr;
   self->eng->native_dispatch.store(on != 0, std::memory_order_relaxed);
+  Py_RETURN_NONE;
+}
+
+static PyObject* Engine_set_domain_tlv(EngineObj* self, PyObject* args) {
+  Py_buffer data = {};
+  if (!PyArg_ParseTuple(args, "y*", &data)) return nullptr;
+  if (self->eng->started) {
+    PyBuffer_Release(&data);
+    PyErr_SetString(PyExc_RuntimeError,
+                    "domain TLV must be set before listen()");
+    return nullptr;
+  }
+  self->eng->domain_tlv.assign((const char*)data.buf, (size_t)data.len);
+  PyBuffer_Release(&data);
   Py_RETURN_NONE;
 }
 
@@ -1687,6 +1859,9 @@ static PyMethodDef Engine_methods[] = {
      "run one event loop on the calling (Python) thread until stop()"},
     {"set_http_max_body", (PyCFunction)Engine_set_http_max_body,
      METH_VARARGS, "cap HTTP request bodies (mirrors max_body_size)"},
+    {"set_domain_tlv", (PyCFunction)Engine_set_domain_tlv, METH_VARARGS,
+     "pre-encoded local ici-domain TLV for kind-3 domain-exchange "
+     "answers; pre-listen only"},
     {"send", (PyCFunction)Engine_send, METH_VARARGS,
      "queue buffers for vectored write on a connection"},
     {"close_conn", (PyCFunction)Engine_close_conn, METH_VARARGS, nullptr},
@@ -1717,12 +1892,6 @@ static PyTypeObject EngineType = {
 // ---------------------------------------------------------------------------
 
 #include <poll.h>
-
-static int64_t now_ms() {
-  struct timespec ts;
-  clock_gettime(CLOCK_MONOTONIC, &ts);
-  return (int64_t)ts.tv_sec * 1000 + ts.tv_nsec / 1000000;
-}
 
 // one recv into buf[*got..cap], blocking on the deadline when the socket
 // is dry.  Returns 0 ok (>=1 byte appended), 1 timeout, 2 conn error.
@@ -1766,6 +1935,73 @@ static int wait_fd(int fd, short events, int64_t deadline_ms) {
     if (errno == EINTR) continue;
     return -1;
   }
+}
+
+// ---- client request frame layout (single source, shared by raw_call
+// and scatter_call) ----
+
+// remaining-deadline TLV; returns its length (0 when no timeout)
+static size_t build_tmo_tlv(char* tmo, int timeout_ms) {
+  if (timeout_ms <= 0) return 0;
+  uint32_t l4 = 4;
+  tmo[0] = 13;
+  memcpy(tmo + 1, &l4, 4);
+  uint32_t t32 = (uint32_t)timeout_ms;
+  memcpy(tmo + 5, &t32, 4);
+  return 9;
+}
+
+// TRPC header + cid TLV + [att TLV] into head (>= 34 bytes); the
+// cached tail TLVs, the tmo TLV and the payload/attachment ride their
+// own iovs — mlen covers cid/att TLVs + tail_len + tmo_len.  Returns
+// the head length.
+static size_t build_request_head(char* head, uint64_t cid, size_t alen,
+                                 size_t tail_len, size_t tmo_len,
+                                 size_t payload_len) {
+  char* w = head + kHeaderSize;
+  uint32_t l8 = 8, l4 = 4;
+  *w = 1;
+  memcpy(w + 1, &l8, 4);
+  memcpy(w + 5, &cid, 8);
+  w += 13;
+  if (alen) {
+    *w = 3;
+    memcpy(w + 1, &l4, 4);
+    uint32_t a32 = (uint32_t)alen;
+    memcpy(w + 5, &a32, 4);
+    w += 9;
+  }
+  uint32_t mlen = (uint32_t)((size_t)(w - head - kHeaderSize) + tail_len
+                             + tmo_len);
+  uint32_t body = mlen + (uint32_t)payload_len + (uint32_t)alen;
+  memcpy(head, "TRPC", 4);
+  memcpy(head + 4, &body, 4);
+  memcpy(head + 8, &mlen, 4);
+  return (size_t)(w - head);
+}
+
+// Scan a response meta for the PLAIN success shape — cid(1)/att(3)/
+// ici-domain(15) tags only.  True = plain; rcid/ratt/dom filled.
+// Anything else goes back to Python whole for the full RpcMeta decode.
+static bool scan_plain_resp(const char* p, size_t meta, uint64_t* rcid,
+                            uint32_t* ratt, const char** dom,
+                            uint32_t* dom_len) {
+  bool plain = true;
+  size_t off = 0;
+  while (off < meta) {
+    if (off + 5 > meta) return false;
+    uint8_t tag = (uint8_t)p[off];
+    uint32_t ln;
+    memcpy(&ln, p + off + 1, 4);
+    off += 5;
+    if (ln > meta || off + ln > meta) return false;
+    if (tag == 1 && ln == 8) memcpy(rcid, p + off, 8);
+    else if (tag == 3 && ln == 4) memcpy(ratt, p + off, 4);
+    else if (tag == 15) { *dom = p + off; *dom_len = ln; }
+    else plain = false;
+    off += ln;
+  }
+  return plain;
 }
 
 // Write an iovec array fully (poll on EAGAIN, resume partials) with
@@ -2102,36 +2338,14 @@ static PyObject* raw_call(PyObject*, PyObject* args) {
     return nullptr;
   }
 
-  // head block: TRPC header + cid TLV + [att TLV] + tail + [tmo TLV]
-  char head[22 + 9 + 96];
-  char* w = head + kHeaderSize;
-  *w = 1;                                        // cid TLV
-  uint32_t l8 = 8, l4 = 4;
-  memcpy(w + 1, &l8, 4);
-  memcpy(w + 5, &cid, 8);
-  w += 13;
-  if (alen) {
-    *w = 3;                                      // attachment-size TLV
-    memcpy(w + 1, &l4, 4);
-    uint32_t a32 = (uint32_t)alen;
-    memcpy(w + 5, &a32, 4);
-    w += 9;
-  }
+  // head block: TRPC header + cid TLV + [att TLV]; the cached tail and
+  // the tmo TLV ride their own iovs (single-source frame layout —
+  // build_request_head is shared with scatter_call)
+  char head[40];
   char tmo[9];
-  size_t tmo_len = 0;
-  if (timeout_ms > 0) {
-    tmo[0] = 13;                                 // remaining-deadline TLV
-    memcpy(tmo + 1, &l4, 4);
-    uint32_t t32 = (uint32_t)timeout_ms;
-    memcpy(tmo + 5, &t32, 4);
-    tmo_len = 9;
-  }
-  uint32_t mlen = (uint32_t)((w - head - kHeaderSize) + tail.len
-                             + tmo_len);
-  uint32_t body = mlen + (uint32_t)payload.len + (uint32_t)alen;
-  memcpy(head, "TRPC", 4);
-  memcpy(head + 4, &body, 4);
-  memcpy(head + 8, &mlen, 4);
+  size_t tmo_len = build_tmo_tlv(tmo, timeout_ms);
+  size_t head_len = build_request_head(head, cid, alen, (size_t)tail.len,
+                                       tmo_len, (size_t)payload.len);
 
   int64_t deadline = timeout_ms > 0 ? now_ms() + timeout_ms : -1;
   int err = 0;
@@ -2144,7 +2358,7 @@ static PyObject* raw_call(PyObject*, PyObject* args) {
   struct iovec iov[6];
   int n = 0;
   if (lead.obj && lead.len > 0) iov[n++] = {lead.buf, (size_t)lead.len};
-  iov[n++] = {head, (size_t)(w - head)};
+  iov[n++] = {head, head_len};
   if (tail.len > 0) iov[n++] = {tail.buf, (size_t)tail.len};
   if (tmo_len) iov[n++] = {tmo, tmo_len};
   if (payload.len > 0) iov[n++] = {payload.buf, (size_t)payload.len};
@@ -2173,24 +2387,8 @@ static PyObject* raw_call(PyObject*, PyObject* args) {
   uint32_t ratt = 0;
   const char* dom = nullptr;
   uint32_t dom_len = 0;
-  bool plain = true;
-  {
-    const char* p = out->data;
-    size_t off = 0, end = meta;
-    while (off < end) {
-      if (off + 5 > end) { plain = false; break; }
-      uint8_t tag = (uint8_t)p[off];
-      uint32_t ln;
-      memcpy(&ln, p + off + 1, 4);
-      off += 5;
-      if (ln > end || off + ln > end) { plain = false; break; }
-      if (tag == 1 && ln == 8) memcpy(&rcid, p + off, 8);
-      else if (tag == 3 && ln == 4) memcpy(&ratt, p + off, 4);
-      else if (tag == 15) { dom = p + off; dom_len = ln; }
-      else plain = false;
-      off += ln;
-    }
-  }
+  bool plain = scan_plain_resp(out->data, meta, &rcid, &ratt, &dom,
+                               &dom_len);
   PyObject* acks = Py_None;
   if (!ack_vec.empty()) {
     acks = PyList_New((Py_ssize_t)ack_vec.size());
@@ -2226,6 +2424,200 @@ static PyObject* raw_call(PyObject*, PyObject* args) {
   }
   return Py_BuildValue("(ONkON)", Py_False, (PyObject*)out,
                        (unsigned long)meta, Py_None, acks);
+}
+
+
+// scatter_call(items, timeout_s) -> [result, ...]
+//
+// The fan-out fast lane for ParallelChannel (≈ the reference's
+// parallel_channel.h scatter): items is a sequence of
+// (fd, tail, payload, att_or_None, cid, lead_or_None).  ALL request
+// frames are built and written first (wire-level scatter — every
+// branch's server starts working), then one response frame is read per
+// fd in item order, so the whole fan-out costs Python ONE call instead
+// of one build+write+read round per branch.  Each fd must be
+// exclusively owned with exactly one in-flight request (the Python
+// side falls back to per-branch calls when a remote repeats).
+//
+// results[i] mirrors raw_call's contract:
+//   (True,  buf, att_size, dom_or_None, acks_or_None)   plain success
+//   (False, buf, meta_size, None, acks_or_None)         full RpcMeta
+//                                                       decode path
+//   (None,  errkind, text, None, None)                  transport error
+//       errkind: 1 = timeout, 2 = connection error, 3 = bad frame
+// A failed branch never aborts the others.
+static PyObject* scatter_call(PyObject*, PyObject* args) {
+  PyObject* items;
+  double timeout_s = -1.0;
+  if (!PyArg_ParseTuple(args, "O|d", &items, &timeout_s)) return nullptr;
+  PyObject* seq = PySequence_Fast(items, "items must be a sequence");
+  if (!seq) return nullptr;
+  Py_ssize_t n = PySequence_Fast_GET_SIZE(seq);
+  if (n < 1 || n > 4096) {
+    Py_DECREF(seq);
+    PyErr_SetString(PyExc_ValueError, "bad scatter item count");
+    return nullptr;
+  }
+
+  struct ScItem {
+    int fd = -1;
+    Py_buffer tail{}, payload{}, att{}, lead{};
+    uint64_t cid = 0;
+    char head[40];                 // TRPC hdr + cid TLV + att TLV
+    size_t head_len = 0;
+    char tmo[9];
+    size_t tmo_len = 0;
+    int err = 0;
+    char errbuf[96] = {0};
+    NativeBuf* out = nullptr;
+    uint32_t meta = 0;
+    std::vector<uint64_t> acks;
+  };
+  std::vector<ScItem> its((size_t)n);
+  auto release_item = [](ScItem& it) {
+    if (it.tail.obj) PyBuffer_Release(&it.tail);
+    if (it.payload.obj) PyBuffer_Release(&it.payload);
+    if (it.att.obj) PyBuffer_Release(&it.att);
+    if (it.lead.obj) PyBuffer_Release(&it.lead);
+    it.tail.obj = it.payload.obj = it.att.obj = it.lead.obj = nullptr;
+  };
+  auto release_all = [&]() {
+    for (auto& it : its) {
+      release_item(it);
+      Py_XDECREF((PyObject*)it.out);
+    }
+    Py_DECREF(seq);
+  };
+  int timeout_ms = timeout_s >= 0 ? (int)(timeout_s * 1000) : 0;
+  for (Py_ssize_t i = 0; i < n; i++) {
+    ScItem& it = its[(size_t)i];
+    PyObject* t = PySequence_Fast_GET_ITEM(seq, i);
+    PyObject *att_obj = Py_None, *lead_obj = Py_None;
+    unsigned long long cid = 0;
+    if (!PyArg_ParseTuple(t, "iy*y*OKO", &it.fd, &it.tail, &it.payload,
+                          &att_obj, &cid, &lead_obj)) {
+      release_all();
+      return nullptr;
+    }
+    it.cid = cid;
+    if (att_obj != Py_None
+        && PyObject_GetBuffer(att_obj, &it.att, PyBUF_SIMPLE) != 0) {
+      release_all();
+      return nullptr;
+    }
+    if (lead_obj != Py_None
+        && PyObject_GetBuffer(lead_obj, &it.lead, PyBUF_SIMPLE) != 0) {
+      release_all();
+      return nullptr;
+    }
+    size_t alen = it.att.obj ? (size_t)it.att.len : 0;
+    if ((size_t)it.payload.len + alen + (size_t)it.tail.len + 31
+        > (size_t)kMaxBody) {
+      release_all();
+      PyErr_SetString(PyExc_ValueError,
+                      "payload + attachment exceeds max body");
+      return nullptr;
+    }
+    // same wire layout as raw_call's — single source in
+    // build_request_head/build_tmo_tlv
+    it.tmo_len = build_tmo_tlv(it.tmo, timeout_ms);
+    it.head_len = build_request_head(it.head, it.cid, alen,
+                                     (size_t)it.tail.len, it.tmo_len,
+                                     (size_t)it.payload.len);
+  }
+
+  int64_t deadline = timeout_s >= 0 ? now_ms() + (int64_t)(timeout_s * 1000)
+                                    : -1;
+  // phase 1: scatter — write every branch's frame before reading any
+  // response (per-branch errors recorded, the rest proceed)
+  Py_BEGIN_ALLOW_THREADS;
+  for (auto& it : its) {
+    struct iovec iov[6];
+    int ni = 0;
+    if (it.lead.obj && it.lead.len > 0)
+      iov[ni++] = {it.lead.buf, (size_t)it.lead.len};
+    iov[ni++] = {it.head, it.head_len};
+    if (it.tail.len > 0) iov[ni++] = {it.tail.buf, (size_t)it.tail.len};
+    if (it.tmo_len) iov[ni++] = {it.tmo, it.tmo_len};
+    if (it.payload.len > 0)
+      iov[ni++] = {it.payload.buf, (size_t)it.payload.len};
+    if (it.att.obj && it.att.len > 0)
+      iov[ni++] = {it.att.buf, (size_t)it.att.len};
+    it.err = write_all_iov(it.fd, iov, ni, deadline, it.errbuf,
+                           sizeof it.errbuf);
+  }
+  Py_END_ALLOW_THREADS;
+
+  // phase 2: gather — one response frame per fd (read_one_response
+  // manages its own GIL transitions; entered with the GIL held)
+  for (auto& it : its) {
+    if (it.err) continue;
+    it.err = read_one_response(it.fd, deadline, &it.out, &it.meta,
+                               it.acks, it.errbuf, sizeof it.errbuf);
+  }
+
+  // phase 3: materialize per-item results (GIL held)
+  PyObject* out_list = PyList_New(n);
+  if (!out_list) {
+    release_all();
+    return nullptr;
+  }
+  bool fail = false;
+  for (Py_ssize_t i = 0; i < n && !fail; i++) {
+    ScItem& it = its[(size_t)i];
+    PyObject* res = nullptr;
+    if (it.err) {
+      res = Py_BuildValue("(OisOO)", Py_None, it.err, it.errbuf,
+                          Py_None, Py_None);
+    } else {
+      // scan the response meta exactly like raw_call: plain success
+      // (cid/att/domain only, cid matching) unpacks here
+      uint64_t rcid = 0;
+      uint32_t ratt = 0;
+      const char* dom = nullptr;
+      uint32_t dom_len = 0;
+      bool plain = scan_plain_resp(it.out->data, it.meta, &rcid, &ratt,
+                                   &dom, &dom_len);
+      PyObject* acks = Py_None;
+      if (!it.acks.empty()) {
+        acks = PyList_New((Py_ssize_t)it.acks.size());
+        if (!acks) { fail = true; break; }
+        for (size_t k = 0; k < it.acks.size(); k++)
+          PyList_SET_ITEM(acks, (Py_ssize_t)k,
+                          PyLong_FromUnsignedLongLong(it.acks[k]));
+      } else {
+        Py_INCREF(Py_None);
+      }
+      size_t blen = (size_t)it.out->size - it.meta;
+      if (plain && rcid == it.cid && ratt <= blen) {
+        PyObject* dom_obj;
+        if (dom_len) {
+          dom_obj = PyBytes_FromStringAndSize(dom, (Py_ssize_t)dom_len);
+          if (!dom_obj) { Py_DECREF(acks); fail = true; break; }
+        } else {
+          dom_obj = Py_None;
+          Py_INCREF(Py_None);
+        }
+        memmove(it.out->data, it.out->data + it.meta, blen);
+        it.out->size = (Py_ssize_t)blen;
+        res = Py_BuildValue("(ONkNN)", Py_True, (PyObject*)it.out,
+                            (unsigned long)ratt, dom_obj, acks);
+        if (res) it.out = nullptr;       // ownership moved into res
+      } else {
+        res = Py_BuildValue("(ONkON)", Py_False, (PyObject*)it.out,
+                            (unsigned long)it.meta, Py_None, acks);
+        if (res) it.out = nullptr;
+      }
+    }
+    if (!res) { fail = true; break; }
+    PyList_SET_ITEM(out_list, i, res);
+  }
+  release_all();
+  if (fail) {
+    Py_DECREF(out_list);
+    return nullptr;
+  }
+  return out_list;
 }
 
 
@@ -2910,6 +3302,10 @@ static PyMethodDef module_methods[] = {
      "raw_call(fd, tail, payload, attachment, timeout_ms, cid, lead) -> "
      "(ok, buf, n, dom, acks): one raw-lane round trip fully native — "
      "frame built, written, read and meta-scanned in C++"},
+    {"scatter_call", (PyCFunction)scatter_call, METH_VARARGS,
+     "scatter_call(items, timeout_s) -> [per-item result]: fan-out fast "
+     "lane — write every branch's frame, then read one response per fd; "
+     "items are (fd, tail, payload, att, cid, lead) tuples"},
     {nullptr, nullptr, 0, nullptr},
 };
 

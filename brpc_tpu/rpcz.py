@@ -342,6 +342,23 @@ def rpcz_enabled() -> bool:
 _sample_window = [0.0, 0, 1000]    # window start (s), taken, budget
 
 
+def _passive_sample_gate() -> bool:
+    """One-per-second-window budget check shared by every passive
+    sampling entry point — True takes one slot from this second's
+    ``rpcz_max_samples_per_second`` budget."""
+    import time as _time
+    w = _sample_window
+    now = _time.monotonic()
+    if now - w[0] >= 1.0:
+        w[0] = now
+        w[1] = 0
+        w[2] = int(get_flag("rpcz_max_samples_per_second", 1000))
+    if w[1] >= w[2]:
+        return False
+    w[1] += 1
+    return True
+
+
 def start_server_span(full_method: str, meta, remote_side) -> Optional[Span]:
     """Called by the dispatch layer per request (None when disabled or
     over the sampling budget).  Like the reference's Collector-budgeted
@@ -351,18 +368,23 @@ def start_server_span(full_method: str, meta, remote_side) -> Optional[Span]:
     trace_id) always record."""
     if not rpcz_enabled():
         return None
-    w = _sample_window
-    if not meta.trace_id:
-        import time as _time
-        now = _time.monotonic()
-        if now - w[0] >= 1.0:
-            w[0] = now
-            w[1] = 0
-            w[2] = int(get_flag("rpcz_max_samples_per_second", 1000))
-        if w[1] >= w[2]:
-            return None
-        w[1] += 1
+    if not meta.trace_id and not _passive_sample_gate():
+        return None
     span = Span(full_method, trace_id=meta.trace_id,
                 parent_span_id=meta.span_id, is_server=True)
+    span.remote_side = str(remote_side or "")
+    return span
+
+
+def start_slim_server_span(full_method: str, remote_side) -> Optional[Span]:
+    """Sampling gate for the slim native dispatch lane
+    (server/slim_dispatch.py): same per-second budget window as
+    :func:`start_server_span`, no request meta — explicitly traced
+    requests carry trace tags and never reach the slim lane (the
+    engine's meta scan routes them to the classic path, where
+    start_server_span honors the forced trace)."""
+    if not rpcz_enabled() or not _passive_sample_gate():
+        return None
+    span = Span(full_method, trace_id=0, parent_span_id=0, is_server=True)
     span.remote_side = str(remote_side or "")
     return span

@@ -1,0 +1,160 @@
+"""Slim native server-side dispatch — the Python half of the engine's
+kind-3 lane.
+
+The reference runs the ENTIRE request path to the user callback in C++
+(/root/reference/src/brpc/policy/baidu_rpc_protocol.cpp:314-536); here
+the C++ engine scans the meta TLV, batches every eligible unary request
+of a read burst, and enters Python ONCE calling the shim built below as
+``handler(payload: bytes, att: bytes | None, cid: int, conn_id: int)``.
+The shim is the whole per-call Python cost of the lane:
+
+    admission   server.on_request_in + MethodStatus.on_requested (the
+                concurrency-limiter path — NOT dropped; ELIMIT answers
+                are sent through the classic error builder)
+    sampling    rpcz spans keep their per-second budget via
+                start_slim_server_span; a sampled call escalates to the
+                classic completion so the span records real sizes
+    user code   entry.fn(cntl, request) with a REAL ServerController —
+                handlers keep attachments, set_failed, begin_async,
+                session_local_data, annotate, everything
+    accounting  MethodStatus.on_responded with the measured latency
+
+Return contract with the engine (flush_py_batch, kind 3):
+
+    bytes / memoryview      success payload; frame built natively and
+                            coalesced into the burst's single writev
+    (payload, att_bytes)    success with response attachment
+    None                    the shim completed (or will complete, for
+                            async methods) the RPC through the classic
+                            Python send path — byte-identical fallback
+
+Everything the slim frame cannot express natively escalates through
+``cntl.finish`` into rpc_dispatch._send_response, so escalated calls
+are byte-identical with the classic path by construction: async
+completion, sampled spans, compressed/streamed/device responses,
+non-bytes responses, errors.  Request-side ineligibility (trace tags,
+compression, streams, device descriptors, ici domain exchange,
+over-threshold attachments, large frames) never reaches the shim — the
+engine's meta scan routes those frames to the classic path.
+"""
+
+from __future__ import annotations
+
+from time import monotonic_ns as _mono_ns
+
+from ..butil.iobuf import IOBuf
+from ..butil.logging_util import LOG
+from ..butil.status import Errno
+from ..protocol.meta import RpcMeta
+from ..protocol.tpu_std import parse_payload
+from ..rpcz import start_slim_server_span
+from .controller import ServerController
+from .rpc_dispatch import _send_error, _send_response
+
+_EINTERNAL = int(Errno.EINTERNAL)
+_EREQUEST = int(Errno.EREQUEST)
+_ELIMIT = int(Errno.ELIMIT)
+_ELOGOFF = int(Errno.ELOGOFF)
+
+
+def make_slim_handler(bridge, server, entry, svc: str, mth: str):
+    """Build the kind-3 shim for one (service, method) entry.  All
+    per-entry state is bound into default args — the steady-state call
+    touches no module globals."""
+    status = entry.status
+    fn = entry.fn
+    req_type = entry.request_type
+    full_name = status.full_name
+    socks = bridge._socks          # conn_id -> NativeSocket (live dict)
+
+    # one shared completion closure (not one lambda per call): it only
+    # reads its (cntl, response) arguments
+    def _send(cntl, response, _server=server, _entry=entry):
+        _send_response(_server, _entry, cntl, response)
+
+    def slim(payload, att, cid, conn_id, dom, nonce,
+             _server=server, _status=status, _fn=fn, _rt=req_type,
+             _svc=svc, _mth=mth, _send=_send, _socks=socks,
+             _ns=_mono_ns, _sample=start_slim_server_span):
+        sock = _socks.get(conn_id)
+        if sock is None:
+            return None          # connection died mid-burst: drop, like
+                                 # the classic path drops dead-conn sends
+        if not _server.running:
+            _send_error(sock, cid, _ELOGOFF, "server is stopping")
+            return None
+        if not _server.on_request_in():
+            _send_error(sock, cid, _ELIMIT, "server max_concurrency")
+            return None
+        if not _status.on_requested():
+            _server.on_request_out()
+            _send_error(sock, cid, _ELIMIT,
+                        f"{_status.full_name} max_concurrency")
+            return None
+        if dom is not None:
+            # learn the peer's device-fabric domain; the engine answers
+            # the exchange natively (cached local-domain TLV), and the
+            # meta field below keeps escalated completions identical
+            sock.ici_peer_domain = dom
+        if nonce is not None and sock.ici_conn_token is None:
+            sock.ici_conn_token = nonce    # first write wins
+        meta = RpcMeta()
+        meta.correlation_id = cid
+        meta.service_name = _svc
+        meta.method_name = _mth
+        if dom is not None:
+            meta.ici_domain = dom
+        na = len(att) if att is not None else 0
+        if na:
+            meta.attachment_size = na
+        cntl = ServerController(meta, sock.remote_side, sock.id, _send)
+        cntl.server = _server
+        if na:
+            ab = IOBuf()
+            ab.append_user_data(att)
+            cntl._req_att = ab
+        span = _sample(_status.full_name, sock.remote_side)
+        if span is not None:
+            span.request_size = len(payload) + na
+            cntl.span = span
+        try:
+            request = parse_payload(payload, _rt)
+        except Exception as e:
+            cntl.set_failed(Errno.EREQUEST, f"request parse failed: {e}")
+            cntl.finish(None)
+            return None
+        try:
+            response = _fn(cntl, request)
+        except Exception as e:
+            LOG.exception("method %s raised", _status.full_name)
+            cntl.set_failed(Errno.EINTERNAL, f"{type(e).__name__}: {e}")
+            cntl.finish(None)
+            return None
+        if cntl.is_async:
+            return None          # user owns completion via cntl.finish
+        if (cntl.failed or cntl.span is not None
+                or cntl._accepted_stream_id
+                or cntl.response_compress_type
+                or cntl.response_device_attachment is not None
+                or not isinstance(response,
+                                  (bytes, bytearray, memoryview))):
+            # anything the native frame builder cannot express (or a
+            # sampled span that must record response size): classic
+            # completion — byte-identical by construction
+            cntl.finish(response)
+            return None
+        # ---- slim fast completion: accounting + native frame build ----
+        if not cntl._mark_finished_if_first():
+            return None
+        _status.on_responded(0, _ns() // 1000 - cntl.begin_time_us)
+        _server.on_request_out()
+        if cntl._session_data is not None \
+                and _server._session_pool is not None:
+            _server._session_pool.give_back(cntl._session_data)
+            cntl._session_data = None
+        ratt = cntl._resp_att
+        if ratt is not None and len(ratt):
+            return response, ratt.to_bytes()
+        return response
+
+    return slim

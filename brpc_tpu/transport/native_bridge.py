@@ -143,68 +143,97 @@ class NativeBridge:
         self._nloops = loops
         self._loop_threads: list = []
         self._conns: Dict[int, int] = {}      # engine conn_id -> socket id
-        self._pt_queues: Dict[int, Any] = {}  # passthrough serializers
+        self._socks: Dict[int, Any] = {}      # engine conn_id -> NativeSocket
+        self._pt_queues: Dict[int, Any] = {}  # per-conn dispatch serializers
         self._native_ok = False
         self._native_vars = []                # PassiveStatus keep-alives
 
     def _register_native_methods(self) -> None:
-        """Hand echo-class @raw_method(native=...) handlers to the C++
-        engine so they are answered GIL-free.  Only when nothing needs
-        to observe requests per-call from Python: inline usercode, no
-        auth/interceptor, no server-level concurrency limit, and no
-        per-method limiter on the method itself.  Counters surface as
-        PassiveStatus bvars (rpc_server_<m>_native_{requests,errors}) —
-        native requests never touch Python's MethodStatus."""
+        """Hand eligible methods to the C++ engine:
+
+        - @raw_method(native=...) echo/const semantics (kind 0/1):
+          answered GIL-free — no Python per request at all.
+        - plain @raw_method (kind 2): the engine calls the handler in
+          burst-batched GIL entries and builds the frame natively.
+        - plain (cntl, request) methods (kind 3, the SLIM SERVER LANE):
+          the engine scans the meta and calls a shim that runs
+          admission, MethodStatus accounting, rpcz sampling and the
+          user method in ONE batched GIL entry per burst; the response
+          frame is built natively (server/slim_dispatch.py).
+
+        Gating: auth/interceptor-bearing servers keep the full Python
+        path for everything (verify-on-first / per-request admission
+        must observe every call).  Kinds 2 and 3 run user code on the
+        engine loop, so they additionally require usercode_inline — on
+        a non-inline server raw and full methods keep the fiber-pool
+        path (ADVICE r5 #1/#2: a blocking handler must never freeze a
+        loop).  Kinds 0/1/2 bypass server/method concurrency caps and
+        are skipped when one is set; the slim shim ENFORCES both caps,
+        so kind 3 registers regardless.  Counters surface as
+        PassiveStatus bvars (rpc_server_<m>_native_{requests,errors});
+        kind-2/3 requests additionally keep full MethodStatus."""
         opts = self._server.options
-        if not opts.usercode_inline or opts.auth is not None \
-                or opts.interceptor is not None \
-                or getattr(opts, "max_concurrency", 0):
+        if opts.auth is not None or opts.interceptor is not None:
             return
+        inline = bool(opts.usercode_inline)
+        server_cap = bool(getattr(opts, "max_concurrency", 0))
         from ..bvar.passive_status import PassiveStatus
         from ..tools.rpc_dump import dump_enabled
         registered = False
         for (svc, mth), entry in self._server._methods.items():
-            if entry.raw_fn is None:
-                continue
-            kind = _NATIVE_KINDS.get(entry.native_kind or "")
-            if kind is None:
-                if entry.native_kind:
-                    continue      # unknown native= tag: Python path
-                # plain @raw_method: the engine calls the handler
-                # directly (kind 2) — burst-batched GIL entry, response
-                # frame built natively.  Same lane contract as kind
-                # 0/1: counters ride the native bvars, not MethodStatus
-                kind = 2
-            if entry.status.max_concurrency or entry.status.limiter:
-                continue          # admission must stay in Python
-            data = b""
-            if kind == 1:
-                # capture the const response once (behavioral spec)
-                out = entry.raw_fn(b"", None)
-                data = bytes(out[0] if type(out) is tuple else out)
-            if kind == 2:
-                # accounting shim: the Python raw lane keeps its FULL
-                # MethodStatus observability (request/error counts,
-                # inflight gauge, latency recorder) — @raw_method
-                # promises "per-method stats still apply", and a
-                # latency series moving while qps reads zero would be
-                # a split-brain metrics shape.  ~2us on a warm frame.
-                def _observed(payload, att, _fn=entry.raw_fn,
-                              _st=entry.status, _ns=_mono_ns):
-                    _st.on_requested()
-                    t0 = _ns()
-                    code = 0
-                    try:
-                        return _fn(payload, att)
-                    except BaseException:
-                        code = int(Errno.EINTERNAL)
-                        raise
-                    finally:
-                        _st.on_responded(code, (_ns() - t0) // 1000)
-                self.engine.register_native_method(svc, mth, 2, b"",
-                                                   _observed)
+            if entry.raw_fn is not None:
+                if server_cap:
+                    continue      # kinds 0/1/2 bypass server admission
+                kind = _NATIVE_KINDS.get(entry.native_kind or "")
+                if kind is None:
+                    if entry.native_kind:
+                        continue  # unknown native= tag: Python path
+                    # plain @raw_method: the engine calls the handler
+                    # directly (kind 2) — burst-batched GIL entry,
+                    # response frame built natively
+                    kind = 2
+                if kind == 2 and not inline:
+                    continue      # user code stays off the IO loop
+                if entry.status.max_concurrency or entry.status.limiter:
+                    continue      # admission must stay in Python
+                data = b""
+                if kind == 1:
+                    # capture the const response once (behavioral spec)
+                    out = entry.raw_fn(b"", None)
+                    data = bytes(out[0] if type(out) is tuple else out)
+                if kind == 2:
+                    # accounting shim: the Python raw lane keeps its
+                    # FULL MethodStatus observability (request/error
+                    # counts, inflight gauge, latency recorder) —
+                    # @raw_method promises "per-method stats still
+                    # apply".  ~2us on a warm frame.
+                    def _observed(payload, att, _fn=entry.raw_fn,
+                                  _st=entry.status, _ns=_mono_ns):
+                        _st.on_requested()
+                        t0 = _ns()
+                        code = 0
+                        try:
+                            return _fn(payload, att)
+                        except BaseException:
+                            code = int(Errno.EINTERNAL)
+                            raise
+                        finally:
+                            _st.on_responded(code, (_ns() - t0) // 1000)
+                    self.engine.register_native_method(svc, mth, 2, b"",
+                                                       _observed)
+                else:
+                    self.engine.register_native_method(svc, mth, kind,
+                                                       data)
             else:
-                self.engine.register_native_method(svc, mth, kind, data)
+                # slim server lane (kind 3): unary (cntl, request)
+                # methods only — streaming shapes keep the full path
+                if not inline or entry.grpc_streaming:
+                    continue
+                from ..server.slim_dispatch import make_slim_handler
+                shim = make_slim_handler(self, self._server, entry,
+                                         svc, mth)
+                self.engine.register_native_method(svc, mth, 3, b"",
+                                                   shim)
             safe = f"{svc}_{mth}".lower()
             eng = self.engine
             self._native_vars.append(PassiveStatus(
@@ -229,6 +258,11 @@ class NativeBridge:
         self._register_native_methods()
         from ..protocol.base import max_body_size
         self.engine.set_http_max_body(int(max_body_size()))
+        # kind-3 domain-exchange answers: the local ici-domain TLV is a
+        # per-process constant (empty when ici is off) — cache it in
+        # the engine so slim responses carry it natively
+        from ..server.rpc_dispatch import _domain_tlv
+        self.engine.set_domain_tlv(_domain_tlv())
         self.engine.listen(listen_socket.fileno())
         import threading
         for i in range(self._nloops):
@@ -262,6 +296,7 @@ class NativeBridge:
             if s is not None:
                 s.release()
         self._conns.clear()
+        self._socks.clear()
 
     def connection_count(self) -> int:
         return self.engine.stats()["connections"]
@@ -303,11 +338,13 @@ class NativeBridge:
         s.local_side = self._local_ep    # conn-pair key for ICI binding
         s.tag = None
         self._conns[conn_id] = sid
+        self._socks[conn_id] = s         # slim-lane lookup (one dict hit)
 
     def _on_close(self, conn_id: int) -> None:
         q = self._pt_queues.pop(conn_id, None)
         if q is not None:
             q.stop()
+        self._socks.pop(conn_id, None)
         sid = self._conns.pop(conn_id, None)
         if sid is None:
             return
@@ -494,22 +531,13 @@ class NativeBridge:
         except ConnectionError:
             pass
 
-    def _on_http(self, conn_id: int, buf) -> None:
+    def _process_http(self, conn_id: int, sock, buf) -> None:
         """One COMPLETE raw HTTP/1.x message cut by the engine: parse
         headers in Python (protocol/http.py — the single source of HTTP
         semantics) and route through the normal server dispatch
         (RPC bridge, restful routes, builtin portal).  This is the
         native port serving every protocol, like the reference's C++
-        core does (input_messenger.cpp:329).
-
-        Always processed ON the loop thread (even for non-inline
-        servers): HTTP/1.1 has no correlation id — pipelined responses
-        MUST leave in request order, and per-connection arrival order
-        is exactly what this thread provides (the Python transport
-        dispatches HTTP synchronously per connection too)."""
-        sock = self._sock(conn_id)
-        if sock is None:
-            return
+        core does (input_messenger.cpp:329)."""
         from ..protocol import http as http_mod
 
         source = IOBuf()
@@ -523,8 +551,64 @@ class NativeBridge:
         if not res.message.keep_alive:
             # HTTP/1.0 (or explicit Connection: close): the SERVER ends
             # the connection after the response — 1.0 clients may wait
-            # for EOF as the message delimiter
+            # for EOF as the message delimiter.  The engine's
+            # close-after-flush linger drains the queued response first.
             self.engine.close_conn(conn_id)
+
+    def _conn_queue(self, conn_id: int, sock):
+        """Per-connection dispatch serializer for non-inline servers:
+        user code stays OFF the engine loop (the bridge's EV_MESSAGE
+        contract — a blocking handler must never freeze a loop) while
+        per-connection FIFO order is preserved, which is exactly what
+        HTTP/1.1 pipelining (no correlation id — responses must leave
+        in request order) and the passthrough portal's single-consumer
+        discipline need.  Items are ("http", buf) messages or
+        ("bytes", buf) passthrough gulps."""
+        q = self._pt_queues.get(conn_id)
+        if q is not None:
+            return q
+        from ..fiber.execution_queue import ExecutionQueue
+
+        def executor(it, _cid=conn_id, _sock=sock):
+            for kind, chunk in it:
+                if kind == "http":
+                    try:
+                        self._process_http(_cid, _sock, chunk)
+                    except Exception:
+                        LOG.exception("native HTTP dispatch failed")
+                        _sock.set_failed(Errno.EREQUEST,
+                                         "http dispatch error")
+                        # close the engine conn too (mirrors
+                        # _pump_passthrough): the client must see EOF,
+                        # not hang until its own timeout
+                        self.engine.close_conn(_cid)
+                else:
+                    messenger = getattr(self._server, "_messenger", None)
+                    if messenger is None:
+                        self.engine.close_conn(_cid)
+                        break
+                    _sock.read_portal.append_user_data(memoryview(chunk))
+                    self._pump_passthrough(_cid, _sock, messenger)
+                if _sock.failed:
+                    break
+
+        q = self._pt_queues[conn_id] = ExecutionQueue(
+            executor, name=f"native_pt_{conn_id}")
+        return q
+
+    def _on_http(self, conn_id: int, buf) -> None:
+        """Inline servers process on the loop thread (zero handoffs —
+        the usercode_inline contract: handlers never block).  Otherwise
+        the message runs on the per-connection ExecutionQueue, keeping
+        user dispatch off the shared IO loop while preserving the
+        request-order response discipline (ADVICE r5 #1)."""
+        sock = self._sock(conn_id)
+        if sock is None:
+            return
+        if self._server.options.usercode_inline:
+            self._process_http(conn_id, sock, buf)
+            return
+        self._conn_queue(conn_id, sock).execute(("http", buf))
 
     def _on_bytes(self, conn_id: int, buf) -> None:
         """Passthrough gulp: the engine recognized none of its natively-
@@ -535,11 +619,8 @@ class NativeBridge:
         registered protocol (≈ input_messenger.cpp:329's all-protocols
         loop), with tpu_std and HTTP/1.x still cut in C++.
 
-        Inline servers process on the loop thread (the usercode_inline
-        contract: handlers never block).  Otherwise the gulps run on a
-        per-connection ExecutionQueue — service code stays OFF the IO
-        loop (the bridge's EV_MESSAGE contract) while gulp order and
-        the portal's single-consumer discipline are preserved."""
+        Inline servers process on the loop thread; otherwise the gulps
+        ride the per-connection ExecutionQueue (see _conn_queue)."""
         sock = self._sock(conn_id)
         if sock is None:
             return
@@ -551,20 +632,7 @@ class NativeBridge:
             sock.read_portal.append_user_data(memoryview(buf))
             self._pump_passthrough(conn_id, sock, messenger)
             return
-        q = self._pt_queues.get(conn_id)
-        if q is None:
-            from ..fiber.execution_queue import ExecutionQueue
-
-            def executor(it, _cid=conn_id, _sock=sock, _msgr=messenger):
-                for chunk in it:
-                    _sock.read_portal.append_user_data(memoryview(chunk))
-                    self._pump_passthrough(_cid, _sock, _msgr)
-                    if _sock.failed:
-                        break
-
-            q = self._pt_queues[conn_id] = ExecutionQueue(
-                executor, name=f"native_pt_{conn_id}")
-        q.execute(buf)
+        self._conn_queue(conn_id, sock).execute(("bytes", buf))
 
     def _pump_passthrough(self, conn_id: int, sock, messenger) -> None:
         try:

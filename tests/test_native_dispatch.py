@@ -270,6 +270,66 @@ def test_native_dispatch_concurrent_callers(native_server):
     assert _native_count(srv, "N.Echo")[0] == 200
 
 
+def test_slim_and_raw_coexist_one_server():
+    """A service mixing raw methods and plain (cntl, request) methods:
+    raw rides kinds 0/2, plain rides the slim lane (kind 3) — on the
+    same connection, interleaved."""
+    require_native()
+
+    class Mixed(Service):
+        @raw_method
+        def Raw(self, payload, attachment):
+            return bytes(payload) + b"!"
+
+        def Full(self, cntl, request):
+            return b"full:" + bytes(request)
+
+    opts = ServerOptions()
+    opts.native = True
+    opts.usercode_inline = True
+    srv = Server(opts)
+    srv.add_service(Mixed(), name="X")
+    assert srv.start("127.0.0.1:0") == 0
+    try:
+        ch = _ch(srv)
+        for i in range(3):
+            r, _ = ch.call_raw("X.Raw", b"r%d" % i, timeout_ms=5_000)
+            assert bytes(r) == b"r%d!" % i
+            c = ch.call_method("X.Full", b"f%d" % i, cntl=Controller())
+            assert not c.failed and bytes(c.response) == b"full:f%d" % i
+        assert _native_count(srv, "X.Raw")[0] == 3
+        assert _native_count(srv, "X.Full")[0] == 3
+    finally:
+        srv.stop()
+
+
+def test_slim_pipelined_batch():
+    """call_batch against a plain (cntl, request) method: the whole
+    burst is parsed by the engine and dispatched through the slim shim
+    in batched GIL entries, responses cid-matched."""
+    require_native()
+
+    class Plain(Service):
+        def Ident(self, cntl, request):
+            return bytes(request)
+
+    opts = ServerOptions()
+    opts.native = True
+    opts.usercode_inline = True
+    srv = Server(opts)
+    srv.add_service(Plain(), name="B")
+    assert srv.start("127.0.0.1:0") == 0
+    try:
+        ch = _ch(srv)
+        reqs = [b"b%04d" % i for i in range(300)]
+        out = ch.call_batch("B.Ident", reqs, timeout_ms=10_000)
+        assert len(out) == 300
+        assert all(bytes(o) == r for o, r in zip(out, reqs))
+        assert _native_count(srv, "B.Ident")[0] >= 1   # slim lane used
+    finally:
+        srv.stop()
+
+
 def test_malformed_meta_never_crashes_engine(native_server):
     """Fuzz-shaped metas against the native scanner: truncated TLV
     lengths, zero-length names, lengths past the body — the engine must

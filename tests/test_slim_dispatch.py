@@ -1,0 +1,460 @@
+"""Slim native server-side dispatch (engine kind 3) — adversarial suite.
+
+Contract under test (server/slim_dispatch.py + engine.cpp kind 3): an
+eligible unary (cntl, request) method on a native inline server is
+dispatched by the C++ engine straight to the shim in one batched GIL
+entry and its response frame is built natively — while staying
+BYTE-IDENTICAL with the classic Python dispatch, preserving
+MethodStatus accounting, concurrency admission, and rpcz sampling, and
+falling back to the classic path for everything the slim frame cannot
+express.
+"""
+
+import socket as pysock
+import struct
+import threading
+import time
+
+import pytest
+
+from brpc_tpu.butil.flags import get_flag, set_flag
+from brpc_tpu.butil.status import Errno
+from brpc_tpu.client import Channel, ChannelOptions, Controller
+from brpc_tpu.protocol.meta import (RpcMeta, TLV_ATTACHMENT,
+                                    TLV_CORRELATION, encode_tlv)
+from brpc_tpu.server import Server, ServerOptions, Service
+
+from conftest import require_native  # noqa: E402
+
+
+class SlimSvc(Service):
+    def __init__(self):
+        self.calls = []        # thread names, to see where dispatch ran
+
+    def Echo(self, cntl, request):
+        self.calls.append(threading.current_thread().name)
+        cntl.response_attachment.append_iobuf(cntl.request_attachment)
+        return b"ok:" + bytes(request)
+
+    def Boom(self, cntl, request):
+        raise ValueError("kapow")
+
+    def SetFail(self, cntl, request):
+        cntl.set_failed(Errno.EREQUEST, "refused politely")
+        return None
+
+    def Later(self, cntl, request):
+        cntl.begin_async()
+        data = bytes(request)
+
+        def finisher():
+            time.sleep(0.05)
+            cntl.finish(b"async:" + data)
+
+        threading.Thread(target=finisher, daemon=True).start()
+        return None
+
+
+def _server(native: bool, **opt_kw):
+    opts = ServerOptions()
+    if native:
+        opts.native = True
+        opts.usercode_inline = True
+        opts.native_loops = 1
+    for k, v in opt_kw.items():
+        setattr(opts, k, v)
+    svc = SlimSvc()
+    srv = Server(opts)
+    srv.add_service(svc, name="S")
+    assert srv.start("127.0.0.1:0") == 0
+    return srv, svc
+
+
+def _channel(srv):
+    co = ChannelOptions()
+    co.connection_type = "pooled"
+    ch = Channel(co)
+    ch.init(str(srv.listen_endpoint))
+    return ch
+
+
+def _native_count(srv, name):
+    stats = srv._native_bridge.engine.native_stats()
+    return stats.get(name, (0, 0))
+
+
+def _raw_exchange(ep, frame: bytes) -> bytes:
+    """Send one crafted frame, read one complete TRPC response frame —
+    the raw wire bytes, for byte-identity comparisons."""
+    with pysock.create_connection((str(ep.host), ep.port), timeout=10) as c:
+        c.sendall(frame)
+        c.settimeout(10)
+        buf = b""
+        while len(buf) < 12:
+            buf += c.recv(65536)
+        (blen,) = struct.unpack_from("<I", buf, 4)
+        while len(buf) < 12 + blen:
+            buf += c.recv(65536)
+        return buf[:12 + blen]
+
+
+def _frame(cid: int, svc: bytes, mth: bytes, payload: bytes,
+           att: bytes = b"", extra_meta: bytes = b"") -> bytes:
+    mb = TLV_CORRELATION + struct.pack("<Q", cid)
+    if att:
+        mb += TLV_ATTACHMENT + struct.pack("<I", len(att))
+    mb += encode_tlv(4, svc) + encode_tlv(5, mth) + extra_meta
+    body = mb + payload + att
+    return b"TRPC" + struct.pack("<II", len(body), len(mb)) + body
+
+
+@pytest.fixture()
+def rpcz_off():
+    """The byte-identity comparisons must exercise the slim FAST path —
+    a sampled span escalates to the classic completion (byte-identical
+    by construction, so it would vacuously pass)."""
+    prev = get_flag("enable_rpcz", True)
+    set_flag("enable_rpcz", False)
+    yield
+    set_flag("enable_rpcz", prev)
+
+
+@pytest.fixture()
+def pair(rpcz_off):
+    require_native()
+    nsrv, nsvc = _server(native=True)
+    psrv, psvc = _server(native=False)
+    yield (nsrv, nsvc, psrv, psvc)
+    nsrv.stop()
+    psrv.stop()
+
+
+# ---- (a) slim vs classic: byte-identical responses --------------------
+
+def test_byteident_plain(pair):
+    nsrv, nsvc, psrv, psvc = pair
+    f = _frame(77, b"S", b"Echo", b"hello")
+    nat = _raw_exchange(nsrv.listen_endpoint, f)
+    cls = _raw_exchange(psrv.listen_endpoint, f)
+    assert nat == cls
+    assert _native_count(nsrv, "S.Echo")[0] == 1
+    assert nsvc.calls and psvc.calls      # the handler ran on both
+
+
+def test_byteident_attachment(pair):
+    nsrv, _, psrv, _ = pair
+    f = _frame(78, b"S", b"Echo", b"pay", att=b"A" * 300)
+    nat = _raw_exchange(nsrv.listen_endpoint, f)
+    cls = _raw_exchange(psrv.listen_endpoint, f)
+    assert nat == cls
+    # sanity: the response carries the echoed attachment TLV
+    meta_len = struct.unpack_from("<I", nat, 8)[0]
+    meta = RpcMeta.decode(nat[12:12 + meta_len])
+    assert meta.correlation_id == 78 and meta.attachment_size == 300
+
+
+def test_byteident_handler_exception(pair):
+    nsrv, _, psrv, _ = pair
+    f = _frame(79, b"S", b"Boom", b"x")
+    nat = _raw_exchange(nsrv.listen_endpoint, f)
+    cls = _raw_exchange(psrv.listen_endpoint, f)
+    assert nat == cls
+    meta = RpcMeta.decode(nat[12:12 + struct.unpack_from("<I", nat, 8)[0]])
+    assert meta.error_code == int(Errno.EINTERNAL)
+    assert "ValueError: kapow" in meta.error_text
+
+
+def test_byteident_set_failed(pair):
+    nsrv, _, psrv, _ = pair
+    f = _frame(80, b"S", b"SetFail", b"x")
+    nat = _raw_exchange(nsrv.listen_endpoint, f)
+    cls = _raw_exchange(psrv.listen_endpoint, f)
+    assert nat == cls
+    meta = RpcMeta.decode(nat[12:12 + struct.unpack_from("<I", nat, 8)[0]])
+    assert meta.error_code == int(Errno.EREQUEST)
+    assert meta.error_text == "refused politely"
+
+
+def test_byteident_malformed_attachment(pair):
+    """Attachment-size TLV exceeding the body: the engine answers
+    EREQUEST with the same text the classic split_attachment path
+    raises — without entering the handler."""
+    nsrv, nsvc, psrv, psvc = pair
+    mb = (TLV_CORRELATION + struct.pack("<Q", 81)
+          + TLV_ATTACHMENT + struct.pack("<I", 999)
+          + encode_tlv(4, b"S") + encode_tlv(5, b"Echo"))
+    f = b"TRPC" + struct.pack("<II", len(mb) + 4, len(mb)) + mb + b"zzzz"
+    nat = _raw_exchange(nsrv.listen_endpoint, f)
+    cls = _raw_exchange(psrv.listen_endpoint, f)
+    assert nat == cls
+    meta = RpcMeta.decode(nat[12:12 + struct.unpack_from("<I", nat, 8)[0]])
+    assert meta.error_code == int(Errno.EREQUEST)
+    assert not nsvc.calls and not psvc.calls
+
+
+def test_byteident_admission_reject(pair):
+    """ELIMIT from the concurrency gate: the shim's classic error
+    builder must produce the same frame as the classic dispatch."""
+    nsrv, _, psrv, _ = pair
+    for srv in (nsrv, psrv):
+        status = srv.find_method("S", "Echo").status
+        status.max_concurrency = 1
+        status._inflight = 1          # saturate the cap deterministically
+    f = _frame(82, b"S", b"Echo", b"x")
+    nat = _raw_exchange(nsrv.listen_endpoint, f)
+    cls = _raw_exchange(psrv.listen_endpoint, f)
+    assert nat == cls
+    meta = RpcMeta.decode(nat[12:12 + struct.unpack_from("<I", nat, 8)[0]])
+    assert meta.error_code == int(Errno.ELIMIT)
+
+
+def test_async_method_over_slim_lane(pair):
+    """begin_async + finish from another thread: the shim returns None
+    (out-of-band) and the classic completion sends the response."""
+    nsrv, _, psrv, _ = pair
+    f = _frame(83, b"S", b"Later", b"zz")
+    nat = _raw_exchange(nsrv.listen_endpoint, f)
+    cls = _raw_exchange(psrv.listen_endpoint, f)
+    assert nat == cls
+    meta_len = struct.unpack_from("<I", nat, 8)[0]
+    assert nat[12 + meta_len:] == b"async:zz"
+
+
+# ---- (b) fallback triggers take the Python path -----------------------
+
+def test_fallback_traced_request(pair):
+    nsrv, nsvc, _, _ = pair
+    ch = _channel(nsrv)
+    cntl = Controller()
+    cntl.timeout_ms = 5_000
+    cntl.trace_id = 4242
+    c = ch.call_method("S.Echo", b"traced", cntl=cntl)
+    assert not c.failed and bytes(c.response) == b"ok:traced"
+    assert _native_count(nsrv, "S.Echo")[0] == 0
+    assert len(nsvc.calls) == 1          # classic path ran the handler
+
+
+def test_fallback_large_attachment(pair):
+    """Attachments over the slim threshold (16KB) take the classic
+    path; under it they ride the slim lane.  Both answer correctly."""
+    from brpc_tpu.butil.iobuf import IOBuf
+
+    nsrv, nsvc, _, _ = pair
+    ch = _channel(nsrv)
+    small, big = bytes(1024), bytes(20 * 1024)
+    for att, expect_native in ((small, 1), (big, 1)):
+        cntl = Controller()
+        cntl.timeout_ms = 10_000
+        cntl.request_attachment = IOBuf(att)
+        c = ch.call_method("S.Echo", b"p", cntl=cntl)
+        assert not c.failed, c.error_text
+        assert c.response_attachment.to_bytes() == att
+    # exactly ONE of the two rode the slim lane (the small one)
+    assert _native_count(nsrv, "S.Echo")[0] == 1
+    assert len(nsvc.calls) == 2
+
+
+def test_fallback_stream_tag(pair):
+    """A controller-tier tag (stream window) in the meta bypasses the
+    slim lane — the classic dispatch owns anything stream-shaped."""
+    nsrv, nsvc, _, _ = pair
+    f = _frame(84, b"S", b"Echo", b"sw",
+               extra_meta=encode_tlv(14, struct.pack("<I", 4096)))
+    nat = _raw_exchange(nsrv.listen_endpoint, f)
+    meta_len = struct.unpack_from("<I", nat, 8)[0]
+    assert nat[12 + meta_len:] == b"ok:sw"
+    assert _native_count(nsrv, "S.Echo")[0] == 0
+    assert len(nsvc.calls) == 1
+
+
+def test_fallback_auth_server_not_registered(rpcz_off):
+    """An auth-bearing server registers NOTHING with the engine: every
+    request must be observable by the verifier."""
+    require_native()
+
+    class Auth:
+        def verify(self, auth_data, cntl):
+            return True
+
+    srv, svc = _server(native=True, auth=Auth())
+    try:
+        assert srv._native_bridge.engine.native_stats() == {}
+        co = ChannelOptions()
+        co.connection_type = "pooled"
+        co.auth_data = b"tok"
+        ch = Channel(co)
+        ch.init(str(srv.listen_endpoint))
+        c = ch.call_method("S.Echo", b"a", cntl=Controller())
+        assert not c.failed and bytes(c.response) == b"ok:a"
+        assert len(svc.calls) == 1
+    finally:
+        srv.stop()
+
+
+def test_non_inline_server_keeps_python_path(rpcz_off):
+    """usercode_inline=False: user code must stay off the engine loops,
+    so the slim lane (and kind 2) must not register."""
+    require_native()
+    opts = ServerOptions()
+    opts.native = True
+    opts.usercode_inline = False
+    opts.native_loops = 1
+    svc = SlimSvc()
+    srv = Server(opts)
+    srv.add_service(svc, name="S")
+    assert srv.start("127.0.0.1:0") == 0
+    try:
+        assert srv._native_bridge.engine.native_stats() == {}
+        ch = _channel(srv)
+        c = ch.call_method("S.Echo", b"ni", cntl=Controller())
+        assert not c.failed and bytes(c.response) == b"ok:ni"
+        # dispatched on a fiber, not an engine loop thread
+        assert not any(n.startswith("native-loop") for n in svc.calls)
+    finally:
+        srv.stop()
+
+
+# ---- (c) MethodStatus + rpcz survive native dispatch ------------------
+
+def test_method_status_survives_slim_dispatch(rpcz_off):
+    require_native()
+    srv, svc = _server(native=True)
+    try:
+        ch = _channel(srv)
+        entry = srv.find_method("S", "Echo")
+        base = entry.status.latency.count()
+        for i in range(7):
+            c = ch.call_method("S.Echo", b"m%d" % i, cntl=Controller())
+            assert not c.failed
+        assert _native_count(srv, "S.Echo")[0] == 7
+        assert entry.status.latency.count() == base + 7
+        assert entry.status.inflight == 0
+        # errors are accounted too (escalated through the classic path)
+        c = ch.call_method("S.Boom", b"x", cntl=Controller())
+        assert c.failed
+        boom = srv.find_method("S", "Boom")
+        assert boom.status.errors.get_value() >= 1
+        assert boom.status.inflight == 0
+    finally:
+        srv.stop()
+
+
+def test_rpcz_sampled_spans_survive_slim_dispatch():
+    require_native()
+    import brpc_tpu.rpcz as rpcz
+
+    prev = get_flag("enable_rpcz", True)
+    set_flag("enable_rpcz", True)
+    srv, svc = _server(native=True)
+    try:
+        ch = _channel(srv)
+        before = {id(s) for s in rpcz.global_span_store().recent(2048)}
+        for i in range(3):
+            c = ch.call_method("S.Echo", b"sp", cntl=Controller())
+            assert not c.failed
+        spans = [s for s in rpcz.global_span_store().recent(2048)
+                 if id(s) not in before and s.full_method == "S.Echo"
+                 and s.is_server]
+        assert spans, "no sampled server span recorded via the slim lane"
+        s = spans[0]
+        assert s.request_size > 0 and s.end_us >= s.received_us
+    finally:
+        srv.stop()
+        set_flag("enable_rpcz", prev)
+
+
+def test_slim_concurrency_limited_method_still_limited(rpcz_off):
+    """A per-method cap stays ENFORCED on the slim lane (the shim runs
+    admission) — unlike raw kinds, the method still registers."""
+    require_native()
+    opts = ServerOptions()
+    opts.native = True
+    opts.usercode_inline = True
+    opts.native_loops = 1
+    opts.method_max_concurrency = {"S.Echo": 4}
+    svc = SlimSvc()
+    srv = Server(opts)
+    srv.add_service(svc, name="S")
+    assert srv.start("127.0.0.1:0") == 0
+    try:
+        ch = _channel(srv)
+        c = ch.call_method("S.Echo", b"lim", cntl=Controller())
+        assert not c.failed and bytes(c.response) == b"ok:lim"
+        assert _native_count(srv, "S.Echo")[0] == 1   # slim lane active
+        status = srv.find_method("S", "Echo").status
+        status._inflight = 4          # saturate the cap deterministically
+        cntl = Controller()
+        cntl.timeout_ms = 5_000
+        cntl.max_retry = 0
+        c = ch.call_method("S.Echo", b"over", cntl=cntl)
+        assert c.failed and c.error_code == int(Errno.ELIMIT)
+        status._inflight = 0
+    finally:
+        srv.stop()
+
+
+# ---- (d) blocking handlers on a non-inline server ---------------------
+
+def test_blocking_http_handler_does_not_stall_other_conns():
+    """ADVICE r5 #1: on a non-inline native server, EV_HTTP dispatch
+    rides a per-connection ExecutionQueue — one blocking HTTP handler
+    must stall neither tpu_std traffic nor other HTTP connections."""
+    require_native()
+    import http.client
+
+    release = threading.Event()
+    entered = threading.Event()
+
+    class Mixed(Service):
+        def Block(self, cntl, request):
+            entered.set()
+            release.wait(15)
+            return b"released"
+
+        def Fast(self, cntl, request):
+            return b"fast"
+
+    opts = ServerOptions()
+    opts.native = True
+    opts.usercode_inline = False
+    opts.native_loops = 1
+    srv = Server(opts)
+    srv.add_service(Mixed(), name="M")
+    assert srv.start("127.0.0.1:0") == 0
+    try:
+        ep = srv.listen_endpoint
+        results = {}
+
+        def blocked_http():
+            conn = http.client.HTTPConnection(ep.host, ep.port,
+                                              timeout=20)
+            conn.request("POST", "/M/Block", body=b"")
+            results["block"] = conn.getresponse().read()
+            conn.close()
+
+        t = threading.Thread(target=blocked_http, daemon=True)
+        t.start()
+        assert entered.wait(10), "blocking handler never entered"
+
+        # another HTTP connection proceeds while the first one blocks
+        t0 = time.monotonic()
+        conn2 = http.client.HTTPConnection(ep.host, ep.port, timeout=10)
+        conn2.request("POST", "/M/Fast", body=b"")
+        assert conn2.getresponse().read() == b"fast"
+        conn2.close()
+        http_latency = time.monotonic() - t0
+
+        # tpu_std traffic proceeds too
+        t0 = time.monotonic()
+        ch = _channel(srv)
+        c = ch.call_method("M.Fast", b"", cntl=Controller())
+        assert not c.failed and bytes(c.response) == b"fast"
+        rpc_latency = time.monotonic() - t0
+
+        release.set()
+        t.join(10)
+        assert results.get("block") == b"released"
+        assert http_latency < 5.0 and rpc_latency < 5.0
+    finally:
+        release.set()
+        srv.stop()
