@@ -547,6 +547,74 @@ def bench_http(extra: dict) -> None:
         finally:
             srv.stop()
 
+    def measure_pipelined(burst: int = 32, seconds: float = 1.5,
+                          rounds: int = 3):
+        """Keep-alive PIPELINED bursts on a raw socket — the HTTP
+        analogue of sweep_64b_pipelined_qps — measured through the
+        SLIM HTTP LANE (engine kind 4) and the classic EV_HTTP lane
+        INTERLEAVED in the same process on the same connection
+        (set_http_slim toggles per phase), so the slim_vs_classic
+        ratio stays honest on gVisor-class boxes where absolute
+        numbers are meaningless."""
+        import socket as psock
+
+        opts = ServerOptions()
+        opts.native = True
+        opts.native_loops = 1
+        opts.usercode_inline = True
+        srv = Server(opts)
+        srv.add_service(HttpEcho(), name="H")
+        assert srv.start("127.0.0.1:0") == 0
+        try:
+            ep = srv.listen_endpoint
+            eng = srv._native_bridge.engine
+            body = bytes(1024)
+            req = (b"POST /H/Echo HTTP/1.1\r\nHost: b\r\n"
+                   b"Content-Length: 1024\r\n"
+                   b"Content-Type: application/octet-stream\r\n\r\n"
+                   + body)
+            conn = psock.create_connection((ep.host, ep.port),
+                                           timeout=10)
+            conn.setsockopt(psock.IPPROTO_TCP, psock.TCP_NODELAY, 1)
+            # learn the exact response size once (both lanes are
+            # byte-identical — enforced by tests/test_http_slim.py)
+            conn.sendall(req)
+            buf = b""
+            while b"\r\n\r\n" not in buf:
+                buf += conn.recv(65536)
+            head, _, rest = buf.partition(b"\r\n\r\n")
+            clen = int([l.split(b":")[1] for l in head.split(b"\r\n")
+                        if l.lower().startswith(b"content-length")][0])
+            resp_len = len(head) + 4 + clen
+            while len(buf) < resp_len:
+                buf += conn.recv(65536)
+            blob = req * burst
+            want = resp_len * burst
+
+            def phase(slim_on: bool, secs: float) -> float:
+                eng.set_http_slim(slim_on)
+                n = 0
+                t0 = time.perf_counter()
+                while time.perf_counter() - t0 < secs:
+                    conn.sendall(blob)
+                    got = 0
+                    while got < want:
+                        got += len(conn.recv(min(65536, want - got)))
+                    n += burst
+                return n / (time.perf_counter() - t0)
+
+            phase(True, 0.2)                  # warm both lanes
+            phase(False, 0.2)
+            slim = classic = 0.0
+            for _ in range(rounds):           # interleaved A/B rounds
+                slim += phase(True, seconds / rounds)
+                classic += phase(False, seconds / rounds)
+            eng.set_http_slim(True)
+            conn.close()
+            return round(slim / rounds, 1), round(classic / rounds, 1)
+        finally:
+            srv.stop()
+
     qps, p50, p99 = measure(native=True)
     extra["http_1kb_qps"] = qps
     if p50 is not None:
@@ -556,6 +624,15 @@ def bench_http(extra: dict) -> None:
         extra["http_1kb_qps_c16"] = measure_load(16)
     except Exception as e:
         extra["http_c16_error"] = f"{type(e).__name__}: {e}"[:120]
+    try:
+        slim_qps, classic_qps = measure_pipelined()
+        extra["http_1kb_pipelined_qps"] = slim_qps
+        extra["http_1kb_pipelined_classic_qps"] = classic_qps
+        if classic_qps:
+            extra["http_slim_vs_classic"] = round(slim_qps / classic_qps,
+                                                  2)
+    except Exception as e:
+        extra["http_pipelined_error"] = f"{type(e).__name__}: {e}"[:120]
     qps, p50, p99 = measure(native=False)
     extra["http_1kb_pytransport_qps"] = qps
     if p99 is not None:

@@ -164,6 +164,23 @@ struct WriteItem {
                                      // deleted on completion, no copy
 };
 
+// Incremental chunked-body accumulation (ADVICE r5 #4): a chunked
+// request outgrowing the inbuf streams its RAW bytes (headers + chunk
+// framing, exactly as received — the EV_HTTP contract) into `acc`
+// while this FSM tracks chunk boundaries across reads, so the message
+// is bounded by http_max_body instead of the 128KB inbuf.  The phase
+// walk mirrors http_walk_chunks below — a change to either MUST be
+// mirrored in the other.
+struct ChunkState {
+  std::string acc;       // raw message bytes so far
+  size_t cap = 0;        // header length + http_max_body at entry
+  int phase = 0;         // 0 size-line, 1 data, 2 CR, 3 LF, 4 trailer
+  size_t remaining = 0;  // data bytes left in the current chunk
+  size_t line = 0;       // chars accumulated in the current line
+  char first = 0;        // first char of the current trailer line
+  char szline[34];       // current chunk-size line (hex + extensions)
+};
+
 struct Conn {
   int fd = -1;
   uint64_t id = 0;
@@ -174,6 +191,12 @@ struct Conn {
   // write queue drains (EPOLLOUT-armed) or this deadline passes —
   // short writev/EAGAIN must not truncate a final response
   int64_t close_deadline = 0;
+  // HTTP sniff commitment (ADVICE r5 #5): 0 = prefix matched a method
+  // token but the request line has not yet shown " HTTP/1." — the conn
+  // must not be held by the HTTP cutter forever; 1 = committed.
+  uint8_t http_state = 0;
+  int64_t sniff_deadline = 0;   // armed while uncommitted bytes wait
+  ChunkState* chunk = nullptr;  // in-flight over-inbuf chunked message
 
   // read state: fixed buffer, no zero-fill churn (vector::resize would
   // memset 64KB per recv)
@@ -217,6 +240,9 @@ struct Loop {
   std::vector<uint64_t> pending_close;
   // conns in close-after-flush linger (owned-loop state, no lock)
   std::vector<uint64_t> lingering;
+  // conns holding a sniffed-HTTP prefix not yet committed by the
+  // " HTTP/1." marker (owned-loop state; swept on the epoll tick)
+  std::vector<uint64_t> sniffing;
   // Py_buffer releases deferred until we hold the GIL anyway
   std::vector<Py_buffer> decrefs;
   std::mutex decref_mu;
@@ -244,20 +270,42 @@ struct NativeMethod {
   std::atomic<uint64_t> errors{0};    // EREQUEST answers (malformed att)
 };
 
-// One buffered-path request bound for a kind=2/3 Python handler.  The
-// payload/dom/conn pointers aim into the connection's inbuf and are
-// valid only until parse_frames returns — every exit path flushes the
-// batch first.
+// An HTTP route the engine dispatches through the SLIM HTTP LANE
+// (kind 4): the request line + headers of an eligible HTTP/1.1
+// message are parsed in C++, the per-route shim
+// (server/http_slim.py) runs admission/MethodStatus/rpcz in the
+// burst's single batched GIL entry, and the engine serializes the
+// (status, headers, body) return natively into the burst's coalesced
+// writev.  Registered pre-listen; read-only afterwards.
+struct HttpRoute {
+  PyObject* handler = nullptr;
+  std::atomic<uint64_t> count{0};     // requests through the slim lane
+  std::atomic<uint64_t> errors{0};    // shim raised / bad return shape
+};
+
+// One buffered-path request bound for a kind=2/3 Python handler, or a
+// kind-4 slim-HTTP request (hroute set).  The payload/dom/conn/query/
+// ctype pointers aim into the connection's inbuf and are valid only
+// until parse_frames returns — every exit path flushes the batch first.
 struct PyRawItem {
   NativeMethod* m;
   uint64_t cid;
-  const char* payload;   // body past the meta (payload ++ attachment)
+  const char* payload;   // body past the meta (payload ++ attachment);
+                         // kind 4: the HTTP request body
   size_t plen;           // total body-after-meta length
   uint32_t att;          // attachment tail size
   const char* dom = nullptr;    // kind 3: request's ici-domain bytes
   uint32_t dom_len = 0;
   const char* conn = nullptr;   // kind 3: request's conn-nonce bytes
   uint32_t conn_len = 0;
+  // kind-4 slim-HTTP fields (hroute != nullptr selects the lane)
+  HttpRoute* hroute = nullptr;
+  const char* query = nullptr;  // bytes after '?' in the request target
+  uint32_t qlen = 0;
+  const char* ctype = nullptr;  // Content-Type header value (raw)
+  uint32_t ctlen = 0;
+  const char* attsz = nullptr;  // x-rpc-attachment-size value (raw)
+  uint32_t attszlen = 0;
 };
 
 struct EngineImpl {
@@ -276,6 +324,11 @@ struct EngineImpl {
   // (live rpc_dump capture must see every request -> Python path).
   std::unordered_map<std::string, NativeMethod*> native_methods;
   std::atomic<bool> native_dispatch{false};
+  // slim HTTP lane: "METHOD\0path" -> route.  Mutated only before
+  // listen(); loops read it lock-free.  The bool gates at runtime
+  // (tests/bench flip it to compare lanes in one process).
+  std::unordered_map<std::string, HttpRoute*> http_routes;
+  std::atomic<bool> http_slim{false};
   // pre-encoded local ici-domain TLV (empty when ici is off): kind-3
   // responses answer a request's domain exchange with it, exactly like
   // rpc_dispatch._domain_tlv on the classic fast path.  Set by the
@@ -384,6 +437,7 @@ static void conn_destroy(EngineImpl* eng, Loop* lp, Conn* c, bool notify) {
   PyGILState_Release(gs);
   if (notify) call_dispatch(eng, lp, EV_CLOSE, c->id, nullptr, 0);
   free(c->inbuf);
+  delete c->chunk;
   delete c;
 }
 
@@ -595,6 +649,110 @@ static void native_error(Conn* c, uint64_t cid, int32_t code,
   c->native_out.append(meta);
 }
 
+// defined in the HTTP section below / after this function
+static bool native_stage(Conn* c, WriteItem* follow);
+static void http_slim_respond(Conn* c, long status, const char* hdr,
+                              size_t hlen, const char* body, size_t blen);
+static void http_slim_error(Conn* c, const char* text);
+
+// Run one kind-4 slim-HTTP item: call the per-route shim and serialize
+// its (status, headers, body) return natively.  Runs under the GIL,
+// inside flush_py_batch's single per-burst acquisition.
+//
+// ORDER GUARD: a shim may complete out-of-band DURING the call
+// (progressive heads, fast async finishes) — those writes go through
+// engine.send straight into the write queue, so any slim responses
+// already accumulated in native_out must be staged into the queue
+// FIRST or the pipelined response order breaks (HTTP has no
+// correlation id).  Staging is not flushing: the burst still leaves in
+// one writev at burst end.
+static void http_slim_item(Loop* lp, Conn* c, PyRawItem& it) {
+  if (!c->native_out.empty()) native_stage(c, nullptr);
+  PyObject* body = PyBytes_FromStringAndSize(it.payload, it.plen);
+  PyObject* q = it.query
+      ? PyBytes_FromStringAndSize(it.query, it.qlen) : nullptr;
+  PyObject* ct = it.ctype
+      ? PyBytes_FromStringAndSize(it.ctype, it.ctlen) : nullptr;
+  PyObject* asz = it.attsz
+      ? PyBytes_FromStringAndSize(it.attsz, it.attszlen) : nullptr;
+  PyObject* conn = body ? PyLong_FromUnsignedLongLong(c->id) : nullptr;
+  PyObject* r = nullptr;
+  if (body && conn && (!it.query || q) && (!it.ctype || ct)
+      && (!it.attsz || asz))
+    r = PyObject_CallFunctionObjArgs(it.hroute->handler, body,
+                                     q ? q : Py_None, ct ? ct : Py_None,
+                                     asz ? asz : Py_None, conn, nullptr);
+  Py_XDECREF(body);
+  Py_XDECREF(q);
+  Py_XDECREF(ct);
+  Py_XDECREF(asz);
+  Py_XDECREF(conn);
+  if (!r) {
+    // shim raised (or OOM building args): answer a plain 500 with the
+    // exception text, keeping the keep-alive conn in sync
+    char msg[160] = "http slim shim failed";
+    PyObject *t, *v, *tb;
+    PyErr_Fetch(&t, &v, &tb);
+    if (v) {
+      PyObject* s = PyObject_Str(v);
+      if (s) {
+        const char* u = PyUnicode_AsUTF8(s);
+        if (u) snprintf(msg, sizeof msg, "%.*s", 150, u);
+        Py_DECREF(s);
+      }
+    }
+    PyErr_Clear();
+    Py_XDECREF(t); Py_XDECREF(v); Py_XDECREF(tb);
+    it.hroute->errors++;
+    http_slim_error(c, msg);
+    return;
+  }
+  if (r == Py_None) {
+    // completed (or will complete, for async methods) out-of-band
+    // through the classic write path
+    Py_DECREF(r);
+    it.hroute->count++;
+    return;
+  }
+  if (PyTuple_Check(r) && PyTuple_GET_SIZE(r) == 3) {
+    long st = PyLong_AsLong(PyTuple_GET_ITEM(r, 0));
+    Py_buffer hb = {}, bb = {};
+    if ((st == -1 && PyErr_Occurred())
+        || PyObject_GetBuffer(PyTuple_GET_ITEM(r, 1), &hb,
+                              PyBUF_SIMPLE) != 0
+        || PyObject_GetBuffer(PyTuple_GET_ITEM(r, 2), &bb,
+                              PyBUF_SIMPLE) != 0) {
+      PyErr_Clear();
+      if (hb.obj) PyBuffer_Release(&hb);
+      Py_DECREF(r);
+      it.hroute->errors++;
+      http_slim_error(c, "http slim shim returned a bad tuple");
+      return;
+    }
+    http_slim_respond(c, st, (const char*)hb.buf, (size_t)hb.len,
+                      (const char*)bb.buf, (size_t)bb.len);
+    PyBuffer_Release(&hb);
+    PyBuffer_Release(&bb);
+    Py_DECREF(r);
+    it.hroute->count++;
+    return;
+  }
+  // pre-serialized full response bytes (classic-built escalations that
+  // still must keep wire order): append verbatim
+  Py_buffer vb = {};
+  if (PyObject_GetBuffer(r, &vb, PyBUF_SIMPLE) == 0) {
+    c->native_out.append((const char*)vb.buf, (size_t)vb.len);
+    PyBuffer_Release(&vb);
+    Py_DECREF(r);
+    it.hroute->count++;
+    return;
+  }
+  PyErr_Clear();
+  Py_DECREF(r);
+  it.hroute->errors++;
+  http_slim_error(c, "http slim shim returned a non-buffer");
+}
+
 // Run a burst's worth of kind=2 Python raw handlers under ONE GIL
 // acquisition and append their responses to c->native_out (shipped by
 // the burst-end native_flush as one writev).  This is the amortized
@@ -610,6 +768,10 @@ static void flush_py_batch(Loop* lp, Conn* c,
   PyGILState_STATE gs = PyGILState_Ensure();
   flush_decrefs_locked_gil(lp);
   for (PyRawItem& it : batch) {
+    if (it.hroute) {
+      http_slim_item(lp, c, it);   // kind-4 slim-HTTP item
+      continue;
+    }
     size_t plen = it.plen - it.att;
     PyObject* r = nullptr;
     if (it.m->kind == 3) {
@@ -897,9 +1059,13 @@ static ssize_t http_walk_chunks(const char* p, size_t avail) {
 // 0 = need more bytes, -1 = not/never HTTP or malformed (close),
 // -2 = Content-Length body too large for the inbuf: *cl_total carries
 // the full message size for the direct-read path,
-// -3 = body exceeds max_body (answer 413, then close)
+// -3 = body exceeds max_body (answer 413, then close),
+// -4 = incomplete chunked body about to outgrow the inbuf: switch to
+// the incremental chunk-stream mode (bounded by max_body, not the
+// inbuf).  *hlen_out carries the header-block length (request line
+// through the blank line) whenever the headers are complete.
 static ssize_t http_cut(const char* p, size_t avail, size_t max_body,
-                        size_t* cl_total) {
+                        size_t* cl_total, size_t* hlen_out) {
   if (!http_sniff(p)) return -1;
   size_t cap = avail < kMaxHttpHeader ? avail : kMaxHttpHeader;
   const char* he = nullptr;
@@ -912,6 +1078,7 @@ static ssize_t http_cut(const char* p, size_t avail, size_t max_body,
   }
   if (!he) return avail >= kMaxHttpHeader ? -1 : 0;
   size_t hlen = (size_t)(he - p);
+  *hlen_out = hlen;
   const char* te = http_find_header(p, hlen, "transfer-encoding", 17);
   if (te != nullptr && http_value_contains(te, he, "chunked", 7)) {
     // chunked framing (any other Transfer-Encoding value keeps CL
@@ -919,11 +1086,11 @@ static ssize_t http_cut(const char* p, size_t avail, size_t max_body,
     ssize_t consumed = http_walk_chunks(he, avail - hlen);
     if (consumed < 0) return -1;
     if (consumed == 0) {
-      // total unknown up front: the accumulating message must fit the
-      // inbuf; a stream outgrowing it gets a clean 413 (the Python-
-      // transport port accepts chunked up to max_body — documented
-      // native-port limit)
-      return avail + kMaxHttpHeader >= kInbufCap ? -3 : 0;
+      // total unknown up front: once the accumulating message would
+      // outgrow the inbuf, hand it to the incremental chunk FSM
+      // (ADVICE r5 #4 — parity with the Python transport's
+      // chunked-up-to-max_body acceptance)
+      return avail + kMaxHttpHeader >= kInbufCap ? -4 : 0;
     }
     if ((size_t)consumed > max_body) return -3;
     return (ssize_t)(hlen + (size_t)consumed);
@@ -952,6 +1119,243 @@ static const char k413[] =
     "HTTP/1.1 413 Payload Too Large\r\n"
     "Content-Length: 0\r\nConnection: close\r\n\r\n";
 
+// does the (complete) request line carry the HTTP-version marker?  A
+// 4-byte method-token prefix is not proof of HTTP (redis "GET k\r\n"
+// collides) — only " HTTP/1." commits the conn to the HTTP cutter.
+static bool line_has_http_marker(const char* p, size_t len) {
+  if (len < 8) return false;
+  for (size_t i = 0; i + 8 <= len; i++)
+    if (memcmp(p + i, " HTTP/1.", 8) == 0) return true;
+  return false;
+}
+
+// bounds for the sniff commitment: a request line longer than this, or
+// one that stalls incomplete past the time budget, is arbitrated by
+// the passthrough registry instead of held by the HTTP cutter forever
+constexpr size_t kMaxHttpReqLine = 8 * 1024;
+constexpr int64_t kSniffBudgetMs = 2000;
+
+// Feed bytes to the incremental chunked-body FSM (mirror of
+// http_walk_chunks — keep the two in sync).  Consumes from [d, d+len)
+// and reports via *used how many bytes belong to THIS message.
+// Returns 1 = message complete (*used ends one past the terminal LF),
+// 0 = need more bytes (*used == len), -1 = malformed.
+static int chunk_feed(ChunkState* cs, const char* d, size_t len,
+                      size_t* used) {
+  size_t off = 0;
+  while (off < len) {
+    char ch = d[off];
+    switch (cs->phase) {
+      case 0:  // chunk-size line (hex + optional extensions).  Only a
+               // bounded prefix is STORED (the hex size lives at line
+               // start); longer extension tails are counted and
+               // skipped, matching http_walk_chunks accepting complete
+               // size lines of any length.
+        off++;
+        if (ch == '\n') {
+          size_t stored = cs->line < sizeof cs->szline - 1
+                              ? cs->line : sizeof cs->szline - 1;
+          cs->szline[stored] = '\0';
+          char* endp = nullptr;
+          long sz = strtol(cs->szline, &endp, 16);
+          // reject when nothing parsed, or when the stored prefix was
+          // truncated AND is hex to the brim (the size itself may have
+          // been cut — an absurd >32-digit size either way)
+          if (endp == cs->szline || sz < 0
+              || (cs->line > stored && *endp == '\0')) {
+            *used = off;
+            return -1;
+          }
+          cs->line = 0;
+          if (sz == 0) {
+            cs->phase = 4;           // trailers until a blank line
+            cs->first = 0;
+          } else {
+            cs->remaining = (size_t)sz;
+            cs->phase = 1;
+          }
+        } else {
+          if (cs->line < sizeof cs->szline - 1)
+            cs->szline[cs->line] = ch;
+          cs->line++;
+        }
+        break;
+      case 1: {  // chunk data
+        size_t take = len - off;
+        if (take > cs->remaining) take = cs->remaining;
+        cs->remaining -= take;
+        off += take;
+        if (cs->remaining == 0) cs->phase = 2;
+        break;
+      }
+      case 2:  // CR after chunk data
+        if (ch != '\r') { *used = off; return -1; }
+        off++;
+        cs->phase = 3;
+        break;
+      case 3:  // LF after chunk data
+        if (ch != '\n') { *used = off; return -1; }
+        off++;
+        cs->phase = 0;
+        break;
+      case 4:  // trailer lines; blank line ends the message
+        if (cs->line == 0) cs->first = ch;
+        cs->line++;
+        off++;
+        if (ch == '\n') {
+          size_t tl = cs->line - 1;              // excludes the LF
+          cs->line = 0;
+          if (tl == 0 || (tl == 1 && cs->first == '\r')) {
+            *used = off;
+            return 1;                            // terminal blank line
+          }
+        }
+        break;
+    }
+  }
+  *used = len;
+  return 0;
+}
+
+// mirror of protocol/http.py STATUS_REASONS — the slim lane's native
+// status line must be byte-identical with build_response's
+static const char* http_reason(long status) {
+  switch (status) {
+    case 200: return "OK";
+    case 204: return "No Content";
+    case 301: return "Moved Permanently";
+    case 302: return "Found";
+    case 400: return "Bad Request";
+    case 403: return "Forbidden";
+    case 404: return "Not Found";
+    case 405: return "Method Not Allowed";
+    case 500: return "Internal Server Error";
+    case 501: return "Not Implemented";
+    case 503: return "Service Unavailable";
+    default:  return "Unknown";
+  }
+}
+
+// Serialize one slim-lane response natively: status line +
+// Content-Length + the shim's pre-formatted header block ("Name: v\r\n"
+// per line, Content-Type first) + blank line + body — the exact byte
+// layout of protocol/http.py build_response(keep_alive=True).
+static void http_slim_respond(Conn* c, long status, const char* hdr,
+                              size_t hlen, const char* body,
+                              size_t blen) {
+  char line[96];
+  int n = snprintf(line, sizeof line,
+                   "HTTP/1.1 %ld %s\r\nContent-Length: %zu\r\n", status,
+                   http_reason(status), blen);
+  c->native_out.append(line, (size_t)n);
+  c->native_out.append(hdr, hlen);
+  c->native_out.append("\r\n", 2);
+  if (blen) c->native_out.append(body, blen);
+}
+
+// never-happens lane failure (shim raised / returned a bad shape):
+// answer a plain 500 so the keep-alive conn is not desynced
+static void http_slim_error(Conn* c, const char* text) {
+  size_t tl = strlen(text);
+  http_slim_respond(c, 500, "Content-Type: text/plain\r\n", 26, text,
+                    tl);
+}
+
+// Scan one complete, fully-buffered HTTP message for slim-lane
+// eligibility: HTTP/1.1, CRLF line endings, a registered METHOD+path
+// route, no Transfer-Encoding / Expect / Upgrade, Connection absent or
+// exactly keep-alive.  Fills the kind-4 PyRawItem fields (pointers
+// into the inbuf — batch lifetime rules apply).  False = take the
+// classic EV_HTTP path.
+static bool http_slim_match(EngineImpl* eng, const char* p, size_t total,
+                            size_t hlen, PyRawItem* out) {
+  const char* he = p + hlen;                    // body start
+  const char* nl = (const char*)memchr(p, '\n', hlen);
+  if (!nl) return false;
+  const char* sp1 = (const char*)memchr(p, ' ', (size_t)(nl - p));
+  if (!sp1) return false;
+  const char* sp2 =
+      (const char*)memchr(sp1 + 1, ' ', (size_t)(nl - sp1 - 1));
+  if (!sp2) return false;
+  // version token must be exactly "HTTP/1.1" with a CRLF line ending
+  if ((size_t)(nl - sp2) != 10 || memcmp(sp2 + 1, "HTTP/1.1\r", 9) != 0)
+    return false;
+  const char* tgt = sp1 + 1;
+  size_t tlen = (size_t)(sp2 - tgt);
+  const char* qm = (const char*)memchr(tgt, '?', tlen);
+  size_t path_len = qm ? (size_t)(qm - tgt) : tlen;
+  std::string key;                // "METHOD\0path" — SSO for short ones
+  key.reserve((size_t)(sp1 - p) + 1 + path_len);
+  key.append(p, (size_t)(sp1 - p));
+  key.push_back('\0');
+  key.append(tgt, path_len);
+  auto itr = eng->http_routes.find(key);
+  if (itr == eng->http_routes.end()) return false;
+  const char* ctype = nullptr;
+  uint32_t ctlen = 0;
+  const char* attsz = nullptr;
+  uint32_t attszlen = 0;
+  const char* line = nl + 1;
+  while (line < he) {
+    const char* leol =
+        (const char*)memchr(line, '\n', (size_t)(he - line));
+    if (!leol) break;
+    size_t ll = (size_t)(leol - line);          // excl LF
+    if (ll == 0 || line[ll - 1] != '\r') return false;  // demand CRLF
+    ll--;                                       // excl CR
+    if (ll == 0) break;                         // blank line: done
+    const char* col = (const char*)memchr(line, ':', ll);
+    if (!col) return false;
+    size_t nlen = (size_t)(col - line);
+    const char* v = col + 1;
+    size_t vlen = ll - nlen - 1;
+    switch (nlen) {
+      case 6:
+        if (strncasecmp(line, "expect", 6) == 0) return false;
+        break;
+      case 7:
+        if (strncasecmp(line, "upgrade", 7) == 0) return false;
+        break;
+      case 10:
+        if (strncasecmp(line, "connection", 10) == 0) {
+          while (vlen && (*v == ' ' || *v == '\t')) { v++; vlen--; }
+          while (vlen && (v[vlen - 1] == ' ' || v[vlen - 1] == '\t'))
+            vlen--;
+          if (vlen != 10 || strncasecmp(v, "keep-alive", 10) != 0)
+            return false;                       // close / upgrade / odd
+        }
+        break;
+      case 12:
+        if (strncasecmp(line, "content-type", 12) == 0) {
+          ctype = v;                            // last one wins, like
+          ctlen = (uint32_t)vlen;               // HttpHeaders.set
+        }
+        break;
+      case 17:
+        if (strncasecmp(line, "transfer-encoding", 17) == 0)
+          return false;                         // chunked OR identity
+        break;
+      case 21:
+        if (strncasecmp(line, "x-rpc-attachment-size", 21) == 0) {
+          attsz = v;
+          attszlen = (uint32_t)vlen;
+        }
+        break;
+    }
+    line = leol + 1;
+  }
+  out->hroute = itr->second;
+  out->payload = he;
+  out->plen = total - hlen;
+  out->query = qm ? qm + 1 : nullptr;
+  out->qlen = qm ? (uint32_t)(tlen - path_len - 1) : 0;
+  out->ctype = ctype;
+  out->ctlen = ctlen;
+  out->attsz = attsz;
+  out->attszlen = attszlen;
+  return true;
+}
+
 // parse as many complete frames as possible from c->inbuf / direct reads
 static bool parse_frames_inner(EngineImpl* eng, Loop* lp, Conn* c,
                                std::vector<PyRawItem>& batch) {
@@ -977,6 +1381,59 @@ static bool parse_frames_inner(EngineImpl* eng, Loop* lp, Conn* c,
     }
     c->in_start = c->in_end = 0;
     return ok;
+  }
+  if (c->chunk) {
+    // mid chunked-stream HTTP message (ADVICE r5 #4): feed new bytes
+    // through the chunk FSM; raw bytes accumulate until the terminal
+    // blank line, then ONE EV_HTTP delivers the whole message.  Burst
+    // batches are empty here — the mode consumes everything until the
+    // message completes.  The raw stream is buffered once here and
+    // copied once into the delivery NativeBuf (total size is unknown
+    // until the terminal chunk, so the CL direct-read pattern does not
+    // apply); the Python-transport chunked path pays the same
+    // fetch-then-decode double buffering, so parity holds.
+    size_t avail = c->in_end - c->in_start;
+    if (avail == 0) return true;
+    const char* p = c->inbuf + c->in_start;
+    size_t used = 0;
+    int st = chunk_feed(c->chunk, p, avail, &used);
+    c->chunk->acc.append(p, used);
+    c->in_start += used;
+    if (c->in_start == c->in_end) c->in_start = c->in_end = 0;
+    if (st < 0) return false;               // malformed chunk framing
+    if (c->chunk->acc.size() > c->chunk->cap) {
+      // raw stream outgrew http_max_body (the Python parser's too_big
+      // bound): clean 413, then close
+      c->native_out.append(k413, sizeof(k413) - 1);
+      native_flush(lp, c);
+      return false;
+    }
+    if (st == 0) return true;               // need more bytes
+    // slim responses accumulated earlier in this burst (before the -4
+    // entry) must reach the wire before Python can answer this
+    // message — HTTP responses have no correlation id
+    if (!c->native_out.empty() && !native_flush(lp, c)) return false;
+    bool ok;
+    {
+      PyGILState_STATE gs = PyGILState_Ensure();
+      flush_decrefs_locked_gil(lp);
+      NativeBuf* b = nativebuf_new((Py_ssize_t)c->chunk->acc.size());
+      ok = (b != nullptr);
+      if (ok) {
+        memcpy(b->data, c->chunk->acc.data(), c->chunk->acc.size());
+        PyObject* r = PyObject_CallFunction(
+            eng->dispatch, "iKNl", EV_HTTP, (unsigned long long)c->id,
+            (PyObject*)b, 0L);
+        if (!r) PyErr_WriteUnraisable(eng->dispatch);
+        else Py_DECREF(r);
+      }
+      PyGILState_Release(gs);
+    }
+    eng->nmessages++;
+    delete c->chunk;
+    c->chunk = nullptr;
+    if (!ok) return false;
+    // fall through: pipelined bytes after the chunked message parse on
   }
   for (;;) {
     size_t avail = c->in_end - c->in_start;
@@ -1027,10 +1484,55 @@ static bool parse_frames_inner(EngineImpl* eng, Loop* lp, Conn* c,
         // re-enter: the passthrough head delivers the buffered bytes
         return parse_frames_inner(eng, lp, c, batch);
       }
-      size_t cl_total = 0;
+      if (c->http_state == 0) {
+        // SNIFF COMMITMENT (ADVICE r5 #5): a 4-byte method-token match
+        // is not proof of HTTP.  Only a request line carrying
+        // " HTTP/1." commits the conn to the HTTP cutter; a complete
+        // line without it (or an over-long / time-stalled one, swept
+        // by the loop) goes to the passthrough registry instead of
+        // hanging here waiting for a CRLFCRLF that never comes.
+        size_t linecap = avail < kMaxHttpReqLine ? avail
+                                                 : kMaxHttpReqLine;
+        const char* nl = (const char*)memchr(p, '\n', linecap);
+        bool commit = false, arbitrate = false;
+        if (nl) {
+          if (line_has_http_marker(p, (size_t)(nl - p))) commit = true;
+          else arbitrate = true;
+        } else if (avail >= kMaxHttpReqLine) {
+          arbitrate = true;
+        }
+        if (arbitrate) {
+          flush_py_batch(lp, c, batch);
+          if (!c->native_out.empty() && !native_flush(lp, c))
+            return false;
+          c->sniff_deadline = 0;
+          c->passthrough = true;
+          return parse_frames_inner(eng, lp, c, batch);
+        }
+        if (!commit) {
+          // incomplete request line: wait, but only within the sniff
+          // budget — the loop's sweep flips a stalled conn to the
+          // passthrough registry (a slow legit HTTP client is still
+          // served there: the registry speaks HTTP too)
+          if (c->sniff_deadline == 0) {
+            c->sniff_deadline = now_ms() + kSniffBudgetMs;
+            lp->sniffing.push_back(c->id);
+          }
+          if (c->in_start > 0) {
+            flush_py_batch(lp, c, batch);
+            memmove(c->inbuf, c->inbuf + c->in_start, avail);
+            c->in_end = avail;
+            c->in_start = 0;
+          }
+          return true;
+        }
+        c->http_state = 1;
+        c->sniff_deadline = 0;
+      }
+      size_t cl_total = 0, http_hlen = 0;
       ssize_t hr = http_cut(
           p, avail, eng->http_max_body.load(std::memory_order_relaxed),
-          &cl_total);
+          &cl_total, &http_hlen);
       if (hr == -3) {
         // body over the limit: answer 413 cleanly, then close
         flush_py_batch(lp, c, batch);
@@ -1038,8 +1540,39 @@ static bool parse_frames_inner(EngineImpl* eng, Loop* lp, Conn* c,
         native_flush(lp, c);
         return false;
       }
+      if (hr == -4) {
+        // chunked body outgrowing the inbuf: stream raw bytes through
+        // the incremental chunk FSM, bounded by http_max_body
+        flush_py_batch(lp, c, batch);
+        c->chunk = new (std::nothrow) ChunkState();
+        if (!c->chunk) return false;
+        c->chunk->cap =
+            http_hlen
+            + eng->http_max_body.load(std::memory_order_relaxed);
+        size_t used = 0;
+        int st = chunk_feed(c->chunk, p + http_hlen, avail - http_hlen,
+                            &used);
+        (void)used;                    // all buffered bytes are ours
+        c->chunk->acc.assign(p, avail);
+        c->in_start = c->in_end = 0;
+        if (st < 0) return false;
+        // st == 1 cannot happen (http_walk_chunks said incomplete);
+        // more bytes arrive through the chunk head above
+        return true;
+      }
       if (hr > 0) {
-        // one complete HTTP message
+        if (eng->http_slim.load(std::memory_order_relaxed)) {
+          // SLIM HTTP LANE (kind 4): eligible messages batch with the
+          // burst and enter Python once, in flush_py_batch
+          PyRawItem hit{};
+          if (http_slim_match(eng, p, (size_t)hr, http_hlen, &hit)) {
+            c->in_start += (size_t)hr;
+            eng->nmessages++;
+            batch.push_back(hit);
+            continue;
+          }
+        }
+        // one complete HTTP message: classic EV_HTTP dispatch
         flush_py_batch(lp, c, batch);   // wire order vs earlier frames
         if (!c->native_out.empty() && !native_flush(lp, c)) return false;
         c->in_start += (size_t)hr;
@@ -1184,6 +1717,14 @@ static bool parse_frames(EngineImpl* eng, Loop* lp, Conn* c) {
   // requests already complete on the wire get processed even when a
   // later frame kills the connection (same order the Python path gives)
   flush_py_batch(lp, c, batch);
+  if (!ok && !c->native_out.empty()) {
+    // the conn is about to be destroyed, but the batch above ran side
+    // effects (user code, MethodStatus) for requests that were fully
+    // on the wire — deliver their responses best-effort before the
+    // close, like the classic path's inline sends reached the socket
+    // before a close
+    native_flush(lp, c);
+  }
   return ok;
 }
 
@@ -1193,7 +1734,12 @@ static bool conn_readable(EngineImpl* eng, Loop* lp, Conn* c) {
       // direct read of the in-flight message body
       size_t want = (size_t)c->msg->size - c->msg_filled;
       ssize_t r = recv(c->fd, c->msg->data + c->msg_filled, want, 0);
-      if (r == 0) return false;
+      if (r == 0) {
+        // peer half-closed mid-burst: deliver responses already
+        // produced for earlier pipelined requests best-effort
+        if (!c->native_out.empty()) native_flush(lp, c);
+        return false;
+      }
       if (r < 0) {
         if (errno == EAGAIN || errno == EWOULDBLOCK)
           return native_flush(lp, c);       // burst over: ship responses
@@ -1282,7 +1828,10 @@ static bool conn_readable(EngineImpl* eng, Loop* lp, Conn* c) {
     if (room > 65536) room = 65536;
     ssize_t r = recv(c->fd, c->inbuf + c->in_end, room, 0);
     if (r <= 0) {
-      if (r == 0) return false;
+      if (r == 0) {
+        if (!c->native_out.empty()) native_flush(lp, c);
+        return false;
+      }
       if (errno == EAGAIN || errno == EWOULDBLOCK)
         return native_flush(lp, c);         // burst over: ship responses
       if (errno == EINTR) continue;
@@ -1443,6 +1992,29 @@ static void loop_run(Loop* lp) {
       if (ok && (evs[i].events & EPOLLIN) && !c->closing)
         ok = conn_readable(eng, lp, c);
       if (!ok) conn_destroy(eng, lp, c, true);
+    }
+    // sniff sweep: conns holding a sniffed-HTTP prefix that never
+    // committed (" HTTP/1." unseen) within the budget are flipped to
+    // the passthrough registry — a slow legit HTTP client is still
+    // served there, and a colliding protocol gets arbitrated instead
+    // of hanging against the CRLFCRLF hunt (ADVICE r5 #5)
+    if (!lp->sniffing.empty()) {
+      int64_t now = now_ms();
+      std::vector<uint64_t> keep;
+      for (uint64_t id : lp->sniffing) {
+        auto it = lp->conns.find(id);
+        if (it == lp->conns.end()) continue;          // conn gone
+        Conn* c = it->second;
+        if (c->sniff_deadline == 0) continue;         // committed
+        if (now < c->sniff_deadline) {
+          keep.push_back(id);
+          continue;
+        }
+        c->sniff_deadline = 0;
+        c->passthrough = true;
+        if (!parse_frames(eng, lp, c)) conn_destroy(eng, lp, c, true);
+      }
+      lp->sniffing.swap(keep);
     }
     // linger sweep: closing conns that could not drain within the
     // deadline are torn down (destroyed conns are simply absent)
@@ -1620,6 +2192,87 @@ static PyObject* Engine_set_native_dispatch(EngineObj* self,
   if (!PyArg_ParseTuple(args, "p", &on)) return nullptr;
   self->eng->native_dispatch.store(on != 0, std::memory_order_relaxed);
   Py_RETURN_NONE;
+}
+
+// register_http_route(method, path, handler) — pre-listen only.  The
+// SLIM HTTP LANE (kind 4): eligible HTTP/1.1 requests matching
+// METHOD+path are parsed in C++, burst-batched, and dispatched to the
+// shim as handler(body, query, content_type, att_size, conn_id); a
+// (status, header_block, body) return is serialized natively, bytes
+// are appended verbatim (pre-built classic escalations), None means
+// the shim completed out-of-band.
+static PyObject* Engine_register_http_route(EngineObj* self,
+                                            PyObject* args) {
+  const char* method;
+  const char* path;
+  PyObject* handler;
+  if (!PyArg_ParseTuple(args, "ssO", &method, &path, &handler))
+    return nullptr;
+  EngineImpl* eng = self->eng;
+  if (eng->started) {
+    PyErr_SetString(PyExc_RuntimeError,
+                    "http routes must be registered before listen()");
+    return nullptr;
+  }
+  if (!PyCallable_Check(handler)) {
+    PyErr_SetString(PyExc_TypeError, "handler must be callable");
+    return nullptr;
+  }
+  std::string key(method);
+  key.push_back('\0');
+  key.append(path);
+  auto it = eng->http_routes.find(key);
+  HttpRoute* r = it != eng->http_routes.end() ? it->second
+                                              : new HttpRoute();
+  Py_INCREF(handler);
+  Py_XDECREF(r->handler);
+  r->handler = handler;
+  eng->http_routes[key] = r;
+  Py_RETURN_NONE;
+}
+
+static PyObject* Engine_set_http_slim(EngineObj* self, PyObject* args) {
+  int on;
+  if (!PyArg_ParseTuple(args, "p", &on)) return nullptr;
+  self->eng->http_slim.store(on != 0, std::memory_order_relaxed);
+  Py_RETURN_NONE;
+}
+
+// http_slim_stats() -> {"METHOD path": (handled, errors)}, or
+// http_slim_stats(method, path) -> (handled, errors)
+static PyObject* Engine_http_slim_stats(EngineObj* self, PyObject* args) {
+  EngineImpl* eng = self->eng;
+  const char* method = nullptr;
+  const char* path = nullptr;
+  if (!PyArg_ParseTuple(args, "|ss", &method, &path)) return nullptr;
+  if (method != nullptr && path != nullptr) {
+    std::string key(method);
+    key.push_back('\0');
+    key.append(path);
+    auto it = eng->http_routes.find(key);
+    if (it == eng->http_routes.end())
+      return Py_BuildValue("(KK)", 0ULL, 0ULL);
+    return Py_BuildValue("(KK)",
+                         (unsigned long long)it->second->count.load(),
+                         (unsigned long long)it->second->errors.load());
+  }
+  PyObject* d = PyDict_New();
+  if (!d) return nullptr;
+  for (auto& kv : eng->http_routes) {
+    std::string name = kv.first;
+    size_t z = name.find('\0');
+    if (z != std::string::npos) name[z] = ' ';
+    PyObject* t = Py_BuildValue(
+        "(KK)", (unsigned long long)kv.second->count.load(),
+        (unsigned long long)kv.second->errors.load());
+    if (!t || PyDict_SetItemString(d, name.c_str(), t) != 0) {
+      Py_XDECREF(t);
+      Py_DECREF(d);
+      return nullptr;
+    }
+    Py_DECREF(t);
+  }
+  return d;
 }
 
 static PyObject* Engine_set_domain_tlv(EngineObj* self, PyObject* args) {
@@ -1846,6 +2499,10 @@ static void Engine_dealloc(EngineObj* self) {
       Py_XDECREF(kv.second->handler);
       delete kv.second;
     }
+    for (auto& kv : self->eng->http_routes) {
+      Py_XDECREF(kv.second->handler);
+      delete kv.second;
+    }
     Py_XDECREF(self->eng->dispatch);
     delete self->eng;
   }
@@ -1873,6 +2530,16 @@ static PyMethodDef Engine_methods[] = {
      "method in C++ (kind 0=echo, 1=const); pre-listen only"},
     {"set_native_dispatch", (PyCFunction)Engine_set_native_dispatch,
      METH_VARARGS, "enable/disable GIL-free native dispatch at runtime"},
+    {"register_http_route", (PyCFunction)Engine_register_http_route,
+     METH_VARARGS,
+     "register_http_route(method, path, handler) — slim HTTP lane "
+     "route (kind 4); pre-listen only"},
+    {"set_http_slim", (PyCFunction)Engine_set_http_slim, METH_VARARGS,
+     "enable/disable the slim HTTP lane at runtime"},
+    {"http_slim_stats", (PyCFunction)Engine_http_slim_stats,
+     METH_VARARGS,
+     "http_slim_stats([method, path]) — per-route (handled, errors) "
+     "counters for the slim HTTP lane; no args returns the whole map"},
     {"native_stats", (PyCFunction)Engine_native_stats, METH_VARARGS,
      "native_stats([svc, mth]) — per-method (answered, errors) counters "
      "for native dispatch; no args returns the whole map"},
@@ -2602,11 +3269,12 @@ static PyObject* scatter_call(PyObject*, PyObject* args) {
         it.out->size = (Py_ssize_t)blen;
         res = Py_BuildValue("(ONkNN)", Py_True, (PyObject*)it.out,
                             (unsigned long)ratt, dom_obj, acks);
-        if (res) it.out = nullptr;       // ownership moved into res
+        it.out = nullptr;   // "N" consumed the reference either way —
+                            // release_all must not decref it again
       } else {
         res = Py_BuildValue("(ONkON)", Py_False, (PyObject*)it.out,
                             (unsigned long)it.meta, Py_None, acks);
-        if (res) it.out = nullptr;
+        it.out = nullptr;
       }
     }
     if (!res) { fail = true; break; }

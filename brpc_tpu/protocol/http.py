@@ -154,6 +154,15 @@ def parse(source: IOBuf, sock, read_eof: bool, arg) -> ParseResult:
     window = source.fetch(min(avail, _MAX_HEADER))
     header_end = window.find(b"\r\n\r\n")
     if header_end < 0:
+        # Commitment check (mirrors the native engine's sniff rule): a
+        # 4-byte method-token prefix is not proof of HTTP — a complete
+        # first line without the version marker (redis "GET k\r\n", any
+        # colliding protocol) must yield to the other handlers instead
+        # of holding the connection against a CRLFCRLF that never comes.
+        nl = window.find(b"\n")
+        if nl >= 0 and b" HTTP/1." not in window[:nl] \
+                and not window.startswith(b"HTTP/1."):
+            return ParseResult.try_others()
         if avail > _MAX_HEADER:
             return ParseResult.absolutely_wrong()
         return ParseResult.not_enough_data()

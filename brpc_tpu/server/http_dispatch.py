@@ -26,6 +26,13 @@ from .controller import ServerController
 PUBLIC_BUILTIN_PAGES = ("health", "version")
 
 
+def http_status_for_error(error_code: int) -> int:
+    """RPC error -> HTTP status for the bridge (shared with the slim
+    HTTP lane, server/http_slim.py — the two must map identically for
+    the lanes to stay byte-identical)."""
+    return 400 if error_code == int(Errno.EREQUEST) else 500
+
+
 def portal_restricted(server, sock, first_segment: str) -> bool:
     """True when builtin pages must be refused on this connection: an
     internal port is configured, this connection is not on it, and the
@@ -174,7 +181,7 @@ def _bridge_rpc(msg: HttpMessage, sock, server, svc: str,
         if cntl.failed:
             if cntl._progressive is not None:
                 cntl._progressive._abort()
-            code = 400 if cntl.error_code in (int(Errno.EREQUEST),) else 500
+            code = http_status_for_error(cntl.error_code)
             s.write(build_response(
                 code, cntl.error_text.encode(),
                 headers=[("x-rpc-error-code", str(cntl.error_code))],

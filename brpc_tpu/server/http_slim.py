@@ -1,0 +1,250 @@
+"""Slim native HTTP dispatch — the Python half of the engine's kind-4
+lane.
+
+The round-6 slim tpu_std lane (`slim_dispatch.py`) proved that
+per-message Python/GIL software overhead, not the wire, dominates
+small-RPC throughput.  HTTP — the protocol browsers, load balancers
+and the builtin portal actually speak — still paid that overhead once
+per message: C++ cut the message (`EV_HTTP`), then Python parsed the
+request line + headers (`protocol/http.py`), built an `HttpMessage`,
+routed it, and sent each response through its own `engine.send`.
+
+Kind 4 removes all of it from the eligible path: the C++ engine parses
+the request line + headers itself, batches every eligible HTTP/1.1
+request of a read burst, and enters Python ONCE calling the per-route
+shim built below as ``handler(body, query, content_type, att_size,
+conn_id)`` (bytes-or-None for the middle three).  The shim is the whole
+per-call Python cost of the lane:
+
+    admission   server.on_request_in + MethodStatus.on_requested —
+                503 answers ride the slim serializer, byte-identical
+                with the classic ``build_response`` output
+    sampling    rpcz spans keep their per-second budget via
+                start_slim_server_span (the classic HTTP bridge never
+                sampled; the slim lane records real sizes inline)
+    user code   entry.fn(cntl, request) with a REAL ServerController —
+                handlers keep attachments, set_failed, begin_async,
+                progressive attachments, session_local_data
+    accounting  MethodStatus.on_responded with the measured latency
+
+Return contract with the engine (flush_py_batch -> http_slim_item):
+
+    (status, header_block, body)   serialized natively — status line +
+                                   Content-Length + header_block +
+                                   CRLF + body, coalesced into the
+                                   burst's single writev.  The header
+                                   block is pre-formatted "Name: v\\r\\n"
+                                   lines, Content-Type first — exactly
+                                   build_response's layout
+    bytes                          a pre-serialized full response,
+                                   appended verbatim (keeps wire order
+                                   for classic-built edge responses)
+    None                           completed (or will complete, for
+                                   async/progressive methods) through
+                                   the classic write path
+
+Request-side ineligibility (chunked/`Expect`/`Upgrade` requests,
+`Connection: close`, HTTP/1.0, unregistered paths, over-inbuf bodies)
+never reaches the shim — the engine's header scan routes those
+messages to the classic `EV_HTTP` path byte-identically.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from urllib.parse import unquote_plus
+
+from ..butil.iobuf import IOBuf
+from ..butil.logging_util import LOG
+from ..butil.status import Errno
+from ..butil.time_utils import monotonic_us
+from ..protocol.http import build_response
+from ..protocol.meta import RpcMeta
+from ..rpcz import start_slim_server_span
+from ..transport.socket import Socket
+from .controller import ServerController
+from .http_dispatch import _encode_http_body, http_status_for_error
+
+_EREQUEST = int(Errno.EREQUEST)
+_EINTERNAL = int(Errno.EINTERNAL)
+
+_CT = b"Content-Type: "
+_CRLF = b"\r\n"
+_503_SERVER = (503, b"Content-Type: text/plain\r\n",
+               b"server max_concurrency")
+_503_METHOD = (503, b"Content-Type: text/plain\r\n",
+               b"method max_concurrency")
+
+
+def _hdr_block(ctype: str, extra) -> bytes:
+    """The slim tuple's header block: Content-Type first, then extras —
+    the exact line order build_response emits after Content-Length."""
+    out = _CT + ctype.encode("latin1") + _CRLF
+    if extra:
+        for k, v in extra:
+            out += f"{k}: {v}".encode("latin1") + _CRLF
+    return out
+
+
+def _query_to_json(query: bytes) -> bytes:
+    """Mirror of HttpMessage.query() + the GET bridge's json.dumps."""
+    out = {}
+    for pair in query.decode("latin1").split("&"):
+        if not pair:
+            continue
+        k, _, v = pair.partition("=")
+        out[unquote_plus(k)] = unquote_plus(v)
+    return json.dumps(out).encode()
+
+
+def make_http_slim_handler(bridge, server, entry, svc: str, mth: str,
+                           http_method: str):
+    """Build the kind-4 shim for one (service, method, HTTP-method)
+    route.  All per-entry state is bound into closure cells — the
+    steady-state call touches no module globals."""
+    status = entry.status
+    fn = entry.fn
+    req_type = entry.request_type
+    full_name = status.full_name
+    path = f"/{svc}/{mth}"
+    socks = bridge._socks          # conn_id -> NativeSocket (live dict)
+    is_get = http_method in ("GET", "HEAD")
+
+    def slim(body, query, ctype, attsz, conn_id):
+        sock = socks.get(conn_id)
+        if sock is None:
+            return None          # connection died mid-burst
+        if not server.on_request_in():
+            return _503_SERVER
+        if not status.on_requested():
+            server.on_request_out()
+            return _503_METHOD
+
+        meta = RpcMeta()
+        meta.service_name = svc
+        meta.method_name = mth
+
+        # Completion plumbing: while `inline` holds, the send closure
+        # parks its response in `cell` and the engine serializes it into
+        # the burst's coalesced writev; once the shim returns (async
+        # methods), completions write classically via build_response —
+        # same bytes, classic path.  The lock closes the race between a
+        # fast async finisher and the shim's return.
+        cell = []
+        inline = [True]
+        lk = threading.Lock()
+
+        def _deliver(code, body_, ctype_, extra):
+            with lk:
+                if inline[0]:
+                    cell.append((code, _hdr_block(ctype_, extra), body_))
+                    return
+            s = Socket.address(sock.id)
+            if s is not None and not s.failed:
+                s.write(build_response(code, body_, ctype_,
+                                       headers=extra, keep_alive=True))
+
+        def send(cntl, response):
+            latency_us = monotonic_us() - cntl.begin_time_us
+            status.on_responded(cntl.error_code, latency_us)
+            server.on_request_out()
+            span = cntl.span
+            if cntl.failed:
+                if cntl._progressive is not None:
+                    cntl._progressive._abort()
+                code = http_status_for_error(cntl.error_code)
+                body_ = cntl.error_text.encode()
+                if span is not None:
+                    span.response_size = len(body_)
+                    span.finish(cntl.error_code)
+                _deliver(code, body_, "text/plain",
+                         [("x-rpc-error-code", str(cntl.error_code))])
+                return
+            if cntl._progressive is not None:
+                # chunked transfer: headers out now through the classic
+                # writer (the chunk stream follows via Socket.write —
+                # the engine's order guard staged earlier slim
+                # responses first), byte-identical with _bridge_rpc
+                body_, ctype_ = _encode_http_body(response)
+                head = (b"HTTP/1.1 200 OK\r\n"
+                        b"content-type: " + ctype_.encode() + b"\r\n"
+                        b"transfer-encoding: chunked\r\n"
+                        b"connection: keep-alive\r\n\r\n")
+                first = (b"%x\r\n" % len(body_) + body_ + b"\r\n"
+                         if body_ else b"")
+                s = Socket.address(sock.id)
+                if s is not None and not s.failed:
+                    s.write(IOBuf(head + first))
+                    cntl._progressive._start()
+                if span is not None:
+                    span.response_size = len(body_)
+                    span.finish(0)
+                return
+            body_, ctype_ = _encode_http_body(response)
+            extra = None
+            att = cntl.response_attachment.to_bytes() \
+                if len(cntl.response_attachment) else b""
+            if att:
+                body_ += att
+                extra = [("x-rpc-attachment-size", str(len(att)))]
+            if span is not None:
+                span.response_size = len(body_)
+                span.finish(0)
+            _deliver(200, body_, ctype_, extra)
+
+        cntl = ServerController(meta, sock.remote_side, sock.id, send)
+        cntl.server = server
+        cntl.http_method = http_method
+        cntl.http_path = path
+        cntl.http_unresolved_path = ""
+        span = start_slim_server_span(full_name, sock.remote_side)
+        if span is not None:
+            span.request_size = len(body)
+            cntl.span = span
+
+        # request build — mirror of _bridge_rpc
+        if is_get and query:
+            request = _query_to_json(query)
+        else:
+            request = body
+            asz = (attsz.decode("latin1").strip()
+                   if attsz is not None else None)
+            if asz and asz.isdigit():
+                n = int(asz)
+                if 0 < n <= len(request):
+                    cntl.request_attachment = \
+                        IOBuf(request[len(request) - n:])
+                    request = request[:len(request) - n]
+        try:
+            from ..protocol.json2pb import maybe_parse_request
+            ct = (ctype.decode("latin1").strip()
+                  if ctype is not None else "")
+            converted = maybe_parse_request(request, req_type, ct)
+            if converted is not None:
+                request = converted          # json2pb: JSON -> pb
+            else:
+                from ..protocol.tpu_std import parse_payload
+                request = parse_payload(request, req_type)
+        except Exception as e:
+            cntl.set_failed(Errno.EREQUEST, f"request parse failed: {e}")
+            cntl.finish(None)
+            return cell[0] if cell else None
+        try:
+            response = fn(cntl, request)
+        except Exception as e:
+            LOG.exception("http method %s raised", full_name)
+            cntl.set_failed(Errno.EINTERNAL, f"{type(e).__name__}: {e}")
+            cntl.finish(None)
+            return cell[0] if cell else None
+        if cntl.is_async:
+            with lk:
+                inline[0] = False
+                # a fast finisher may have completed before we returned
+                return cell[0] if cell else None
+        cntl.finish(response)
+        with lk:
+            inline[0] = False
+            return cell[0] if cell else None
+
+    return slim

@@ -249,6 +249,59 @@ class NativeBridge:
             _install_dump_watcher()
             self.engine.set_native_dispatch(not dump_enabled())
 
+    def _register_http_routes(self) -> None:
+        """Hand eligible HTTP routes to the C++ engine — the SLIM HTTP
+        LANE (kind 4, the HTTP analogue of the kind-3 tpu_std lane):
+        the engine parses the request line + headers of eligible
+        HTTP/1.1 messages itself, batches a read burst's worth, and
+        enters Python once per burst calling a per-route shim
+        (server/http_slim.py) that keeps admission, MethodStatus and
+        rpcz; the response is serialized natively and coalesced into
+        the burst's single writev.
+
+        Gating mirrors the tpu_std slim lane: auth/interceptor servers
+        keep the full Python path (every request must be observable),
+        and the shim runs user code on the engine loop so
+        ``usercode_inline`` is required.  Raw/streaming entries and
+        everything the engine's header scan rejects (chunked, Expect,
+        Upgrade, Connection: close, HTTP/1.0, unregistered paths —
+        restful, builtin portal, dotted or slash-suffixed forms) fall
+        back to the classic EV_HTTP path byte-identically.  The shim
+        enforces both concurrency caps, so capped methods register."""
+        opts = self._server.options
+        if opts.auth is not None or opts.interceptor is not None:
+            return
+        if not opts.usercode_inline:
+            return
+        from ..bvar.passive_status import PassiveStatus
+        from ..server.http_slim import make_http_slim_handler
+        registered = False
+        for (svc, mth), entry in self._server._methods.items():
+            if entry.grpc_streaming or entry.raw_fn is not None \
+                    or entry.fn is None:
+                continue
+            path = f"/{svc}/{mth}"
+            for http_method in ("POST", "GET"):
+                shim = make_http_slim_handler(self, self._server, entry,
+                                              svc, mth, http_method)
+                self.engine.register_http_route(http_method, path, shim)
+            safe = f"{svc}_{mth}".lower()
+            eng = self.engine
+
+            def _sum(idx, _p=path, _e=eng):
+                return (_e.http_slim_stats("POST", _p)[idx]
+                        + _e.http_slim_stats("GET", _p)[idx])
+
+            self._native_vars.append(PassiveStatus(
+                lambda _s=_sum: _s(0),
+                name=f"rpc_server_{safe}_http_slim_requests"))
+            self._native_vars.append(PassiveStatus(
+                lambda _s=_sum: _s(1),
+                name=f"rpc_server_{safe}_http_slim_errors"))
+            registered = True
+        if registered:
+            self.engine.set_http_slim(True)
+
     def listen(self, listen_socket) -> None:
         listen_socket.setblocking(False)
         # the bridge owns the fd's lifetime alongside the engine
@@ -256,6 +309,7 @@ class NativeBridge:
         name = listen_socket.getsockname()
         self._local_ep = EndPoint(host=name[0], port=name[1])
         self._register_native_methods()
+        self._register_http_routes()
         from ..protocol.base import max_body_size
         self.engine.set_http_max_body(int(max_body_size()))
         # kind-3 domain-exchange answers: the local ici-domain TLV is a

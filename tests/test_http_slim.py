@@ -1,0 +1,596 @@
+"""Slim native HTTP dispatch (engine kind 4) — adversarial suite.
+
+Contract under test (server/http_slim.py + engine.cpp kind 4): an
+eligible HTTP/1.1 request to a registered /Service/Method route on a
+native inline server is parsed (request line + headers) by the C++
+engine, burst-batched into ONE GIL entry, dispatched to the per-route
+shim, and its response is serialized natively into the burst's
+coalesced writev — while staying BYTE-IDENTICAL with the classic
+EV_HTTP path (and the pure-Python transport), preserving MethodStatus
+accounting, concurrency admission and rpcz sampling, and falling back
+to the classic path for everything the slim serializer cannot express.
+
+Also regression-tests the two round-6 ADVICE fixes that ride along:
+the http_sniff prefix-collision hang (#5) and the chunked-body
+kInbufCap parity gap (#4).
+"""
+
+import socket as pysock
+import threading
+import time
+
+import pytest
+
+from brpc_tpu.butil.flags import get_flag, set_flag
+from brpc_tpu.butil.status import Errno
+from brpc_tpu.server import Server, ServerOptions, Service
+
+from conftest import require_native  # noqa: E402
+
+
+class HttpSvc(Service):
+    def __init__(self):
+        self.calls = []
+
+    def Echo(self, cntl, request):
+        self.calls.append(threading.current_thread().name)
+        cntl.response_attachment.append_iobuf(cntl.request_attachment)
+        return b"ok:" + bytes(request)
+
+    def Dict(self, cntl, request):
+        return {"got": len(request)}
+
+    def Boom(self, cntl, request):
+        raise ValueError("kapow")
+
+    def SetFail(self, cntl, request):
+        cntl.set_failed(Errno.EREQUEST, "refused politely")
+        return None
+
+    def Later(self, cntl, request):
+        cntl.begin_async()
+        data = bytes(request)
+
+        def finisher():
+            time.sleep(0.05)
+            cntl.finish(b"async:" + data)
+
+        threading.Thread(target=finisher, daemon=True).start()
+        return None
+
+    def Stream(self, cntl, request):
+        pa = cntl.create_progressive_attachment()
+
+        def writer():
+            time.sleep(0.02)
+            pa.write(b"part1-")
+            pa.write(b"part2")
+            pa.close()
+
+        threading.Thread(target=writer, daemon=True).start()
+        return b"head:"
+
+
+def _server(native: bool, **opt_kw):
+    opts = ServerOptions()
+    if native:
+        opts.native = True
+        opts.usercode_inline = True
+        opts.native_loops = 1
+    for k, v in opt_kw.items():
+        setattr(opts, k, v)
+    svc = HttpSvc()
+    srv = Server(opts)
+    srv.add_service(svc, name="S")
+    assert srv.start("127.0.0.1:0") == 0
+    return srv, svc
+
+
+def _slim_count(srv, mth, http_method="POST"):
+    return srv._native_bridge.engine.http_slim_stats(
+        http_method, f"/S/{mth}")[0]
+
+
+def _exchange(ep, raw: bytes, chunked: bool = False) -> bytes:
+    """Send raw request bytes, read one complete HTTP response
+    (Content-Length or chunked framing) — the raw wire bytes, for
+    byte-identity comparisons."""
+    with pysock.create_connection((str(ep.host), ep.port),
+                                  timeout=15) as c:
+        c.sendall(raw)
+        c.settimeout(15)
+        buf = b""
+        while b"\r\n\r\n" not in buf:
+            part = c.recv(65536)
+            if not part:
+                return buf
+            buf += part
+        head, _, rest = buf.partition(b"\r\n\r\n")
+        if chunked:
+            while not rest.endswith(b"0\r\n\r\n"):
+                part = c.recv(65536)
+                if not part:
+                    break
+                rest += part
+            return head + b"\r\n\r\n" + rest
+        clen = 0
+        for line in head.split(b"\r\n"):
+            if line.lower().startswith(b"content-length:"):
+                clen = int(line.split(b":")[1])
+        while len(rest) < clen:
+            part = c.recv(65536)
+            if not part:
+                break
+            rest += part
+        return head + b"\r\n\r\n" + rest[:clen]
+
+
+def _post(path, body=b"", headers=()):
+    h = b""
+    for k, v in headers:
+        h += k + b": " + v + b"\r\n"
+    return (b"POST " + path + b" HTTP/1.1\r\nHost: x\r\n"
+            + b"Content-Length: " + str(len(body)).encode() + b"\r\n"
+            + h + b"\r\n" + body)
+
+
+def _tri_exchange(nsrv, psrv, raw, chunked=False):
+    """The same raw request through all three lanes: slim (native),
+    classic EV_HTTP (same native server, lane gated off), and the
+    pure-Python transport.  Returns (slim, classic, pytransport)."""
+    eng = nsrv._native_bridge.engine
+    slim = _exchange(nsrv.listen_endpoint, raw, chunked)
+    eng.set_http_slim(False)
+    try:
+        classic = _exchange(nsrv.listen_endpoint, raw, chunked)
+    finally:
+        eng.set_http_slim(True)
+    pyt = _exchange(psrv.listen_endpoint, raw, chunked)
+    return slim, classic, pyt
+
+
+@pytest.fixture()
+def rpcz_off():
+    """Determinism for the byte-identity comparisons (spans never alter
+    bytes on this lane, but keep the fast path uniform)."""
+    prev = get_flag("enable_rpcz", True)
+    set_flag("enable_rpcz", False)
+    yield
+    set_flag("enable_rpcz", prev)
+
+
+@pytest.fixture()
+def pair(rpcz_off):
+    require_native()
+    nsrv, nsvc = _server(native=True)
+    psrv, psvc = _server(native=False)
+    yield (nsrv, nsvc, psrv, psvc)
+    nsrv.stop()
+    psrv.stop()
+
+
+# ---- (a) slim vs classic vs pytransport: byte-identical ---------------
+
+def test_byteident_plain_post(pair):
+    nsrv, nsvc, psrv, psvc = pair
+    raw = _post(b"/S/Echo", b"hello")
+    slim, classic, pyt = _tri_exchange(nsrv, psrv, raw)
+    assert slim == classic == pyt
+    assert slim.startswith(b"HTTP/1.1 200 OK\r\n")
+    assert slim.endswith(b"ok:hello")
+    assert _slim_count(nsrv, "Echo") == 1      # exactly the first one
+    assert len(nsvc.calls) == 2 and len(psvc.calls) == 1
+
+
+def test_byteident_json_and_get_query(pair):
+    nsrv, _, psrv, _ = pair
+    raw = _post(b"/S/Dict", b"abcdef")
+    slim, classic, pyt = _tri_exchange(nsrv, psrv, raw)
+    assert slim == classic == pyt
+    assert b"application/json" in slim and b'{"got": 6}' in slim
+    raw = b"GET /S/Echo?a=1&b=two%20words HTTP/1.1\r\nHost: x\r\n\r\n"
+    slim, classic, pyt = _tri_exchange(nsrv, psrv, raw)
+    assert slim == classic == pyt
+    assert b'"b": "two words"' in slim
+    assert _slim_count(nsrv, "Echo", "GET") == 1
+
+
+def test_byteident_attachment_roundtrip(pair):
+    nsrv, _, psrv, _ = pair
+    body = b"payload" + b"A" * 64
+    raw = _post(b"/S/Echo", body,
+                headers=((b"x-rpc-attachment-size", b"64"),))
+    slim, classic, pyt = _tri_exchange(nsrv, psrv, raw)
+    assert slim == classic == pyt
+    assert b"x-rpc-attachment-size: 64" in slim
+    assert slim.endswith(b"ok:payload" + b"A" * 64)
+
+
+def test_byteident_handler_exception(pair):
+    nsrv, _, psrv, _ = pair
+    raw = _post(b"/S/Boom", b"x")
+    slim, classic, pyt = _tri_exchange(nsrv, psrv, raw)
+    assert slim == classic == pyt
+    assert slim.startswith(b"HTTP/1.1 500 ")
+    assert b"ValueError: kapow" in slim
+    assert b"x-rpc-error-code" in slim
+
+
+def test_byteident_set_failed(pair):
+    nsrv, _, psrv, _ = pair
+    raw = _post(b"/S/SetFail", b"x")
+    slim, classic, pyt = _tri_exchange(nsrv, psrv, raw)
+    assert slim == classic == pyt
+    assert slim.startswith(b"HTTP/1.1 400 ")
+    assert b"refused politely" in slim
+
+
+def test_byteident_admission_reject(pair):
+    nsrv, _, psrv, _ = pair
+    for srv in (nsrv, psrv):
+        status = srv.find_method("S", "Echo").status
+        status.max_concurrency = 1
+        status._inflight = 1        # saturate the cap deterministically
+    try:
+        raw = _post(b"/S/Echo", b"x")
+        slim, classic, pyt = _tri_exchange(nsrv, psrv, raw)
+        assert slim == classic == pyt
+        assert slim.startswith(b"HTTP/1.1 503 ")
+        assert b"method max_concurrency" in slim
+        # the reject itself rode the slim lane (admission runs IN it)
+        assert _slim_count(nsrv, "Echo") >= 1
+    finally:
+        for srv in (nsrv, psrv):
+            status = srv.find_method("S", "Echo").status
+            status.max_concurrency = 0
+            status._inflight = 0
+
+
+def test_byteident_async_method(pair):
+    """begin_async + finish from another thread: the shim returns None
+    (out-of-band) and the classic build_response write completes it."""
+    nsrv, _, psrv, _ = pair
+    raw = _post(b"/S/Later", b"zz")
+    slim, classic, pyt = _tri_exchange(nsrv, psrv, raw)
+    assert slim == classic == pyt
+    assert slim.endswith(b"async:zz")
+    assert _slim_count(nsrv, "Later") == 1     # counted as slim-handled
+
+
+def test_byteident_progressive_attachment(pair):
+    nsrv, _, psrv, _ = pair
+    raw = _post(b"/S/Stream", b"")
+    slim, classic, pyt = _tri_exchange(nsrv, psrv, raw, chunked=True)
+    assert slim == classic == pyt
+    assert b"transfer-encoding: chunked" in slim
+    assert b"head:" in slim and b"part1-" in slim and b"part2" in slim
+
+
+def test_pipelined_burst_in_order(pair):
+    """A pipelined burst of keep-alive requests in ONE write: every
+    response returns IN REQUEST ORDER (HTTP/1.1 has no correlation id),
+    all through the slim lane, and the concatenated bytes equal the
+    classic native lane's.  (The pure-Python transport spawns a fiber
+    per pipelined message and does not guarantee response order — the
+    native lanes do, so the oracle here is the classic EV_HTTP lane.)"""
+    nsrv, _, _, _ = pair
+    burst = b"".join(_post(b"/S/Echo", b"req%d" % i) for i in range(8))
+    before = _slim_count(nsrv, "Echo")
+
+    def read_n(ep, n):
+        with pysock.create_connection((str(ep.host), ep.port),
+                                      timeout=15) as c:
+            c.sendall(burst)
+            c.settimeout(15)
+            buf = b""
+            while buf.count(b"HTTP/1.1 200") < n:
+                part = c.recv(65536)
+                if not part:
+                    break
+                buf += part
+            return buf
+
+    slim = read_n(nsrv.listen_endpoint, 8)
+    eng = nsrv._native_bridge.engine
+    eng.set_http_slim(False)
+    try:
+        classic = read_n(nsrv.listen_endpoint, 8)
+    finally:
+        eng.set_http_slim(True)
+    assert slim == classic
+    positions = [slim.index(b"ok:req%d" % i) for i in range(8)]
+    assert positions == sorted(positions)      # strict request order
+    assert _slim_count(nsrv, "Echo") == before + 8
+
+
+# ---- (b) fallback triggers take the classic path ----------------------
+
+FALLBACK_REQUESTS = [
+    ("http10", b"GET /S/Echo HTTP/1.0\r\nHost: x\r\n\r\n"),
+    ("conn_close", b"POST /S/Echo HTTP/1.1\r\nHost: x\r\n"
+     b"Connection: close\r\nContent-Length: 2\r\n\r\nxy"),
+    ("chunked", b"POST /S/Echo HTTP/1.1\r\nHost: x\r\n"
+     b"Transfer-Encoding: chunked\r\n\r\n2\r\nxy\r\n0\r\n\r\n"),
+    ("expect", b"POST /S/Echo HTTP/1.1\r\nHost: x\r\n"
+     b"Expect: 100-continue\r\nContent-Length: 2\r\n\r\nxy"),
+    ("upgrade", b"POST /S/Echo HTTP/1.1\r\nHost: x\r\n"
+     b"Upgrade: h2c\r\nConnection: keep-alive\r\n"
+     b"Content-Length: 2\r\n\r\nxy"),
+    ("trailing_slash", _post(b"/S/Echo/", b"xy")),
+    ("dotted_form", _post(b"/S.Echo", b"xy")),
+]
+
+
+@pytest.mark.parametrize("name,raw",
+                         FALLBACK_REQUESTS,
+                         ids=[n for n, _ in FALLBACK_REQUESTS])
+def test_fallback_shapes_served_classically(pair, name, raw):
+    nsrv, _, psrv, _ = pair
+    before = sum(
+        v[0] for v in nsrv._native_bridge.engine.http_slim_stats()
+        .values())
+    nat = _exchange(nsrv.listen_endpoint, raw)
+    pyt = _exchange(psrv.listen_endpoint, raw)
+    assert nat == pyt
+    assert nat.startswith(b"HTTP/1.1 200")
+    after = sum(
+        v[0] for v in nsrv._native_bridge.engine.http_slim_stats()
+        .values())
+    assert after == before, f"{name} must not ride the slim lane"
+
+
+def test_fallback_builtin_portal_and_404(pair):
+    nsrv, _, psrv, _ = pair
+    for raw in (b"GET /health HTTP/1.1\r\nHost: x\r\n\r\n",
+                b"GET /no/such/route HTTP/1.1\r\nHost: x\r\n\r\n"):
+        nat = _exchange(nsrv.listen_endpoint, raw)
+        pyt = _exchange(psrv.listen_endpoint, raw)
+        assert nat == pyt
+    stats = nsrv._native_bridge.engine.http_slim_stats()
+    assert sum(v[0] for v in stats.values()) == 0
+
+
+def test_non_inline_server_registers_nothing(rpcz_off):
+    """usercode_inline=False: user code must stay off the engine loops,
+    so no HTTP route registers; requests serve via the classic path on
+    the per-connection ExecutionQueue."""
+    require_native()
+    opts = ServerOptions()
+    opts.native = True
+    opts.usercode_inline = False
+    opts.native_loops = 1
+    svc = HttpSvc()
+    srv = Server(opts)
+    srv.add_service(svc, name="S")
+    assert srv.start("127.0.0.1:0") == 0
+    try:
+        assert srv._native_bridge.engine.http_slim_stats() == {}
+        got = _exchange(srv.listen_endpoint, _post(b"/S/Echo", b"ni"))
+        assert got.endswith(b"ok:ni")
+        assert not any(n.startswith("native-loop") for n in svc.calls)
+    finally:
+        srv.stop()
+
+
+def test_auth_server_registers_nothing(rpcz_off):
+    require_native()
+
+    class Auth:
+        def verify(self, auth_data, cntl):
+            return True
+
+    srv, _ = _server(native=True, auth=Auth())
+    try:
+        assert srv._native_bridge.engine.http_slim_stats() == {}
+        got = _exchange(srv.listen_endpoint, _post(b"/S/Echo", b"a"))
+        assert got.endswith(b"ok:a")
+    finally:
+        srv.stop()
+
+
+# ---- (c) MethodStatus + rpcz survive the slim lane --------------------
+
+def test_method_status_survives_slim_http(rpcz_off):
+    require_native()
+    srv, svc = _server(native=True)
+    try:
+        ep = srv.listen_endpoint
+        entry = srv.find_method("S", "Echo")
+        base = entry.status.latency.count()
+        for i in range(5):
+            got = _exchange(ep, _post(b"/S/Echo", b"m%d" % i))
+            assert got.endswith(b"ok:m%d" % i)
+        assert _slim_count(srv, "Echo") == 5
+        assert entry.status.latency.count() == base + 5
+        assert entry.status.inflight == 0
+        got = _exchange(ep, _post(b"/S/Boom", b"x"))
+        assert got.startswith(b"HTTP/1.1 500")
+        boom = srv.find_method("S", "Boom")
+        assert boom.status.errors.get_value() >= 1
+        assert boom.status.inflight == 0
+    finally:
+        srv.stop()
+
+
+def test_rpcz_sampled_spans_survive_slim_http():
+    require_native()
+    import brpc_tpu.rpcz as rpcz
+
+    prev = get_flag("enable_rpcz", True)
+    set_flag("enable_rpcz", True)
+    srv, _ = _server(native=True)
+    try:
+        ep = srv.listen_endpoint
+        before = {id(s) for s in rpcz.global_span_store().recent(2048)}
+        for _ in range(3):
+            got = _exchange(ep, _post(b"/S/Echo", b"sp"))
+            assert got.endswith(b"ok:sp")
+        assert _slim_count(srv, "Echo") == 3   # sampled calls stay slim
+        spans = [s for s in rpcz.global_span_store().recent(2048)
+                 if id(s) not in before and s.full_method == "S.Echo"
+                 and s.is_server]
+        assert spans, "no sampled server span recorded via the slim lane"
+        s = spans[0]
+        assert s.request_size > 0 and s.response_size > 0
+    finally:
+        srv.stop()
+        set_flag("enable_rpcz", prev)
+
+
+def test_concurrency_cap_still_enforced_on_slim_lane(rpcz_off):
+    require_native()
+    opts = ServerOptions()
+    opts.native = True
+    opts.usercode_inline = True
+    opts.native_loops = 1
+    opts.method_max_concurrency = {"S.Echo": 4}
+    svc = HttpSvc()
+    srv = Server(opts)
+    srv.add_service(svc, name="S")
+    assert srv.start("127.0.0.1:0") == 0
+    try:
+        ep = srv.listen_endpoint
+        got = _exchange(ep, _post(b"/S/Echo", b"lim"))
+        assert got.endswith(b"ok:lim")
+        assert _slim_count(srv, "Echo") == 1   # the lane is active
+        status = srv.find_method("S", "Echo").status
+        status._inflight = 4        # saturate the cap deterministically
+        got = _exchange(ep, _post(b"/S/Echo", b"over"))
+        assert got.startswith(b"HTTP/1.1 503")
+        status._inflight = 0
+    finally:
+        srv.stop()
+
+
+# ---- (d) ADVICE r5 #5: sniff prefix-collision no longer hangs ---------
+
+def test_sniff_collision_does_not_hang(pair):
+    """First 4 bytes collide with an HTTP method token but the request
+    line never carries ' HTTP/1.': the conn must be arbitrated (served
+    or closed) promptly, not held against a CRLFCRLF hunt forever."""
+    nsrv, _, _, _ = pair
+    ep = nsrv.listen_endpoint
+    with pysock.create_connection((str(ep.host), ep.port),
+                                  timeout=10) as c:
+        c.sendall(b"POST like a redis inline command\r\nkey value\r\n")
+        c.settimeout(8)
+        t0 = time.monotonic()
+        try:
+            data = c.recv(4096)
+        except pysock.timeout:
+            pytest.fail("colliding prefix hung the connection")
+        assert data == b""                     # cleanly closed
+        assert time.monotonic() - t0 < 5.0
+
+
+def test_slow_request_line_still_served_after_budget(pair):
+    """A legit HTTP client dribbling its request line slower than the
+    sniff budget falls to the passthrough registry — and is still
+    SERVED there (the registry speaks HTTP too)."""
+    nsrv, _, _, _ = pair
+    ep = nsrv.listen_endpoint
+    with pysock.create_connection((str(ep.host), ep.port),
+                                  timeout=15) as c:
+        c.sendall(b"POST /S/Echo HT")
+        time.sleep(2.6)                        # past the 2s budget
+        c.sendall(b"TP/1.1\r\nHost: x\r\nContent-Length: 4\r\n\r\nslow")
+        c.settimeout(10)
+        buf = b""
+        while b"ok:slow" not in buf:
+            part = c.recv(65536)
+            if not part:
+                break
+            buf += part
+        assert b"ok:slow" in buf
+
+
+# ---- (e) ADVICE r5 #4: chunked bodies bounded by http_max_body --------
+
+def test_large_chunked_upload_on_native_port(pair):
+    """A >64KB chunked upload (over the old inbuf bound) succeeds."""
+    nsrv, _, psrv, _ = pair
+    blob = bytes(range(256)) * 32              # 8KB
+    chunks = b"".join(b"2000\r\n" + blob + b"\r\n" for _ in range(12))
+    raw = (b"POST /S/Echo HTTP/1.1\r\nHost: x\r\n"
+           b"Transfer-Encoding: chunked\r\n\r\n" + chunks + b"0\r\n\r\n")
+    nat = _exchange(nsrv.listen_endpoint, raw)     # 96KB body
+    pyt = _exchange(psrv.listen_endpoint, raw)
+    assert nat == pyt
+    assert nat.endswith(b"ok:" + blob * 12)
+
+
+def test_pipelined_slim_then_large_chunked_stays_ordered(pair):
+    """One burst carrying [slim-eligible POST][chunked POST that
+    overflows the inbuf]: the slim response accumulated in native_out
+    must reach the wire BEFORE Python answers the chunked message —
+    HTTP responses carry no correlation id."""
+    nsrv, _, _, _ = pair
+    blob = bytes(8192)
+    chunks = b"".join(b"2000\r\n" + blob + b"\r\n" for _ in range(16))
+    raw = _post(b"/S/Echo", b"pipe") + (
+        b"POST /S/Echo HTTP/1.1\r\nHost: x\r\n"
+        b"Transfer-Encoding: chunked\r\n\r\n" + chunks + b"0\r\n\r\n")
+    ep = nsrv.listen_endpoint
+    with pysock.create_connection((str(ep.host), ep.port),
+                                  timeout=15) as c:
+        c.sendall(raw)
+        c.settimeout(15)
+        buf = b""
+        # first response on the wire must be the slim one, complete
+        while buf.count(b"\r\n\r\n") < 1 or b"ok:pipe" not in buf:
+            part = c.recv(65536)
+            assert part, f"connection closed early: {buf[:120]!r}"
+            buf += part
+        assert buf.index(b"ok:pipe") < len(buf)
+        first_body = buf.index(b"ok:pipe")
+        assert b"ok:" + blob[:1] not in buf[:first_body]
+        # then the 128KB chunked echo follows whole
+        want = b"ok:" + blob * 16
+        while want not in buf:
+            part = c.recv(65536)
+            assert part, "chunked response never arrived"
+            buf += part
+        assert buf.index(b"ok:pipe") < buf.index(want)
+    assert _slim_count(nsrv, "Echo") >= 1
+
+
+def test_batch_response_delivered_before_error_close(pair):
+    """A burst of [valid slim request][malformed HTTP that kills the
+    conn]: the valid request ran (side effects committed), so its
+    response must be delivered best-effort before the close — not
+    silently discarded with the dying connection."""
+    nsrv, _, _, _ = pair
+    raw = (_post(b"/S/Echo", b"last")
+           + b"GET /bad HTTP/1.1\r\n" + b"A" * (70 * 1024))
+    ep = nsrv.listen_endpoint
+    with pysock.create_connection((str(ep.host), ep.port),
+                                  timeout=15) as c:
+        c.sendall(raw)
+        c.settimeout(10)
+        buf = b""
+        while True:
+            try:
+                part = c.recv(65536)
+            except pysock.timeout:
+                break
+            if not part:
+                break
+            buf += part
+        assert b"ok:last" in buf, buf[:200]
+
+
+def test_large_chunked_upload_with_long_extensions(pair):
+    """Chunk-size lines carrying long extensions (>33 bytes) must parse
+    identically in the buffered walker and the incremental FSM — the
+    same message accepted small must not be hard-closed large."""
+    nsrv, _, psrv, _ = pair
+    blob = bytes(range(256)) * 32              # 8KB
+    ext = b";sig=" + b"0123456789abcdef" * 4   # 69-byte extension tail
+    chunks = b"".join(b"2000" + ext + b"\r\n" + blob + b"\r\n"
+                      for _ in range(12))
+    raw = (b"POST /S/Echo HTTP/1.1\r\nHost: x\r\n"
+           b"Transfer-Encoding: chunked\r\n\r\n" + chunks + b"0\r\n\r\n")
+    nat = _exchange(nsrv.listen_endpoint, raw)     # 96KB body
+    pyt = _exchange(psrv.listen_endpoint, raw)
+    assert nat == pyt
+    assert nat.endswith(b"ok:" + blob * 12)

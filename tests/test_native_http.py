@@ -189,29 +189,63 @@ def test_oversized_content_length_rejected_from_headers(server):
     sk.close()
 
 
-def test_oversized_chunked_stream_gets_413(server):
-    """Chunked bodies on the native port must fit the engine inbuf;
-    an outgrowing stream gets a clean 413, not a TCP reset."""
+def test_large_chunked_upload_succeeds(server):
+    """Chunked bodies are bounded by http_max_body, NOT the 128KB
+    engine inbuf (ADVICE r5 #4): an over-inbuf stream accumulates
+    through the incremental chunk FSM and is served whole."""
     import socket as s
 
     ep = server.listen_endpoint
-    sk = s.create_connection((ep.host, ep.port), timeout=10)
+    sk = s.create_connection((ep.host, ep.port), timeout=15)
     sk.sendall(b"POST /Calc/Echo HTTP/1.1\r\nHost: x\r\n"
                b"Transfer-Encoding: chunked\r\n\r\n")
-    blob = bytes(8192)
-    got = b""
-    sk.settimeout(10)
-    try:
-        for _ in range(40):                    # ~320KB of chunks
-            sk.sendall(b"2000\r\n" + blob + b"\r\n")
-    except (BrokenPipeError, ConnectionResetError):
-        pass                                   # server answered early
-    try:
-        got = sk.recv(4096)
-    except (ConnectionResetError, s.timeout):
-        got = b""
-    assert got.startswith(b"HTTP/1.1 413"), got
+    blob = bytes(range(256)) * 32              # 8KB
+    for _ in range(40):                        # 320KB of chunks
+        sk.sendall(b"2000\r\n" + blob + b"\r\n")
+    sk.sendall(b"0\r\n\r\n")
+    sk.settimeout(15)
+    buf = b""
+    while b"\r\n\r\n" not in buf:
+        buf += sk.recv(65536)
+    head, _, rest = buf.partition(b"\r\n\r\n")
+    assert head.startswith(b"HTTP/1.1 200"), head
+    clen = int([ln.split(b":")[1] for ln in head.split(b"\r\n")
+                if ln.lower().startswith(b"content-length")][0])
+    while len(rest) < clen:
+        rest += sk.recv(65536)
+    assert rest == blob * 40
     sk.close()
+
+
+def test_oversized_chunked_stream_gets_413(server):
+    """A chunked stream outgrowing http_max_body gets a clean 413, not
+    a TCP reset (the bound is the body limit now, not the inbuf)."""
+    import socket as s
+
+    eng = server._native_bridge.engine
+    eng.set_http_max_body(64 * 1024)
+    try:
+        ep = server.listen_endpoint
+        sk = s.create_connection((ep.host, ep.port), timeout=10)
+        sk.sendall(b"POST /Calc/Echo HTTP/1.1\r\nHost: x\r\n"
+                   b"Transfer-Encoding: chunked\r\n\r\n")
+        blob = bytes(8192)
+        got = b""
+        sk.settimeout(10)
+        try:
+            for _ in range(40):                # ~320KB of chunks
+                sk.sendall(b"2000\r\n" + blob + b"\r\n")
+        except (BrokenPipeError, ConnectionResetError):
+            pass                               # server answered early
+        try:
+            got = sk.recv(4096)
+        except (ConnectionResetError, s.timeout):
+            got = b""
+        assert got.startswith(b"HTTP/1.1 413"), got
+        sk.close()
+    finally:
+        from brpc_tpu.protocol.base import max_body_size
+        eng.set_http_max_body(int(max_body_size()))
 
 
 def test_transfer_encoding_identity_uses_content_length(server):
