@@ -615,6 +615,113 @@ def bench_http(extra: dict) -> None:
         finally:
             srv.stop()
 
+    def measure_telemetry_overhead(burst: int = 32, rounds: int = 7,
+                                   secs: float = 0.5):
+        """Cost of the always-on native telemetry's SNAPSHOT path on
+        the hottest HTTP lane: pipelined slim bursts with a background
+        thread polling engine.telemetry() at 10Hz (a very hot scraper —
+        Prometheus scrapes every 15s) vs no polling, paired
+        per round with alternating order and the MEDIAN per-round
+        overhead reported.  A CONTROL A/B (no polling in either arm,
+        same methodology) runs alongside and records this box's A/B
+        noise floor — its scheduler phases swing short windows ~2x, so
+        the overhead key is only meaningful next to the noise key.
+        The capture side (histograms, fallback counters, timestamps)
+        is always on in BOTH arms — by design it has no off switch —
+        so this pair bounds the marginal cost of reading the table."""
+        import socket as psock
+        import threading
+
+        opts = ServerOptions()
+        opts.native = True
+        opts.native_loops = 1
+        opts.usercode_inline = True
+        srv = Server(opts)
+        srv.add_service(HttpEcho(), name="H")
+        assert srv.start("127.0.0.1:0") == 0
+        try:
+            ep = srv.listen_endpoint
+            eng = srv._native_bridge.engine
+            body = bytes(1024)
+            req = (b"POST /H/Echo HTTP/1.1\r\nHost: b\r\n"
+                   b"Content-Length: 1024\r\n"
+                   b"Content-Type: application/octet-stream\r\n\r\n"
+                   + body)
+            conn = psock.create_connection((ep.host, ep.port),
+                                           timeout=10)
+            conn.setsockopt(psock.IPPROTO_TCP, psock.TCP_NODELAY, 1)
+            conn.sendall(req)
+            buf = b""
+            while b"\r\n\r\n" not in buf:
+                buf += conn.recv(65536)
+            head, _, rest = buf.partition(b"\r\n\r\n")
+            clen = int([l.split(b":")[1] for l in head.split(b"\r\n")
+                        if l.lower().startswith(b"content-length")][0])
+            resp_len = len(head) + 4 + clen
+            while len(buf) < resp_len:
+                buf += conn.recv(65536)
+            blob = req * burst
+            want = resp_len * burst
+            poll_stop = [False]
+            polling = [False]
+
+            def poller():
+                while not poll_stop[0]:
+                    if polling[0]:
+                        eng.telemetry()
+                    time.sleep(0.1)           # 10Hz snapshot rate
+
+            pt = threading.Thread(target=poller, daemon=True)
+            pt.start()
+
+            def phase(poll_on: bool, ssecs: float) -> float:
+                polling[0] = poll_on
+                n = 0
+                t0 = time.perf_counter()
+                while time.perf_counter() - t0 < ssecs:
+                    conn.sendall(blob)
+                    got = 0
+                    while got < want:
+                        part = conn.recv(min(65536, want - got))
+                        if not part:
+                            raise ConnectionError(
+                                "server closed mid-phase")
+                        got += len(part)
+                    n += burst
+                return n / (time.perf_counter() - t0)
+
+            def paired_ab(a_polls: bool) -> tuple:
+                """Median per-round (B - A)/B pct with order alternated
+                per round; arm B never polls."""
+                pcts, a_qps, b_qps = [], [], []
+                for r in range(rounds):
+                    if r % 2 == 0:
+                        qa = phase(a_polls, secs)
+                        qb = phase(False, secs)
+                    else:
+                        qb = phase(False, secs)
+                        qa = phase(a_polls, secs)
+                    a_qps.append(qa)
+                    b_qps.append(qb)
+                    if qb > 0:
+                        pcts.append((qb - qa) / qb * 100)
+                pcts.sort()
+                med = pcts[len(pcts) // 2] if pcts else 0.0
+                return (round(med, 2),
+                        round(sum(a_qps) / len(a_qps), 1),
+                        round(sum(b_qps) / len(b_qps), 1))
+
+            phase(True, 0.2)                  # warm both phase shapes
+            phase(False, 0.2)
+            pct, qp, qn = paired_ab(True)     # poll vs no-poll
+            noise, _, _ = paired_ab(False)    # no-poll vs no-poll
+            poll_stop[0] = True
+            pt.join(5)
+            conn.close()
+            return pct, noise, qp, qn
+        finally:
+            srv.stop()
+
     qps, p50, p99 = measure(native=True)
     extra["http_1kb_qps"] = qps
     if p50 is not None:
@@ -633,6 +740,14 @@ def bench_http(extra: dict) -> None:
                                                   2)
     except Exception as e:
         extra["http_pipelined_error"] = f"{type(e).__name__}: {e}"[:120]
+    try:
+        pct, noise, qps_poll, qps_nopoll = measure_telemetry_overhead()
+        extra["native_telemetry_overhead_pct"] = pct
+        extra["native_telemetry_ab_noise_pct"] = noise
+        extra["native_telemetry_poll_qps"] = qps_poll
+        extra["native_telemetry_nopoll_qps"] = qps_nopoll
+    except Exception as e:
+        extra["telemetry_overhead_error"] = f"{type(e).__name__}: {e}"[:120]
     qps, p50, p99 = measure(native=False)
     extra["http_1kb_pytransport_qps"] = qps
     if p99 is not None:

@@ -56,3 +56,49 @@ class MultiDimension(Variable):
 
     def describe(self) -> str:
         return f"mbvar(labels={self.labels}, count={self.count_stats()})"
+
+
+class _ConstVar:
+    """Value row for PassiveDimension (get_value protocol only)."""
+
+    __slots__ = ("_v",)
+
+    def __init__(self, v=0):
+        self._v = v
+
+    def get_value(self):
+        return self._v
+
+
+class PassiveDimension(MultiDimension):
+    """Labeled PASSIVE metric: rows come from a getter at read time
+    instead of mutable sub-vars, so one shared snapshot (e.g. the
+    native engine's telemetry table) feeds a whole labeled family;
+    prometheus.py renders the rows as ``name{label="v"} value``
+    exposition lines like any mbvar.  The getter returns
+    ``{label_value_or_tuple: numeric}``."""
+
+    def __init__(self, labels, getter, name: Optional[str] = None):
+        super().__init__(labels, _ConstVar, name=name)
+        self._getter = getter
+
+    def items(self):
+        try:
+            rows = self._getter()
+        except Exception:
+            return []
+        out = []
+        for k, v in rows.items():
+            key = (k,) if isinstance(k, str) \
+                else tuple(str(x) for x in k)
+            out.append((key, _ConstVar(v)))
+        return out
+
+    def get_value(self):
+        try:
+            return dict(self._getter())
+        except Exception:
+            return {}
+
+    def describe(self) -> str:
+        return str(self.get_value())

@@ -80,6 +80,44 @@ def _native():
     return _native_mod
 
 
+# ---------------------------------------------------------------------------
+# scatter_call fallback telemetry: every ineligible-shape branch in the
+# fan-out screening below increments a NAMED reason counter (the
+# client-lane mirror of the engine's reason-coded server fallbacks).
+# Exposed as the ``native_scatter_fallback_total{reason=...}`` bvar
+# family and surfaced on the /native portal page.
+# ---------------------------------------------------------------------------
+
+_scatter_fallbacks: dict = {}
+import threading as _threading
+_scatter_lock = _threading.Lock()
+
+# exposed eagerly: the family must exist in /vars//metrics from process
+# start (a scrape keyed on it must not depend on a fallback having
+# happened), and eager creation leaves no check-then-create race
+from ..bvar.multi_dimension import PassiveDimension as _PassiveDimension
+
+_scatter_var = _PassiveDimension(
+    ("reason",), lambda: scatter_fallback_counters(),
+    name="native_scatter_fallback_total")
+
+
+def _scatter_fallback(reason: str) -> bool:
+    """Record one named scatter ineligibility; returns False so the
+    screening sites read ``return _scatter_fallback("...")``.  The
+    lock keeps concurrent fan-out threads from losing increments
+    (read-modify-write on a dict slot is not atomic)."""
+    with _scatter_lock:
+        _scatter_fallbacks[reason] = _scatter_fallbacks.get(reason, 0) + 1
+    return False
+
+
+def scatter_fallback_counters() -> dict:
+    """Snapshot of the named scatter_call fallback counters."""
+    with _scatter_lock:
+        return dict(_scatter_fallbacks)
+
+
 _fast_cid = 0x46_0000_0000            # distinct range from the IdPool's ids
 
 # (domain bytes, encoded TLV) — the domain id object is cached by
@@ -702,12 +740,15 @@ def run_scatter(branches, timeout_ms: Optional[int]) -> bool:
     fan-out costing Python one call; VERDICT r5 Next #7) when every
     branch fits its shape, else the classic per-branch build below."""
     for channel, cntl, _m, request, _r in branches:
-        if not eligible(channel, cntl) or channel.load_balancer is not None:
-            return False
+        if not eligible(channel, cntl):
+            return _scatter_fallback("ineligible_cntl")
+        if channel.load_balancer is not None:
+            return _scatter_fallback("load_balancer")
         if cntl.request_device_attachment is not None:
-            return False      # scatter frames carry no descriptor logic
+            # scatter frames carry no descriptor logic
+            return _scatter_fallback("device_attachment")
         if not isinstance(request, (bytes, bytearray, memoryview)):
-            return False
+            return _scatter_fallback("nonbytes_request")
     nat = _native()
     if nat is not None and hasattr(nat, "scatter_call") \
             and _scatter_native(branches, timeout_ms, nat):
@@ -810,9 +851,11 @@ def _scatter_native(branches, timeout_ms: Optional[int], nat) -> bool:
     for channel, cntl, method_full, request, response_type in branches:
         opts = channel.options
         if opts.auth_data:
-            return False      # verify-on-first rides the classic build
+            # verify-on-first rides the classic build
+            return _scatter_fallback("auth_on_first")
         if len(request) + 96 > _MAX_BODY:
-            return False      # oversized: classic path owns the error
+            # oversized: classic path owns the error
+            return _scatter_fallback("oversized_request")
         if cntl.timeout_ms is None:
             cntl.timeout_ms = timeout_ms or opts.timeout_ms
         # one shared deadline covers the scatter read loop: branches
@@ -820,23 +863,25 @@ def _scatter_native(branches, timeout_ms: Optional[int], nat) -> bool:
         # which enforces each branch's own remaining time
         timeouts.add(cntl.timeout_ms)
         if len(timeouts) > 1:
-            return False
+            return _scatter_fallback("mixed_deadlines")
         cntl.connection_type = cntl.connection_type or opts.connection_type
         cntl._begin_us = monotonic_us()
         remote = channel.single_server
         if remote is None:
-            return False      # classic path reports the missing server
+            # classic path reports the missing server
+            return _scatter_fallback("no_single_server")
         cntl.remote_side = remote
         sid, sock = _raw_socket(remote)
         if sock is None:
-            return False      # classic path reports the connect failure
+            # classic path reports the connect failure
+            return _scatter_fallback("connect_failed")
         if not sock.direct_read or not sock.read_portal.empty() \
                 or not sock.write_path_idle():
             _unpin(remote, sid)
-            return False
+            return _scatter_fallback("socket_busy")
         fd = sock.fd.fileno()
         if fd in seen_fds:
-            return False
+            return _scatter_fallback("repeated_remote")
         seen_fds.add(fd)
         screened.append((channel, cntl, sock, sid, method_full, request,
                          response_type))

@@ -164,6 +164,107 @@ struct WriteItem {
                                      // deleted on completion, no copy
 };
 
+// ---------------------------------------------------------------------------
+// Native telemetry (always-on): per-lane fixed-bucket histograms,
+// reason-coded fallback counters, burst/writev distributions and loop
+// busy accounting.  All hot-path captures are PLAIN per-loop-thread
+// counters (each Loop owns a LoopTelemetry; only its own thread writes
+// it) — no atomics, no locks on the request path.  engine.telemetry()
+// reads them racily from a GIL-holding thread and sums across loops:
+// a snapshot may be a few increments stale, never torn in a way that
+// matters (monotonic uint64 on x86).  This is the "RPC Considered
+// Harmful" discipline: per-stage timing of the messaging pipeline, so
+// the fastest lanes stay inspectable in production.
+// ---------------------------------------------------------------------------
+
+static int64_t now_ns() {
+  struct timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  return (int64_t)ts.tv_sec * 1000000000 + ts.tv_nsec;
+}
+
+// log2 buckets: value v (us, or a count for the size distributions)
+// lands in bucket bit_length(v) — bucket 0 holds zeros, bucket i
+// covers [2^(i-1), 2^i).  20 buckets span 1us .. ~0.5s and 1 .. 512K
+// items, the whole plausible range of both uses.
+constexpr int kHistBuckets = 20;
+
+struct Hist {
+  uint64_t b[kHistBuckets] = {};
+  uint64_t count = 0;
+  uint64_t sum = 0;          // us (latency hists) or items (size hists)
+  void add(uint64_t v) {
+    int i = 0;
+    uint64_t x = v;
+    while (x > 0 && i < kHistBuckets - 1) { x >>= 1; i++; }
+    b[i]++;
+    count++;
+    sum += v;
+  }
+};
+
+// server-lane index for the per-stage histograms
+enum Lane : int { LANE_RAW = 0, LANE_SLIM = 1, LANE_HTTP = 2, kLanes = 3 };
+static const char* kLaneNames[kLanes] = {"raw", "slim", "http"};
+
+// Reason-coded fallbacks: every branch that routes a request OFF a
+// native lane (kind 2/3 tpu_std, kind 4 HTTP) and onto the classic
+// Python path increments exactly one of these.  The Python-side
+// scatter_call screening keeps its own named counters
+// (client/fast_call.py) — client lanes never reach the engine loops.
+enum FbReason : int {
+  FB_RPC_DISPATCH_OFF = 0,   // native dispatch gated off (rpc_dump live)
+  FB_RPC_META_TAG,           // controller-tier TLV / malformed meta
+  FB_RPC_NO_METHOD,          // svc.mth not registered with the engine
+  FB_RPC_ATT_OVER_CAP,       // kind-3 attachment above kSlimAttCap
+  FB_RPC_LARGE_FRAME,        // kind-2/3 frame on the direct-read path
+  FB_HTTP_SLIM_OFF,          // slim HTTP lane gated off
+  FB_HTTP_MALFORMED_LINE,    // request line missing tokens
+  FB_HTTP_VERSION,           // version not exactly "HTTP/1.1\r\n"
+  FB_HTTP_NO_ROUTE,          // METHOD+path not registered
+  FB_HTTP_EXPECT,            // Expect header present
+  FB_HTTP_UPGRADE,           // Upgrade header present
+  FB_HTTP_CONNECTION,        // Connection other than keep-alive
+  FB_HTTP_TRANSFER_ENCODING, // Transfer-Encoding framing
+  FB_HTTP_BAD_HEADER,        // LF-only endings / colon-less line
+  FB_HTTP_LARGE_BODY,        // over-inbuf Content-Length (direct read)
+  FB_HTTP_CHUNK_STREAM,      // over-inbuf chunked body (stream FSM)
+  FB_REASONS
+};
+static const char* kFbNames[FB_REASONS] = {
+    "rpc_dispatch_off",   "rpc_meta_tag",     "rpc_no_method",
+    "rpc_att_over_cap",   "rpc_large_frame",  "http_slim_off",
+    "http_malformed_line", "http_version",    "http_no_route",
+    "http_expect",        "http_upgrade",     "http_connection",
+    "http_transfer_encoding", "http_bad_header", "http_large_body",
+    "http_chunk_stream",
+};
+
+// per-route fallback reasons the header scan can attribute to a
+// resolved route (the route lookup precedes the header walk)
+enum RouteFb : int {
+  RFB_EXPECT = 0, RFB_UPGRADE, RFB_CONNECTION, RFB_TE, RFB_BAD_HEADER,
+  kRouteFb
+};
+static const char* kRouteFbNames[kRouteFb] = {
+    "http_expect", "http_upgrade", "http_connection",
+    "http_transfer_encoding", "http_bad_header",
+};
+
+struct LoopTelemetry {
+  uint64_t fallbacks[FB_REASONS] = {};
+  Hist queue[kLanes];   // frame parse -> batched shim entry (us)
+  Hist shim[kLanes];    // shim entry -> item complete (us)
+  Hist resid[kLanes];   // frame parse -> response build done (us)
+  Hist burst;           // batched items per flush_py_batch
+  Hist wiov;            // iovs coalesced per writev in conn_flush
+  uint64_t busy_ns = 0; // loop body time (callbacks, parsing, writes)
+  uint64_t idle_ns = 0; // time blocked in epoll_wait
+  uint64_t polls = 0;   // epoll_wait returns
+  uint64_t wq_hwm = 0;  // write-queue items high-water mark
+  uint64_t inbuf_hwm = 0;  // inbuf fill high-water mark (bytes)
+};
+
 // Incremental chunked-body accumulation (ADVICE r5 #4): a chunked
 // request outgrowing the inbuf streams its RAW bytes (headers + chunk
 // framing, exactly as received — the EV_HTTP contract) into `acc`
@@ -246,6 +347,8 @@ struct Loop {
   // Py_buffer releases deferred until we hold the GIL anyway
   std::vector<Py_buffer> decrefs;
   std::mutex decref_mu;
+  // always-on counters/histograms, written ONLY by this loop's thread
+  LoopTelemetry tel;
 };
 
 // A method the engine answers entirely in C++ (no GIL, no Python
@@ -255,7 +358,8 @@ struct Loop {
 // kind 3 is the SLIM SERVER LANE for full (cntl, request) methods: the
 // engine scans the meta, batches eligible requests, and enters Python
 // ONCE per read burst calling
-// handler(payload, att, cid, conn_id, dom, nonce) — admission,
+// handler(payload, att, cid, conn_id, dom, nonce, recv_ns) —
+// admission,
 // MethodStatus accounting and rpcz span sampling live in that shim
 // (server/slim_dispatch.py).  A buffer return is framed
 // natively; None means the shim escalated to the classic Python
@@ -268,6 +372,10 @@ struct NativeMethod {
   PyObject* handler = nullptr;        // kind=2/3 Python callable
   std::atomic<uint64_t> count{0};     // answered natively
   std::atomic<uint64_t> errors{0};    // EREQUEST answers (malformed att)
+  // per-method fallback attribution (reasons where the method is
+  // already resolved); atomics: several loops may hit one method
+  std::atomic<uint64_t> fb_att_over_cap{0};
+  std::atomic<uint64_t> fb_large_frame{0};
 };
 
 // An HTTP route the engine dispatches through the SLIM HTTP LANE
@@ -281,6 +389,9 @@ struct HttpRoute {
   PyObject* handler = nullptr;
   std::atomic<uint64_t> count{0};     // requests through the slim lane
   std::atomic<uint64_t> errors{0};    // shim raised / bad return shape
+  // per-route fallback attribution (header-scan rejects on a resolved
+  // route); indexed by RouteFb
+  std::atomic<uint64_t> fb[kRouteFb] = {};
 };
 
 // One buffered-path request bound for a kind=2/3 Python handler, or a
@@ -306,6 +417,9 @@ struct PyRawItem {
   uint32_t ctlen = 0;
   const char* attsz = nullptr;  // x-rpc-attachment-size value (raw)
   uint32_t attszlen = 0;
+  // telemetry: CLOCK_MONOTONIC ns at frame parse (comparable with
+  // Python's time.monotonic_ns — the shims backdate rpcz spans with it)
+  int64_t t_parse = 0;
 };
 
 struct EngineImpl {
@@ -444,6 +558,7 @@ static void conn_destroy(EngineImpl* eng, Loop* lp, Conn* c, bool notify) {
 // try to flush the write queue; returns false on fatal error
 static bool conn_flush(Loop* lp, Conn* c) {
   std::unique_lock<std::mutex> g(c->wmu);
+  if (c->wq.size() > lp->tel.wq_hwm) lp->tel.wq_hwm = c->wq.size();
   while (!c->wq.empty()) {
     struct iovec iov[64];
     int n = 0;
@@ -451,6 +566,7 @@ static bool conn_flush(Loop* lp, Conn* c) {
       iov[n].iov_base = (char*)it->view.buf + it->offset;
       iov[n].iov_len = it->view.len - it->offset;
     }
+    lp->tel.wiov.add((uint64_t)n);
     ssize_t w = writev(c->fd, iov, n);
     if (w < 0) {
       if (errno == EAGAIN || errno == EWOULDBLOCK) {
@@ -676,17 +792,21 @@ static void http_slim_item(Loop* lp, Conn* c, PyRawItem& it) {
   PyObject* asz = it.attsz
       ? PyBytes_FromStringAndSize(it.attsz, it.attszlen) : nullptr;
   PyObject* conn = body ? PyLong_FromUnsignedLongLong(c->id) : nullptr;
+  PyObject* rcv = conn
+      ? PyLong_FromLongLong((long long)it.t_parse) : nullptr;
   PyObject* r = nullptr;
-  if (body && conn && (!it.query || q) && (!it.ctype || ct)
+  if (body && conn && rcv && (!it.query || q) && (!it.ctype || ct)
       && (!it.attsz || asz))
     r = PyObject_CallFunctionObjArgs(it.hroute->handler, body,
                                      q ? q : Py_None, ct ? ct : Py_None,
-                                     asz ? asz : Py_None, conn, nullptr);
+                                     asz ? asz : Py_None, conn, rcv,
+                                     nullptr);
   Py_XDECREF(body);
   Py_XDECREF(q);
   Py_XDECREF(ct);
   Py_XDECREF(asz);
   Py_XDECREF(conn);
+  Py_XDECREF(rcv);
   if (!r) {
     // shim raised (or OOM building args): answer a plain 500 with the
     // exception text, keeping the keep-alive conn in sync
@@ -753,25 +873,13 @@ static void http_slim_item(Loop* lp, Conn* c, PyRawItem& it) {
   http_slim_error(c, "http slim shim returned a non-buffer");
 }
 
-// Run a burst's worth of kind=2 Python raw handlers under ONE GIL
-// acquisition and append their responses to c->native_out (shipped by
-// the burst-end native_flush as one writev).  This is the amortized
-// GIL crossing of the reference's message-batch pattern
-// (input_messenger.cpp:374-394: one bthread per batch + flush): a
-// pipelined client pays one Python entry per read burst, not one per
-// message.  Payload/attachment reach the handler as bytes copies —
-// the source bytes live in the transient inbuf, and a handler that
-// retains its argument must never observe them changing.
-static void flush_py_batch(Loop* lp, Conn* c,
-                           std::vector<PyRawItem>& batch) {
-  if (batch.empty()) return;
-  PyGILState_STATE gs = PyGILState_Ensure();
-  flush_decrefs_locked_gil(lp);
-  for (PyRawItem& it : batch) {
-    if (it.hroute) {
-      http_slim_item(lp, c, it);   // kind-4 slim-HTTP item
-      continue;
-    }
+// Run one kind-2/3 batched item: call the raw handler / slim shim and
+// build the response frame natively.  Runs under the GIL, inside
+// flush_py_batch's single per-burst acquisition.
+// Payload/attachment reach the handler as bytes copies — the source
+// bytes live in the transient inbuf, and a handler that retains its
+// argument must never observe them changing.
+static void raw_slim_item(Loop* lp, Conn* c, PyRawItem& it) {
     size_t plen = it.plen - it.att;
     PyObject* r = nullptr;
     if (it.m->kind == 3) {
@@ -779,7 +887,8 @@ static void flush_py_batch(Loop* lp, Conn* c,
       // path hands parse_payload bytes too — handlers may .decode()),
       // plus cid and conn id so escalations can complete classically,
       // plus the request's ici domain/nonce bytes (peer-domain
-      // learning / conn-nonce pinning, classic-path semantics)
+      // learning / conn-nonce pinning, classic-path semantics), plus
+      // the engine's receive timestamp (rpcz spans backdate to it)
       PyObject* pb = PyBytes_FromStringAndSize(it.payload, plen);
       PyObject* ab = nullptr;
       if (pb && it.att)
@@ -790,25 +899,28 @@ static void flush_py_batch(Loop* lp, Conn* c,
           ? PyBytes_FromStringAndSize(it.dom, it.dom_len) : nullptr;
       PyObject* nonce = it.conn_len
           ? PyBytes_FromStringAndSize(it.conn, it.conn_len) : nullptr;
-      if (pb && (it.att == 0 || ab) && cid && conn
+      PyObject* rcv = conn
+          ? PyLong_FromLongLong((long long)it.t_parse) : nullptr;
+      if (pb && (it.att == 0 || ab) && cid && conn && rcv
           && (it.dom_len == 0 || dom) && (it.conn_len == 0 || nonce))
         r = PyObject_CallFunctionObjArgs(it.m->handler, pb,
                                          ab ? ab : Py_None, cid, conn,
                                          dom ? dom : Py_None,
                                          nonce ? nonce : Py_None,
-                                         nullptr);
+                                         rcv, nullptr);
       Py_XDECREF(pb);
       Py_XDECREF(ab);
       Py_XDECREF(cid);
       Py_XDECREF(conn);
       Py_XDECREF(dom);
       Py_XDECREF(nonce);
+      Py_XDECREF(rcv);
       if (r == Py_None) {
         // handled out-of-band: the shim completed (or will complete)
         // the RPC through the classic Python send path
         Py_DECREF(r);
         it.m->count++;
-        continue;
+        return;
       }
     } else {
       // the @raw_method contract hands the handler MEMORYVIEWS (the
@@ -849,7 +961,7 @@ static void flush_py_batch(Loop* lp, Conn* c,
       Py_XDECREF(t); Py_XDECREF(v); Py_XDECREF(tb);
       it.m->errors++;
       native_error(c, it.cid, 2001 /* EINTERNAL */, msg);
-      continue;
+      return;
     }
     PyObject* resp = r;
     PyObject* ratt = nullptr;
@@ -867,7 +979,7 @@ static void flush_py_batch(Loop* lp, Conn* c,
       it.m->errors++;
       native_error(c, it.cid, 2001,
                    "raw method returned non-bytes");
-      continue;
+      return;
     }
     size_t ralen = ab.obj ? (size_t)ab.len : 0;
     // kind 3: a request that carried the ici-domain TLV gets the local
@@ -885,6 +997,37 @@ static void flush_py_batch(Loop* lp, Conn* c,
     if (ab.obj) PyBuffer_Release(&ab);
     Py_DECREF(r);
     it.m->count++;
+}
+
+// Run a burst's worth of batched items (kind-2 raw, kind-3 slim,
+// kind-4 slim-HTTP) under ONE GIL acquisition and append their
+// responses to c->native_out (shipped by the burst-end native_flush as
+// one writev).  This is the amortized GIL crossing of the reference's
+// message-batch pattern (input_messenger.cpp:374-394: one bthread per
+// batch + flush): a pipelined client pays one Python entry per read
+// burst, not one per message.  Telemetry stages captured per item:
+// queue (frame parse -> this batch entry), shim (item dispatch time),
+// resid (parse -> response build done).
+static void flush_py_batch(Loop* lp, Conn* c,
+                           std::vector<PyRawItem>& batch) {
+  if (batch.empty()) return;
+  int64_t t_entry = now_ns();
+  lp->tel.burst.add((uint64_t)batch.size());
+  PyGILState_STATE gs = PyGILState_Ensure();
+  flush_decrefs_locked_gil(lp);
+  for (PyRawItem& it : batch) {
+    int lane = it.hroute ? LANE_HTTP
+                         : (it.m->kind == 3 ? LANE_SLIM : LANE_RAW);
+    lp->tel.queue[lane].add(
+        (uint64_t)((t_entry - it.t_parse) / 1000));
+    int64_t t0 = now_ns();
+    if (it.hroute)
+      http_slim_item(lp, c, it);   // kind-4 slim-HTTP item
+    else
+      raw_slim_item(lp, c, it);    // kind-2/3 tpu_std item
+    int64_t t1 = now_ns();
+    lp->tel.shim[lane].add((uint64_t)((t1 - t0) / 1000));
+    lp->tel.resid[lane].add((uint64_t)((t1 - it.t_parse) / 1000));
   }
   PyGILState_Release(gs);
   batch.clear();
@@ -892,15 +1035,27 @@ static void flush_py_batch(Loop* lp, Conn* c,
 
 // Try to answer one complete TRPC frame natively.  body = meta+payload
 // (body_len bytes), meta_size from the frame header.  True = handled,
-// response appended to c->native_out.
-static bool native_try_handle(EngineImpl* eng, Conn* c, const char* body,
-                              size_t body_len, uint32_t meta_size,
+// response appended to c->native_out.  Every False exit increments a
+// reason-coded fallback counter on the owning loop — the classic path
+// a frame takes instead is never silent.
+static bool native_try_handle(EngineImpl* eng, Loop* lp, Conn* c,
+                              const char* body, size_t body_len,
+                              uint32_t meta_size,
                               std::vector<PyRawItem>* batch = nullptr) {
-  if (!eng->native_dispatch.load(std::memory_order_relaxed)) return false;
+  if (!eng->native_dispatch.load(std::memory_order_relaxed)) {
+    lp->tel.fallbacks[FB_RPC_DISPATCH_OFF]++;
+    return false;
+  }
   MetaScan s;
-  if (!scan_request_meta(body, meta_size, &s)) return false;
+  if (!scan_request_meta(body, meta_size, &s)) {
+    lp->tel.fallbacks[FB_RPC_META_TAG]++;
+    return false;
+  }
   NativeMethod* m = find_native(eng, s);
-  if (!m) return false;
+  if (!m) {
+    lp->tel.fallbacks[FB_RPC_NO_METHOD]++;
+    return false;
+  }
   const char* payload = body + meta_size;
   size_t plen = body_len - meta_size;
   if (s.att > plen) {
@@ -909,6 +1064,12 @@ static bool native_try_handle(EngineImpl* eng, Conn* c, const char* body,
                  "attachment size exceeds body");
     return true;
   }
+  PyRawItem pi{};
+  pi.m = m;
+  pi.cid = s.cid;
+  pi.payload = payload;
+  pi.plen = plen;
+  pi.att = s.att;
   switch (m->kind) {
     case 0:  // echo: payload + attachment unchanged
       native_respond(c, s.cid, payload, plen, s.att);
@@ -918,16 +1079,33 @@ static bool native_try_handle(EngineImpl* eng, Conn* c, const char* body,
                      0);
       break;
     case 2:  // Python raw handler: batch for one GIL entry per burst
-      if (!batch) return false;   // direct-read path: full Python route
-      batch->push_back({m, s.cid, payload, plen, s.att});
+      if (!batch) {               // direct-read path: full Python route
+        lp->tel.fallbacks[FB_RPC_LARGE_FRAME]++;
+        m->fb_large_frame++;
+        return false;
+      }
+      pi.t_parse = now_ns();
+      batch->push_back(pi);
       break;
     case 3:  // slim full-method dispatch: batched like kind 2; over-
              // threshold attachments take the byte-identical Python
              // route (large frames already fall back via direct read)
-      if (!batch) return false;   // direct-read path: full Python route
-      if (s.att > kSlimAttCap) return false;
-      batch->push_back({m, s.cid, payload, plen, s.att,
-                        s.dom, s.dom_len, s.conn, s.conn_len});
+      if (!batch) {               // direct-read path: full Python route
+        lp->tel.fallbacks[FB_RPC_LARGE_FRAME]++;
+        m->fb_large_frame++;
+        return false;
+      }
+      if (s.att > kSlimAttCap) {
+        lp->tel.fallbacks[FB_RPC_ATT_OVER_CAP]++;
+        m->fb_att_over_cap++;
+        return false;
+      }
+      pi.dom = s.dom;
+      pi.dom_len = s.dom_len;
+      pi.conn = s.conn;
+      pi.conn_len = s.conn_len;
+      pi.t_parse = now_ns();
+      batch->push_back(pi);
       break;
     default:
       return false;
@@ -1266,20 +1444,33 @@ static void http_slim_error(Conn* c, const char* text) {
 // route, no Transfer-Encoding / Expect / Upgrade, Connection absent or
 // exactly keep-alive.  Fills the kind-4 PyRawItem fields (pointers
 // into the inbuf — batch lifetime rules apply).  False = take the
-// classic EV_HTTP path.
-static bool http_slim_match(EngineImpl* eng, const char* p, size_t total,
-                            size_t hlen, PyRawItem* out) {
+// classic EV_HTTP path; every reject increments a reason-coded
+// fallback counter (and the per-route breakdown once the route is
+// resolved — the route lookup precedes the header walk).
+static bool http_slim_match(EngineImpl* eng, Loop* lp, const char* p,
+                            size_t total, size_t hlen, PyRawItem* out) {
   const char* he = p + hlen;                    // body start
   const char* nl = (const char*)memchr(p, '\n', hlen);
-  if (!nl) return false;
+  if (!nl) {
+    lp->tel.fallbacks[FB_HTTP_MALFORMED_LINE]++;
+    return false;
+  }
   const char* sp1 = (const char*)memchr(p, ' ', (size_t)(nl - p));
-  if (!sp1) return false;
+  if (!sp1) {
+    lp->tel.fallbacks[FB_HTTP_MALFORMED_LINE]++;
+    return false;
+  }
   const char* sp2 =
       (const char*)memchr(sp1 + 1, ' ', (size_t)(nl - sp1 - 1));
-  if (!sp2) return false;
-  // version token must be exactly "HTTP/1.1" with a CRLF line ending
-  if ((size_t)(nl - sp2) != 10 || memcmp(sp2 + 1, "HTTP/1.1\r", 9) != 0)
+  if (!sp2) {
+    lp->tel.fallbacks[FB_HTTP_MALFORMED_LINE]++;
     return false;
+  }
+  // version token must be exactly "HTTP/1.1" with a CRLF line ending
+  if ((size_t)(nl - sp2) != 10 || memcmp(sp2 + 1, "HTTP/1.1\r", 9) != 0) {
+    lp->tel.fallbacks[FB_HTTP_VERSION]++;
+    return false;
+  }
   const char* tgt = sp1 + 1;
   size_t tlen = (size_t)(sp2 - tgt);
   const char* qm = (const char*)memchr(tgt, '?', tlen);
@@ -1290,7 +1481,17 @@ static bool http_slim_match(EngineImpl* eng, const char* p, size_t total,
   key.push_back('\0');
   key.append(tgt, path_len);
   auto itr = eng->http_routes.find(key);
-  if (itr == eng->http_routes.end()) return false;
+  if (itr == eng->http_routes.end()) {
+    lp->tel.fallbacks[FB_HTTP_NO_ROUTE]++;
+    return false;
+  }
+  HttpRoute* route = itr->second;
+  // reject helper: global reason + the resolved route's breakdown
+  auto route_fb = [&](FbReason fb, RouteFb rfb) {
+    lp->tel.fallbacks[fb]++;
+    route->fb[rfb]++;
+    return false;
+  };
   const char* ctype = nullptr;
   uint32_t ctlen = 0;
   const char* attsz = nullptr;
@@ -1301,20 +1502,23 @@ static bool http_slim_match(EngineImpl* eng, const char* p, size_t total,
         (const char*)memchr(line, '\n', (size_t)(he - line));
     if (!leol) break;
     size_t ll = (size_t)(leol - line);          // excl LF
-    if (ll == 0 || line[ll - 1] != '\r') return false;  // demand CRLF
+    if (ll == 0 || line[ll - 1] != '\r')        // demand CRLF
+      return route_fb(FB_HTTP_BAD_HEADER, RFB_BAD_HEADER);
     ll--;                                       // excl CR
     if (ll == 0) break;                         // blank line: done
     const char* col = (const char*)memchr(line, ':', ll);
-    if (!col) return false;
+    if (!col) return route_fb(FB_HTTP_BAD_HEADER, RFB_BAD_HEADER);
     size_t nlen = (size_t)(col - line);
     const char* v = col + 1;
     size_t vlen = ll - nlen - 1;
     switch (nlen) {
       case 6:
-        if (strncasecmp(line, "expect", 6) == 0) return false;
+        if (strncasecmp(line, "expect", 6) == 0)
+          return route_fb(FB_HTTP_EXPECT, RFB_EXPECT);
         break;
       case 7:
-        if (strncasecmp(line, "upgrade", 7) == 0) return false;
+        if (strncasecmp(line, "upgrade", 7) == 0)
+          return route_fb(FB_HTTP_UPGRADE, RFB_UPGRADE);
         break;
       case 10:
         if (strncasecmp(line, "connection", 10) == 0) {
@@ -1322,7 +1526,8 @@ static bool http_slim_match(EngineImpl* eng, const char* p, size_t total,
           while (vlen && (v[vlen - 1] == ' ' || v[vlen - 1] == '\t'))
             vlen--;
           if (vlen != 10 || strncasecmp(v, "keep-alive", 10) != 0)
-            return false;                       // close / upgrade / odd
+            return route_fb(FB_HTTP_CONNECTION,  // close / upgrade /
+                            RFB_CONNECTION);     // odd value
         }
         break;
       case 12:
@@ -1333,7 +1538,8 @@ static bool http_slim_match(EngineImpl* eng, const char* p, size_t total,
         break;
       case 17:
         if (strncasecmp(line, "transfer-encoding", 17) == 0)
-          return false;                         // chunked OR identity
+          return route_fb(FB_HTTP_TRANSFER_ENCODING,  // chunked OR
+                          RFB_TE);                    // identity
         break;
       case 21:
         if (strncasecmp(line, "x-rpc-attachment-size", 21) == 0) {
@@ -1344,7 +1550,7 @@ static bool http_slim_match(EngineImpl* eng, const char* p, size_t total,
     }
     line = leol + 1;
   }
-  out->hroute = itr->second;
+  out->hroute = route;
   out->payload = he;
   out->plen = total - hlen;
   out->query = qm ? qm + 1 : nullptr;
@@ -1543,6 +1749,7 @@ static bool parse_frames_inner(EngineImpl* eng, Loop* lp, Conn* c,
       if (hr == -4) {
         // chunked body outgrowing the inbuf: stream raw bytes through
         // the incremental chunk FSM, bounded by http_max_body
+        lp->tel.fallbacks[FB_HTTP_CHUNK_STREAM]++;
         flush_py_batch(lp, c, batch);
         c->chunk = new (std::nothrow) ChunkState();
         if (!c->chunk) return false;
@@ -1565,12 +1772,16 @@ static bool parse_frames_inner(EngineImpl* eng, Loop* lp, Conn* c,
           // SLIM HTTP LANE (kind 4): eligible messages batch with the
           // burst and enter Python once, in flush_py_batch
           PyRawItem hit{};
-          if (http_slim_match(eng, p, (size_t)hr, http_hlen, &hit)) {
+          if (http_slim_match(eng, lp, p, (size_t)hr, http_hlen,
+                              &hit)) {
+            hit.t_parse = now_ns();
             c->in_start += (size_t)hr;
             eng->nmessages++;
             batch.push_back(hit);
             continue;
           }
+        } else {
+          lp->tel.fallbacks[FB_HTTP_SLIM_OFF]++;
         }
         // one complete HTTP message: classic EV_HTTP dispatch
         flush_py_batch(lp, c, batch);   // wire order vs earlier frames
@@ -1609,6 +1820,7 @@ static bool parse_frames_inner(EngineImpl* eng, Loop* lp, Conn* c,
       if (hr == -2) {
         // large Content-Length body: direct-into-buffer reads, same
         // machinery as large tpu_std frames (msg_kind = EV_HTTP)
+        lp->tel.fallbacks[FB_HTTP_LARGE_BODY]++;
         flush_py_batch(lp, c, batch);
         NativeBuf* b;
         {
@@ -1645,7 +1857,7 @@ static bool parse_frames_inner(EngineImpl* eng, Loop* lp, Conn* c,
       // response rides c->native_out, coalesced across the burst);
       // kind=2 Python raw handlers are BATCHED into one GIL entry
       if (kind == EV_MESSAGE
-          && native_try_handle(eng, c, p + hdr, body, meta, &batch)) {
+          && native_try_handle(eng, lp, c, p + hdr, body, meta, &batch)) {
         continue;
       }
       // a Python-path frame mid-burst: flush queued native responses
@@ -1757,15 +1969,24 @@ static bool conn_readable(EngineImpl* eng, Loop* lp, Conn* c) {
         // the received NativeBuf (header+meta owned; body is a view)
         MetaScan s;
         NativeMethod* m = nullptr;
-        if (c->msg_kind == EV_MESSAGE
-            && eng->native_dispatch.load(std::memory_order_relaxed)
-            && scan_request_meta(b->data, c->msg_meta, &s))
-          m = find_native(eng, s);
-        if (m && (m->kind == 2 || m->kind == 3))
+        if (c->msg_kind == EV_MESSAGE) {
+          // reason-coded mirror of native_try_handle's screening for
+          // the direct-read (large-frame) path
+          if (!eng->native_dispatch.load(std::memory_order_relaxed))
+            lp->tel.fallbacks[FB_RPC_DISPATCH_OFF]++;
+          else if (!scan_request_meta(b->data, c->msg_meta, &s))
+            lp->tel.fallbacks[FB_RPC_META_TAG]++;
+          else if ((m = find_native(eng, s)) == nullptr)
+            lp->tel.fallbacks[FB_RPC_NO_METHOD]++;
+        }
+        if (m && (m->kind == 2 || m->kind == 3)) {
+          lp->tel.fallbacks[FB_RPC_LARGE_FRAME]++;
+          m->fb_large_frame++;
           m = nullptr;   // large-frame Python raw/slim: the bridge's
                          // zero-copy NativeBuf path beats a batch copy
                          // (for slim this IS the big-attachment
                          // fallback to the classic dispatch)
+        }
         if (m) {
           size_t plen = (size_t)b->size - c->msg_meta;
           if (s.att > plen) {
@@ -1839,6 +2060,7 @@ static bool conn_readable(EngineImpl* eng, Loop* lp, Conn* c) {
     }
     c->in_end += (size_t)r;
     eng->bytes_in += (uint64_t)r;
+    if (c->in_end > lp->tel.inbuf_hwm) lp->tel.inbuf_hwm = c->in_end;
     if (!parse_frames(eng, lp, c)) return false;
   }
 }
@@ -1904,7 +2126,19 @@ static void loop_run(Loop* lp) {
   EngineImpl* eng = lp->eng;
   struct epoll_event evs[128];
   while (!eng->stopping.load()) {
+    // busy/idle split: time blocked in epoll_wait is idle, everything
+    // else in the iteration (callbacks, parsing, writes) is busy —
+    // the loop-thread analogue of /hotspots for the C++ data plane
+    int64_t t_pre = now_ns();
     int n = epoll_wait(lp->epfd, evs, 128, 200);
+    int64_t t_wake = now_ns();
+    lp->tel.idle_ns += (uint64_t)(t_wake - t_pre);
+    lp->tel.polls++;
+    struct BusyScope {
+      LoopTelemetry* tel;
+      int64_t t0;
+      ~BusyScope() { tel->busy_ns += (uint64_t)(now_ns() - t0); }
+    } busy_scope{&lp->tel, t_wake};
     if (n < 0) {
       if (errno == EINTR) continue;
       break;
@@ -2132,8 +2366,8 @@ static PyObject* Engine_run_loop(EngineObj* self, PyObject* args) {
 // 1 = const(data), 2 = Python @raw_method handler called from the
 // engine loop (burst-batched; one GIL entry per read burst),
 // 3 = slim full-method dispatch shim (burst-batched like 2; called as
-// handler(payload, att, cid, conn_id, dom, nonce), None return =
-// out-of-band).
+// handler(payload, att, cid, conn_id, dom, nonce, recv_ns), None
+// return = out-of-band).
 static PyObject* Engine_register_native_method(EngineObj* self,
                                                PyObject* args) {
   const char* svc;
@@ -2197,7 +2431,8 @@ static PyObject* Engine_set_native_dispatch(EngineObj* self,
 // register_http_route(method, path, handler) — pre-listen only.  The
 // SLIM HTTP LANE (kind 4): eligible HTTP/1.1 requests matching
 // METHOD+path are parsed in C++, burst-batched, and dispatched to the
-// shim as handler(body, query, content_type, att_size, conn_id); a
+// shim as handler(body, query, content_type, att_size, conn_id,
+// recv_ns); a
 // (status, header_block, body) return is serialized natively, bytes
 // are appended verbatim (pre-built classic escalations), None means
 // the shim completed out-of-band.
@@ -2336,6 +2571,199 @@ static PyObject* Engine_native_stats(EngineObj* self, PyObject* args) {
     Py_DECREF(t);
   }
   return d;
+}
+
+// ---- telemetry snapshot helpers (GIL held) ----
+
+static PyObject* hist_buckets(const uint64_t* b) {
+  PyObject* l = PyList_New(kHistBuckets);
+  if (!l) return nullptr;
+  for (int i = 0; i < kHistBuckets; i++) {
+    PyObject* v = PyLong_FromUnsignedLongLong(b[i]);
+    if (!v) {
+      Py_DECREF(l);
+      return nullptr;
+    }
+    PyList_SET_ITEM(l, i, v);
+  }
+  return l;
+}
+
+static int set_u64(PyObject* d, const char* k, uint64_t v) {
+  PyObject* o = PyLong_FromUnsignedLongLong(v);
+  if (!o) return -1;
+  int rc = PyDict_SetItemString(d, k, o);
+  Py_DECREF(o);
+  return rc;
+}
+
+// set "<name>": bucket list, "<name>_count", "<name>_sum" on d
+static int set_hist(PyObject* d, const char* name, const Hist& h) {
+  PyObject* l = hist_buckets(h.b);
+  if (!l) return -1;
+  int rc = PyDict_SetItemString(d, name, l);
+  Py_DECREF(l);
+  if (rc != 0) return -1;
+  char key[64];
+  snprintf(key, sizeof key, "%s_count", name);
+  if (set_u64(d, key, h.count) != 0) return -1;
+  snprintf(key, sizeof key, "%s_sum", name);
+  return set_u64(d, key, h.sum);
+}
+
+static void hist_merge(Hist& dst, const Hist& src) {
+  for (int i = 0; i < kHistBuckets; i++) dst.b[i] += src.b[i];
+  dst.count += src.count;
+  dst.sum += src.sum;
+}
+
+// telemetry() -> one dict with the engine's whole observability table:
+// reason-coded fallback counters, per-lane stage histograms
+// (queue/shim/resid, log2-us buckets), burst & writev-coalescing
+// distributions, write-queue/inbuf high-water marks, per-loop
+// busy/idle nanoseconds, and per-method/per-route breakdowns.  ONE
+// GIL crossing serves every bvar/portal reader per sampling interval
+// — replaces the per-var native_stats/http_slim_stats polling.
+static PyObject* Engine_telemetry(EngineObj* self, PyObject*) {
+  EngineImpl* eng = self->eng;
+  // aggregate per-loop counters (racy by design: each loop's thread
+  // owns its LoopTelemetry; a snapshot may trail a few increments,
+  // which monotonic counters tolerate)
+  uint64_t fb[FB_REASONS] = {};
+  Hist queue[kLanes], shim[kLanes], resid[kLanes], burst, wiov;
+  uint64_t wq_hwm = 0, inbuf_hwm = 0;
+  PyObject* loops = PyList_New((Py_ssize_t)eng->loops.size());
+  if (!loops) return nullptr;
+  for (size_t i = 0; i < eng->loops.size(); i++) {
+    const LoopTelemetry& t = eng->loops[i]->tel;
+    for (int r = 0; r < FB_REASONS; r++) fb[r] += t.fallbacks[r];
+    for (int ln = 0; ln < kLanes; ln++) {
+      hist_merge(queue[ln], t.queue[ln]);
+      hist_merge(shim[ln], t.shim[ln]);
+      hist_merge(resid[ln], t.resid[ln]);
+    }
+    hist_merge(burst, t.burst);
+    hist_merge(wiov, t.wiov);
+    if (t.wq_hwm > wq_hwm) wq_hwm = t.wq_hwm;
+    if (t.inbuf_hwm > inbuf_hwm) inbuf_hwm = t.inbuf_hwm;
+    PyObject* lo = Py_BuildValue(
+        "{s:K,s:K,s:K}", "busy_ns", (unsigned long long)t.busy_ns,
+        "idle_ns", (unsigned long long)t.idle_ns, "polls",
+        (unsigned long long)t.polls);
+    if (!lo) {
+      Py_DECREF(loops);
+      return nullptr;
+    }
+    PyList_SET_ITEM(loops, (Py_ssize_t)i, lo);
+  }
+  // per-lane handled/errors roll up from the registered handlers
+  uint64_t handled[kLanes] = {}, errors[kLanes] = {};
+  PyObject* methods = PyDict_New();
+  if (!methods) {
+    Py_DECREF(loops);
+    return nullptr;
+  }
+  for (auto& kv : eng->native_methods) {
+    NativeMethod* m = kv.second;
+    uint64_t cnt = m->count.load(std::memory_order_relaxed);
+    uint64_t err = m->errors.load(std::memory_order_relaxed);
+    if (m->kind == 2) {
+      handled[LANE_RAW] += cnt;
+      errors[LANE_RAW] += err;
+    } else if (m->kind == 3) {
+      handled[LANE_SLIM] += cnt;
+      errors[LANE_SLIM] += err;
+    }
+    std::string name = kv.first;
+    size_t z = name.find('\0');
+    if (z != std::string::npos) name[z] = '.';
+    PyObject* md = Py_BuildValue(
+        "{s:i,s:K,s:K,s:K,s:K}", "kind", m->kind, "handled",
+        (unsigned long long)cnt, "errors", (unsigned long long)err,
+        "fb_rpc_att_over_cap",
+        (unsigned long long)m->fb_att_over_cap.load(
+            std::memory_order_relaxed),
+        "fb_rpc_large_frame",
+        (unsigned long long)m->fb_large_frame.load(
+            std::memory_order_relaxed));
+    if (!md || PyDict_SetItemString(methods, name.c_str(), md) != 0) {
+      Py_XDECREF(md);
+      Py_DECREF(methods);
+      Py_DECREF(loops);
+      return nullptr;
+    }
+    Py_DECREF(md);
+  }
+  PyObject* routes = PyDict_New();
+  if (!routes) {
+    Py_DECREF(methods);
+    Py_DECREF(loops);
+    return nullptr;
+  }
+  for (auto& kv : eng->http_routes) {
+    HttpRoute* r = kv.second;
+    uint64_t cnt = r->count.load(std::memory_order_relaxed);
+    uint64_t err = r->errors.load(std::memory_order_relaxed);
+    handled[LANE_HTTP] += cnt;
+    errors[LANE_HTTP] += err;
+    std::string name = kv.first;
+    size_t z = name.find('\0');
+    if (z != std::string::npos) name[z] = ' ';
+    PyObject* rd = Py_BuildValue(
+        "{s:K,s:K}", "handled", (unsigned long long)cnt, "errors",
+        (unsigned long long)err);
+    bool ok = rd != nullptr;
+    for (int i = 0; ok && i < kRouteFb; i++) {
+      char key[48];
+      snprintf(key, sizeof key, "fb_%s", kRouteFbNames[i]);
+      ok = set_u64(rd, key,
+                   r->fb[i].load(std::memory_order_relaxed)) == 0;
+    }
+    if (!ok || PyDict_SetItemString(routes, name.c_str(), rd) != 0) {
+      Py_XDECREF(rd);
+      Py_DECREF(routes);
+      Py_DECREF(methods);
+      Py_DECREF(loops);
+      return nullptr;
+    }
+    Py_DECREF(rd);
+  }
+  PyObject* out = PyDict_New();
+  PyObject* fbd = PyDict_New();
+  PyObject* lanes = PyDict_New();
+  bool ok = out && fbd && lanes;
+  for (int r = 0; ok && r < FB_REASONS; r++)
+    ok = set_u64(fbd, kFbNames[r], fb[r]) == 0;
+  for (int ln = 0; ok && ln < kLanes; ln++) {
+    PyObject* ld = PyDict_New();
+    ok = ld != nullptr;
+    if (ok) ok = set_u64(ld, "handled", handled[ln]) == 0;
+    if (ok) ok = set_u64(ld, "errors", errors[ln]) == 0;
+    if (ok) ok = set_hist(ld, "queue_us", queue[ln]) == 0;
+    if (ok) ok = set_hist(ld, "shim_us", shim[ln]) == 0;
+    if (ok) ok = set_hist(ld, "resid_us", resid[ln]) == 0;
+    if (ok) ok = PyDict_SetItemString(lanes, kLaneNames[ln], ld) == 0;
+    Py_XDECREF(ld);
+  }
+  if (ok) ok = PyDict_SetItemString(out, "fallbacks", fbd) == 0;
+  if (ok) ok = PyDict_SetItemString(out, "lanes", lanes) == 0;
+  if (ok) ok = set_hist(out, "burst", burst) == 0;
+  if (ok) ok = set_hist(out, "writev_iov", wiov) == 0;
+  if (ok) ok = set_u64(out, "wq_hwm", wq_hwm) == 0;
+  if (ok) ok = set_u64(out, "inbuf_hwm", inbuf_hwm) == 0;
+  if (ok) ok = PyDict_SetItemString(out, "loops", loops) == 0;
+  if (ok) ok = PyDict_SetItemString(out, "methods", methods) == 0;
+  if (ok) ok = PyDict_SetItemString(out, "routes", routes) == 0;
+  Py_XDECREF(fbd);
+  Py_XDECREF(lanes);
+  Py_DECREF(loops);
+  Py_DECREF(methods);
+  Py_DECREF(routes);
+  if (!ok) {
+    Py_XDECREF(out);
+    return nullptr;
+  }
+  return out;
 }
 
 static PyObject* Engine_send(EngineObj* self, PyObject* args) {
@@ -2543,6 +2971,11 @@ static PyMethodDef Engine_methods[] = {
     {"native_stats", (PyCFunction)Engine_native_stats, METH_VARARGS,
      "native_stats([svc, mth]) — per-method (answered, errors) counters "
      "for native dispatch; no args returns the whole map"},
+    {"telemetry", (PyCFunction)Engine_telemetry, METH_NOARGS,
+     "telemetry() — the whole always-on observability table in one "
+     "snapshot: per-lane stage histograms, reason-coded fallback "
+     "counters, burst/writev distributions, high-water marks, loop "
+     "busy/idle time, per-method and per-route breakdowns"},
     {nullptr, nullptr, 0, nullptr},
 };
 

@@ -388,3 +388,18 @@ def start_slim_server_span(full_method: str, remote_side) -> Optional[Span]:
     span = Span(full_method, trace_id=0, parent_span_id=0, is_server=True)
     span.remote_side = str(remote_side or "")
     return span
+
+
+def backdate_span(span: Optional[Span], recv_mono_ns) -> None:
+    """Stamp a slim-lane span with the ENGINE's receive timestamp: the
+    C++ loop records CLOCK_MONOTONIC ns when it parses the frame — the
+    same clock as Python's ``time.monotonic_ns()`` — and passes it
+    through the shim call.  ``received_us`` moves back by the elapsed
+    monotonic delta, so the span covers the native queueing/batching
+    delay instead of starting at shim entry; ``start_us`` keeps the
+    shim-entry time, making the queueing visible as received->start."""
+    if span is None or not recv_mono_ns:
+        return
+    delta_us = (time.monotonic_ns() - recv_mono_ns) // 1000
+    if delta_us > 0:
+        span.received_us -= delta_us

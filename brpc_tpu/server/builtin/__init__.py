@@ -214,10 +214,96 @@ def _rpcz(server, msg, rest):
     }, indent=1)
 
 
+def _hist_view(buckets, count, total) -> Dict:
+    """Portal rendering of one engine histogram: non-empty buckets
+    keyed by exclusive upper bound, plus count/avg."""
+    from ...transport.native_bridge import bucket_label
+    view = {bucket_label(i, len(buckets)): n
+            for i, n in enumerate(buckets) if n}
+    return {
+        "count": count,
+        "avg": round(total / count, 1) if count else 0,
+        "buckets": view,
+    }
+
+
+def _native(server, msg, rest):
+    """/native — the native engine's always-on telemetry table: per-lane
+    stage histograms (queue = frame parse -> batched shim entry, shim =
+    dispatch time, resid = parse -> response build), burst/writev
+    coalescing distributions, reason-coded fallback counters with the
+    top reasons per route/method, loop busy ratios and high-water
+    marks.  One engine.telemetry() snapshot renders the whole page."""
+    bridge = getattr(server, "_native_bridge", None)
+    if bridge is None:
+        return (404, "text/plain",
+                "this server has no native engine (ServerOptions.native"
+                " is off)\n")
+    # the shared cache: a hot dashboard polling /native costs one
+    # engine snapshot per TTL, same as the bvar readers
+    t = bridge.telemetry.get()
+    lanes = {}
+    for ln, d in t["lanes"].items():
+        lanes[ln] = {
+            "handled": d["handled"],
+            "errors": d["errors"],
+            "queue_us": _hist_view(d["queue_us"], d["queue_us_count"],
+                                   d["queue_us_sum"]),
+            "shim_us": _hist_view(d["shim_us"], d["shim_us_count"],
+                                  d["shim_us_sum"]),
+            "resid_us": _hist_view(d["resid_us"], d["resid_us_count"],
+                                   d["resid_us_sum"]),
+        }
+    top_fallbacks = sorted(
+        ((k, v) for k, v in t["fallbacks"].items() if v),
+        key=lambda kv: -kv[1])
+
+    def _per_target(table):
+        out = {}
+        for name, d in sorted(table.items()):
+            fbs = sorted(((k[3:], v) for k, v in d.items()
+                          if k.startswith("fb_") and v),
+                         key=lambda kv: -kv[1])
+            row = {"handled": d["handled"], "errors": d["errors"]}
+            if fbs:
+                row["top_fallbacks"] = dict(fbs)
+            out[name] = row
+        return out
+
+    loops = []
+    for lo in t["loops"]:
+        denom = lo["busy_ns"] + lo["idle_ns"]
+        loops.append({
+            "busy_ratio": round(lo["busy_ns"] / denom, 4) if denom
+            else 0.0,
+            "busy_ms": round(lo["busy_ns"] / 1e6, 1),
+            "idle_ms": round(lo["idle_ns"] / 1e6, 1),
+            "polls": lo["polls"],
+        })
+    from ...client.fast_call import scatter_fallback_counters
+    out = {
+        "lanes": lanes,
+        "fallbacks": dict(top_fallbacks),
+        "scatter_fallbacks": scatter_fallback_counters(),
+        "burst": _hist_view(t["burst"], t["burst_count"],
+                            t["burst_sum"]),
+        "writev_iov": _hist_view(t["writev_iov"], t["writev_iov_count"],
+                                 t["writev_iov_sum"]),
+        "wq_hwm": t["wq_hwm"],
+        "inbuf_hwm": t["inbuf_hwm"],
+        "loops": loops,
+        "methods": _per_target(t["methods"]),
+        "routes": _per_target(t["routes"]),
+    }
+    return 200, "application/json", json.dumps(out, indent=1)
+
+
 def _hotspots(server, msg, rest):
-    """/hotspots/{cpu,contention,growth,heap,device} — profilers.
+    """/hotspots/{cpu,contention,growth,heap,device,engine} — profilers.
     ≈ hotspots_service.cpp:35-40 (CPU/heap/growth/contention); device
-    traces are the TPU-native addition (jax.profiler capture)."""
+    traces are the TPU-native addition (jax.profiler capture); engine
+    samples the C++ loops' busy ratio, which the Python-thread
+    profilers cannot see."""
     from ... import profiling
     from ...fiber.runtime import blocking
 
@@ -256,6 +342,41 @@ def _hotspots_run(server, q, kind, seconds):
         return 200, "text/plain", profiling.collect_growth(seconds)
     if kind == "heap":
         return 200, "text/plain", profiling.collect_heap()
+    if kind == "engine":
+        # C++ loop busy ratio over a sampled window: the engine loops
+        # never appear in the Python-thread samplers above, yet they
+        # ARE the data plane — time in callbacks vs epoll_wait is
+        # their whole hotspot story (satellite of the telemetry PR)
+        bridge = getattr(server, "_native_bridge", None)
+        if bridge is None:
+            return (200, "text/plain",
+                    "no native engine loops on this server\n")
+        a = bridge.engine.telemetry()["loops"]
+        time.sleep(seconds)
+        b = bridge.engine.telemetry()["loops"]
+        lines = [f"native engine loops — {seconds:.1f}s window",
+                 f"{'loop':>4} {'busy_ratio':>10} {'busy_ms':>9} "
+                 f"{'idle_ms':>9} {'polls':>7}"]
+        stuck = False
+        for i, (la, lb) in enumerate(zip(a, b)):
+            busy = lb["busy_ns"] - la["busy_ns"]
+            idle = lb["idle_ns"] - la["idle_ns"]
+            polls = lb["polls"] - la["polls"]
+            denom = busy + idle
+            # a loop that never re-entered epoll_wait during the window
+            # spent ALL of it inside one callback (on an inline server
+            # that includes the callback rendering this very page)
+            ratio = busy / denom if denom else 1.0
+            if denom == 0:
+                stuck = True
+            lines.append(
+                f"{i:>4} {ratio:>10.4f} "
+                f"{busy / 1e6:>9.1f} {idle / 1e6:>9.1f} {polls:>7}")
+        if stuck:
+            lines.append("(0-poll loop: the whole window ran inside a "
+                         "single callback — on usercode_inline servers "
+                         "this request itself occupies its loop)")
+        return 200, "text/plain", "\n".join(lines) + "\n"
     if kind == "device":
         try:
             data, name = profiling.collect_device_trace(seconds)
@@ -267,7 +388,8 @@ def _hotspots_run(server, q, kind, seconds):
             "hotspots profilers: /hotspots/cpu?seconds=5&hz=99"
             "[&view=flame|flat|folded], /hotspots/contention?seconds=5, "
             "/hotspots/growth?seconds=5, /hotspots/heap, "
-            "/hotspots/device?seconds=3\n")
+            "/hotspots/device?seconds=3, /hotspots/engine?seconds=5 "
+            "(C++ loop busy ratio)\n")
 
 
 def _sockets(server, msg, rest):
@@ -393,3 +515,4 @@ register_builtin("flags", _flags)
 register_builtin("connections", _connections)
 register_builtin("fibers", _fibers)
 register_builtin("rpcz", _rpcz)
+register_builtin("native", _native)

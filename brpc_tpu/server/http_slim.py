@@ -13,7 +13,9 @@ Kind 4 removes all of it from the eligible path: the C++ engine parses
 the request line + headers itself, batches every eligible HTTP/1.1
 request of a read burst, and enters Python ONCE calling the per-route
 shim built below as ``handler(body, query, content_type, att_size,
-conn_id)`` (bytes-or-None for the middle three).  The shim is the whole
+conn_id, recv_ns)`` (bytes-or-None for the middle three; ``recv_ns``
+is the engine's CLOCK_MONOTONIC parse timestamp, used to backdate
+rpcz spans so they cover native queueing).  The shim is the whole
 per-call Python cost of the lane:
 
     admission   server.on_request_in + MethodStatus.on_requested —
@@ -61,7 +63,7 @@ from ..butil.status import Errno
 from ..butil.time_utils import monotonic_us
 from ..protocol.http import build_response
 from ..protocol.meta import RpcMeta
-from ..rpcz import start_slim_server_span
+from ..rpcz import backdate_span, start_slim_server_span
 from ..transport.socket import Socket
 from .controller import ServerController
 from .http_dispatch import _encode_http_body, http_status_for_error
@@ -111,7 +113,7 @@ def make_http_slim_handler(bridge, server, entry, svc: str, mth: str,
     socks = bridge._socks          # conn_id -> NativeSocket (live dict)
     is_get = http_method in ("GET", "HEAD")
 
-    def slim(body, query, ctype, attsz, conn_id):
+    def slim(body, query, ctype, attsz, conn_id, recv_ns):
         sock = socks.get(conn_id)
         if sock is None:
             return None          # connection died mid-burst
@@ -201,6 +203,9 @@ def make_http_slim_handler(bridge, server, entry, svc: str, mth: str,
         span = start_slim_server_span(full_name, sock.remote_side)
         if span is not None:
             span.request_size = len(body)
+            # span start = the ENGINE's parse time, not shim entry:
+            # native read/parse/batch queueing is real latency
+            backdate_span(span, recv_ns)
             cntl.span = span
 
         # request build — mirror of _bridge_rpc

@@ -5,8 +5,11 @@ The reference runs the ENTIRE request path to the user callback in C++
 (/root/reference/src/brpc/policy/baidu_rpc_protocol.cpp:314-536); here
 the C++ engine scans the meta TLV, batches every eligible unary request
 of a read burst, and enters Python ONCE calling the shim built below as
-``handler(payload: bytes, att: bytes | None, cid: int, conn_id: int)``.
-The shim is the whole per-call Python cost of the lane:
+``handler(payload: bytes, att: bytes | None, cid: int, conn_id: int,
+dom, nonce, recv_ns: int)`` — ``recv_ns`` is the engine's
+CLOCK_MONOTONIC frame-parse timestamp, used to backdate rpcz spans so
+they cover native queueing.  The shim is the whole per-call Python
+cost of the lane:
 
     admission   server.on_request_in + MethodStatus.on_requested (the
                 concurrency-limiter path — NOT dropped; ELIMIT answers
@@ -47,7 +50,7 @@ from ..butil.logging_util import LOG
 from ..butil.status import Errno
 from ..protocol.meta import RpcMeta
 from ..protocol.tpu_std import parse_payload
-from ..rpcz import start_slim_server_span
+from ..rpcz import backdate_span, start_slim_server_span
 from .controller import ServerController
 from .rpc_dispatch import _send_error, _send_response
 
@@ -72,10 +75,11 @@ def make_slim_handler(bridge, server, entry, svc: str, mth: str):
     def _send(cntl, response, _server=server, _entry=entry):
         _send_response(_server, _entry, cntl, response)
 
-    def slim(payload, att, cid, conn_id, dom, nonce,
+    def slim(payload, att, cid, conn_id, dom, nonce, recv_ns,
              _server=server, _status=status, _fn=fn, _rt=req_type,
              _svc=svc, _mth=mth, _send=_send, _socks=socks,
-             _ns=_mono_ns, _sample=start_slim_server_span):
+             _ns=_mono_ns, _sample=start_slim_server_span,
+             _backdate=backdate_span):
         sock = _socks.get(conn_id)
         if sock is None:
             return None          # connection died mid-burst: drop, like
@@ -116,6 +120,9 @@ def make_slim_handler(bridge, server, entry, svc: str, mth: str):
         span = _sample(_status.full_name, sock.remote_side)
         if span is not None:
             span.request_size = len(payload) + na
+            # span start = the ENGINE's frame-parse time, not shim
+            # entry: native read/parse/batch queueing is real latency
+            _backdate(span, recv_ns)
             cntl.span = span
         try:
             request = parse_payload(payload, _rt)

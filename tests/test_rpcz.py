@@ -71,3 +71,56 @@ def test_rpcz_disable_flag(server):
         assert global_span_store().recent() == []
     finally:
         flags_mod.set_flag("enable_rpcz", "true")
+
+
+def test_slim_lane_span_backdated_to_engine_receive():
+    """Regression (observability PR): slim-lane spans used to start at
+    shim entry, undercounting native read/parse/batch queueing.  The
+    engine now passes its CLOCK_MONOTONIC frame-parse timestamp into
+    the shim and the span's received_us is backdated to it — so
+    received_us <= start_us (shim entry) and the span latency is >= the
+    shim-measured (start-based) latency, never under it."""
+    import socket as pysock
+
+    from conftest import require_native
+    from brpc_tpu.server import ServerOptions
+
+    require_native()
+    global_span_store().clear()
+    opts = ServerOptions()
+    opts.native = True
+    opts.usercode_inline = True
+    opts.native_loops = 1
+    srv = Server(opts)
+    srv.add_service(Traced())
+    assert srv.start("127.0.0.1:0") == 0
+    try:
+        ep = srv.listen_endpoint
+        # a pipelined burst in ONE write: later items of the batch wait
+        # behind earlier handlers, so real engine-side queueing exists
+        burst = b"".join(
+            b"POST /Traced/Work HTTP/1.1\r\nHost: x\r\n"
+            b"Content-Length: 2\r\n\r\nhi" for _ in range(16))
+        with pysock.create_connection((str(ep.host), ep.port),
+                                      timeout=10) as c:
+            c.sendall(burst)
+            c.settimeout(10)
+            buf = b""
+            while buf.count(b"done") < 16:
+                part = c.recv(65536)
+                assert part, buf[:200]
+                buf += part
+        spans = [s for s in global_span_store().recent(2048)
+                 if s.full_method == "Traced.Work" and s.is_server]
+        assert spans, "no slim-lane server spans recorded"
+        for s in spans:
+            assert s.received_us <= s.start_us
+            shim_measured = s.end_us - s.start_us
+            assert s.latency_us >= shim_measured
+        # across a 16-deep pipelined burst at least one span saw
+        # non-zero native queueing before shim entry
+        assert any(s.start_us - s.received_us > 0 for s in spans), \
+            [(s.start_us - s.received_us) for s in spans]
+    finally:
+        srv.stop()
+        global_span_store().clear()
