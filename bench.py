@@ -754,6 +754,88 @@ def bench_http(extra: dict) -> None:
         extra["http_1kb_pytransport_p99_us"] = p99
 
 
+def bench_trace(extra: dict) -> None:
+    """trace_propagation_overhead_pct: cost of FORCING a trace on the
+    hottest Controller lane (tpu_std slim native dispatch) — forced
+    traces ride the same native path as untraced calls since the
+    distributed-rpcz PR (trace TLVs in the raw_call tail, context
+    through the kind-3 shim, client+server span recording), so this
+    pair bounds the whole observer effect: TLV bytes + two Span
+    objects + two store inserts per call.  Paired interleaved A/B with
+    alternating order and the MEDIAN per-round overhead reported, plus
+    the same-methodology no-trace/no-trace control as the noise floor
+    (methodology of native_telemetry_overhead_pct)."""
+    from brpc_tpu.client import Channel, ChannelOptions, Controller
+    from brpc_tpu.rpcz import global_span_store
+    from brpc_tpu.server import Server, ServerOptions, Service
+
+    class TraceEcho(Service):
+        def Echo(self, cntl, request):
+            return request
+
+    rounds, secs = 7, 0.4
+    opts = ServerOptions()
+    opts.native = True
+    opts.native_loops = 1
+    opts.usercode_inline = True
+    srv = Server(opts)
+    srv.add_service(TraceEcho(), name="TR")
+    assert srv.start("127.0.0.1:0") == 0
+    try:
+        co = ChannelOptions()
+        co.connection_type = "pooled"
+        ch = Channel(co)
+        ch.init(str(srv.listen_endpoint))
+        payload = bytes(128)
+        tid_counter = [1]
+
+        def phase(traced: bool, ssecs: float) -> float:
+            n = 0
+            t0 = time.perf_counter()
+            while time.perf_counter() - t0 < ssecs:
+                cntl = Controller()
+                cntl.timeout_ms = 10_000
+                if traced:
+                    tid_counter[0] += 1
+                    cntl.trace_id = tid_counter[0]
+                c = ch.call_method("TR.Echo", payload, cntl=cntl)
+                if c.failed:
+                    raise RuntimeError(c.error_text)
+                n += 1
+            return n / (time.perf_counter() - t0)
+
+        def paired_ab(a_traced: bool) -> tuple:
+            pcts, a_qps, b_qps = [], [], []
+            for r in range(rounds):
+                if r % 2 == 0:
+                    qa = phase(a_traced, secs)
+                    qb = phase(False, secs)
+                else:
+                    qb = phase(False, secs)
+                    qa = phase(a_traced, secs)
+                a_qps.append(qa)
+                b_qps.append(qb)
+                if qb > 0:
+                    pcts.append((qb - qa) / qb * 100)
+            pcts.sort()
+            med = pcts[len(pcts) // 2] if pcts else 0.0
+            return (round(med, 2),
+                    round(sum(a_qps) / len(a_qps), 1),
+                    round(sum(b_qps) / len(b_qps), 1))
+
+        phase(True, 0.2)                  # warm both shapes
+        phase(False, 0.2)
+        pct, q_traced, q_plain = paired_ab(True)
+        noise, _, _ = paired_ab(False)
+        extra["trace_propagation_overhead_pct"] = pct
+        extra["trace_propagation_ab_noise_pct"] = noise
+        extra["trace_forced_qps"] = q_traced
+        extra["trace_untraced_qps"] = q_plain
+        global_span_store().clear()       # the bench recorded ~1e4 spans
+    finally:
+        srv.stop()
+
+
 def bench_grpc(extra: dict) -> None:
     """gRPC unary 1KB echo: a real grpcio client against our server ON
     THE NATIVE PORT (h2 rides the engine's passthrough lane — native
@@ -1387,6 +1469,7 @@ def main() -> None:
     for name, fn in (("streaming", bench_streaming),
                      ("fanout", bench_fanout),
                      ("http", bench_http),
+                     ("trace", bench_trace),
                      ("grpc", bench_grpc)):
         if not budget_left():
             extra[f"{name}_skipped"] = "bench budget spent"

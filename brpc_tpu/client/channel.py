@@ -122,6 +122,12 @@ class Channel:
             from ..butil.iobuf import IOBuf
             c.request_attachment = attachment if isinstance(attachment, IOBuf) \
                 else IOBuf(attachment)
+        if c.trace_id:
+            # explicitly traced call: open the client half of the span
+            # pair before any lane is chosen, so every wire protocol
+            # (tpu_std TLVs, HTTP/h2 traceparent) carries this hop's
+            # span id and the server span parents to it
+            c._begin_trace_span(method_full)
         if self.options.protocol == "grpc":
             if done is not None:
                 # keep call_method's async contract: the blocking h2
@@ -183,9 +189,20 @@ class Channel:
             return c
         svc, _, mth = method_full.rpartition(".")
         timeout_s = (c.timeout_ms or self.options.timeout_ms or 30000) / 1e3
+        metadata = None
+        if c.trace_id and c.span_id:
+            # trace context over h2 as a W3C traceparent header (HPACK
+            # metadata — same mapping as the HTTP/1.1 client); omitted
+            # when span_id==0 (rpcz disabled: no client span) — an
+            # all-zero parent-id is W3C-invalid and strict peers drop
+            # the whole header
+            from ..rpcz import format_traceparent
+            metadata = [("traceparent",
+                         format_traceparent(c.trace_id, c.span_id))]
         begin = monotonic_us()
         status, message, body = grpc_connection(remote).unary_call(
-            f"/{svc}/{mth}", payload, timeout_s=timeout_s)
+            f"/{svc}/{mth}", payload, timeout_s=timeout_s,
+            metadata=metadata)
         c.latency_us = monotonic_us() - begin
         if status != 0:
             c.set_failed(errno_of_grpc_status(status),

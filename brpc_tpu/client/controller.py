@@ -68,6 +68,7 @@ class Controller(LazyAttachmentsMixin):
         "_attempt_sids", "_inflight_marks", "attempt_remotes",
         "_stream_to_create",
         "_channel", "_lb_ctx", "trace_id", "span_id", "_direct_ok",
+        "_client_span",
     )
 
     def __init__(self):
@@ -115,10 +116,26 @@ class Controller(LazyAttachmentsMixin):
         self._lb_ctx = None
         self.trace_id = 0
         self.span_id = 0
+        self._client_span = None         # rpcz Span for a forced trace
 
     # -- lazy hot-path members ---------------------------------------------
     # attachments: LazyAttachmentsMixin.  The Event is also lazy: a sync
     # unary call never touches it (completed inline on the caller).
+
+    def _begin_trace_span(self, method_full: str) -> None:
+        """Open the client half of an EXPLICITLY traced call (trace_id
+        set): the client span parents to whatever span id the caller
+        carried in (a fan-out root, an upstream server span) and the
+        call's own span id replaces it on the wire, so the server span
+        links back to THIS hop.  Idempotent — retries and lane
+        escalations reuse the one span."""
+        if not self.trace_id or self._client_span is not None:
+            return
+        from ..rpcz import start_client_span
+        span = start_client_span(method_full, self.trace_id, self.span_id)
+        if span is not None:
+            self._client_span = span
+            self.span_id = span.span_id
 
     def _signal_ended(self) -> None:
         """Completion signal: flag first, then wake any created Event.
@@ -126,6 +143,11 @@ class Controller(LazyAttachmentsMixin):
         in-flight set — a call that ends without a response (timeout,
         cancel, abandoned retry) must not leave its id pinned on a
         long-lived connection."""
+        span = self._client_span
+        if span is not None:
+            self._client_span = None
+            span.remote_side = str(self.remote_side or "")
+            span.finish(self._error_code)
         self._ended_flag = True
         ev = self._ended
         if ev is not None:
@@ -353,9 +375,19 @@ class Controller(LazyAttachmentsMixin):
             att = self.request_attachment.to_bytes()
             body = self._request_payload.to_bytes() + att
             headers = [("x-rpc-attachment-size", str(len(att)))] \
-                if att else None
+                if att else []
+            if self.trace_id and self.span_id:
+                # trace context rides HTTP as a W3C traceparent header
+                # (the tpu_std meta TLVs' cross-protocol spelling).
+                # span_id==0 (rpcz disabled: no client span recorded)
+                # would spell an all-zero parent-id, which the W3C
+                # grammar forbids and strict peers drop — omit instead
+                from ..rpcz import format_traceparent
+                headers.append(("traceparent", format_traceparent(
+                    self.trace_id, self.span_id)))
             frame = build_request("POST", f"/{svc}/{mth}", body=body,
-                                  host=str(remote), headers=headers)
+                                  host=str(remote),
+                                  headers=headers or None)
             sock.correlation_id = attempt_id   # response routing (no
             # failure-notification role: the inflight set owns that, so
             # a set_failed racing this write cannot double-error the id)

@@ -344,14 +344,16 @@ def run(channel, cntl, method_full: str, request: Any,
 
         # -- pinned native round trip (the controller lane's fast sub-
         # path): when nothing per-call needs Python-built meta (no
-        # device attachment, no ici domain, no trace/span, auth already
-        # on the wire), the whole frame build + write + read + response
-        # scan runs in C via nat.raw_call on the thread-pinned pooled
-        # socket — the same engine call the raw lane uses, carrying the
-        # controller's retry/backup-excluded bookkeeping around it.
+        # device attachment, no ici domain, auth already on the wire),
+        # the whole frame build + write + read + response scan runs in
+        # C via nat.raw_call on the thread-pinned pooled socket — the
+        # same engine call the raw lane uses, carrying the controller's
+        # retry/backup-excluded bookkeeping around it.  Trace context
+        # is NOT a screening condition: the trace/span TLVs ride the
+        # per-call tail the engine serializes verbatim, so tracing a
+        # request no longer changes the very path being observed.
         if (pooled and nat is not None and _HAS_RAW_CALL
-                and cntl.request_device_attachment is None
-                and not cntl.trace_id and not cntl.span_id):
+                and cntl.request_device_attachment is None):
             psid, psock = _raw_socket(remote)
             if psock is not None and (
                     not psock.direct_read or not psock.read_portal.empty()
@@ -383,6 +385,15 @@ def run(channel, cntl, method_full: str, request: Any,
                     if tails is None:
                         tails = psock._cntl_tails = {}
                     tails[method_full] = tail
+                if cntl.trace_id:
+                    # per-call trace TLVs after the cached tail (never
+                    # cached: ids differ per call) — the engine writes
+                    # them into the meta region verbatim
+                    tail = tail + TLV_TRACE \
+                        + struct.pack("<Q", cntl.trace_id)
+                    if cntl.span_id:
+                        tail += TLV_SPAN \
+                            + struct.pack("<Q", cntl.span_id)
                 if att_len and len(att_parts) > 1:
                     att_buf = att.to_bytes()
                 elif att_len:
@@ -749,6 +760,14 @@ def run_scatter(branches, timeout_ms: Optional[int]) -> bool:
             return _scatter_fallback("device_attachment")
         if not isinstance(request, (bytes, bytearray, memoryview)):
             return _scatter_fallback("nonbytes_request")
+    for channel, cntl, _m, _req, _r in branches:
+        if cntl.trace_id:
+            # traced fan-out: each branch opens its own client span
+            # (parented to whatever span id the branch carried in —
+            # the fan-out root), and the branch's OWN span id rides
+            # the wire so every sub-server span links to its branch.
+            # Both sub-lanes below serialize the trace TLVs natively.
+            cntl._begin_trace_span(_m)
     nat = _native()
     if nat is not None and hasattr(nat, "scatter_call") \
             and _scatter_native(branches, timeout_ms, nat):
@@ -782,6 +801,10 @@ def run_scatter(branches, timeout_ms: Optional[int]) -> bool:
         mb = _CID_TAG + struct.pack("<Q", cid) + tlv
         if cntl.timeout_ms and cntl.timeout_ms > 0:
             mb += _TMO_TAG + struct.pack("<I", int(cntl.timeout_ms))
+        if cntl.trace_id:
+            mb += TLV_TRACE + struct.pack("<Q", cntl.trace_id)
+            if cntl.span_id:
+                mb += TLV_SPAN + struct.pack("<Q", cntl.span_id)
         frame = (_MAGIC
                  + struct.pack("<II", len(mb) + len(request), len(mb))
                  + mb + request)
@@ -904,6 +927,15 @@ def _scatter_native(branches, timeout_ms: Optional[int], nat) -> bool:
             if tails is None:
                 tails = sock._cntl_tails = {}
             tails[method_full] = tail
+        if cntl.trace_id:
+            # per-branch trace TLVs after the cached tail (never
+            # cached: each branch's span id is unique) — scatter_call
+            # serializes them into the meta region verbatim, so a
+            # traced fan-out emits N properly-parented child spans
+            # without leaving the native lane
+            tail = tail + TLV_TRACE + struct.pack("<Q", cntl.trace_id)
+            if cntl.span_id:
+                tail += TLV_SPAN + struct.pack("<Q", cntl.span_id)
         cid = _next_cid()
         ack0 = sock._take_ack_frame() if sock._pending_acks else None
         items.append((sock.fd.fileno(), tail, request, None, cid, ack0))

@@ -74,6 +74,20 @@ class ParallelChannel:
         n = len(branches)
         fail_limit = self.fail_limit if self.fail_limit >= 0 else n
 
+        if c.trace_id:
+            # traced fan-out: one ROOT client span for the whole
+            # scatter-gather; every branch parents to it (each branch
+            # opens its own client span under the root, and the
+            # sub-servers' spans parent to their branch) — one trace id
+            # explains the entire call tree, stitched at /rpcz
+            from ..rpcz import start_client_span
+            root = start_client_span(f"ParallelChannel.{method_full}",
+                                     c.trace_id, c.span_id)
+            if root is not None:
+                root.annotate(f"fan-out: {n} branches")
+                c._client_span = root       # finished by _signal_ended
+                c.span_id = root.span_id
+
         if done is None:
             # scatter-gather fast lane: all requests on the wire first,
             # then collect — no per-branch dispatcher/fiber machinery
@@ -87,6 +101,10 @@ class ParallelChannel:
                 # branches are unary one-shots: exclusive pooled
                 # connections let one thread own all the reads
                 sc.connection_type = "pooled"
+                # trace context flows to every branch; run_scatter
+                # opens the per-branch client span under the root
+                sc.trace_id = c.trace_id
+                sc.span_id = c.span_id
                 sub_cntls.append(sc)
                 scatter.append((sub, sc, method_full, mapped,
                                 response_type))
@@ -163,6 +181,10 @@ class ParallelChannel:
             sub_cntl = Controller()
             sub_cntl.timeout_ms = c.timeout_ms
             sub_cntl.max_retry = c.max_retry
+            # trace context flows to every branch; call_method opens
+            # the per-branch client span under the root
+            sub_cntl.trace_id = c.trace_id
+            sub_cntl.span_id = c.span_id
             sub.call_method(method_full, mapped, response_type,
                             done=on_branch_done(slot), cntl=sub_cntl)
         if done is None:

@@ -218,6 +218,10 @@ enum FbReason : int {
   FB_RPC_NO_METHOD,          // svc.mth not registered with the engine
   FB_RPC_ATT_OVER_CAP,       // kind-3 attachment above kSlimAttCap
   FB_RPC_LARGE_FRAME,        // kind-2/3 frame on the direct-read path
+  FB_RPC_TRACE_RAW,          // explicit trace on a kind-0/1/2 method:
+                             // only the Python path can record a span
+                             // there (the kind-3/4 slim lanes carry
+                             // trace context through the shim instead)
   FB_HTTP_SLIM_OFF,          // slim HTTP lane gated off
   FB_HTTP_MALFORMED_LINE,    // request line missing tokens
   FB_HTTP_VERSION,           // version not exactly "HTTP/1.1\r\n"
@@ -233,7 +237,8 @@ enum FbReason : int {
 };
 static const char* kFbNames[FB_REASONS] = {
     "rpc_dispatch_off",   "rpc_meta_tag",     "rpc_no_method",
-    "rpc_att_over_cap",   "rpc_large_frame",  "http_slim_off",
+    "rpc_att_over_cap",   "rpc_large_frame",  "rpc_trace_raw_lane",
+    "http_slim_off",
     "http_malformed_line", "http_version",    "http_no_route",
     "http_expect",        "http_upgrade",     "http_connection",
     "http_transfer_encoding", "http_bad_header", "http_large_body",
@@ -358,7 +363,8 @@ struct Loop {
 // kind 3 is the SLIM SERVER LANE for full (cntl, request) methods: the
 // engine scans the meta, batches eligible requests, and enters Python
 // ONCE per read burst calling
-// handler(payload, att, cid, conn_id, dom, nonce, recv_ns) —
+// handler(payload, att, cid, conn_id, dom, nonce, recv_ns, trace) —
+// trace is None or the request's (trace_id, span_id, parent_id) —
 // admission,
 // MethodStatus accounting and rpcz span sampling live in that shim
 // (server/slim_dispatch.py).  A buffer return is framed
@@ -376,6 +382,7 @@ struct NativeMethod {
   // already resolved); atomics: several loops may hit one method
   std::atomic<uint64_t> fb_att_over_cap{0};
   std::atomic<uint64_t> fb_large_frame{0};
+  std::atomic<uint64_t> fb_trace_raw{0};
 };
 
 // An HTTP route the engine dispatches through the SLIM HTTP LANE
@@ -409,6 +416,11 @@ struct PyRawItem {
   uint32_t dom_len = 0;
   const char* conn = nullptr;   // kind 3: request's conn-nonce bytes
   uint32_t conn_len = 0;
+  // kind 3: trace context TLVs (trace/span/parent) — handed to the
+  // shim so traced requests stay on the slim lane
+  uint64_t trace_id = 0;
+  uint64_t span_id = 0;
+  uint64_t parent_id = 0;
   // kind-4 slim-HTTP fields (hroute != nullptr selects the lane)
   HttpRoute* hroute = nullptr;
   const char* query = nullptr;  // bytes after '?' in the request target
@@ -417,6 +429,8 @@ struct PyRawItem {
   uint32_t ctlen = 0;
   const char* attsz = nullptr;  // x-rpc-attachment-size value (raw)
   uint32_t attszlen = 0;
+  const char* tp = nullptr;     // traceparent header value (raw)
+  uint32_t tplen = 0;
   // telemetry: CLOCK_MONOTONIC ns at frame parse (comparable with
   // Python's time.monotonic_ns — the shims backdate rpcz spans with it)
   int64_t t_parse = 0;
@@ -633,11 +647,18 @@ struct MetaScan {
   uint32_t dom_len = 0;
   const char* conn = nullptr;
   uint32_t conn_len = 0;
+  // tags 9/10/11 (trace/span/parent): the SLIM lane (kind 3) forwards
+  // the context to the shim so traced requests STAY on the fast path;
+  // kinds 0/1/2 fall back (reason-coded) — no span machinery there
+  uint64_t trace_id = 0;
+  uint64_t span_id = 0;
+  uint64_t parent_id = 0;
 };
 
-// Mirror of native_bridge._scan_request_meta: collect cid/att/svc/mth,
+// Mirror of native_bridge._scan_request_meta: collect cid/att/svc/mth
+// plus the trace context (9/10/11 — slim lane carries it through),
 // tolerate timeout/ici-domain/conn-nonce (13/15/17), bail on anything
-// controller-tier (compress, errors, auth, trace, span, stream, desc).
+// controller-tier (compress, errors, auth, stream, desc).
 static bool scan_request_meta(const char* p, size_t len, MetaScan* out) {
   size_t off = 0;
   while (off < len) {
@@ -663,6 +684,18 @@ static bool scan_request_meta(const char* p, size_t len, MetaScan* out) {
       case 5:
         out->mth = p + off;
         out->mth_len = ln;
+        break;
+      case 9:
+        if (ln != 8) return false;
+        memcpy(&out->trace_id, p + off, 8);
+        break;
+      case 10:
+        if (ln != 8) return false;
+        memcpy(&out->span_id, p + off, 8);
+        break;
+      case 11:
+        if (ln != 8) return false;
+        memcpy(&out->parent_id, p + off, 8);
         break;
       case 13:
         break;              // remaining-deadline: safe for every lane
@@ -794,19 +827,22 @@ static void http_slim_item(Loop* lp, Conn* c, PyRawItem& it) {
   PyObject* conn = body ? PyLong_FromUnsignedLongLong(c->id) : nullptr;
   PyObject* rcv = conn
       ? PyLong_FromLongLong((long long)it.t_parse) : nullptr;
+  PyObject* tp = it.tp
+      ? PyBytes_FromStringAndSize(it.tp, it.tplen) : nullptr;
   PyObject* r = nullptr;
   if (body && conn && rcv && (!it.query || q) && (!it.ctype || ct)
-      && (!it.attsz || asz))
+      && (!it.attsz || asz) && (!it.tp || tp))
     r = PyObject_CallFunctionObjArgs(it.hroute->handler, body,
                                      q ? q : Py_None, ct ? ct : Py_None,
                                      asz ? asz : Py_None, conn, rcv,
-                                     nullptr);
+                                     tp ? tp : Py_None, nullptr);
   Py_XDECREF(body);
   Py_XDECREF(q);
   Py_XDECREF(ct);
   Py_XDECREF(asz);
   Py_XDECREF(conn);
   Py_XDECREF(rcv);
+  Py_XDECREF(tp);
   if (!r) {
     // shim raised (or OOM building args): answer a plain 500 with the
     // exception text, keeping the keep-alive conn in sync
@@ -901,13 +937,22 @@ static void raw_slim_item(Loop* lp, Conn* c, PyRawItem& it) {
           ? PyBytes_FromStringAndSize(it.conn, it.conn_len) : nullptr;
       PyObject* rcv = conn
           ? PyLong_FromLongLong((long long)it.t_parse) : nullptr;
+      // trace context (tags 9/10/11) as one tuple — None on the
+      // untraced hot path (no per-call tuple churn there)
+      PyObject* tr = nullptr;
+      if (it.trace_id)
+        tr = Py_BuildValue("(KKK)", (unsigned long long)it.trace_id,
+                           (unsigned long long)it.span_id,
+                           (unsigned long long)it.parent_id);
       if (pb && (it.att == 0 || ab) && cid && conn && rcv
-          && (it.dom_len == 0 || dom) && (it.conn_len == 0 || nonce))
+          && (it.dom_len == 0 || dom) && (it.conn_len == 0 || nonce)
+          && (it.trace_id == 0 || tr))
         r = PyObject_CallFunctionObjArgs(it.m->handler, pb,
                                          ab ? ab : Py_None, cid, conn,
                                          dom ? dom : Py_None,
                                          nonce ? nonce : Py_None,
-                                         rcv, nullptr);
+                                         rcv, tr ? tr : Py_None,
+                                         nullptr);
       Py_XDECREF(pb);
       Py_XDECREF(ab);
       Py_XDECREF(cid);
@@ -915,6 +960,7 @@ static void raw_slim_item(Loop* lp, Conn* c, PyRawItem& it) {
       Py_XDECREF(dom);
       Py_XDECREF(nonce);
       Py_XDECREF(rcv);
+      Py_XDECREF(tr);
       if (r == Py_None) {
         // handled out-of-band: the shim completed (or will complete)
         // the RPC through the classic Python send path
@@ -1056,6 +1102,14 @@ static bool native_try_handle(EngineImpl* eng, Loop* lp, Conn* c,
     lp->tel.fallbacks[FB_RPC_NO_METHOD]++;
     return false;
   }
+  if (s.trace_id && m->kind != 3) {
+    // explicit trace on an echo/const/raw method: a span must record,
+    // and only the Python path has the span machinery for those lanes
+    // (kind 3 carries the context through the shim instead)
+    lp->tel.fallbacks[FB_RPC_TRACE_RAW]++;
+    m->fb_trace_raw++;
+    return false;
+  }
   const char* payload = body + meta_size;
   size_t plen = body_len - meta_size;
   if (s.att > plen) {
@@ -1104,6 +1158,9 @@ static bool native_try_handle(EngineImpl* eng, Loop* lp, Conn* c,
       pi.dom_len = s.dom_len;
       pi.conn = s.conn;
       pi.conn_len = s.conn_len;
+      pi.trace_id = s.trace_id;
+      pi.span_id = s.span_id;
+      pi.parent_id = s.parent_id;
       pi.t_parse = now_ns();
       batch->push_back(pi);
       break;
@@ -1496,6 +1553,8 @@ static bool http_slim_match(EngineImpl* eng, Loop* lp, const char* p,
   uint32_t ctlen = 0;
   const char* attsz = nullptr;
   uint32_t attszlen = 0;
+  const char* tp = nullptr;
+  uint32_t tplen = 0;
   const char* line = nl + 1;
   while (line < he) {
     const char* leol =
@@ -1530,6 +1589,12 @@ static bool http_slim_match(EngineImpl* eng, Loop* lp, const char* p,
                             RFB_CONNECTION);     // odd value
         }
         break;
+      case 11:
+        if (strncasecmp(line, "traceparent", 11) == 0) {
+          tp = v;                               // W3C trace context —
+          tplen = (uint32_t)vlen;               // the shim parses it,
+        }                                       // traced stays slim
+        break;
       case 12:
         if (strncasecmp(line, "content-type", 12) == 0) {
           ctype = v;                            // last one wins, like
@@ -1559,6 +1624,8 @@ static bool http_slim_match(EngineImpl* eng, Loop* lp, const char* p,
   out->ctlen = ctlen;
   out->attsz = attsz;
   out->attszlen = attszlen;
+  out->tp = tp;
+  out->tplen = tplen;
   return true;
 }
 
@@ -1986,6 +2053,13 @@ static bool conn_readable(EngineImpl* eng, Loop* lp, Conn* c) {
                          // zero-copy NativeBuf path beats a batch copy
                          // (for slim this IS the big-attachment
                          // fallback to the classic dispatch)
+        }
+        if (m && s.trace_id) {
+          // traced echo/const on the direct-read path: the span must
+          // record — mirror of native_try_handle's trace screening
+          lp->tel.fallbacks[FB_RPC_TRACE_RAW]++;
+          m->fb_trace_raw++;
+          m = nullptr;
         }
         if (m) {
           size_t plen = (size_t)b->size - c->msg_meta;
@@ -2432,7 +2506,7 @@ static PyObject* Engine_set_native_dispatch(EngineObj* self,
 // SLIM HTTP LANE (kind 4): eligible HTTP/1.1 requests matching
 // METHOD+path are parsed in C++, burst-batched, and dispatched to the
 // shim as handler(body, query, content_type, att_size, conn_id,
-// recv_ns); a
+// recv_ns, traceparent); a
 // (status, header_block, body) return is serialized natively, bytes
 // are appended verbatim (pre-built classic escalations), None means
 // the shim completed out-of-band.
@@ -2678,13 +2752,16 @@ static PyObject* Engine_telemetry(EngineObj* self, PyObject*) {
     size_t z = name.find('\0');
     if (z != std::string::npos) name[z] = '.';
     PyObject* md = Py_BuildValue(
-        "{s:i,s:K,s:K,s:K,s:K}", "kind", m->kind, "handled",
+        "{s:i,s:K,s:K,s:K,s:K,s:K}", "kind", m->kind, "handled",
         (unsigned long long)cnt, "errors", (unsigned long long)err,
         "fb_rpc_att_over_cap",
         (unsigned long long)m->fb_att_over_cap.load(
             std::memory_order_relaxed),
         "fb_rpc_large_frame",
         (unsigned long long)m->fb_large_frame.load(
+            std::memory_order_relaxed),
+        "fb_rpc_trace_raw_lane",
+        (unsigned long long)m->fb_trace_raw.load(
             std::memory_order_relaxed));
     if (!md || PyDict_SetItemString(methods, name.c_str(), md) != 0) {
       Py_XDECREF(md);

@@ -529,12 +529,24 @@ def _process_grpc(req: H2Request, sock, server) -> None:
     meta = RpcMeta()
     meta.service_name = entry.status.full_name.rsplit(".", 1)[0]
     meta.method_name = entry.method_name
+    tp_header = req.header("traceparent")
+    if tp_header:
+        from ..rpcz import parse_traceparent
+        tp = parse_traceparent(tp_header)
+        if tp is not None:
+            # W3C trace context over HPACK → the internal trace model:
+            # the server span parents to the caller's span id, exactly
+            # like the tpu_std meta's trace/span TLVs
+            meta.trace_id, meta.span_id = tp
 
     def send(cntl: ServerController, response) -> None:
         latency_us = monotonic_us() - cntl.begin_time_us
         entry.status.on_responded(cntl.error_code, latency_us)
         server.on_request_out()
+        span = cntl.span
         if cntl.failed:
+            if span is not None:
+                span.finish(cntl.error_code)
             req.conn.send_grpc_response(
                 sock, req.stream_id, None,
                 grpc_status_of(cntl.error_code), cntl.error_text)
@@ -542,13 +554,23 @@ def _process_grpc(req: H2Request, sock, server) -> None:
         try:
             body = serialize_payload(response).to_bytes()
         except TypeError as e:
+            if span is not None:
+                span.finish(int(Errno.EINTERNAL))
             req.conn.send_grpc_response(sock, req.stream_id, None, 13,
                                         f"serialize: {e}")
             return
+        if span is not None:
+            span.response_size = len(body)
+            span.finish(0)
         req.conn.send_grpc_response(sock, req.stream_id, body, 0)
 
     cntl = ServerController(meta, sock.remote_side, sock.id, send)
     cntl.server = server
+    from ..rpcz import start_server_span
+    cntl.span = start_server_span(entry.status.full_name, meta,
+                                  sock.remote_side)
+    if cntl.span is not None:
+        cntl.span.request_size = len(payload)
     try:
         request = parse_payload(payload, entry.request_type)
     except Exception as e:

@@ -2,10 +2,14 @@
 
 ≈ /root/reference/src/brpc/span.h:47-84 + builtin/rpcz_service.cpp:
 spans are rate-limited samples (bvar Collector, collector.h:57-72) so
-tracing can stay always-on; trace context (trace_id/span_id/parent) rides
-the tpu_std meta; storage is an in-memory bounded store browsable at
+tracing can stay always-on; trace context (trace_id/span_id/parent)
+rides EVERY wire protocol — the tpu_std meta TLVs, a W3C
+``traceparent`` header on HTTP/1.1, and the same header over gRPC/h2
+(HPACK) — so one trace id explains a whole cross-protocol call tree.
+Storage is an in-memory bounded store (trace-id indexed) browsable at
 /rpcz (the reference uses leveldb — deliberately simpler here, same
-capability surface: recent spans by id/time, annotations).
+capability surface: recent spans by id/time, annotations); the
+cross-process stitcher lives in rpcz_stitch.py.
 """
 
 from __future__ import annotations
@@ -34,14 +38,19 @@ define_flag("rpcz_db_max_spans", 200_000,
             "per-process cap on persisted spans (oldest trimmed)",
             lambda v: int(v) > 0)
 
-_span_seq = itertools.count(1)
+# span ids must stay unique ACROSS processes for stitched traces (a
+# child span in another rank links back by parent_span_id alone): seed
+# the per-process counter into a random 48-bit window instead of 1, so
+# two ranks' sequences virtually never collide while ids stay compact
+# enough for sqlite/JSON round trips
+_span_seq = itertools.count((fast_rand() & ((1 << 47) - 1)) | (1 << 47))
 
 
 class Span(Collected):
     __slots__ = ("trace_id", "span_id", "parent_span_id", "full_method",
                  "remote_side", "received_us", "start_us", "end_us",
                  "error_code", "request_size", "response_size",
-                 "annotations", "is_server", "forced")
+                 "annotations", "is_server", "forced", "mono_ns")
 
     def __init__(self, full_method: str, trace_id: int = 0,
                  parent_span_id: int = 0, is_server: bool = True):
@@ -56,6 +65,10 @@ class Span(Collected):
         self.received_us = int(time.time() * 1e6)
         self.start_us = self.received_us
         self.end_us = 0
+        # CLOCK_MONOTONIC anchor: comparable across processes on ONE
+        # host (same clock since boot) — the stitcher uses it to flag
+        # wall-clock skew instead of silently mis-ordering spans
+        self.mono_ns = time.monotonic_ns()
         self.error_code = 0
         self.request_size = 0
         self.response_size = 0
@@ -83,7 +96,10 @@ class Span(Collected):
             "method": self.full_method,
             "remote": self.remote_side,
             "received_us": self.received_us,
+            "start_us": self.start_us,
+            "end_us": self.end_us,
             "latency_us": self.latency_us,
+            "mono_ns": self.mono_ns,
             "error_code": self.error_code,
             "request_size": self.request_size,
             "response_size": self.response_size,
@@ -100,6 +116,9 @@ class SpanStore:
     def __init__(self):
         self._lock = threading.Lock()
         self._spans: Deque[Span] = deque()
+        # trace_id -> spans, maintained on add/evict: by_trace is the
+        # stitcher's hot query and must not scan the whole deque
+        self._by_trace: Dict[int, List[Span]] = {}
         # rate limiter: at most ~1000 spans/s retained (collector.h role)
         self._collector = Collector()
         self._pending: List[Span] = []      # awaiting the disk flusher
@@ -112,8 +131,22 @@ class SpanStore:
         keep = get_flag("rpcz_keep_spans", 2048)
         with self._lock:
             self._spans.append(span)
+            self._by_trace.setdefault(span.trace_id, []).append(span)
             while len(self._spans) > keep:
-                self._spans.popleft()
+                old = self._spans.popleft()
+                lst = self._by_trace.get(old.trace_id)
+                if lst is not None:
+                    # eviction order matches insertion order, so the
+                    # evictee is (almost always) the list head
+                    if lst and lst[0] is old:
+                        lst.pop(0)
+                    else:
+                        try:
+                            lst.remove(old)
+                        except ValueError:
+                            pass
+                    if not lst:
+                        del self._by_trace[old.trace_id]
             if get_flag("rpcz_dir", ""):
                 self._pending.append(span)
                 if self._flusher is None:
@@ -131,9 +164,10 @@ class SpanStore:
         with self._lock:
             return list(self._spans)[-limit:]
 
-    def by_trace(self, trace_id: int) -> List[Span]:
+    def by_trace(self, trace_id: int, limit: int = 0) -> List[Span]:
         with self._lock:
-            return [s for s in self._spans if s.trace_id == trace_id]
+            spans = list(self._by_trace.get(trace_id, ()))
+        return spans[-limit:] if limit else spans
 
     def flush_now(self) -> None:
         """Synchronously persist anything pending (tests, shutdown)."""
@@ -142,6 +176,7 @@ class SpanStore:
     def clear(self) -> None:
         with self._lock:
             self._spans.clear()
+            self._by_trace.clear()
             self._pending.clear()
 
 
@@ -376,18 +411,17 @@ def start_server_span(full_method: str, meta, remote_side) -> Optional[Span]:
     return span
 
 
-def start_slim_server_span(full_method: str, remote_side) -> Optional[Span]:
-    """Sampling gate for the slim native dispatch lane
-    (server/slim_dispatch.py): same per-second budget window as
-    :func:`start_server_span`, no request meta — explicitly traced
-    requests carry trace tags and never reach the slim lane (the
-    engine's meta scan routes them to the classic path, where
-    start_server_span honors the forced trace)."""
-    if not rpcz_enabled() or not _passive_sample_gate():
+def start_client_span(full_method: str, trace_id: int,
+                      parent_span_id: int = 0) -> Optional[Span]:
+    """Client-side span for an EXPLICITLY traced call (cntl.trace_id
+    set): forced spans always record, so the caller's half of the round
+    trip shows up next to the server span it parents.  Untraced calls
+    return None — passive client sampling would put span churn on the
+    latency fast lanes, and the server side already samples those."""
+    if not rpcz_enabled() or not trace_id:
         return None
-    span = Span(full_method, trace_id=0, parent_span_id=0, is_server=True)
-    span.remote_side = str(remote_side or "")
-    return span
+    return Span(full_method, trace_id=trace_id,
+                parent_span_id=parent_span_id, is_server=False)
 
 
 def backdate_span(span: Optional[Span], recv_mono_ns) -> None:
@@ -397,9 +431,57 @@ def backdate_span(span: Optional[Span], recv_mono_ns) -> None:
     through the shim call.  ``received_us`` moves back by the elapsed
     monotonic delta, so the span covers the native queueing/batching
     delay instead of starting at shim entry; ``start_us`` keeps the
-    shim-entry time, making the queueing visible as received->start."""
+    shim-entry time, making the queueing visible as received->start.
+    The monotonic anchor moves to the engine timestamp with it."""
     if span is None or not recv_mono_ns:
         return
     delta_us = (time.monotonic_ns() - recv_mono_ns) // 1000
     if delta_us > 0:
         span.received_us -= delta_us
+        span.mono_ns = recv_mono_ns
+
+
+# -- W3C trace-context mapping (https://www.w3.org/TR/trace-context/) --
+#
+# HTTP/1.1 and gRPC/h2 carry the trace context as a ``traceparent``
+# header instead of meta TLVs:
+#
+#     traceparent: 00-<32 hex trace-id>-<16 hex parent-id>-<2 hex flags>
+#
+# The internal model is 64-bit ids (fast_rand), so the 128-bit wire
+# trace-id keeps our id in its LOW 64 bits; a foreign 128-bit id from
+# an external W3C peer is truncated to its low 64 bits consistently on
+# every hop, which preserves linkage within this system.
+
+def format_traceparent(trace_id: int, span_id: int) -> str:
+    """``traceparent`` header value for an outbound call: the caller's
+    span id rides as the parent-id field (exactly the tpu_std meta's
+    trace_id/span_id pair re-spelled)."""
+    return (f"00-{trace_id & ((1 << 128) - 1):032x}"
+            f"-{span_id & ((1 << 64) - 1):016x}-01")
+
+
+def parse_traceparent(value) -> Optional[tuple]:
+    """``(trace_id, parent_span_id)`` from a traceparent header value
+    (str or bytes), or None when malformed.  Unknown versions are
+    accepted if the first four fields parse (per spec: treat like 00)."""
+    if value is None:
+        return None
+    if isinstance(value, (bytes, bytearray, memoryview)):
+        try:
+            value = bytes(value).decode("ascii")
+        except UnicodeDecodeError:
+            return None
+    parts = value.strip().split("-")
+    if len(parts) < 4 or len(parts[0]) != 2 or len(parts[1]) != 32 \
+            or len(parts[2]) != 16:
+        return None
+    try:
+        int(parts[0], 16)
+        trace = int(parts[1], 16)
+        parent = int(parts[2], 16)
+    except ValueError:
+        return None
+    if trace == 0:
+        return None                    # all-zero trace-id is invalid
+    return trace & ((1 << 64) - 1), parent

@@ -28,6 +28,17 @@ _START_TIME = time.time()
 
 
 def register_builtin(prefix: str, handler: Handler) -> None:
+    """Register a portal page.  Re-registering a prefix with a
+    DIFFERENT handler is almost always an import-order accident (two
+    modules claiming one page — the /rpcz JSON contract broke this way
+    once): the newest registration wins, loudly, so the shadowed page
+    is discoverable instead of silently serving the wrong handler."""
+    existing = _routes.get(prefix)
+    if existing is not None and existing is not handler:
+        from ...butil.logging_util import LOG
+        LOG.warning("builtin page %r re-registered: %s replaces %s",
+                    prefix or "/", getattr(handler, "__name__", handler),
+                    getattr(existing, "__name__", existing))
     _routes[prefix] = handler
 
 
@@ -177,6 +188,25 @@ def _list_vars(server, msg, rest):
 
 
 def _rpcz(server, msg, rest):
+    """/rpcz — span browser + distributed trace queries.
+
+    Query modes:
+      (none)                       recent local spans (JSON)
+      ?trace_id=HEX&format=json    this process's spans of one trace —
+                                   the stitcher's per-hop fetch; always
+                                   bounded by &limit (never the full
+                                   store in one response)
+      ?trace_id=HEX&stitch=1       follow client spans' remote_side
+                                   over RPC and merge the sub-process
+                                   spans (clock skew annotated); render
+                                   as JSON (+ nested tree), as
+                                   format=chrome (Perfetto-loadable
+                                   Chrome trace events), or as
+                                   format=tree (text tree)
+      ?start_us=&end_us=&persisted=1   sqlite time-range browse (dead
+                                   ranks included), paged by &limit and
+                                   the start_us/end_us cursor
+    """
     from ...rpcz import (browse_persisted, global_span_store,
                          rpcz_enabled)
 
@@ -186,6 +216,7 @@ def _rpcz(server, msg, rest):
         limit = max(1, int(q.get("limit", "100")))
     except ValueError:
         return 400, "text/plain", "bad limit (integer)\n"
+    fmt = q.get("format", "json")
     tid = 0
     if "trace_id" in q:
         try:
@@ -195,7 +226,9 @@ def _rpcz(server, msg, rest):
     if "start_us" in q or "end_us" in q or "persisted" in q:
         # time-range browse over the sqlite mirrors (rpcz_dir) — covers
         # spans of DEAD processes too (≈ the reference's leveldb-backed
-        # time browsing, span.cpp:306-319)
+        # time browsing, span.cpp:306-319).  ``limit`` + the
+        # start_us/end_us cursor page the 200K-row mirror; a stitcher
+        # (or any scraper) can never pull the whole db in one response.
         try:
             start_us = int(q.get("start_us", "0"))
             end_us = int(q.get("end_us", "0"))
@@ -207,7 +240,41 @@ def _rpcz(server, msg, rest):
             "persisted": True,
             "spans": browse_persisted(start_us, end_us, limit, tid),
         }, indent=1)
-    spans = store.by_trace(tid) if tid else store.recent(limit)
+    if tid:
+        from ...rpcz_stitch import (annotate_skew, build_tree,
+                                    render_tree_text, to_chrome_trace)
+        if "stitch" in q:
+            from ...rpcz_stitch import collect_trace
+            try:
+                hops = max(1, int(q.get("max_hops", "16")))
+                budget_s = float(q.get("budget_s", "8"))
+            except ValueError:
+                return (400, "text/plain",
+                        "bad max_hops (integer) / budget_s (number)\n")
+            stitched = collect_trace(
+                tid, limit=limit, max_hops=hops, budget_s=budget_s,
+                # never RPC ourselves: our spans ARE the local seed
+                skip=(str(server.listen_endpoint),))
+            spans = stitched["spans"]
+            extra = {"stitched": True, "remotes": stitched["remotes"],
+                     "truncated": stitched["truncated"]}
+        else:
+            spans = [s.describe() for s in store.by_trace(tid, limit)]
+            for s in spans:
+                s["source"] = "local"
+            annotate_skew(spans)
+            extra = {"stitched": False}
+        if fmt == "chrome":
+            return (200, "application/json",
+                    json.dumps(to_chrome_trace(spans)))
+        if fmt == "tree":
+            return (200, "text/plain",
+                    f"trace {tid:x} — " + render_tree_text(spans))
+        out = {"enabled": rpcz_enabled(), "trace_id": f"{tid:x}",
+               "spans": spans, "tree": build_tree(spans)}
+        out.update(extra)
+        return 200, "application/json", json.dumps(out, indent=1)
+    spans = store.recent(limit)
     return 200, "application/json", json.dumps({
         "enabled": rpcz_enabled(),
         "spans": [s.describe() for s in reversed(spans)],

@@ -170,20 +170,36 @@ def _bridge_rpc(msg: HttpMessage, sock, server, svc: str,
     meta = RpcMeta()
     meta.service_name = svc
     meta.method_name = mth
+    tp_header = msg.headers.get("traceparent")
+    if tp_header:
+        from ..rpcz import parse_traceparent
+        tp = parse_traceparent(tp_header)
+        if tp is not None:
+            # W3C trace context → the internal trace model: the server
+            # span parents to the caller's span id, exactly like the
+            # tpu_std meta's trace/span TLVs
+            meta.trace_id, meta.span_id = tp
 
     def send(cntl: ServerController, response: Any) -> None:
         latency_us = monotonic_us() - cntl.begin_time_us
         entry.status.on_responded(cntl.error_code, latency_us)
         server.on_request_out()
+        span = cntl.span
         s = Socket.address(cntl.socket_id)
         if s is None:
+            if span is not None:
+                span.finish(cntl.error_code)
             return
         if cntl.failed:
             if cntl._progressive is not None:
                 cntl._progressive._abort()
             code = http_status_for_error(cntl.error_code)
+            body = cntl.error_text.encode()
+            if span is not None:
+                span.response_size = len(body)
+                span.finish(cntl.error_code)
             s.write(build_response(
-                code, cntl.error_text.encode(),
+                code, body,
                 headers=[("x-rpc-error-code", str(cntl.error_code))],
                 keep_alive=msg.keep_alive))
             return
@@ -198,6 +214,9 @@ def _bridge_rpc(msg: HttpMessage, sock, server, svc: str,
             first = b"%x\r\n" % len(body) + body + b"\r\n" if body else b""
             s.write(IOBuf(head + first))
             cntl._progressive._start()
+            if span is not None:
+                span.response_size = len(body)
+                span.finish(0)
             return
         body, ctype = _encode_http_body(response)
         extra = None
@@ -208,6 +227,9 @@ def _bridge_rpc(msg: HttpMessage, sock, server, svc: str,
             # peer split (HTTP has no native side channel)
             body += att
             extra = [("x-rpc-attachment-size", str(len(att)))]
+        if span is not None:
+            span.response_size = len(body)
+            span.finish(0)
         s.write(build_response(200, body, ctype, headers=extra,
                                keep_alive=msg.keep_alive))
 
@@ -216,6 +238,11 @@ def _bridge_rpc(msg: HttpMessage, sock, server, svc: str,
     cntl.http_method = msg.method
     cntl.http_path = msg.path
     cntl.http_unresolved_path = unresolved
+    from ..rpcz import start_server_span
+    cntl.span = start_server_span(entry.status.full_name, meta,
+                                  sock.remote_side)
+    if cntl.span is not None:
+        cntl.span.request_size = len(msg.body)
     if msg.method in ("GET", "HEAD") and msg.query_string:
         request: Any = json.dumps(msg.query()).encode()
     else:

@@ -13,17 +13,20 @@ Kind 4 removes all of it from the eligible path: the C++ engine parses
 the request line + headers itself, batches every eligible HTTP/1.1
 request of a read burst, and enters Python ONCE calling the per-route
 shim built below as ``handler(body, query, content_type, att_size,
-conn_id, recv_ns)`` (bytes-or-None for the middle three; ``recv_ns``
-is the engine's CLOCK_MONOTONIC parse timestamp, used to backdate
-rpcz spans so they cover native queueing).  The shim is the whole
-per-call Python cost of the lane:
+conn_id, recv_ns, traceparent)`` (bytes-or-None for the middle three
+and for ``traceparent``; ``recv_ns`` is the engine's CLOCK_MONOTONIC
+parse timestamp, used to backdate rpcz spans so they cover native
+queueing).  ``traceparent`` is the raw W3C trace-context header value
+the engine captured — explicitly traced HTTP requests STAY on the
+slim lane, with the span parented to the caller.  The shim is the
+whole per-call Python cost of the lane:
 
     admission   server.on_request_in + MethodStatus.on_requested —
                 503 answers ride the slim serializer, byte-identical
                 with the classic ``build_response`` output
     sampling    rpcz spans keep their per-second budget via
-                start_slim_server_span (the classic HTTP bridge never
-                sampled; the slim lane records real sizes inline)
+                start_server_span; traced requests always record and
+                the slim lane records real sizes inline
     user code   entry.fn(cntl, request) with a REAL ServerController —
                 handlers keep attachments, set_failed, begin_async,
                 progressive attachments, session_local_data
@@ -63,7 +66,7 @@ from ..butil.status import Errno
 from ..butil.time_utils import monotonic_us
 from ..protocol.http import build_response
 from ..protocol.meta import RpcMeta
-from ..rpcz import backdate_span, start_slim_server_span
+from ..rpcz import backdate_span, parse_traceparent, start_server_span
 from ..transport.socket import Socket
 from .controller import ServerController
 from .http_dispatch import _encode_http_body, http_status_for_error
@@ -113,7 +116,8 @@ def make_http_slim_handler(bridge, server, entry, svc: str, mth: str,
     socks = bridge._socks          # conn_id -> NativeSocket (live dict)
     is_get = http_method in ("GET", "HEAD")
 
-    def slim(body, query, ctype, attsz, conn_id, recv_ns):
+    def slim(body, query, ctype, attsz, conn_id, recv_ns,
+             traceparent=None):
         sock = socks.get(conn_id)
         if sock is None:
             return None          # connection died mid-burst
@@ -126,6 +130,12 @@ def make_http_slim_handler(bridge, server, entry, svc: str, mth: str,
         meta = RpcMeta()
         meta.service_name = svc
         meta.method_name = mth
+        if traceparent is not None:
+            tp = parse_traceparent(traceparent)
+            if tp is not None:
+                # W3C header → the internal trace model: the span below
+                # is forced and parents to the caller's span id
+                meta.trace_id, meta.span_id = tp
 
         # Completion plumbing: while `inline` holds, the send closure
         # parks its response in `cell` and the engine serializes it into
@@ -200,7 +210,7 @@ def make_http_slim_handler(bridge, server, entry, svc: str, mth: str,
         cntl.http_method = http_method
         cntl.http_path = path
         cntl.http_unresolved_path = ""
-        span = start_slim_server_span(full_name, sock.remote_side)
+        span = start_server_span(full_name, meta, sock.remote_side)
         if span is not None:
             span.request_size = len(body)
             # span start = the ENGINE's parse time, not shim entry:

@@ -6,17 +6,21 @@ The reference runs the ENTIRE request path to the user callback in C++
 the C++ engine scans the meta TLV, batches every eligible unary request
 of a read burst, and enters Python ONCE calling the shim built below as
 ``handler(payload: bytes, att: bytes | None, cid: int, conn_id: int,
-dom, nonce, recv_ns: int)`` — ``recv_ns`` is the engine's
+dom, nonce, recv_ns: int, trace)`` — ``recv_ns`` is the engine's
 CLOCK_MONOTONIC frame-parse timestamp, used to backdate rpcz spans so
-they cover native queueing.  The shim is the whole per-call Python
-cost of the lane:
+they cover native queueing; ``trace`` is None or the request's
+``(trace_id, span_id, parent_id)`` meta TLVs, so explicitly traced
+requests STAY on the slim lane instead of changing the very path being
+observed.  The shim is the whole per-call Python cost of the lane:
 
     admission   server.on_request_in + MethodStatus.on_requested (the
                 concurrency-limiter path — NOT dropped; ELIMIT answers
                 are sent through the classic error builder)
     sampling    rpcz spans keep their per-second budget via
-                start_slim_server_span; a sampled call escalates to the
-                classic completion so the span records real sizes
+                start_server_span; traced requests (non-zero trace
+                context) always record; span sizes are recorded INLINE
+                on the slim completion — sampling a call no longer
+                escalates it off the lane
     user code   entry.fn(cntl, request) with a REAL ServerController —
                 handlers keep attachments, set_failed, begin_async,
                 session_local_data, annotate, everything
@@ -34,11 +38,12 @@ Return contract with the engine (flush_py_batch, kind 3):
 Everything the slim frame cannot express natively escalates through
 ``cntl.finish`` into rpc_dispatch._send_response, so escalated calls
 are byte-identical with the classic path by construction: async
-completion, sampled spans, compressed/streamed/device responses,
-non-bytes responses, errors.  Request-side ineligibility (trace tags,
-compression, streams, device descriptors, ici domain exchange,
-over-threshold attachments, large frames) never reaches the shim — the
-engine's meta scan routes those frames to the classic path.
+completion, compressed/streamed/device responses, non-bytes responses,
+errors.  Request-side ineligibility (compression, streams, device
+descriptors, over-threshold attachments, large frames) never reaches
+the shim — the engine's meta scan routes those frames to the classic
+path.  Trace context is NOT an ineligibility: the engine hands it
+through ``trace`` and the span completes on the lane.
 """
 
 from __future__ import annotations
@@ -50,7 +55,7 @@ from ..butil.logging_util import LOG
 from ..butil.status import Errno
 from ..protocol.meta import RpcMeta
 from ..protocol.tpu_std import parse_payload
-from ..rpcz import backdate_span, start_slim_server_span
+from ..rpcz import backdate_span, start_server_span
 from .controller import ServerController
 from .rpc_dispatch import _send_error, _send_response
 
@@ -76,9 +81,10 @@ def make_slim_handler(bridge, server, entry, svc: str, mth: str):
         _send_response(_server, _entry, cntl, response)
 
     def slim(payload, att, cid, conn_id, dom, nonce, recv_ns,
+             trace=None,
              _server=server, _status=status, _fn=fn, _rt=req_type,
              _svc=svc, _mth=mth, _send=_send, _socks=socks,
-             _ns=_mono_ns, _sample=start_slim_server_span,
+             _ns=_mono_ns, _sample=start_server_span,
              _backdate=backdate_span):
         sock = _socks.get(conn_id)
         if sock is None:
@@ -108,6 +114,11 @@ def make_slim_handler(bridge, server, entry, svc: str, mth: str):
         meta.method_name = _mth
         if dom is not None:
             meta.ici_domain = dom
+        if trace is not None:
+            # the request's trace context rode the slim lane: the span
+            # below is FORCED (never sampled out) and parents to the
+            # caller's span id, exactly like the classic path
+            meta.trace_id, meta.span_id, meta.parent_span_id = trace
         na = len(att) if att is not None else 0
         if na:
             meta.attachment_size = na
@@ -117,7 +128,7 @@ def make_slim_handler(bridge, server, entry, svc: str, mth: str):
             ab = IOBuf()
             ab.append_user_data(att)
             cntl._req_att = ab
-        span = _sample(_status.full_name, sock.remote_side)
+        span = _sample(_status.full_name, meta, sock.remote_side)
         if span is not None:
             span.request_size = len(payload) + na
             # span start = the ENGINE's frame-parse time, not shim
@@ -139,14 +150,12 @@ def make_slim_handler(bridge, server, entry, svc: str, mth: str):
             return None
         if cntl.is_async:
             return None          # user owns completion via cntl.finish
-        if (cntl.failed or cntl.span is not None
-                or cntl._accepted_stream_id
+        if (cntl.failed or cntl._accepted_stream_id
                 or cntl.response_compress_type
                 or cntl.response_device_attachment is not None
                 or not isinstance(response,
                                   (bytes, bytearray, memoryview))):
-            # anything the native frame builder cannot express (or a
-            # sampled span that must record response size): classic
+            # anything the native frame builder cannot express: classic
             # completion — byte-identical by construction
             cntl.finish(response)
             return None
@@ -160,7 +169,16 @@ def make_slim_handler(bridge, server, entry, svc: str, mth: str):
             _server._session_pool.give_back(cntl._session_data)
             cntl._session_data = None
         ratt = cntl._resp_att
-        if ratt is not None and len(ratt):
+        na_resp = len(ratt) if ratt is not None else 0
+        span = cntl.span
+        if span is not None:
+            # sizes are known right here — record them inline and keep
+            # the call on the lane (sampled AND traced spans alike; the
+            # old behavior escalated every sampled call off the lane,
+            # making tracing change the path being observed)
+            span.response_size = len(response) + na_resp
+            span.finish(0)
+        if na_resp:
             return response, ratt.to_bytes()
         return response
 

@@ -573,8 +573,11 @@ class NativeBridge:
         """Minimal TLV walk for the raw lane: (cid, service, method,
         att_size, timeout_ms, ici_domain, ici_conn) — or None when the
         meta carries any controller-tier tag (compress=2, error=6/7,
-        auth=8, trace=9, span=10/11, stream=12/14, ici desc=16) or is
-        malformed, meaning the full RpcMeta path must run.  ~3x cheaper
+        auth=8, trace=9, span=10/11 — raw handlers have no span
+        machinery, so traced requests take the full path; the NATIVE
+        slim lanes carry trace context through their shims instead —
+        stream=12/14, ici desc=16) or is malformed, meaning the full
+        RpcMeta path must run.  ~3x cheaper
         than RpcMeta.decode for the echo-class frame; a successful scan
         also lets the FULL method path build its RpcMeta from these
         fields without re-walking (slim-meta path in _on_message)."""
@@ -668,8 +671,10 @@ class NativeBridge:
         the request needs the full path after all (live traffic capture
         — the dump observer must see the RpcMessage).  Passive rpcz
         SAMPLING deliberately skips raw methods and explicitly traced
-        requests never reach here (the meta scan rejects tag 9) — that
-        is the lane's contract (documented on @raw_method)."""
+        requests never reach here (the meta scan rejects tag 9; the
+        native engine mirrors this as the named `rpc_trace_raw_lane`
+        fallback) — that is the lane's contract (documented on
+        @raw_method)."""
         from ..tools.rpc_dump import dump_enabled
         if dump_enabled():
             return False

@@ -239,7 +239,9 @@ def test_rpc_fallback_reasons_named(server):
     srv, _ = server
     ep = srv.listen_endpoint
     before = _tele(srv)["fallbacks"]
-    # controller-tier trace tag -> rpc_meta_tag
+    # trace tags are NO LONGER a fallback on the slim lane (the
+    # distributed-rpcz PR hands them through the shim): a traced call
+    # must leave every rpc_* fallback counter untouched
     ch = _channel(srv)
     cntl = Controller()
     cntl.timeout_ms = 5_000
@@ -247,8 +249,9 @@ def test_rpc_fallback_reasons_named(server):
     c = ch.call_method("S.Echo", b"tr", cntl=cntl)
     assert not c.failed and bytes(c.response) == b"ok:tr"
     mid = _tele(srv)["fallbacks"]
-    assert mid["rpc_meta_tag"] > before["rpc_meta_tag"]
-    # stream-window tag (14) -> rpc_meta_tag as well
+    assert mid["rpc_meta_tag"] == before["rpc_meta_tag"]
+    assert mid["rpc_trace_raw_lane"] == before["rpc_trace_raw_lane"]
+    # stream-window tag (14) -> rpc_meta_tag still
     f = _frame(91, b"S", b"Echo", b"sw",
                extra_meta=encode_tlv(14, struct.pack("<I", 4096)))
     _rpc_exchange(ep, f)

@@ -38,12 +38,22 @@ def test_span_collected_with_annotations(server):
     c = ch.call_method("Traced.Work", b"payload", cntl=cntl)
     assert not c.failed
     spans = global_span_store().by_trace(0xABCDEF)
-    assert len(spans) == 1
-    s = spans[0]
+    # an explicitly traced call records BOTH halves: the client span
+    # (this process is the caller) and the server span it parents
+    assert len(spans) == 2
+    server_spans = [s for s in spans if s.is_server]
+    client_spans = [s for s in spans if not s.is_server]
+    assert len(server_spans) == 1 and len(client_spans) == 1
+    s = server_spans[0]
     assert s.full_method == "Traced.Work"
     assert s.request_size == len(b"payload")
     assert s.latency_us > 0
     assert [t for _, t in s.annotations] == ["step-one", "step-two"]
+    # linkage: the server span's parent is the client span's id
+    cs = client_spans[0]
+    assert cs.full_method == "Traced.Work"
+    assert s.parent_span_id == cs.span_id
+    assert str(server.listen_endpoint) == cs.remote_side
 
 
 def test_rpcz_page(server):

@@ -222,16 +222,34 @@ def test_async_method_over_slim_lane(pair):
 
 # ---- (b) fallback triggers take the Python path -----------------------
 
-def test_fallback_traced_request(pair):
+def test_traced_request_rides_slim_lane(pair):
+    """Observer-effect-free tracing (distributed-rpcz PR): an explicit
+    trace id used to kick the request off the slim lane — tracing
+    changed the very path being observed.  The engine now hands the
+    trace TLVs through the shim: the request stays native, the forced
+    span records with the caller's span id as parent."""
+    from brpc_tpu.rpcz import global_span_store
+
+    global_span_store().clear()
     nsrv, nsvc, _, _ = pair
-    ch = _channel(nsrv)
-    cntl = Controller()
-    cntl.timeout_ms = 5_000
-    cntl.trace_id = 4242
-    c = ch.call_method("S.Echo", b"traced", cntl=cntl)
-    assert not c.failed and bytes(c.response) == b"ok:traced"
-    assert _native_count(nsrv, "S.Echo")[0] == 0
-    assert len(nsvc.calls) == 1          # classic path ran the handler
+    set_flag("enable_rpcz", True)        # pair runs rpcz_off; tracing
+    try:                                 # is exactly what's under test
+        ch = _channel(nsrv)
+        cntl = Controller()
+        cntl.timeout_ms = 5_000
+        cntl.trace_id = 4242
+        c = ch.call_method("S.Echo", b"traced", cntl=cntl)
+        assert not c.failed and bytes(c.response) == b"ok:traced"
+        assert _native_count(nsrv, "S.Echo")[0] == 1  # stayed native
+        assert len(nsvc.calls) == 1      # the shim ran the handler
+        spans = global_span_store().by_trace(4242)
+        server_spans = [s for s in spans if s.is_server]
+        client_spans = [s for s in spans if not s.is_server]
+        assert len(server_spans) == 1 and len(client_spans) == 1
+        assert server_spans[0].parent_span_id == client_spans[0].span_id
+    finally:
+        set_flag("enable_rpcz", False)
+        global_span_store().clear()
 
 
 def test_fallback_large_attachment(pair):

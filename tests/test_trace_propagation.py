@@ -1,0 +1,364 @@
+"""Distributed rpcz — cross-protocol trace propagation + stitching.
+
+The contract under test (the distributed-rpcz PR):
+
+- an explicitly traced call records BOTH halves — a client span in the
+  caller and a server span parented to it — on EVERY wire protocol
+  ({tpu_std, HTTP/1.1, gRPC-h2}) against BOTH server transports
+  ({pytransport, native slim lanes});
+- tracing is observer-effect-free on the native lanes: the engine
+  hands the trace context through the kind-3/kind-4 shims instead of
+  falling back, so the trace-caused fallback counters stay at zero;
+- a ParallelChannel fan-out under one forced trace yields one stitched
+  tree (root + N parented branch spans, each with its server child)
+  from /rpcz?trace_id=X, and the Chrome trace export is well-formed.
+"""
+
+import json
+import urllib.request
+
+import pytest
+
+from brpc_tpu.client import Channel, ChannelOptions, Controller
+from brpc_tpu.client.parallel_channel import ParallelChannel
+from brpc_tpu.rpcz import (format_traceparent, global_span_store,
+                           parse_traceparent)
+from brpc_tpu.server import Server, ServerOptions, Service
+
+from conftest import require_native  # noqa: E402
+
+
+class TSvc(Service):
+    def Echo(self, cntl, request):
+        cntl.annotate("handled")
+        return b"ok:" + bytes(request)
+
+
+def _server(native: bool):
+    opts = ServerOptions()
+    if native:
+        require_native()
+        opts.native = True
+        opts.usercode_inline = True
+        opts.native_loops = 1
+    srv = Server(opts)
+    srv.add_service(TSvc(), name="T")
+    assert srv.start("127.0.0.1:0") == 0
+    return srv
+
+
+def _channel(srv, protocol: str) -> Channel:
+    co = ChannelOptions()
+    co.protocol = protocol
+    if protocol != "grpc":
+        co.connection_type = "pooled"
+    ch = Channel(co)
+    ch.init(str(srv.listen_endpoint))
+    return ch
+
+
+def _assert_linked(trace_id: int, remote: str):
+    """One client + one server span under ``trace_id``, parented."""
+    spans = global_span_store().by_trace(trace_id)
+    server_spans = [s for s in spans if s.is_server]
+    client_spans = [s for s in spans if not s.is_server]
+    assert len(server_spans) == 1, [s.describe() for s in spans]
+    assert len(client_spans) == 1, [s.describe() for s in spans]
+    srv_s, cli_s = server_spans[0], client_spans[0]
+    assert srv_s.trace_id == trace_id and cli_s.trace_id == trace_id
+    assert srv_s.parent_span_id == cli_s.span_id
+    assert cli_s.parent_span_id == 0
+    assert cli_s.remote_side == remote
+    return cli_s, srv_s
+
+
+# ---- propagation matrix: protocol x server transport ------------------
+
+MATRIX = [("tpu_std", False), ("tpu_std", True),
+          ("http", False), ("http", True),
+          ("grpc", False), ("grpc", True)]
+
+
+@pytest.mark.parametrize("protocol,native", MATRIX,
+                         ids=[f"{p}-{'native' if n else 'py'}"
+                              for p, n in MATRIX])
+def test_propagation_matrix(protocol, native):
+    global_span_store().clear()
+    srv = _server(native)
+    trace_id = 0x7A0000 + len(protocol) + (1 if native else 0)
+    try:
+        ch = _channel(srv, protocol)
+        cntl = Controller()
+        cntl.timeout_ms = 10_000
+        cntl.trace_id = trace_id
+        c = ch.call_method("T.Echo", b"m", cntl=cntl)
+        assert not c.failed, c.error_text
+        assert bytes(c.response) == b"ok:m"
+        _assert_linked(trace_id, str(srv.listen_endpoint))
+    finally:
+        srv.stop()
+        global_span_store().clear()
+
+
+def test_traced_slim_lane_no_fallbacks_and_native_count():
+    """Observer effect retired: a traced tpu_std call against the slim
+    native lane stays ON the lane (native counter moves) and neither
+    trace-related fallback reason moves."""
+    global_span_store().clear()
+    srv = _server(native=True)
+    try:
+        before = srv._native_bridge.engine.telemetry()["fallbacks"]
+        ch = _channel(srv, "tpu_std")
+        cntl = Controller()
+        cntl.timeout_ms = 10_000
+        cntl.trace_id = 0x51EEF
+        c = ch.call_method("T.Echo", b"zz", cntl=cntl)
+        assert not c.failed, c.error_text
+        tele = srv._native_bridge.engine.telemetry()
+        after = tele["fallbacks"]
+        assert after["rpc_meta_tag"] == before["rpc_meta_tag"]
+        assert after["rpc_trace_raw_lane"] == before["rpc_trace_raw_lane"]
+        assert tele["methods"]["T.Echo"]["handled"] >= 1
+        # the span covers engine queueing (backdated receive)
+        srv_span = [s for s in global_span_store().by_trace(0x51EEF)
+                    if s.is_server][0]
+        assert srv_span.received_us <= srv_span.start_us
+    finally:
+        srv.stop()
+        global_span_store().clear()
+
+
+def test_traced_raw_lane_falls_back_with_named_reason():
+    """kind-0/1/2 methods have no span machinery: an explicit trace
+    routes them to the Python path under the NAMED rpc_trace_raw_lane
+    reason (never the catch-all rpc_meta_tag)."""
+    require_native()
+    from brpc_tpu.server.service import raw_method
+
+    class RawSvc(Service):
+        @raw_method(native="echo")
+        def Raw(self, payload, attachment):
+            return payload, attachment
+
+    opts = ServerOptions()
+    opts.native = True
+    opts.usercode_inline = True
+    opts.native_loops = 1
+    srv = Server(opts)
+    srv.add_service(RawSvc(), name="R")
+    assert srv.start("127.0.0.1:0") == 0
+    global_span_store().clear()
+    try:
+        before = srv._native_bridge.engine.telemetry()["fallbacks"]
+        ch = _channel(srv, "tpu_std")
+        cntl = Controller()
+        cntl.timeout_ms = 10_000
+        cntl.trace_id = 0xBAD5EED
+        c = ch.call_method("R.Raw", b"tr", cntl=cntl)
+        assert not c.failed, c.error_text
+        tele = srv._native_bridge.engine.telemetry()
+        after = tele["fallbacks"]
+        assert after["rpc_trace_raw_lane"] > before["rpc_trace_raw_lane"]
+        assert after["rpc_meta_tag"] == before["rpc_meta_tag"]
+        assert tele["methods"]["R.Raw"]["fb_rpc_trace_raw_lane"] >= 1
+    finally:
+        srv.stop()
+        global_span_store().clear()
+
+
+# ---- the acceptance scenario: traced fan-out, stitched tree -----------
+
+@pytest.fixture()
+def fanout():
+    require_native()
+    global_span_store().clear()
+    subs = []
+    for _ in range(2):
+        subs.append(_server(native=True))
+    pch = ParallelChannel()
+    for s in subs:
+        sub = Channel()
+        sub.init(str(s.listen_endpoint))
+        pch.add_channel(sub)
+    yield subs, pch
+    for s in subs:
+        s.stop()
+    global_span_store().clear()
+
+
+def _fetch(ep, query: str):
+    with urllib.request.urlopen(f"http://{ep}/rpcz?{query}",
+                                timeout=10) as r:
+        return r.read()
+
+
+def test_parallel_fanout_stitched_tree(fanout):
+    subs, pch = fanout
+    before = [s._native_bridge.engine.telemetry()["fallbacks"]
+              for s in subs]
+    cntl = Controller()
+    cntl.timeout_ms = 10_000
+    cntl.trace_id = 0xFA27
+    c = pch.call_method("T.Echo", b"fan", cntl=cntl)
+    assert not c.failed, c.error_text
+    assert c.response == [b"ok:fan", b"ok:fan"]
+
+    # zero trace-caused fallbacks on every sub-server for the traced run
+    for i, s in enumerate(subs):
+        after = s._native_bridge.engine.telemetry()["fallbacks"]
+        assert after["rpc_meta_tag"] == before[i]["rpc_meta_tag"]
+        assert after["rpc_trace_raw_lane"] == \
+            before[i]["rpc_trace_raw_lane"]
+
+    # one stitched tree from /rpcz?trace_id=...&format=json: root + 2
+    # parented branch client spans, each with its server child
+    doc = json.loads(_fetch(subs[0].listen_endpoint,
+                            "trace_id=fa27&stitch=1&format=json"))
+    assert doc["stitched"] is True
+    spans = doc["spans"]
+    assert len(spans) == 5, spans
+    by_id = {s["span_id"]: s for s in spans}
+    roots = doc["tree"]
+    assert len(roots) == 1
+    root = by_id[roots[0]["span_id"]]
+    assert root["side"] == "client"
+    assert "ParallelChannel" in root["method"]
+    branches = roots[0]["children"]
+    assert len(branches) == 2
+    sub_eps = {str(s.listen_endpoint) for s in subs}
+    for b in branches:
+        bs = by_id[b["span_id"]]
+        assert bs["side"] == "client"
+        assert bs["remote"] in sub_eps
+        assert len(b["children"]) == 1
+        leaf = by_id[b["children"][0]["span_id"]]
+        assert leaf["side"] == "server"
+        assert leaf["method"] == "T.Echo"
+
+    # Chrome trace export is well-formed and Perfetto-loadable in shape
+    chrome = json.loads(_fetch(subs[0].listen_endpoint,
+                               "trace_id=fa27&stitch=1&format=chrome"))
+    xev = [e for e in chrome["traceEvents"] if e.get("ph") == "X"]
+    assert len(xev) == 5
+    for e in xev:
+        assert {"name", "ts", "dur", "pid", "tid", "args"} <= set(e)
+        assert e["dur"] >= 1
+    # the text tree renders every span
+    txt = _fetch(subs[0].listen_endpoint,
+                 "trace_id=fa27&stitch=1&format=tree").decode()
+    assert txt.count("T.Echo") >= 4 and "ParallelChannel" in txt
+
+
+def test_trace_dump_cli(fanout):
+    import contextlib
+    import io
+
+    subs, pch = fanout
+    cntl = Controller()
+    cntl.timeout_ms = 10_000
+    cntl.trace_id = 0xFA28
+    c = pch.call_method("T.Echo", b"x", cntl=cntl)
+    assert not c.failed, c.error_text
+    from brpc_tpu.tools.trace_dump import main as td_main
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        rc = td_main([str(subs[0].listen_endpoint), "fa28"])
+    assert rc == 0
+    doc = json.loads(buf.getvalue())
+    assert sum(1 for e in doc["traceEvents"] if e.get("ph") == "X") == 5
+
+
+# ---- /rpcz paging + traceparent unit coverage -------------------------
+
+def test_rpcz_json_limit_paging():
+    """The stitcher's per-hop fetch is always bounded: &limit caps the
+    span list even when a trace has more spans than the page."""
+    global_span_store().clear()
+    srv = _server(native=False)
+    try:
+        ch = _channel(srv, "tpu_std")
+        for i in range(6):
+            cntl = Controller()
+            cntl.timeout_ms = 10_000
+            cntl.trace_id = 0xCAB1E
+            assert not ch.call_method("T.Echo", b"x", cntl=cntl).failed
+        doc = json.loads(_fetch(srv.listen_endpoint,
+                                "trace_id=cab1e&format=json&limit=3"))
+        assert len(doc["spans"]) == 3
+        doc = json.loads(_fetch(srv.listen_endpoint,
+                                "trace_id=cab1e&format=json&limit=100"))
+        assert len(doc["spans"]) == 12        # 6 client + 6 server
+    finally:
+        srv.stop()
+        global_span_store().clear()
+
+
+def test_traceparent_roundtrip():
+    v = format_traceparent(0xDEADBEEF, 0x1234)
+    assert v == ("00-000000000000000000000000deadbeef-"
+                 "0000000000001234-01")
+    assert parse_traceparent(v) == (0xDEADBEEF, 0x1234)
+    assert parse_traceparent(v.encode()) == (0xDEADBEEF, 0x1234)
+    # 128-bit foreign ids truncate to the low 64 bits, consistently
+    big = "00-" + "ab" * 16 + "-" + "cd" * 8 + "-00"
+    t, p = parse_traceparent(big)
+    assert t == int("ab" * 8, 16)
+    # malformed shapes reject cleanly
+    for bad in ("", "00-zz-11-00", "00-" + "0" * 32 + "-" + "0" * 16
+                + "-00", "garbage", None):
+        assert parse_traceparent(bad) is None
+
+
+def test_stitch_wall_clock_budget():
+    """Dead peers must not hold the stitch (and the portal handler
+    serving it) for max_hops * timeout_s: the walk shares one
+    wall-clock budget and truncates when it runs out."""
+    import time as _time
+
+    from brpc_tpu.rpcz import global_span_store, start_client_span
+    from brpc_tpu.rpcz_stitch import collect_trace
+
+    global_span_store().clear()
+    try:
+        # 4 client spans, each pointing at a distinct unreachable peer
+        for i in range(4):
+            s = start_client_span("T.Echo", 0xB0D6E7)
+            assert s is not None
+            s.remote_side = f"10.255.0.{i}:1"
+            s.finish()
+        slept = []
+
+        def dead_fetch(remote, trace_id, timeout_s, limit):
+            # per-fetch timeout is clamped to the remaining budget
+            assert timeout_s <= 0.25 + 1e-6
+            slept.append(timeout_s)
+            _time.sleep(timeout_s)
+            raise ConnectionError("blackholed")
+
+        t0 = _time.monotonic()
+        out = collect_trace(0xB0D6E7, timeout_s=2.0, budget_s=0.25,
+                            fetch=dead_fetch)
+        elapsed = _time.monotonic() - t0
+        assert out["truncated"] is True
+        assert elapsed < 1.0, elapsed          # nowhere near 4 * 2s
+        assert 1 <= len(slept) < 4             # budget cut the walk short
+        assert len(out["spans"]) == 4          # local seed still returned
+    finally:
+        global_span_store().clear()
+
+
+def test_clock_skew_annotation():
+    from brpc_tpu.rpcz_stitch import annotate_skew, build_tree
+    spans = [
+        {"span_id": 1, "parent_span_id": 0, "received_us": 1000,
+         "side": "client"},
+        {"span_id": 2, "parent_span_id": 1, "received_us": 400,
+         "side": "server"},      # 600us in the parent's past: skewed
+        {"span_id": 3, "parent_span_id": 1, "received_us": 1500,
+         "side": "server"},
+    ]
+    annotate_skew(spans)
+    assert spans[1]["clock_skew_us"] == 600
+    assert "clock_skew_us" not in spans[2]
+    roots = build_tree(spans)
+    assert len(roots) == 1 and len(roots[0]["children"]) == 2
