@@ -836,6 +836,150 @@ def bench_trace(extra: dict) -> None:
         srv.stop()
 
 
+def bench_robustness(extra: dict) -> None:
+    """§10 deadline plane: (a) goodput_under_overload — paired
+    interleaved A/B at ~2x capacity, shedding ON vs OFF, measuring
+    completed-WITHIN-DEADLINE QPS (what doomed work costs a saturated
+    server); (b) retry_amplification_factor — proxy-free attempt
+    accounting against a dead backend, channel retry budget on vs off
+    (what hedging storms cost a degraded one)."""
+    import socket as pysock
+
+    from brpc_tpu.butil.flags import set_flag
+    from brpc_tpu.client import Channel, ChannelOptions, Controller
+    from brpc_tpu.deadline import shed_counters
+    from brpc_tpu.server import Server, ServerOptions, Service
+
+    import struct
+
+    from brpc_tpu.protocol.meta import (RpcMeta, TLV_CORRELATION,
+                                        TLV_TIMEOUT, encode_tlv)
+
+    class Work(Service):
+        def __init__(self):
+            self.good = 0               # completions with budget left
+
+        def Spin(self, cntl, request):
+            time.sleep(0.002)           # 2ms of "handler work"
+            rem = cntl.deadline_remaining_ms()
+            if rem is not None and rem > 0:
+                # the slim lane coalesces a burst's responses into one
+                # writev at end-of-batch, so client-side arrival time
+                # can't tell in-budget work from doomed work; the
+                # handler's own completion-vs-deadline check can
+                # (response build after this is ~µs)
+                self.good += 1
+            return b"done"
+
+    opts = ServerOptions()
+    opts.native = True
+    opts.native_loops = 1
+    opts.usercode_inline = True         # the overload model: one lane,
+    srv = Server(opts)                  # queueing is the engine batch
+    work = Work()
+    srv.add_service(work, name="OV")
+    assert srv.start("127.0.0.1:0") == 0
+    ep = srv.listen_endpoint
+    try:
+        mtlv = encode_tlv(4, b"OV") + encode_tlv(5, b"Spin")
+        DEADLINE_MS = 25                # ~12 handler slots per budget
+
+        def _burst_frames(cid0: int, k: int) -> bytes:
+            out = b""
+            for i in range(k):
+                mb = (TLV_CORRELATION + struct.pack("<Q", cid0 + i)
+                      + mtlv + TLV_TIMEOUT
+                      + struct.pack("<I", DEADLINE_MS))
+                out += b"TRPC" + struct.pack("<II", len(mb), len(mb)) + mb
+            return out
+
+        def overload_window(secs: float) -> float:
+            """One pipelined client, bursts of 24 requests with 25ms
+            propagated budgets: each burst is ~2x what one budget can
+            cover (24 x 2ms handler vs a 25ms deadline), so the tail's
+            budgets die in the engine batch queue.  Shedding ON answers
+            the doomed tail in microseconds and reaches the next
+            burst's FRESH budgets ~20ms sooner; OFF burns 2ms of
+            handler time per corpse first.  Returns completed-WITHIN-
+            DEADLINE QPS, counted at the handler (see Work.Spin: the
+            slim lane coalesces each burst's responses into one writev,
+            so client-side arrival times can't see in-budget work)."""
+            K = 24
+            good0 = work.good
+            cid = 1
+            stop = time.perf_counter() + secs
+            with pysock.create_connection(
+                    (str(ep.host), ep.port), timeout=10) as c:
+                c.settimeout(10)
+                while time.perf_counter() < stop:
+                    c.sendall(_burst_frames(cid, K))
+                    cid += K
+                    buf = b""
+                    got = 0
+                    while got < K:
+                        while True:
+                            if len(buf) >= 12:
+                                (bl,) = struct.unpack_from("<I", buf, 4)
+                                if len(buf) >= 12 + bl:
+                                    break
+                            buf += c.recv(65536)
+                        (bl,) = struct.unpack_from("<I", buf, 4)
+                        m = RpcMeta.decode(buf[12:12 + struct.unpack_from(
+                            "<I", buf, 8)[0]])
+                        assert m is not None
+                        buf = buf[12 + bl:]
+                        got += 1
+            return (work.good - good0) / secs
+
+        overload_window(0.4)            # warm connections + lanes
+        shed_qps, noshed_qps = [], []
+        sheds0 = sum(shed_counters().values())
+        for r in range(4):              # interleaved, alternating order
+            arms = [(True, shed_qps), (False, noshed_qps)]
+            if r % 2:
+                arms.reverse()
+            for on, acc in arms:
+                set_flag("enable_deadline_shed", on)
+                acc.append(overload_window(1.0))
+        set_flag("enable_deadline_shed", True)
+        shed_q = statistics.median(shed_qps)
+        noshed_q = statistics.median(noshed_qps)
+        extra["goodput_under_overload_shed_qps"] = round(shed_q, 1)
+        extra["goodput_under_overload_noshed_qps"] = round(noshed_q, 1)
+        extra["goodput_under_overload"] = \
+            round(shed_q / max(noshed_q, 0.1), 3)
+        extra["goodput_bench_sheds"] = \
+            sum(shed_counters().values()) - sheds0
+    finally:
+        srv.stop()
+
+    # (b) retry amplification against a dead backend: attempts per call
+    probe = pysock.socket()
+    probe.bind(("127.0.0.1", 0))
+    dead = f"127.0.0.1:{probe.getsockname()[1]}"
+    probe.close()
+
+    def amplification(budget_max: float) -> float:
+        co = ChannelOptions()
+        co.timeout_ms = 1000
+        co.max_retry = 3
+        co.connection_type = "pooled"
+        co.retry_budget_max = budget_max
+        ch = Channel(co)
+        ch.init(dead)
+        calls, attempts = 24, 0
+        for _ in range(calls):
+            cntl = Controller()
+            cntl.timeout_ms = 1000
+            c = ch.call_method("OV.Spin", b"", cntl=cntl)
+            attempts += 1 + c.retried_count
+        return attempts / calls
+
+    extra["retry_amplification_factor"] = round(amplification(8.0), 3)
+    extra["retry_amplification_unbudgeted"] = \
+        round(amplification(0.0), 3)
+
+
 def bench_grpc(extra: dict) -> None:
     """gRPC unary 1KB echo: a real grpcio client against our server ON
     THE NATIVE PORT (h2 rides the engine's passthrough lane — native
@@ -1470,6 +1614,7 @@ def main() -> None:
                      ("fanout", bench_fanout),
                      ("http", bench_http),
                      ("trace", bench_trace),
+                     ("robustness", bench_robustness),
                      ("grpc", bench_grpc)):
         if not budget_left():
             extra[f"{name}_skipped"] = "bench budget spent"

@@ -9,6 +9,7 @@ exactly the reference's split between serialize_request and pack_request.
 
 from __future__ import annotations
 
+import threading
 from typing import Any, Callable, Optional
 
 from ..butil.endpoint import EndPoint, parse_endpoint
@@ -27,6 +28,8 @@ class ChannelOptions:
                  "backup_request_ms", "connection_type", "protocol",
                  "request_compress_type", "auth_data",
                  "enable_circuit_breaker",
+                 "retry_budget_max", "retry_budget_ratio",
+                 "retry_backoff_ms", "retry_backoff_max_ms",
                  "ssl", "ssl_context", "ssl_ca", "ssl_verify")
 
     def __init__(self):
@@ -39,6 +42,21 @@ class ChannelOptions:
         self.request_compress_type = CompressType.NONE
         self.auth_data = b""
         self.enable_circuit_breaker = False
+        # retry hardening (deadline plane): every retry AND backup
+        # attempt on this channel draws from one gRPC-style token
+        # bucket (brpc_tpu.deadline.RetryBudget) — under a degraded
+        # backend the sustained retry rate decays to retry_budget_ratio
+        # per success instead of multiplying offered load by
+        # 1 + max_retry.  max <= 0 disables the budget.  The default is
+        # deliberately roomy (50 denied-free retries): ordinary
+        # failover must never starve; only storms hit the throttle.
+        # Retries back off exponentially from retry_backoff_ms (0 =
+        # immediate, the historical behavior) with ±20% jitter, capped
+        # at retry_backoff_max_ms.
+        self.retry_budget_max = 100.0
+        self.retry_budget_ratio = 0.1
+        self.retry_backoff_ms = 0
+        self.retry_backoff_max_ms = 5000
         # TLS (≈ ChannelSSLOptions, /root/reference/src/brpc/ssl_options.h):
         # ssl=True wraps every connection; ssl_context overrides the
         # default client context; ssl_ca pins a CA file; ssl_verify
@@ -58,6 +76,39 @@ class Channel:
         self._initialized = False
         self._method_tlvs = {}      # method_full -> pre-encoded meta TLVs
         self._ssl_ctx_cache = None
+        self._retry_budget = None   # lazy RetryBudget (shared per channel)
+        self._retry_budget_lock = threading.Lock()
+
+    # -- retry hardening ---------------------------------------------------
+
+    def retry_budget(self):
+        """This channel's retry-throttling token bucket (None when
+        disabled via ``retry_budget_max <= 0``)."""
+        if self.options.retry_budget_max <= 0:
+            return None
+        if self._retry_budget is None:
+            from ..deadline import RetryBudget
+            with self._retry_budget_lock:
+                # double-checked: two threads racing the first retry
+                # must share ONE bucket, or tokens spent through the
+                # losing instance vanish and the cap overshoots
+                if self._retry_budget is None:
+                    self._retry_budget = RetryBudget(
+                        self.options.retry_budget_max,
+                        self.options.retry_budget_ratio)
+        return self._retry_budget
+
+    def acquire_retry_token(self) -> bool:
+        """Spend one retry/backup token; True when the attempt may be
+        sent (always True with the budget disabled)."""
+        budget = self.retry_budget()
+        return True if budget is None else budget.acquire()
+
+    def on_call_success(self) -> None:
+        """Refill the retry budget on a successful response."""
+        budget = self._retry_budget
+        if budget is not None:
+            budget.on_success()
 
     def ssl_ctx(self):
         """The channel's client TLS context (None when TLS is off)."""
@@ -188,7 +239,20 @@ class Channel:
             c._fail_before_launch(1003, str(e), done)
             return c
         svc, _, mth = method_full.rpartition(".")
-        timeout_s = (c.timeout_ms or self.options.timeout_ms or 30000) / 1e3
+        # deadline inheritance: a grpc call from a deadline'd handler is
+        # capped to the remaining upstream budget (grpc-timeout carries
+        # it to the server), failing fast when it's already gone
+        from ..butil.status import Errno
+        from ..deadline import cap_timeout_ms
+        tmo_ms, amb_expired = cap_timeout_ms(
+            c.timeout_ms or self.options.timeout_ms or 30000)
+        if amb_expired:
+            c._fail_before_launch(
+                int(Errno.ERPCTIMEDOUT),
+                "inherited deadline already expired (doomed downstream "
+                "call failed fast)", done)
+            return c
+        timeout_s = tmo_ms / 1e3
         metadata = None
         if c.trace_id and c.span_id:
             # trace context over h2 as a W3C traceparent header (HPACK

@@ -18,12 +18,14 @@ Rendezvous design (the reference's, re-expressed):
 from __future__ import annotations
 
 import threading
-from typing import Any, Callable, Optional, Set
+from typing import Any, Callable, Optional, Set, Tuple
 
 from ..butil.iobuf import IOBuf, LazyAttachmentsMixin
 from ..butil.logging_util import LOG
 from ..butil.status import Errno
 from ..butil.time_utils import monotonic_us
+from ..deadline import backoff_ms as _backoff_ms
+from ..deadline import cap_timeout_ms as _cap_timeout_ms
 from ..fiber.timer_thread import global_timer_thread
 from ..fiber.versioned_id import global_id_pool
 from ..protocol import compress as compress_mod
@@ -64,7 +66,8 @@ class Controller(LazyAttachmentsMixin):
         "_error_code", "_error_text", "_cid_base", "_nretry",
         "_live_versions", "_done", "_response_type", "_request_payload",
         "_method_full", "_remote", "_begin_us", "_ended", "_ended_flag",
-        "_timeout_timer", "_backup_timer", "_sending_sid",
+        "_timeout_timer", "_backup_timer", "_last_attempt_error",
+        "_sending_sid",
         "_attempt_sids", "_inflight_marks", "attempt_remotes",
         "_stream_to_create",
         "_channel", "_lb_ctx", "trace_id", "span_id", "_direct_ok",
@@ -106,6 +109,7 @@ class Controller(LazyAttachmentsMixin):
         self._ended_flag = False
         self._timeout_timer = 0
         self._backup_timer = 0
+        self._last_attempt_error: Optional[Tuple[int, str]] = None
         self._sending_sid = 0
         self._attempt_sids = []          # pooled/short sids per attempt
         self._inflight_marks = []        # (sid, cid) to unhook at end
@@ -273,6 +277,16 @@ class Controller(LazyAttachmentsMixin):
         self._done = done
         if self.timeout_ms is None:
             self.timeout_ms = opts.timeout_ms
+        # deadline inheritance: issued from a deadline'd server handler,
+        # this call can never outlive the upstream request's remaining
+        # budget — and fails fast when that budget is already gone
+        self.timeout_ms, _amb_expired = _cap_timeout_ms(self.timeout_ms)
+        if _amb_expired:
+            self._fail_before_launch(
+                int(Errno.ERPCTIMEDOUT),
+                "inherited deadline already expired (doomed downstream "
+                "call failed fast)", done)
+            return
         if self.max_retry is None:
             self.max_retry = opts.max_retry
         if self.backup_request_ms is None:
@@ -376,6 +390,15 @@ class Controller(LazyAttachmentsMixin):
             body = self._request_payload.to_bytes() + att
             headers = [("x-rpc-attachment-size", str(len(att)))] \
                 if att else []
+            if self.timeout_ms and self.timeout_ms > 0:
+                # x-deadline-ms: the HTTP/1.1 spelling of tpu_std's
+                # remaining-deadline TLV 13 — every (retry) attempt
+                # stamps what's LEFT of the budget, not the original
+                # timeout, so the server's shed decision sees the truth
+                elapsed_ms = (monotonic_us() - self._begin_us) // 1000
+                headers.append(("x-deadline-ms",
+                                str(max(1, int(self.timeout_ms
+                                               - elapsed_ms)))))
             if self.trace_id and self.span_id:
                 # trace context rides HTTP as a W3C traceparent header
                 # (the tpu_std meta TLVs' cross-protocol spelling).
@@ -486,12 +509,78 @@ class Controller(LazyAttachmentsMixin):
         if failed_remote is not None:
             self.excluded_servers.add(failed_remote)
         if self.retry_policy(self, code) and self._nretry < self.max_retry:
+            ch = self._channel
+            if ch is not None and not ch.acquire_retry_token():
+                # retry budget exhausted: a degraded backend must not
+                # see offered load multiplied by 1 + max_retry
+                return False
             self._nretry += 1
             self.retried_count = self._nretry
             self._live_versions.add(self._nretry)
-            self._issue_rpc()
+            delay_ms = 0.0
+            if ch is not None:
+                delay_ms = _backoff_ms(ch.options.retry_backoff_ms,
+                                       self._nretry,
+                                       ch.options.retry_backoff_max_ms)
+            if delay_ms > 0:
+                # exponential backoff with jitter: the timer thread only
+                # trampolines — the attempt is issued by a short-lived
+                # thread after the delay (the deadline timer races it
+                # fairly: a backed-off retry that would land past the
+                # deadline simply never fires).  The scheduled attempt's
+                # VERSION rides along so a backup request firing during
+                # the backoff window can't make the late issue duplicate
+                # the backup's cid on the wire.
+                global_timer_thread().schedule(
+                    Controller._backoff_fire, delay_ms / 1e3, None,
+                    self._cid_base, self._nretry)
+            else:
+                self._issue_rpc()
             return True
         return False
+
+    @staticmethod
+    def _backoff_fire(call_id: int, version: int) -> None:
+        """Timer-thread trampoline of a backed-off retry: hop straight
+        onto a short-lived issuer thread.  Both halves of the issue can
+        block (``_idp.lock`` cond-waits on a held id; connect/write can
+        take seconds) and the shared timer thread must keep every other
+        call's deadline/backup timers firing meanwhile."""
+        threading.Thread(target=Controller._backoff_issue,
+                         args=(call_id, version), daemon=True).start()
+
+    @staticmethod
+    def _backoff_issue(call_id: int, version: int) -> None:
+        """Issuer body of a backed-off retry: re-take the id lock (the
+        call may have completed or timed out during the backoff — stale
+        ids refuse to lock) and issue the pending attempt — unless a
+        backup request fired during the backoff and already advanced
+        ``_nretry``: issuing then would put a DUPLICATE of the backup's
+        cid on the wire, so the never-issued scheduled version is
+        retired instead."""
+        ok, cntl = _idp.lock(call_id)
+        if not ok:
+            return
+        if cntl is None:
+            _idp.unlock(call_id)
+            return
+        if cntl._nretry == version:
+            cntl._issue_rpc()
+            _idp.unlock(call_id)
+            return
+        cntl._live_versions.discard(version)
+        if not cntl._live_versions:
+            # every issued attempt already failed and retry was declined
+            # while this version kept the call looking alive: finish it
+            # now with the last REAL failure (a fabricated timeout would
+            # misdirect retry policies and breaker analysis) instead of
+            # hanging to the full deadline
+            code, text = (cntl._last_attempt_error
+                          or (int(Errno.ERPCTIMEDOUT),
+                              "all attempts failed during retry backoff"))
+            cntl._finish_locked(code, text)
+            return
+        _idp.unlock(call_id)
 
     @staticmethod
     def _on_id_error(call_id: int, cntl: "Controller", code: int,
@@ -501,7 +590,12 @@ class Controller(LazyAttachmentsMixin):
             _idp.unlock_and_destroy(call_id)
             return
         if code == int(Errno.EBACKUPREQUEST):
-            if cntl._nretry < cntl.max_retry:
+            # backup/hedged requests draw from the SAME retry budget as
+            # retries: hedging against a degraded backend is exactly a
+            # retry storm with better intentions
+            ch = cntl._channel
+            if cntl._nretry < cntl.max_retry \
+                    and (ch is None or ch.acquire_retry_token()):
                 cntl.has_backup_request = True
                 cntl._nretry += 1
                 cntl.retried_count = cntl._nretry
@@ -519,7 +613,10 @@ class Controller(LazyAttachmentsMixin):
             return
         if cntl._live_versions:
             # another attempt (e.g. the original besides a failed backup)
-            # is still in flight — let it decide the call's fate
+            # is still in flight — let it decide the call's fate; keep
+            # this failure so a never-issued backoff version retiring
+            # last can still report the real error
+            cntl._last_attempt_error = (code, text)
             _idp.unlock(cntl._cid_base)
             return
         cntl._finish_locked(code, text)
@@ -622,6 +719,17 @@ class Controller(LazyAttachmentsMixin):
         ch = self._channel
         if ch is not None and ch.load_balancer is not None:
             ch.load_balancer.feedback(self)
+        elif ch is not None and ch.options.enable_circuit_breaker \
+                and self.remote_side is not None:
+            # single-server channels have no LB to route feedback, but
+            # the breaker map is global (keyed by endpoint): health
+            # observed here must still inform every cluster channel
+            # sharing this backend
+            from .circuit_breaker import global_circuit_breaker_map
+            global_circuit_breaker_map().on_call(
+                self.remote_side, self._error_code, self.latency_us)
+        if ch is not None and code == 0:
+            ch.on_call_success()       # refill the retry budget
         _idp.unlock_and_destroy(self._cid_base)
         self._signal_ended()
         done = self._done

@@ -29,8 +29,11 @@ from typing import Any, Optional, Tuple
 from ..butil.iobuf import IOBuf
 from ..butil.status import Errno
 from time import monotonic_ns as _mono_ns
+from time import sleep as _sleep
 
 from ..butil.time_utils import monotonic_us
+from ..deadline import backoff_ms as _backoff_ms
+from ..deadline import cap_timeout_ms as _cap_timeout_ms
 from ..transport.socket import Socket
 from ..transport.socket_map import (pooled_socket, return_pooled_socket,
                                     short_socket)
@@ -272,6 +275,15 @@ def run(channel, cntl, method_full: str, request: Any,
     opts = channel.options
     if cntl.timeout_ms is None:
         cntl.timeout_ms = opts.timeout_ms
+    # deadline inheritance: inside a deadline'd handler the downstream
+    # call is capped to the upstream's remaining budget (fail fast at 0)
+    cntl.timeout_ms, _amb_expired = _cap_timeout_ms(cntl.timeout_ms)
+    if _amb_expired:
+        cntl._begin_us = _mono_ns() // 1000
+        _finish(channel, cntl, Errno.ERPCTIMEDOUT,
+                "inherited deadline already expired (doomed downstream "
+                "call failed fast)")
+        return
     if cntl.max_retry is None:
         cntl.max_retry = opts.max_retry
     if cntl.connection_type is None:
@@ -315,17 +327,32 @@ def run(channel, cntl, method_full: str, request: Any,
 
     def _retry_or_finish(code: int, text: str) -> bool:
         """Shared retry tail (≈ Controller._retry_locked): True = the
-        caller should retry the loop, False = the call is finished."""
+        caller should retry the loop, False = the call is finished.
+        Mirrors the slow path's retry hardening: the attempt draws a
+        channel retry-budget token, and backs off exponentially with
+        jitter (inline sleep — this lane owns the calling thread)."""
         nonlocal nretry
         cntl.excluded_servers.add(remote)
         if cntl.retry_policy(cntl, code) and nretry < cntl.max_retry:
-            nretry += 1
-            cntl.retried_count = nretry
             if deadline_us is not None \
                     and _mono_ns() // 1000 >= deadline_us:
+                # deadline first, token second: a retry that can never
+                # be sent must not drain the channel budget
                 _finish(channel, cntl, Errno.ERPCTIMEDOUT,
                         f"deadline {timeout_ms}ms exceeded")
                 return False
+            if not channel.acquire_retry_token():
+                _finish(channel, cntl, code, text)
+                return False
+            nretry += 1
+            cntl.retried_count = nretry
+            delay_ms = _backoff_ms(opts.retry_backoff_ms, nretry,
+                                   opts.retry_backoff_max_ms)
+            if delay_ms > 0:
+                if deadline_us is not None:
+                    delay_ms = min(delay_ms, max(
+                        0.0, (deadline_us - _mono_ns() // 1000) / 1000.0))
+                _sleep(delay_ms / 1e3)
             return True
         _finish(channel, cntl, code, text)
         return False
@@ -703,12 +730,29 @@ def _handle_response(channel, cntl, sock, sid: int, pooled: bool, buf,
     return _complete(raw, attachment)
 
 
+def _breaker_feed(channel, remote, code: int, latency_us: int = 0) -> None:
+    """The pinned raw/scatter lanes have no LB in the path to route
+    health feedback — feed the GLOBAL circuit-breaker map directly
+    (keyed by endpoint, so cluster channels sharing this backend see
+    the flap), gated on the channel's enable_circuit_breaker exactly
+    like LB-routed feedback."""
+    if remote is None or not channel.options.enable_circuit_breaker:
+        return
+    from .circuit_breaker import global_circuit_breaker_map
+    global_circuit_breaker_map().on_call(remote, int(code), latency_us)
+
+
 def _finish(channel, cntl, code, text: str) -> None:
     if code:
         cntl.set_failed(code, text)
     cntl.latency_us = _mono_ns() // 1000 - cntl._begin_us
     if channel.load_balancer is not None:
         channel.load_balancer.feedback(cntl)
+    else:
+        _breaker_feed(channel, cntl.remote_side, int(code),
+                      cntl.latency_us)
+    if not code:
+        channel.on_call_success()      # refill the retry budget
     cntl._signal_ended()
 
 
@@ -1260,6 +1304,13 @@ def run_raw(channel, method_full: str, payload, attachment=b"",
     opts = channel.options
     if timeout_ms is None:
         timeout_ms = opts.timeout_ms
+    # deadline inheritance: the raw lane fails fast too when the
+    # enclosing handler's budget is gone, and never outlives it
+    timeout_ms, _amb_expired = _cap_timeout_ms(timeout_ms)
+    if _amb_expired:
+        raise RpcError(int(Errno.ERPCTIMEDOUT),
+                       "inherited deadline already expired (doomed "
+                       "downstream call failed fast)")
     remote = channel.single_server
 
     def _full_path():
@@ -1284,6 +1335,10 @@ def run_raw(channel, method_full: str, payload, attachment=b"",
         tlv = channel._method_tlvs[method_full] = method_tlv(method_full)
     sid, sock = _raw_socket(remote)
     if sock is None:
+        # connect failures are health signal too: without this feed a
+        # fully-dead backend reached only through the raw lane would
+        # never trip the breaker
+        _breaker_feed(channel, remote, int(Errno.EFAILEDSOCKET))
         raise RpcError(int(Errno.EFAILEDSOCKET),
                        f"connect to {remote} failed")
     if not sock.direct_read or not sock.read_portal.empty() \
@@ -1294,6 +1349,22 @@ def run_raw(channel, method_full: str, payload, attachment=b"",
         _unpin(remote, sid)
         return _full_path()
 
+    try:
+        out = _raw_pinned(opts, payload, attachment, timeout_ms, sid,
+                          sock, tlv)
+    except RpcError as e:
+        _breaker_feed(channel, remote, e.code)
+        raise
+    _breaker_feed(channel, remote, 0)
+    return out
+
+
+def _raw_pinned(opts, payload, attachment, timeout_ms, sid, sock, tlv):
+    """The pinned-socket lane body of run_raw (fully-native raw_call
+    round trip when available, classic frame build otherwise), split
+    out so run_raw can route its one outcome into circuit-breaker
+    feedback — the pinned lane has no Controller/LB in the path."""
+    from .channel import RpcError
     nat = _native()
     cid = _next_cid()
     if nat is not None and hasattr(nat, "raw_call") \
@@ -1441,6 +1512,13 @@ def run_batch(channel, method_full: str, requests, response_type: Any,
         return []                 # nothing to send; touch no socket
     if timeout_ms is None:
         timeout_ms = channel.options.timeout_ms
+    # deadline inheritance: a batch from a deadline'd handler shares the
+    # upstream's remaining budget (fail fast when it's already gone)
+    timeout_ms, _amb_expired = _cap_timeout_ms(timeout_ms)
+    if _amb_expired:
+        raise RpcError(int(Errno.ERPCTIMEDOUT),
+                       "inherited deadline already expired (doomed "
+                       "downstream batch failed fast)")
     remote = channel.single_server
     if remote is None:
         # cluster channel: batching across servers loses the single-

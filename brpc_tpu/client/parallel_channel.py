@@ -23,8 +23,22 @@ import threading
 from typing import Any, Callable, List, Optional, Sequence
 
 from ..butil.status import Errno
+from ..butil.time_utils import monotonic_us
+from ..deadline import cap_timeout_ms
 from .channel import Channel
 from .controller import Controller
+
+
+def _leg_budget_ms(begin_us: int, timeout_ms: Optional[int]
+                   ) -> Optional[int]:
+    """The fan-out shares ONE budget: a leg launched ``elapsed`` after
+    the fan-out began gets ``timeout_ms - elapsed``, not a fresh copy of
+    the full timeout (a slow first leg must not let later legs run the
+    total call past the caller's deadline).  ≤ 0 means the budget is
+    spent — the leg fails fast.  None/unset timeouts pass through."""
+    if not timeout_ms or timeout_ms <= 0:
+        return timeout_ms
+    return int(timeout_ms - (monotonic_us() - begin_us) // 1000)
 
 SKIP = object()          # call_mapper return: skip this sub-channel
 
@@ -59,6 +73,15 @@ class ParallelChannel:
                     cntl: Optional[Controller] = None,
                     merger: Optional[Callable] = None) -> Controller:
         c = cntl or Controller()
+        # deadline inheritance: a fan-out issued from a deadline'd
+        # handler shares the upstream's remaining budget
+        c.timeout_ms, amb_expired = cap_timeout_ms(c.timeout_ms)
+        if amb_expired:
+            c._fail_before_launch(int(Errno.ERPCTIMEDOUT),
+                                  "inherited deadline already expired "
+                                  "(doomed fan-out failed fast)", done)
+            return c
+        begin_us = monotonic_us()        # the ONE fan-out budget anchor
         merger = merger or default_response_merger
         branches: List[tuple] = []       # (index, sub, mapped_request)
         for i, (sub, mapper) in enumerate(self._subs):
@@ -92,11 +115,21 @@ class ParallelChannel:
             # scatter-gather fast lane: all requests on the wire first,
             # then collect — no per-branch dispatcher/fiber machinery
             from . import fast_call
+            left = _leg_budget_ms(begin_us, c.timeout_ms)
+            if left is not None and c.timeout_ms and left <= 0:
+                # the whole budget went to mapping/screening: nothing
+                # may be sent (every leg would be doomed work)
+                c._fail_before_launch(int(Errno.ERPCTIMEDOUT),
+                                      "fan-out budget exhausted before "
+                                      "any leg launched", done)
+                return c
             sub_cntls = []
             scatter = []
             for i, sub, mapped in branches:
                 sc = Controller()
-                sc.timeout_ms = c.timeout_ms
+                # legs share the fan-out's remaining budget, not a
+                # fresh copy of the full timeout
+                sc.timeout_ms = left
                 sc.max_retry = c.max_retry
                 # branches are unary one-shots: exclusive pooled
                 # connections let one thread own all the reads
@@ -108,7 +141,7 @@ class ParallelChannel:
                 sub_cntls.append(sc)
                 scatter.append((sub, sc, method_full, mapped,
                                 response_type))
-            if fast_call.run_scatter(scatter, c.timeout_ms):
+            if fast_call.run_scatter(scatter, left):
                 failed = sum(1 for sc in sub_cntls if sc.failed)
                 if failed > 0 and (failed >= fail_limit or failed == n):
                     codes = [sc.error_code for sc in sub_cntls
@@ -179,7 +212,16 @@ class ParallelChannel:
 
         for slot, (i, sub, mapped) in enumerate(branches):
             sub_cntl = Controller()
-            sub_cntl.timeout_ms = c.timeout_ms
+            # remaining-minus-elapsed: legs launch sequentially, and a
+            # slow earlier launch already spent part of the one budget
+            left = _leg_budget_ms(begin_us, c.timeout_ms)
+            if left is not None and c.timeout_ms and left <= 0:
+                sub_cntl._fail_before_launch(
+                    int(Errno.ERPCTIMEDOUT),
+                    "fan-out budget exhausted before this leg launched",
+                    on_branch_done(slot))
+                continue
+            sub_cntl.timeout_ms = left
             sub_cntl.max_retry = c.max_retry
             # trace context flows to every branch; call_method opens
             # the per-branch client span under the root
@@ -226,6 +268,16 @@ class SelectiveChannel:
         if not self._subs:
             c._fail_before_launch(Errno.EINTERNAL, "no sub channels", done)
             return c
+        # deadline inheritance + one shared budget across sub-channel
+        # attempts: attempt k+1 gets what attempt k left, not a fresh
+        # copy of the full timeout
+        c.timeout_ms, amb_expired = cap_timeout_ms(c.timeout_ms)
+        if amb_expired:
+            c._fail_before_launch(int(Errno.ERPCTIMEDOUT),
+                                  "inherited deadline already expired "
+                                  "(doomed call failed fast)", done)
+            return c
+        begin_us = monotonic_us()
         excluded: set = set()
         attempts = min(self.max_retry + 1, len(self._subs))
 
@@ -237,8 +289,17 @@ class SelectiveChannel:
                 if done is not None:
                     done(c)
                 return
+            left = _leg_budget_ms(begin_us, c.timeout_ms)
+            if left is not None and c.timeout_ms and left <= 0:
+                c.set_failed(Errno.ERPCTIMEDOUT,
+                             "budget exhausted across sub-channel "
+                             "attempts")
+                c._signal_ended()
+                if done is not None:
+                    done(c)
+                return
             sub_cntl = Controller()
-            sub_cntl.timeout_ms = c.timeout_ms
+            sub_cntl.timeout_ms = left
 
             def cb(sc: Controller) -> None:
                 if not sc.failed:

@@ -363,8 +363,11 @@ struct Loop {
 // kind 3 is the SLIM SERVER LANE for full (cntl, request) methods: the
 // engine scans the meta, batches eligible requests, and enters Python
 // ONCE per read burst calling
-// handler(payload, att, cid, conn_id, dom, nonce, recv_ns, trace) —
-// trace is None or the request's (trace_id, span_id, parent_id) —
+// handler(payload, att, cid, conn_id, dom, nonce, recv_ns, trace,
+// timeout_ms) —
+// trace is None or the request's (trace_id, span_id, parent_id);
+// timeout_ms is TLV 13's remaining budget (None = absent; 0 =
+// expired at arrival) —
 // admission,
 // MethodStatus accounting and rpcz span sampling live in that shim
 // (server/slim_dispatch.py).  A buffer return is framed
@@ -421,6 +424,12 @@ struct PyRawItem {
   uint64_t trace_id = 0;
   uint64_t span_id = 0;
   uint64_t parent_id = 0;
+  // kind 3: remaining-deadline ms (TLV 13) — the shim anchors it at
+  // t_parse and sheds queue-expired requests (deadline plane);
+  // timeout_present distinguishes an explicit on-wire 0 (expired at
+  // arrival) from an absent deadline
+  uint32_t timeout_ms = 0;
+  bool timeout_present = false;
   // kind-4 slim-HTTP fields (hroute != nullptr selects the lane)
   HttpRoute* hroute = nullptr;
   const char* query = nullptr;  // bytes after '?' in the request target
@@ -431,6 +440,8 @@ struct PyRawItem {
   uint32_t attszlen = 0;
   const char* tp = nullptr;     // traceparent header value (raw)
   uint32_t tplen = 0;
+  const char* dl = nullptr;     // x-deadline-ms header value (raw) —
+  uint32_t dllen = 0;           // the shim sheds queue-expired requests
   // telemetry: CLOCK_MONOTONIC ns at frame parse (comparable with
   // Python's time.monotonic_ns — the shims backdate rpcz spans with it)
   int64_t t_parse = 0;
@@ -653,6 +664,13 @@ struct MetaScan {
   uint64_t trace_id = 0;
   uint64_t span_id = 0;
   uint64_t parent_id = 0;
+  // tag 13 (remaining-deadline ms): the SLIM lane forwards it to the
+  // shim, which sheds the request when — measured against t_parse —
+  // the budget expired in queue (deadline plane); raw kinds ignore it
+  // (no controller to enforce or propagate it).  timeout_present
+  // tells an explicit on-wire 0 apart from an absent tag.
+  uint32_t timeout_ms = 0;
+  bool timeout_present = false;
 };
 
 // Mirror of native_bridge._scan_request_meta: collect cid/att/svc/mth
@@ -698,7 +716,10 @@ static bool scan_request_meta(const char* p, size_t len, MetaScan* out) {
         memcpy(&out->parent_id, p + off, 8);
         break;
       case 13:
-        break;              // remaining-deadline: safe for every lane
+        if (ln != 4) return false;
+        memcpy(&out->timeout_ms, p + off, 4);  // remaining-deadline ms:
+        out->timeout_present = true;
+        break;              // safe for every lane; enforced by kind 3
       case 15:
         out->dom = p + off;
         out->dom_len = ln;
@@ -829,13 +850,16 @@ static void http_slim_item(Loop* lp, Conn* c, PyRawItem& it) {
       ? PyLong_FromLongLong((long long)it.t_parse) : nullptr;
   PyObject* tp = it.tp
       ? PyBytes_FromStringAndSize(it.tp, it.tplen) : nullptr;
+  PyObject* dl = it.dl
+      ? PyBytes_FromStringAndSize(it.dl, it.dllen) : nullptr;
   PyObject* r = nullptr;
   if (body && conn && rcv && (!it.query || q) && (!it.ctype || ct)
-      && (!it.attsz || asz) && (!it.tp || tp))
+      && (!it.attsz || asz) && (!it.tp || tp) && (!it.dl || dl))
     r = PyObject_CallFunctionObjArgs(it.hroute->handler, body,
                                      q ? q : Py_None, ct ? ct : Py_None,
                                      asz ? asz : Py_None, conn, rcv,
-                                     tp ? tp : Py_None, nullptr);
+                                     tp ? tp : Py_None,
+                                     dl ? dl : Py_None, nullptr);
   Py_XDECREF(body);
   Py_XDECREF(q);
   Py_XDECREF(ct);
@@ -843,6 +867,7 @@ static void http_slim_item(Loop* lp, Conn* c, PyRawItem& it) {
   Py_XDECREF(conn);
   Py_XDECREF(rcv);
   Py_XDECREF(tp);
+  Py_XDECREF(dl);
   if (!r) {
     // shim raised (or OOM building args): answer a plain 500 with the
     // exception text, keeping the keep-alive conn in sync
@@ -944,7 +969,14 @@ static void raw_slim_item(Loop* lp, Conn* c, PyRawItem& it) {
         tr = Py_BuildValue("(KKK)", (unsigned long long)it.trace_id,
                            (unsigned long long)it.span_id,
                            (unsigned long long)it.parent_id);
+      // remaining-deadline ms (None = TLV 13 absent; an int — 0
+      // allowed, meaning expired-at-arrival — when present): the shim
+      // anchors it at the t_parse timestamp it already receives and
+      // sheds queue-expired requests before user code runs
+      PyObject* tmo = it.timeout_present
+          ? PyLong_FromUnsignedLong(it.timeout_ms) : nullptr;
       if (pb && (it.att == 0 || ab) && cid && conn && rcv
+          && (!it.timeout_present || tmo)
           && (it.dom_len == 0 || dom) && (it.conn_len == 0 || nonce)
           && (it.trace_id == 0 || tr))
         r = PyObject_CallFunctionObjArgs(it.m->handler, pb,
@@ -952,7 +984,7 @@ static void raw_slim_item(Loop* lp, Conn* c, PyRawItem& it) {
                                          dom ? dom : Py_None,
                                          nonce ? nonce : Py_None,
                                          rcv, tr ? tr : Py_None,
-                                         nullptr);
+                                         tmo ? tmo : Py_None, nullptr);
       Py_XDECREF(pb);
       Py_XDECREF(ab);
       Py_XDECREF(cid);
@@ -961,6 +993,7 @@ static void raw_slim_item(Loop* lp, Conn* c, PyRawItem& it) {
       Py_XDECREF(nonce);
       Py_XDECREF(rcv);
       Py_XDECREF(tr);
+      Py_XDECREF(tmo);
       if (r == Py_None) {
         // handled out-of-band: the shim completed (or will complete)
         // the RPC through the classic Python send path
@@ -1161,6 +1194,8 @@ static bool native_try_handle(EngineImpl* eng, Loop* lp, Conn* c,
       pi.trace_id = s.trace_id;
       pi.span_id = s.span_id;
       pi.parent_id = s.parent_id;
+      pi.timeout_ms = s.timeout_ms;
+      pi.timeout_present = s.timeout_present;
       pi.t_parse = now_ns();
       batch->push_back(pi);
       break;
@@ -1555,6 +1590,8 @@ static bool http_slim_match(EngineImpl* eng, Loop* lp, const char* p,
   uint32_t attszlen = 0;
   const char* tp = nullptr;
   uint32_t tplen = 0;
+  const char* dl = nullptr;
+  uint32_t dllen = 0;
   const char* line = nl + 1;
   while (line < he) {
     const char* leol =
@@ -1595,6 +1632,12 @@ static bool http_slim_match(EngineImpl* eng, Loop* lp, const char* p,
           tplen = (uint32_t)vlen;               // the shim parses it,
         }                                       // traced stays slim
         break;
+      case 13:
+        if (strncasecmp(line, "x-deadline-ms", 13) == 0) {
+          dl = v;                               // remaining deadline —
+          dllen = (uint32_t)vlen;               // the shim sheds
+        }                                       // queue-expired requests
+        break;
       case 12:
         if (strncasecmp(line, "content-type", 12) == 0) {
           ctype = v;                            // last one wins, like
@@ -1626,6 +1669,8 @@ static bool http_slim_match(EngineImpl* eng, Loop* lp, const char* p,
   out->attszlen = attszlen;
   out->tp = tp;
   out->tplen = tplen;
+  out->dl = dl;
+  out->dllen = dllen;
   return true;
 }
 
@@ -2440,8 +2485,8 @@ static PyObject* Engine_run_loop(EngineObj* self, PyObject* args) {
 // 1 = const(data), 2 = Python @raw_method handler called from the
 // engine loop (burst-batched; one GIL entry per read burst),
 // 3 = slim full-method dispatch shim (burst-batched like 2; called as
-// handler(payload, att, cid, conn_id, dom, nonce, recv_ns), None
-// return = out-of-band).
+// handler(payload, att, cid, conn_id, dom, nonce, recv_ns, trace,
+// timeout_ms), None return = out-of-band).
 static PyObject* Engine_register_native_method(EngineObj* self,
                                                PyObject* args) {
   const char* svc;
@@ -2506,7 +2551,7 @@ static PyObject* Engine_set_native_dispatch(EngineObj* self,
 // SLIM HTTP LANE (kind 4): eligible HTTP/1.1 requests matching
 // METHOD+path are parsed in C++, burst-batched, and dispatched to the
 // shim as handler(body, query, content_type, att_size, conn_id,
-// recv_ns, traceparent); a
+// recv_ns, traceparent, x_deadline_ms); a
 // (status, header_block, body) return is serialized natively, bytes
 // are appended verbatim (pre-built classic escalations), None means
 // the shim completed out-of-band.

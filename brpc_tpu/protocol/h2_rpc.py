@@ -24,6 +24,9 @@ from ..butil.iobuf import IOBuf
 from ..butil.logging_util import LOG
 from ..butil.status import Errno
 from ..butil.time_utils import monotonic_us
+from ..deadline import arm as _arm_deadline
+from ..deadline import inherit_deadline as _inherit_deadline
+from ..deadline import maybe_shed as _maybe_shed
 from .base import (ParseResult, Protocol, ProtocolType, max_body_size,
                    register_protocol)
 from .h2_session import PREFACE, E_PROTOCOL, H2Error, H2Session
@@ -68,6 +71,23 @@ def pack_grpc_message(payload: bytes) -> bytes:
     return b"\x00" + struct.pack(">I", len(payload)) + payload
 
 
+_GRPC_TIMEOUT_UNIT_MS = {"H": 3600_000.0, "M": 60_000.0, "S": 1000.0,
+                         "m": 1.0, "u": 1e-3, "n": 1e-6}
+
+
+def parse_grpc_timeout(value: str) -> Optional[int]:
+    """``grpc-timeout`` header (1-8 digits + one of HMSmun) → remaining
+    milliseconds, or None when malformed.  Sub-millisecond values floor
+    to 0 — which means expired-at-arrival, matching ``x-deadline-ms: 0``
+    and distinct from an ABSENT header (no deadline)."""
+    if not value or len(value) > 9:
+        return None
+    digits, unit = value[:-1], value[-1]
+    if not digits.isdigit() or unit not in _GRPC_TIMEOUT_UNIT_MS:
+        return None
+    return int(int(digits) * _GRPC_TIMEOUT_UNIT_MS[unit])
+
+
 def unpack_grpc_messages(buf: bytearray) -> List[bytes]:
     """Cut complete length-prefixed messages off ``buf`` (mutates)."""
     out = []
@@ -98,7 +118,7 @@ def resolve_grpc_entry(server, path: str):
 
 
 class H2Request:
-    __slots__ = ("stream_id", "headers", "body", "conn")
+    __slots__ = ("stream_id", "headers", "body", "conn", "recv_us")
 
     def __init__(self, stream_id: int, headers: List[Tuple[str, str]],
                  body: bytes, conn: "H2ServerConn"):
@@ -106,6 +126,10 @@ class H2Request:
         self.headers = headers
         self.body = body
         self.conn = conn
+        # arrival anchor for the deadline plane (grpc-timeout): stamped
+        # when the stream's END_STREAM completed assembly — fiber
+        # queueing between here and dispatch counts against the budget
+        self.recv_us = monotonic_us()
 
     def header(self, name: str) -> str:
         for n, v in self.headers:
@@ -538,6 +562,12 @@ def _process_grpc(req: H2Request, sock, server) -> None:
             # the server span parents to the caller's span id, exactly
             # like the tpu_std meta's trace/span TLVs
             meta.trace_id, meta.span_id = tp
+    # grpc-timeout: the h2 spelling of tpu_std's remaining-deadline
+    # TLV 13 (0 = already expired); kept in a local — meta.timeout_ms
+    # == 0 conventionally means "none"
+    dl_ms = parse_grpc_timeout(req.header("grpc-timeout"))
+    if dl_ms is not None:
+        meta.timeout_ms = dl_ms
 
     def send(cntl: ServerController, response) -> None:
         latency_us = monotonic_us() - cntl.begin_time_us
@@ -571,6 +601,15 @@ def _process_grpc(req: H2Request, sock, server) -> None:
                                   sock.remote_side)
     if cntl.span is not None:
         cntl.span.request_size = len(payload)
+    if dl_ms is not None:
+        # deadline plane: anchor grpc-timeout at stream assembly (fiber
+        # queueing between END_STREAM and this dispatch counts against
+        # it), then shed doomed work → DEADLINE_EXCEEDED trailers (the
+        # ERPCTIMEDOUT→4 row of the status map) before the handler runs
+        _arm_deadline(cntl, dl_ms, req.recv_us)
+        if _maybe_shed(cntl, "grpc", entry.status.full_name):
+            cntl.finish(None)
+            return
     try:
         request = parse_payload(payload, entry.request_type)
     except Exception as e:
@@ -578,7 +617,8 @@ def _process_grpc(req: H2Request, sock, server) -> None:
         cntl.finish(None)
         return
     try:
-        response = entry.fn(cntl, request)
+        with _inherit_deadline(cntl):
+            response = entry.fn(cntl, request)
     except Exception as e:
         LOG.exception("grpc method %s raised", entry.status.full_name)
         cntl.set_failed(Errno.EINTERNAL, f"{type(e).__name__}: {e}")

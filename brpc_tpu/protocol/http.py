@@ -11,6 +11,7 @@ bodies, case-insensitive headers.
 from __future__ import annotations
 
 import struct
+from time import monotonic_ns as _monotonic_ns
 from typing import Dict, List, Optional, Tuple
 
 from ..butil.iobuf import IOBuf
@@ -59,7 +60,7 @@ class HttpHeaders:
 class HttpMessage:
     __slots__ = ("is_request", "method", "path", "query_string",
                  "version", "status_code", "reason", "headers", "body",
-                 "socket_id")
+                 "socket_id", "recv_us")
 
     def __init__(self):
         self.is_request = True
@@ -72,6 +73,9 @@ class HttpMessage:
         self.headers = HttpHeaders()
         self.body = b""
         self.socket_id = 0
+        # arrival anchor for the deadline plane (x-deadline-ms):
+        # construction ≈ parse time on every ingest path
+        self.recv_us = _monotonic_ns() // 1000
 
     @property
     def keep_alive(self) -> bool:

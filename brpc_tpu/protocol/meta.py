@@ -70,7 +70,7 @@ class RpcMeta:
                  "service_name", "method_name", "error_code", "error_text",
                  "auth_data", "trace_id", "span_id", "parent_span_id",
                  "stream_id", "timeout_ms", "stream_window",
-                 "ici_domain", "ici_desc", "ici_conn")
+                 "ici_domain", "ici_desc", "ici_conn", "timeout_present")
 
     def __init__(self):
         self.correlation_id = 0
@@ -86,6 +86,10 @@ class RpcMeta:
         self.parent_span_id = 0
         self.stream_id = 0
         self.timeout_ms = 0
+        # decode-side: tag 13 was on the wire (clients stamp ≥ 1, so a
+        # crafted explicit 0 means expired-at-arrival — distinguishable
+        # from an absent deadline, which also reads timeout_ms == 0)
+        self.timeout_present = False
         self.stream_window = 0
         self.ici_domain = b""
         self.ici_desc = b""
@@ -180,6 +184,7 @@ class RpcMeta:
                     (m.stream_id,) = struct.unpack("<Q", field)
                 elif tag == _T_TIMEOUT_MS:
                     (m.timeout_ms,) = struct.unpack("<I", field)
+                    m.timeout_present = True
                 elif tag == _T_STREAM_WINDOW:
                     (m.stream_window,) = struct.unpack("<I", field)
                 elif tag == _T_ICI_DOMAIN:

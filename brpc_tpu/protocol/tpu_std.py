@@ -19,6 +19,7 @@ vs ProcessRpcRequest policy glue).
 from __future__ import annotations
 
 import struct
+from time import monotonic_ns as _monotonic_ns
 from typing import Any, Optional
 
 from ..butil.iobuf import IOBuf
@@ -34,12 +35,17 @@ class RpcMessage:
     """One cut frame: meta + payload IOBuf (attachment still inside;
     split by the dispatch layer using meta.attachment_size)."""
 
-    __slots__ = ("meta", "payload", "socket_id")
+    __slots__ = ("meta", "payload", "socket_id", "recv_us")
 
     def __init__(self, meta: RpcMeta, payload: IOBuf, socket_id: int = 0):
         self.meta = meta
         self.payload = payload
         self.socket_id = socket_id
+        # arrival anchor for the deadline plane: construction time IS
+        # the parse time on every ingest path (messenger cut, native
+        # bridge) — queueing between here and dispatch counts against
+        # the request's propagated remaining budget
+        self.recv_us = _monotonic_ns() // 1000
 
     def split_attachment(self) -> IOBuf:
         """Cut the attachment tail off the payload; returns it (empty if

@@ -348,10 +348,17 @@ def _native(server, msg, rest):
             "polls": lo["polls"],
         })
     from ...client.fast_call import scatter_fallback_counters
+    from ...deadline import shed_counters
     out = {
         "lanes": lanes,
         "fallbacks": dict(top_fallbacks),
         "scatter_fallbacks": scatter_fallback_counters(),
+        # deadline plane: per-(lane, method) doomed-work sheds — a
+        # non-zero count means callers' budgets are dying in queue
+        # (the bvar family deadline_shed_total carries the same data
+        # to /vars and /metrics)
+        "deadline_sheds": {f"{lane}|{method}": v for (lane, method), v
+                           in sorted(shed_counters().items())},
         "burst": _hist_view(t["burst"], t["burst_count"],
                             t["burst_sum"]),
         "writev_iov": _hist_view(t["writev_iov"], t["writev_iov_count"],

@@ -32,7 +32,7 @@ class ServerController(LazyAttachmentsMixin):
         "_remote_stream_id", "_accepted_stream_id",
         "_accepted_stream_window", "span", "grpc_stream",
         "http_method", "http_path", "http_unresolved_path",
-        "_session_data", "_progressive",
+        "_session_data", "_progressive", "deadline_us",
     )
 
     def __init__(self, request_meta: RpcMeta,
@@ -70,6 +70,34 @@ class ServerController(LazyAttachmentsMixin):
         self.http_unresolved_path = ""   # restful /* remainder
         self._session_data = None        # borrowed SimpleDataPool object
         self._progressive = None         # ProgressiveAttachment when used
+        # absolute monotonic-µs deadline from the request's propagated
+        # remaining budget (tpu_std TLV 13 / grpc-timeout / x-deadline-ms),
+        # anchored at arrival; 0 = the request carries no deadline.  The
+        # dispatch paths re-anchor it to the protocol parse timestamp
+        # (deadline.arm) where one exists — construction time is the
+        # LATEST possible arrival, so this default is conservative.
+        tmo = request_meta.timeout_ms
+        self.deadline_us = self.begin_time_us + tmo * 1000 if tmo > 0 else 0
+
+    # -- deadline plane ----------------------------------------------------
+
+    def deadline_remaining_ms(self) -> Optional[float]:
+        """Remaining budget of THIS request's propagated deadline in
+        milliseconds (negative once expired), or None when the request
+        carries no deadline.  Handlers doing expensive work should check
+        it between stages and give downstream calls no more than this
+        (downstream calls issued on the handler's own call stack inherit
+        it automatically — see brpc_tpu.deadline.inherit_deadline)."""
+        if not self.deadline_us:
+            return None
+        return (self.deadline_us - _mono_ns() // 1000) / 1000.0
+
+    @property
+    def deadline_expired(self) -> bool:
+        """True when the request's propagated deadline has passed — the
+        caller has given up; any further work is doomed."""
+        return bool(self.deadline_us) \
+            and _mono_ns() // 1000 >= self.deadline_us
 
     # -- error reporting ---------------------------------------------------
 

@@ -17,6 +17,9 @@ from ..butil.iobuf import IOBuf
 from ..butil.logging_util import LOG
 from ..butil.status import Errno
 from ..butil.time_utils import monotonic_us
+from ..deadline import arm as _arm_deadline
+from ..deadline import inherit_deadline, maybe_shed
+from ..deadline import parse_deadline_ms as _parse_deadline_ms
 from ..protocol.http import HttpMessage, build_response
 from ..protocol.meta import RpcMeta
 from ..transport.socket import Socket
@@ -179,6 +182,12 @@ def _bridge_rpc(msg: HttpMessage, sock, server, svc: str,
             # span parents to the caller's span id, exactly like the
             # tpu_std meta's trace/span TLVs
             meta.trace_id, meta.span_id = tp
+    # x-deadline-ms: the HTTP/1.1 spelling of tpu_std's remaining-
+    # deadline TLV 13 (0 = already expired); kept in a local too —
+    # meta.timeout_ms == 0 conventionally means "none"
+    dl_ms = _parse_deadline_ms(msg.headers.get("x-deadline-ms"))
+    if dl_ms is not None:
+        meta.timeout_ms = dl_ms
 
     def send(cntl: ServerController, response: Any) -> None:
         latency_us = monotonic_us() - cntl.begin_time_us
@@ -243,6 +252,15 @@ def _bridge_rpc(msg: HttpMessage, sock, server, svc: str,
                                   sock.remote_side)
     if cntl.span is not None:
         cntl.span.request_size = len(msg.body)
+    if dl_ms is not None:
+        # deadline plane: anchor the propagated budget at the message's
+        # PARSE time (queueing between protocol cut and this bridge
+        # counts against it), then shed doomed work before body parsing
+        # or the handler burn any time on it
+        _arm_deadline(cntl, dl_ms, getattr(msg, "recv_us", 0) or None)
+        if maybe_shed(cntl, "http", entry.status.full_name):
+            cntl.finish(None)
+            return
     if msg.method in ("GET", "HEAD") and msg.query_string:
         request: Any = json.dumps(msg.query()).encode()
     else:
@@ -268,7 +286,8 @@ def _bridge_rpc(msg: HttpMessage, sock, server, svc: str,
         cntl.finish(None)
         return
     try:
-        response = entry.fn(cntl, request)
+        with inherit_deadline(cntl):
+            response = entry.fn(cntl, request)
     except Exception as e:
         LOG.exception("http method %s raised", entry.status.full_name)
         cntl.set_failed(Errno.EINTERNAL, f"{type(e).__name__}: {e}")

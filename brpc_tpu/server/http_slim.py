@@ -13,13 +13,18 @@ Kind 4 removes all of it from the eligible path: the C++ engine parses
 the request line + headers itself, batches every eligible HTTP/1.1
 request of a read burst, and enters Python ONCE calling the per-route
 shim built below as ``handler(body, query, content_type, att_size,
-conn_id, recv_ns, traceparent)`` (bytes-or-None for the middle three
-and for ``traceparent``; ``recv_ns`` is the engine's CLOCK_MONOTONIC
-parse timestamp, used to backdate rpcz spans so they cover native
-queueing).  ``traceparent`` is the raw W3C trace-context header value
-the engine captured — explicitly traced HTTP requests STAY on the
-slim lane, with the span parented to the caller.  The shim is the
-whole per-call Python cost of the lane:
+conn_id, recv_ns, traceparent, deadline)`` (bytes-or-None for the
+middle three, ``traceparent`` and ``deadline``; ``recv_ns`` is the
+engine's CLOCK_MONOTONIC parse timestamp, used to backdate rpcz spans
+so they cover native queueing).  ``traceparent`` is the raw W3C
+trace-context header value the engine captured — explicitly traced
+HTTP requests STAY on the slim lane, with the span parented to the
+caller.  ``deadline`` is the raw ``x-deadline-ms`` header value (the
+HTTP/1.1 spelling of tpu_std's remaining-deadline TLV 13): anchored
+at ``recv_ns``, the shim SHEDS requests whose budget expired in the
+native batch — 500 + ``x-rpc-error-code: ERPCTIMEDOUT``, handler
+never runs (deadline plane).  The shim is the whole per-call Python
+cost of the lane:
 
     admission   server.on_request_in + MethodStatus.on_requested —
                 503 answers ride the slim serializer, byte-identical
@@ -64,6 +69,9 @@ from ..butil.iobuf import IOBuf
 from ..butil.logging_util import LOG
 from ..butil.status import Errno
 from ..butil.time_utils import monotonic_us
+from ..deadline import arm as arm_deadline
+from ..deadline import inherit_deadline, maybe_shed
+from ..deadline import parse_deadline_ms
 from ..protocol.http import build_response
 from ..protocol.meta import RpcMeta
 from ..rpcz import backdate_span, parse_traceparent, start_server_span
@@ -117,7 +125,7 @@ def make_http_slim_handler(bridge, server, entry, svc: str, mth: str,
     is_get = http_method in ("GET", "HEAD")
 
     def slim(body, query, ctype, attsz, conn_id, recv_ns,
-             traceparent=None):
+             traceparent=None, deadline=None):
         sock = socks.get(conn_id)
         if sock is None:
             return None          # connection died mid-burst
@@ -136,6 +144,12 @@ def make_http_slim_handler(bridge, server, entry, svc: str, mth: str,
                 # W3C header → the internal trace model: the span below
                 # is forced and parents to the caller's span id
                 meta.trace_id, meta.span_id = tp
+        # x-deadline-ms: remaining budget; 0 = already expired (meta
+        # keeps it for observability; the cntl deadline below is what
+        # enforcement reads)
+        dl_ms = parse_deadline_ms(deadline)
+        if dl_ms is not None:
+            meta.timeout_ms = dl_ms
 
         # Completion plumbing: while `inline` holds, the send closure
         # parks its response in `cell` and the engine serializes it into
@@ -210,6 +224,10 @@ def make_http_slim_handler(bridge, server, entry, svc: str, mth: str,
         cntl.http_method = http_method
         cntl.http_path = path
         cntl.http_unresolved_path = ""
+        if dl_ms is not None:
+            # deadline anchored at the ENGINE's parse time: native
+            # batching queueing counts against the propagated budget
+            arm_deadline(cntl, dl_ms, recv_ns // 1000)
         span = start_server_span(full_name, meta, sock.remote_side)
         if span is not None:
             span.request_size = len(body)
@@ -217,6 +235,11 @@ def make_http_slim_handler(bridge, server, entry, svc: str, mth: str,
             # native read/parse/batch queueing is real latency
             backdate_span(span, recv_ns)
             cntl.span = span
+        if dl_ms is not None and maybe_shed(cntl, "http_slim", full_name):
+            # doomed work shed: the inline-tuple error completion below
+            # serializes 500 + x-rpc-error-code natively with the burst
+            cntl.finish(None)
+            return cell[0] if cell else None
 
         # request build — mirror of _bridge_rpc
         if is_get and query:
@@ -246,7 +269,8 @@ def make_http_slim_handler(bridge, server, entry, svc: str, mth: str,
             cntl.finish(None)
             return cell[0] if cell else None
         try:
-            response = fn(cntl, request)
+            with inherit_deadline(cntl):
+                response = fn(cntl, request)
         except Exception as e:
             LOG.exception("http method %s raised", full_name)
             cntl.set_failed(Errno.EINTERNAL, f"{type(e).__name__}: {e}")

@@ -15,6 +15,8 @@ from time import monotonic_ns as _mono_ns
 from ..butil.iobuf import IOBuf
 from ..butil.logging_util import LOG
 from ..butil.status import Errno
+from ..deadline import arm as _arm_deadline
+from ..deadline import inherit_deadline, maybe_shed
 from ..protocol import compress as compress_mod
 from ..protocol.meta import RpcMeta
 from ..protocol.tpu_std import RpcMessage, pack_frame, parse_payload, serialize_payload
@@ -219,6 +221,18 @@ def process_rpc_request(msg: RpcMessage, sock: Socket, server) -> None:
         cntl.span.request_size = len(msg.payload) \
             + len(cntl.request_attachment)
 
+    # deadline plane: anchor TLV 13's remaining budget at the message's
+    # PARSE time (fiber-pool queueing between cut and this dispatch
+    # counts against it), then shed doomed work — a request whose caller
+    # already gave up must not burn auth/parse/handler time.  An
+    # explicit on-wire 0 (clients stamp ≥ 1) means expired-at-arrival.
+    if meta.timeout_ms or getattr(meta, "timeout_present", False):
+        _arm_deadline(cntl, meta.timeout_ms,
+                      getattr(msg, "recv_us", 0) or None)
+        if maybe_shed(cntl, "tpu_std", entry.status.full_name):
+            cntl.finish(None)
+            return
+
     # auth on first message of the connection (≈ Protocol::verify)
     auth = server.options.auth
     if auth is not None and sock.app_data is None:
@@ -288,7 +302,8 @@ def process_rpc_request(msg: RpcMessage, sock: Socket, server) -> None:
         cntl.finish(resp)
         return
     try:
-        response = entry.fn(cntl, request)
+        with inherit_deadline(cntl):
+            response = entry.fn(cntl, request)
     except Exception as e:
         LOG.exception("method %s raised", entry.status.full_name)
         cntl.set_failed(Errno.EINTERNAL, f"{type(e).__name__}: {e}")

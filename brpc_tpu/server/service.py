@@ -52,11 +52,19 @@ def raw_method(fn: Callable = None, *, native: str = None) -> Callable:
     invoked with the same (payload, attachment) shape.
 
     Deadline contract: the request's remaining-deadline TLV is accepted
-    but NOT enforced on the slim path — the handler runs immediately
-    after frame parse (no queueing between the two), so an arrival-time
-    deadline cannot have expired, and raw handlers receive no context
-    object to propagate it further.  Handlers needing deadline
-    propagation belong on the full @method path.
+    but NOT enforced on the raw path — raw handlers receive no
+    controller to answer ``ERPCTIMEDOUT`` through or to propagate the
+    budget further.  Note that "cannot have expired at arrival" is NOT
+    true on this lane: burst-batched native dispatch demonstrably
+    queues frames between parse and handler (rpcz ``backdate_span``
+    pins non-zero native queueing), so a raw method under deadline
+    pressure silently does doomed work.  Handlers needing deadline
+    semantics belong on the full ``@method`` path, where EVERY dispatch
+    route — classic tpu_std, the slim kind-3/kind-4 native shims, HTTP
+    and gRPC/h2 — sheds queue-expired requests before user code runs
+    (anchored at the engine's parse timestamp on the native lanes) and
+    exposes ``cntl.deadline_remaining_ms()`` / ``cntl.deadline_expired``
+    (see brpc_tpu.deadline; ≈ brpc ``-server_fail_fast``).
 
     ``native=``: name a C++ built-in semantic and the native engine
     answers the method entirely GIL-free — zero Python per request, the

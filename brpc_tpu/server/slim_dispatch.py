@@ -6,12 +6,17 @@ The reference runs the ENTIRE request path to the user callback in C++
 the C++ engine scans the meta TLV, batches every eligible unary request
 of a read burst, and enters Python ONCE calling the shim built below as
 ``handler(payload: bytes, att: bytes | None, cid: int, conn_id: int,
-dom, nonce, recv_ns: int, trace)`` — ``recv_ns`` is the engine's
-CLOCK_MONOTONIC frame-parse timestamp, used to backdate rpcz spans so
-they cover native queueing; ``trace`` is None or the request's
+dom, nonce, recv_ns: int, trace, timeout_ms)`` — ``recv_ns`` is the
+engine's CLOCK_MONOTONIC frame-parse timestamp, used to backdate rpcz
+spans so they cover native queueing; ``trace`` is None or the request's
 ``(trace_id, span_id, parent_id)`` meta TLVs, so explicitly traced
 requests STAY on the slim lane instead of changing the very path being
-observed.  The shim is the whole per-call Python cost of the lane:
+observed; ``timeout_ms`` is TLV 13's propagated remaining budget
+(None = no deadline on the wire; an explicit 0 means expired at
+arrival) — anchored at ``recv_ns``, the shim SHEDS requests whose
+budget expired while they sat in the native batch (deadline plane:
+the handler never runs; the client gets ``ERPCTIMEDOUT``).  The shim is
+the whole per-call Python cost of the lane:
 
     admission   server.on_request_in + MethodStatus.on_requested (the
                 concurrency-limiter path — NOT dropped; ELIMIT answers
@@ -53,6 +58,8 @@ from time import monotonic_ns as _mono_ns
 from ..butil.iobuf import IOBuf
 from ..butil.logging_util import LOG
 from ..butil.status import Errno
+from ..deadline import arm as arm_deadline
+from ..deadline import inherit_deadline, maybe_shed
 from ..protocol.meta import RpcMeta
 from ..protocol.tpu_std import parse_payload
 from ..rpcz import backdate_span, start_server_span
@@ -81,11 +88,12 @@ def make_slim_handler(bridge, server, entry, svc: str, mth: str):
         _send_response(_server, _entry, cntl, response)
 
     def slim(payload, att, cid, conn_id, dom, nonce, recv_ns,
-             trace=None,
+             trace=None, tmo=None,
              _server=server, _status=status, _fn=fn, _rt=req_type,
              _svc=svc, _mth=mth, _send=_send, _socks=socks,
              _ns=_mono_ns, _sample=start_server_span,
-             _backdate=backdate_span):
+             _backdate=backdate_span, _shed=maybe_shed,
+             _inherit=inherit_deadline, _arm=arm_deadline):
         sock = _socks.get(conn_id)
         if sock is None:
             return None          # connection died mid-burst: drop, like
@@ -119,11 +127,21 @@ def make_slim_handler(bridge, server, entry, svc: str, mth: str):
             # below is FORCED (never sampled out) and parents to the
             # caller's span id, exactly like the classic path
             meta.trace_id, meta.span_id, meta.parent_span_id = trace
+        if tmo is not None:
+            # None = TLV 13 absent; an explicit on-wire 0 means
+            # expired-at-arrival (real clients stamp >= 1)
+            meta.timeout_ms = tmo
         na = len(att) if att is not None else 0
         if na:
             meta.attachment_size = na
         cntl = ServerController(meta, sock.remote_side, sock.id, _send)
         cntl.server = _server
+        if tmo is not None:
+            # deadline anchored at the ENGINE's frame-parse time, not
+            # shim entry: native batching queueing counts against the
+            # propagated budget (that queueing is exactly where a
+            # deadline dies on a saturated server)
+            _arm(cntl, tmo, recv_ns // 1000)
         if na:
             ab = IOBuf()
             ab.append_user_data(att)
@@ -135,6 +153,12 @@ def make_slim_handler(bridge, server, entry, svc: str, mth: str):
             # entry: native read/parse/batch queueing is real latency
             _backdate(span, recv_ns)
             cntl.span = span
+        if tmo is not None and _shed(cntl, "slim", _status.full_name):
+            # doomed work: the budget expired while this frame sat in
+            # the native batch — answer ERPCTIMEDOUT via the classic
+            # completion (accounting + span finish), never run user code
+            cntl.finish(None)
+            return None
         try:
             request = parse_payload(payload, _rt)
         except Exception as e:
@@ -142,7 +166,8 @@ def make_slim_handler(bridge, server, entry, svc: str, mth: str):
             cntl.finish(None)
             return None
         try:
-            response = _fn(cntl, request)
+            with _inherit(cntl):
+                response = _fn(cntl, request)
         except Exception as e:
             LOG.exception("method %s raised", _status.full_name)
             cntl.set_failed(Errno.EINTERNAL, f"{type(e).__name__}: {e}")

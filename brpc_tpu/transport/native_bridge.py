@@ -571,7 +571,8 @@ class NativeBridge:
     @staticmethod
     def _scan_request_meta(data):
         """Minimal TLV walk for the raw lane: (cid, service, method,
-        att_size, timeout_ms, ici_domain, ici_conn) — or None when the
+        att_size, timeout_ms, ici_domain, ici_conn, timeout_present) —
+        or None when the
         meta carries any controller-tier tag (compress=2, error=6/7,
         auth=8, trace=9, span=10/11 — raw handlers have no span
         machinery, so traced requests take the full path; the NATIVE
@@ -584,6 +585,7 @@ class NativeBridge:
         cid = 0
         svc = mth = None
         att = tmo = 0
+        tmo_seen = False
         dom = nonce = b""
         off, end = 0, len(data)
         try:
@@ -603,6 +605,7 @@ class NativeBridge:
                     (att,) = _struct_unpack_from("<I", data, off)
                 elif tag == 13:
                     (tmo,) = _struct_unpack_from("<I", data, off)
+                    tmo_seen = True
                 elif tag == 15:
                     dom = _bytes(data[off:off + ln])
                 elif tag == 17:
@@ -614,7 +617,7 @@ class NativeBridge:
             return None
         if svc is None or mth is None:
             return None
-        return cid, svc, mth, att, tmo, dom, nonce
+        return cid, svc, mth, att, tmo, dom, nonce, tmo_seen
 
     def _on_message(self, conn_id: int, buf, meta_size: int) -> None:
         sock = self._sock(conn_id)
@@ -643,7 +646,7 @@ class NativeBridge:
             meta = RpcMeta()
             (meta.correlation_id, meta.service_name, meta.method_name,
              meta.attachment_size, meta.timeout_ms, meta.ici_domain,
-             meta.ici_conn) = scan
+             meta.ici_conn, meta.timeout_present) = scan
         else:
             meta = RpcMeta.decode(bytes(mv[:meta_size]))
         if meta is None:

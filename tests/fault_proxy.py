@@ -38,6 +38,9 @@ class FaultyTransport:
         self.reorder_window = 0
         self.forwarded_bytes = 0
         self.connections = 0
+        # client→server TRPC frame starts forwarded — the attempt
+        # counter retry-storm tests pin amplification against
+        self.request_frames = 0
         self._lock = threading.Lock()
         self._conns: List[socket.socket] = []
         self._thr = threading.Thread(target=self._accept_loop, daemon=True)
@@ -88,12 +91,14 @@ class FaultyTransport:
             with self._lock:
                 self._conns += [cli, srv]
             state = {"fwd": 0}
-            threading.Thread(target=self._pump, args=(cli, srv, state),
+            threading.Thread(target=self._pump,
+                             args=(cli, srv, state, True),
                              daemon=True).start()
             threading.Thread(target=self._pump, args=(srv, cli, state),
                              daemon=True).start()
 
-    def _pump(self, src: socket.socket, dst: socket.socket, state) -> None:
+    def _pump(self, src: socket.socket, dst: socket.socket, state,
+              inbound: bool = False) -> None:
         held: List[bytes] = []
         try:
             while not self._stop:
@@ -103,6 +108,10 @@ class FaultyTransport:
                     break
                 if not data:
                     break
+                if inbound:
+                    # count request-frame starts even when the fault
+                    # then eats them: an attempt is an attempt
+                    self.request_frames += data.count(b"TRPC")
                 if self.partition:
                     continue                      # blackhole
                 if self.delay_s > 0:

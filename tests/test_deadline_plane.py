@@ -1,0 +1,652 @@
+"""Deadline plane — end-to-end enforcement, shedding, inheritance and
+retry budgets (ISSUE 5 acceptance matrix).
+
+The shed matrix mirrors test_trace_propagation's shape: a request that
+arrives with an already-expired propagated deadline is answered
+``ERPCTIMEDOUT`` WITHOUT the handler running, on all five server
+dispatch paths — classic tpu_std full dispatch, the slim kind-3 native
+lane, classic HTTP/1.1, the kind-4 slim HTTP lane, and gRPC over h2 —
+with per-(lane, method) ``deadline_shed_total`` counters recording each
+shed.  Untraced no-deadline traffic (and deadline'd traffic whose
+budget is alive) must keep riding the slim lanes with zero new
+fallbacks.
+"""
+
+import socket as pysock
+import struct
+import threading
+import time
+
+import pytest
+
+from brpc_tpu.butil.flags import get_flag, set_flag
+from brpc_tpu.butil.status import Errno
+from brpc_tpu.client import Channel, ChannelOptions, Controller
+from brpc_tpu.deadline import (RetryBudget, backoff_ms, shed_counters)
+from brpc_tpu.protocol.meta import (RpcMeta, TLV_CORRELATION, TLV_TIMEOUT,
+                                    encode_tlv)
+from brpc_tpu.server import Server, ServerOptions, Service
+
+from conftest import require_native  # noqa: E402
+
+TIMEDOUT = int(Errno.ERPCTIMEDOUT)
+
+
+class DeadlineSvc(Service):
+    def __init__(self):
+        self.echo_calls = []          # payloads the handler actually saw
+        self.seen_remaining = []      # cntl.deadline_remaining_ms() values
+
+    def Echo(self, cntl, request):
+        self.echo_calls.append(bytes(request))
+        self.seen_remaining.append(cntl.deadline_remaining_ms())
+        return b"ok:" + bytes(request)
+
+    def Sleep(self, cntl, request):
+        time.sleep(0.2)
+        return b"slept"
+
+
+def _server(native: bool, inline: bool = True):
+    opts = ServerOptions()
+    if native:
+        opts.native = True
+        opts.usercode_inline = inline
+        opts.native_loops = 1
+    svc = DeadlineSvc()
+    srv = Server(opts)
+    srv.add_service(svc, name="D")
+    assert srv.start("127.0.0.1:0") == 0
+    return srv, svc
+
+
+def _frame(cid: int, mth: bytes, payload: bytes,
+           timeout_ms=None) -> bytes:
+    mb = TLV_CORRELATION + struct.pack("<Q", cid)
+    mb += encode_tlv(4, b"D") + encode_tlv(5, mth)
+    if timeout_ms is not None:
+        mb += TLV_TIMEOUT + struct.pack("<I", timeout_ms)
+    body = mb + payload
+    return b"TRPC" + struct.pack("<II", len(body), len(mb)) + body
+
+
+def _read_frames(c: pysock.socket, n: int, timeout=10.0):
+    """Read n complete TRPC frames; returns {cid: RpcMeta}."""
+    c.settimeout(timeout)
+    buf = b""
+    out = {}
+    while len(out) < n:
+        while True:
+            if len(buf) >= 12:
+                (blen,) = struct.unpack_from("<I", buf, 4)
+                if len(buf) >= 12 + blen:
+                    break
+            buf += c.recv(65536)
+        (blen,) = struct.unpack_from("<I", buf, 4)
+        (mlen,) = struct.unpack_from("<I", buf, 8)
+        meta = RpcMeta.decode(buf[12:12 + mlen])
+        assert meta is not None
+        out[meta.correlation_id] = meta
+        buf = buf[12 + blen:]
+    return out
+
+
+def _shed_delta(before, lane, method):
+    after = shed_counters()
+    return after.get((lane, method), 0) - before.get((lane, method), 0)
+
+
+# ---------------------------------------------------------------------------
+# the five-lane shed matrix
+# ---------------------------------------------------------------------------
+
+def test_shed_classic_tpu_std():
+    """rpc_dispatch: an explicit on-wire remaining-deadline of 0
+    (expired at arrival; real clients stamp >= 1) is answered
+    ERPCTIMEDOUT before auth/parse/handler."""
+    srv, svc = _server(native=False)
+    try:
+        before = shed_counters()
+        with pysock.create_connection(
+                (str(srv.listen_endpoint.host), srv.listen_endpoint.port),
+                timeout=10) as c:
+            c.sendall(_frame(11, b"Echo", b"doomed", timeout_ms=0))
+            metas = _read_frames(c, 1)
+        assert metas[11].error_code == TIMEDOUT
+        assert svc.echo_calls == []
+        assert _shed_delta(before, "tpu_std", "D.Echo") == 1
+    finally:
+        srv.stop()
+
+
+def test_shed_slim_kind3_native_queueing():
+    """Slim kind-3: a pipelined burst whose first request chews the
+    whole batch (inline Sleep) makes the second one's budget expire IN
+    THE NATIVE BATCH — the shim sheds against the engine's
+    CLOCK_MONOTONIC parse timestamp, handler never runs."""
+    require_native()
+    srv, svc = _server(native=True, inline=True)
+    try:
+        before = shed_counters()
+        ep = srv.listen_endpoint
+        with pysock.create_connection((str(ep.host), ep.port),
+                                      timeout=10) as c:
+            # ONE write → one read burst → one batched GIL entry:
+            # Sleep(200ms) runs first, Echo's 50ms budget dies in queue
+            c.sendall(_frame(21, b"Sleep", b"")
+                      + _frame(22, b"Echo", b"doomed", timeout_ms=50))
+            metas = _read_frames(c, 2)
+        assert metas[21].error_code == 0
+        assert metas[22].error_code == TIMEDOUT
+        assert svc.echo_calls == []
+        assert _shed_delta(before, "slim", "D.Echo") == 1
+    finally:
+        srv.stop()
+
+
+def test_shed_slim_kind3_explicit_zero():
+    """Slim kind-3, the crafted expired-at-arrival case: an explicit
+    on-wire TLV 13 of 0 (real clients stamp >= 1) must shed on the
+    slim lane too — the engine's timeout_present bit tells a present 0
+    apart from an absent deadline (None reaches the shim)."""
+    require_native()
+    srv, svc = _server(native=True, inline=True)
+    try:
+        before = shed_counters()
+        ep = srv.listen_endpoint
+        with pysock.create_connection((str(ep.host), ep.port),
+                                      timeout=10) as c:
+            c.sendall(_frame(25, b"Echo", b"doomed", timeout_ms=0))
+            metas = _read_frames(c, 1)
+        assert metas[25].error_code == TIMEDOUT
+        assert svc.echo_calls == []
+        assert _shed_delta(before, "slim", "D.Echo") == 1
+    finally:
+        srv.stop()
+
+
+def test_shed_bridge_slim_meta_fallback():
+    """An over-cap attachment (> kSlimAttCap, 16KB) makes the engine
+    decline the kind-3 lane (rpc_att_over_cap) and hand the frame to
+    the Python bridge, whose slim-meta path rebuilds RpcMeta from the
+    raw-lane TLV scan — an explicit on-wire TLV 13 of 0 must still
+    shed there (the scan forwards timeout_present)."""
+    require_native()
+    srv, svc = _server(native=True, inline=True)
+    try:
+        before = shed_counters()
+        att = b"A" * (17 * 1024)
+        mb = TLV_CORRELATION + struct.pack("<Q", 27)
+        mb += encode_tlv(4, b"D") + encode_tlv(5, b"Echo")
+        mb += encode_tlv(3, struct.pack("<I", len(att)))
+        mb += TLV_TIMEOUT + struct.pack("<I", 0)
+        body = mb + b"doomed" + att
+        ep = srv.listen_endpoint
+        with pysock.create_connection((str(ep.host), ep.port),
+                                      timeout=10) as c:
+            c.sendall(b"TRPC" + struct.pack("<II", len(body), len(mb))
+                      + body)
+            metas = _read_frames(c, 1)
+        assert metas[27].error_code == TIMEDOUT
+        assert svc.echo_calls == []
+        assert _shed_delta(before, "tpu_std", "D.Echo") == 1
+    finally:
+        srv.stop()
+
+
+def _http_exchange(ep, request: bytes) -> tuple:
+    """One HTTP/1.1 exchange; returns (status, headers dict, body)."""
+    with pysock.create_connection((str(ep.host), ep.port), timeout=10) as c:
+        c.sendall(request)
+        c.settimeout(10)
+        buf = b""
+        while b"\r\n\r\n" not in buf:
+            buf += c.recv(65536)
+        head, _, rest = buf.partition(b"\r\n\r\n")
+        lines = head.decode("latin1").split("\r\n")
+        status = int(lines[0].split()[1])
+        headers = {}
+        for ln in lines[1:]:
+            k, _, v = ln.partition(":")
+            headers[k.strip().lower()] = v.strip()
+        clen = int(headers.get("content-length", "0"))
+        while len(rest) < clen:
+            rest += c.recv(65536)
+        return status, headers, rest[:clen]
+
+
+def _http_req(mth: bytes, path: bytes, body: bytes, deadline_ms,
+              close=False) -> bytes:
+    h = [mth + b" " + path + b" HTTP/1.1",
+         b"Host: x",
+         b"Content-Length: " + str(len(body)).encode()]
+    if deadline_ms is not None:
+        h.append(b"x-deadline-ms: " + str(deadline_ms).encode())
+    if close:
+        h.append(b"Connection: close")
+    return b"\r\n".join(h) + b"\r\n\r\n" + body
+
+
+def test_shed_http_classic():
+    """Classic HTTP/1.1 bridge: x-deadline-ms: 0 → 500 with
+    x-rpc-error-code ERPCTIMEDOUT, handler never runs."""
+    srv, svc = _server(native=False)
+    try:
+        before = shed_counters()
+        status, headers, body = _http_exchange(
+            srv.listen_endpoint,
+            _http_req(b"POST", b"/D/Echo", b"doomed", 0, close=True))
+        assert status == 500
+        assert headers.get("x-rpc-error-code") == str(TIMEDOUT)
+        assert svc.echo_calls == []
+        assert _shed_delta(before, "http", "D.Echo") == 1
+    finally:
+        srv.stop()
+
+
+def test_shed_http_slim_kind4():
+    """Kind-4 slim HTTP lane: the engine captures x-deadline-ms, the
+    shim sheds against the engine parse timestamp, and the 500 is
+    serialized natively with the burst."""
+    require_native()
+    srv, svc = _server(native=True, inline=True)
+    try:
+        before = shed_counters()
+        status, headers, body = _http_exchange(
+            srv.listen_endpoint,
+            _http_req(b"POST", b"/D/Echo", b"doomed", 0))
+        assert status == 500
+        assert headers.get("x-rpc-error-code") == str(TIMEDOUT)
+        assert svc.echo_calls == []
+        assert _shed_delta(before, "http_slim", "D.Echo") == 1
+    finally:
+        srv.stop()
+
+
+def test_shed_grpc_h2():
+    """gRPC/h2: grpc-timeout: 0m → DEADLINE_EXCEEDED (grpc-status 4)
+    trailers, handler never runs."""
+    from brpc_tpu.protocol.h2_rpc import pack_grpc_message
+    from brpc_tpu.protocol.h2_session import H2Session
+
+    srv, svc = _server(native=False)
+    try:
+        before = shed_counters()
+        sess = H2Session(is_server=False)
+        sess.start()
+        sid = sess.next_stream_id()
+        sess.send_headers(sid, [
+            (":method", "POST"), (":path", "/D/Echo"),
+            (":scheme", "http"), (":authority", "t"),
+            ("content-type", "application/grpc"), ("te", "trailers"),
+            ("grpc-timeout", "0m")])
+        sess.send_data(sid, pack_grpc_message(b"doomed"),
+                       end_stream=True)
+        ep = srv.listen_endpoint
+        grpc_status = None
+        with pysock.create_connection((str(ep.host), ep.port),
+                                      timeout=10) as c:
+            c.sendall(sess.take_output())
+            c.settimeout(10)
+            deadline = time.time() + 10
+            while grpc_status is None and time.time() < deadline:
+                data = c.recv(65536)
+                if not data:
+                    break
+                for ev in sess.feed(data):
+                    if ev[0] == "headers":
+                        for k, v in ev[2]:
+                            if k == "grpc-status":
+                                grpc_status = v
+                out = sess.take_output()
+                if out:
+                    c.sendall(out)      # settings acks etc.
+        assert grpc_status == "4"       # DEADLINE_EXCEEDED
+        assert svc.echo_calls == []
+        assert _shed_delta(before, "grpc", "D.Echo") == 1
+    finally:
+        srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# pinned: deadline'd / no-deadline requests still ride the slim lanes
+# ---------------------------------------------------------------------------
+
+def test_no_new_fallbacks_on_slim_lanes():
+    require_native()
+    srv, svc = _server(native=True, inline=True)
+    try:
+        eng = srv._native_bridge.engine
+        t0 = eng.telemetry()
+        ep = srv.listen_endpoint
+        with pysock.create_connection((str(ep.host), ep.port),
+                                      timeout=10) as c:
+            # no deadline, then a live 5s deadline — both must ride slim
+            c.sendall(_frame(31, b"Echo", b"plain"))
+            _read_frames(c, 1)
+            c.sendall(_frame(32, b"Echo", b"budgeted", timeout_ms=5000))
+            metas = _read_frames(c, 1)
+        assert metas[32].error_code == 0
+        # the deadline'd handler saw its remaining budget
+        assert svc.seen_remaining[-1] is not None
+        assert 0 < svc.seen_remaining[-1] <= 5000
+        # kind-4 with a live budget stays slim too
+        status, headers, body = _http_exchange(
+            ep, _http_req(b"POST", b"/D/Echo", b"h", 5000))
+        assert status == 200 and body == b"ok:h"
+        t1 = eng.telemetry()
+        assert sum(t1["fallbacks"].values()) == \
+            sum(t0["fallbacks"].values()), t1["fallbacks"]
+        assert t1["lanes"]["slim"]["handled"] \
+            >= t0["lanes"]["slim"]["handled"] + 2
+        assert t1["lanes"]["http"]["handled"] \
+            >= t0["lanes"]["http"]["handled"] + 1
+    finally:
+        srv.stop()
+
+
+def test_shed_togglable_via_flag():
+    """enable_deadline_shed=False lets an expired request through to
+    the handler (the bench's goodput A/B switch)."""
+    srv, svc = _server(native=False)
+    try:
+        prev = get_flag("enable_deadline_shed", True)
+        set_flag("enable_deadline_shed", False)
+        try:
+            with pysock.create_connection(
+                    (str(srv.listen_endpoint.host),
+                     srv.listen_endpoint.port), timeout=10) as c:
+                c.sendall(_frame(41, b"Echo", b"letin", timeout_ms=0))
+                metas = _read_frames(c, 1)
+            assert metas[41].error_code == 0
+            assert svc.echo_calls == [b"letin"]
+        finally:
+            set_flag("enable_deadline_shed", prev)
+    finally:
+        srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# controller API + ambient inheritance
+# ---------------------------------------------------------------------------
+
+def test_server_controller_deadline_api():
+    srv, svc = _server(native=False)
+    try:
+        co = ChannelOptions()
+        co.connection_type = "pooled"
+        ch = Channel(co)
+        ch.init(str(srv.listen_endpoint))
+        cntl = Controller()
+        cntl.timeout_ms = 3000
+        ch.call_method("D.Echo", b"x", cntl=cntl)
+        assert not cntl.failed, cntl.error_text
+        rem = svc.seen_remaining[-1]
+        assert rem is not None and 0 < rem <= 3000
+    finally:
+        srv.stop()
+
+
+def test_downstream_call_inherits_remaining_budget():
+    """A handler's downstream RPC defaults its timeout to the inherited
+    remaining budget; the downstream server sees a propagated deadline
+    strictly under the upstream timeout."""
+    down_srv, down_svc = _server(native=False)
+
+    class Front(Service):
+        def Relay(self, cntl, request):
+            time.sleep(0.05)         # burn some budget first
+            co = ChannelOptions()
+            co.connection_type = "pooled"
+            # NOTE: no timeout set anywhere — inheritance must supply it
+            co.timeout_ms = 0
+            ch = Channel(co)
+            ch.init(str(down_srv.listen_endpoint))
+            sub = Controller()
+            ch.call_method("D.Echo", b"inner", cntl=sub)
+            assert not sub.failed, sub.error_text
+            return b"relayed"
+
+    front = Server()
+    front.add_service(Front(), name="F")
+    assert front.start("127.0.0.1:0") == 0
+    try:
+        ch = Channel()
+        cntl = Controller()
+        cntl.timeout_ms = 2000
+        ch.init(str(front.listen_endpoint))
+        ch.call_method("F.Relay", b"", cntl=cntl)
+        assert not cntl.failed, cntl.error_text
+        rem = down_svc.seen_remaining[-1]
+        assert rem is not None
+        # inherited minus elapsed: visibly less than the original 2000
+        assert 0 < rem <= 1980
+    finally:
+        front.stop()
+        down_srv.stop()
+
+
+def test_downstream_call_fails_fast_after_budget_gone():
+    """Once the handler outlives its budget, downstream calls fail
+    ERPCTIMEDOUT WITHOUT dispatching (the downstream handler never
+    runs)."""
+    down_srv, down_svc = _server(native=False)
+    observed = {}
+
+    class Front(Service):
+        def Relay(self, cntl, request):
+            time.sleep(0.3)          # overshoot the 150ms budget
+            ch = Channel()
+            ch.init(str(down_srv.listen_endpoint))
+            sub = Controller()
+            ch.call_method("D.Echo", b"doomed-inner", cntl=sub)
+            observed["code"] = sub.error_code
+            return b"late"
+
+    front = Server()
+    front.add_service(Front(), name="F")
+    assert front.start("127.0.0.1:0") == 0
+    try:
+        ch = Channel()
+        cntl = Controller()
+        cntl.timeout_ms = 150
+        ch.init(str(front.listen_endpoint))
+        ch.call_method("F.Relay", b"", cntl=cntl)
+        assert cntl.failed          # the upstream call itself timed out
+        deadline = time.time() + 5
+        while "code" not in observed and time.time() < deadline:
+            time.sleep(0.01)
+        assert observed.get("code") == TIMEDOUT
+        assert b"doomed-inner" not in down_svc.echo_calls
+    finally:
+        front.stop()
+        down_srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# fan-out budget sharing (satellite: parallel_channel regression)
+# ---------------------------------------------------------------------------
+
+def test_selective_channel_legs_share_one_budget():
+    """SelectiveChannel: a slow failing first leg leaves the second leg
+    only the REMAINING budget, not a fresh copy of the timeout."""
+    from brpc_tpu.client.parallel_channel import SelectiveChannel
+
+    class SlowFail(Service):
+        def Echo(self, cntl, request):
+            time.sleep(0.15)
+            cntl.set_failed(Errno.EINTERNAL, "leg down")
+            return None
+
+    s1 = Server()
+    s1.add_service(SlowFail(), name="D")
+    assert s1.start("127.0.0.1:0") == 0
+    s2, svc2 = _server(native=False)
+    try:
+        ch1, ch2 = Channel(), Channel()
+        ch1.init(str(s1.listen_endpoint))
+        ch2.init(str(s2.listen_endpoint))
+        sel = SelectiveChannel()
+        sel.add_channel(ch1)
+        sel.add_channel(ch2)
+        cntl = Controller()
+        cntl.timeout_ms = 600
+        sel.call_method("D.Echo", b"x", cntl=cntl)
+        assert not cntl.failed, cntl.error_text
+        rem = svc2.seen_remaining[-1]
+        assert rem is not None
+        # leg 2's budget must reflect the ~150ms leg 1 burned
+        assert rem <= 470, rem
+    finally:
+        s1.stop()
+        s2.stop()
+
+
+def test_leg_budget_math():
+    from brpc_tpu.butil.time_utils import monotonic_us
+    from brpc_tpu.client.parallel_channel import _leg_budget_ms
+    now = monotonic_us()
+    assert _leg_budget_ms(now, None) is None
+    assert _leg_budget_ms(now, 0) == 0
+    left = _leg_budget_ms(now - 100_000, 500)    # 100ms elapsed
+    assert 390 <= left <= 401
+    assert _leg_budget_ms(now - 700_000, 500) <= 0
+
+
+def test_parallel_channel_scatter_legs_capped():
+    """ParallelChannel sync fan-out: every leg's propagated budget is
+    the fan-out's remaining budget (observed by the sub-servers)."""
+    from brpc_tpu.client.parallel_channel import ParallelChannel
+
+    s1, svc1 = _server(native=False)
+    s2, svc2 = _server(native=False)
+    try:
+        pc = ParallelChannel()
+        for s in (s1, s2):
+            co = ChannelOptions()
+            co.connection_type = "pooled"
+            ch = Channel(co)
+            ch.init(str(s.listen_endpoint))
+            pc.add_channel(ch)
+        cntl = Controller()
+        cntl.timeout_ms = 800
+        pc.call_method("D.Echo", b"fan", cntl=cntl)
+        assert not cntl.failed, cntl.error_text
+        for svc in (svc1, svc2):
+            rem = svc.seen_remaining[-1]
+            assert rem is not None and 0 < rem <= 800
+    finally:
+        s1.stop()
+        s2.stop()
+
+
+# ---------------------------------------------------------------------------
+# retry hardening
+# ---------------------------------------------------------------------------
+
+def test_retry_budget_token_bucket():
+    b = RetryBudget(max_tokens=4, token_ratio=0.5)
+    assert b.acquire() and b.acquire()       # 4 → 3 → 2
+    assert not b.acquire()                   # 2 > 2 is false: denied
+    assert b.denied_count == 1
+    b.on_success()                           # 2 → 2.5
+    assert b.acquire()
+    assert not b.acquire()
+    # refills cap at max_tokens
+    for _ in range(100):
+        b.on_success()
+    assert b.tokens == 4.0
+
+
+def test_backoff_exponential_with_jitter():
+    assert backoff_ms(0, 3) == 0.0
+    d1 = [backoff_ms(50, 1) for _ in range(50)]
+    d3 = [backoff_ms(50, 3) for _ in range(50)]
+    assert all(40.0 <= d <= 60.0 for d in d1)        # 50 ± 20%
+    assert all(160.0 <= d <= 240.0 for d in d3)      # 200 ± 20%
+    # the cap is a hard bound — jitter never pierces it
+    assert all(backoff_ms(1000, 10, max_ms=3000) <= 3000
+               for _ in range(50))
+    assert len(set(d1)) > 1                          # jitter present
+
+
+def test_channel_retry_budget_caps_attempts():
+    """Against a dead backend, retries across calls are capped by the
+    channel budget (and further calls don't retry at all)."""
+    co = ChannelOptions()
+    co.timeout_ms = 2000
+    co.max_retry = 3
+    co.retry_budget_max = 4
+    ch = Channel(co)
+    assert ch.init("127.0.0.1:1") == 0      # nothing listens here
+    total_retries = 0
+    for _ in range(6):
+        cntl = Controller()
+        cntl.timeout_ms = 2000
+        c = ch.call_method("D.Echo", b"x", cntl=cntl)
+        assert c.failed
+        total_retries += c.retried_count
+    # 4 tokens → exactly 2 retries ever granted, then the budget gates
+    assert total_retries == 2, total_retries
+    assert ch.retry_budget().denied_count > 0
+
+
+def test_backup_request_draws_from_budget():
+    """Backup (hedged) requests spend the same tokens as retries: with
+    the budget exhausted, no backup goes out."""
+
+    class Slow(Service):
+        def __init__(self):
+            self.calls = 0
+
+        def Nap(self, cntl, request):
+            self.calls += 1
+            time.sleep(0.3)
+            return b"ok"
+
+    svc = Slow()
+    srv = Server()
+    srv.add_service(svc, name="SL")
+    assert srv.start("127.0.0.1:0") == 0
+    try:
+        co = ChannelOptions()
+        co.timeout_ms = 2000
+        co.backup_request_ms = 50
+        co.connection_type = "single"
+        co.retry_budget_max = 4
+        ch = Channel(co)
+        ch.init(str(srv.listen_endpoint))
+        # drain the budget to the deny line
+        budget = ch.retry_budget()
+        while budget.acquire():
+            pass
+        cntl = Controller()
+        cntl.timeout_ms = 2000
+        ch.call_method("SL.Nap", b"", cntl=cntl)
+        assert not cntl.failed, cntl.error_text
+        assert not cntl.has_backup_request       # budget said no
+        time.sleep(0.1)
+        assert svc.calls == 1
+    finally:
+        srv.stop()
+
+
+def test_backoff_spaces_retries():
+    """retry_backoff_ms spreads the retry chain out in time (timer-
+    thread scheduled, exponential)."""
+    co = ChannelOptions()
+    co.timeout_ms = 5000
+    co.max_retry = 2
+    co.retry_backoff_ms = 80
+    co.connection_type = "single"
+    ch = Channel(co)
+    assert ch.init("127.0.0.1:1") == 0
+    cntl = Controller()
+    cntl.timeout_ms = 5000
+    t0 = time.monotonic()
+    c = ch.call_method("D.Echo", b"x", cntl=cntl)
+    elapsed = time.monotonic() - t0
+    assert c.failed
+    assert c.retried_count == 2
+    # backoff 80ms + 160ms (±20% jitter) must be visible in wall time
+    assert elapsed >= 0.18, elapsed

@@ -430,3 +430,199 @@ def test_corrupted_tici_ack_fails_or_recovers_never_corrupts(raw_proxy):
             np.asarray(c.response_device_attachment.tensor()),
             np.asarray(x))
     raw_proxy.heal()
+
+
+# -- deadline plane under injected faults -----------------------------------
+
+def test_deadline_expiry_sheds_server_side_under_delay():
+    """Through a delay-injecting proxy, a pipelined burst whose first
+    request chews the native batch makes the second one's propagated
+    budget expire IN QUEUE: the server answers ERPCTIMEDOUT without
+    running the handler (deadline plane; ≈ brpc -server_fail_fast)."""
+    import socket as pysock
+    import struct
+
+    from brpc_tpu.deadline import shed_counters
+    from brpc_tpu.protocol.meta import RpcMeta, TLV_CORRELATION, \
+        TLV_TIMEOUT, encode_tlv
+    from brpc_tpu.server import ServerOptions
+    from conftest import require_native
+    require_native()
+
+    class SlowEcho(Service):
+        def __init__(self):
+            self.echo_calls = 0
+
+        def Echo(self, cntl, request):
+            self.echo_calls += 1
+            return bytes(request)
+
+        def Slow(self, cntl, request):
+            time.sleep(0.25)
+            return b"slow"
+
+    opts = ServerOptions()
+    opts.native = True
+    opts.usercode_inline = True
+    opts.native_loops = 1
+    svc = SlowEcho()
+    srv = Server(opts)
+    srv.add_service(svc, name="DL")
+    assert srv.start("127.0.0.1:0") == 0
+    ep = srv.listen_endpoint
+    p = FaultyTransport(ep.host, ep.port)
+    try:
+        p.delay_s = 0.02
+
+        def frame(cid, mth, payload, tmo=None):
+            mb = TLV_CORRELATION + struct.pack("<Q", cid)
+            mb += encode_tlv(4, b"DL") + encode_tlv(5, mth)
+            if tmo is not None:
+                mb += TLV_TIMEOUT + struct.pack("<I", tmo)
+            body = mb + payload
+            return b"TRPC" + struct.pack("<II", len(body), len(mb)) + body
+
+        before = shed_counters().get(("slim", "DL.Echo"), 0)
+        with pysock.create_connection(("127.0.0.1", p.port),
+                                      timeout=10) as c:
+            c.sendall(frame(1, b"Slow", b"") +
+                      frame(2, b"Echo", b"doomed", tmo=60))
+            c.settimeout(10)
+            buf = b""
+            metas = {}
+            while len(metas) < 2:
+                while True:
+                    if len(buf) >= 12:
+                        (blen,) = struct.unpack_from("<I", buf, 4)
+                        if len(buf) >= 12 + blen:
+                            break
+                    buf += c.recv(65536)
+                (blen,) = struct.unpack_from("<I", buf, 4)
+                (mlen,) = struct.unpack_from("<I", buf, 8)
+                m = RpcMeta.decode(buf[12:12 + mlen])
+                metas[m.correlation_id] = m
+                buf = buf[12 + blen:]
+        assert metas[1].error_code == 0
+        assert metas[2].error_code == int(Errno.ERPCTIMEDOUT)
+        assert svc.echo_calls == 0          # the handler never ran
+        assert shed_counters().get(("slim", "DL.Echo"), 0) == before + 1
+    finally:
+        p.close()
+        srv.stop()
+
+
+def test_retry_storm_capped_by_budget():
+    """A dead backend behind the proxy: the channel retry budget bounds
+    proxy-observed attempts; an unbudgeted channel storms.  Attempts
+    are counted AT THE PROXY (connections — every failed attempt costs
+    a fresh connect)."""
+    # a port with no listener: the proxy accepts, fails upstream, and
+    # closes — every client attempt is one accepted connection
+    import socket as pysock
+    probe = pysock.socket()
+    probe.bind(("127.0.0.1", 0))
+    dead_port = probe.getsockname()[1]
+    probe.close()
+
+    p = FaultyTransport("127.0.0.1", dead_port)
+    try:
+        def storm(budget_max):
+            co = ChannelOptions()
+            co.timeout_ms = 2000
+            co.max_retry = 3
+            co.connection_type = "pooled"
+            co.retry_budget_max = budget_max
+            ch = Channel(co)
+            assert ch.init(p.address) == 0
+            start = p.connections
+            for _ in range(8):
+                cntl = Controller()
+                cntl.timeout_ms = 2000
+                c = ch.call_method("E.Echo", b"x", cntl=cntl)
+                assert c.failed
+            # the proxy accept loop is async: settle
+            deadline = time.time() + 2.0
+            last = -1
+            while time.time() < deadline:
+                cur = p.connections
+                if cur == last:
+                    break
+                last = cur
+                time.sleep(0.05)
+            return p.connections - start, ch
+
+        capped_attempts, capped_ch = storm(budget_max=4)
+        uncapped_attempts, _ = storm(budget_max=0)
+        # budget 4 → exactly 2 granted retries: 8 originals + 2
+        assert capped_attempts <= 12, capped_attempts
+        assert capped_ch.retry_budget().denied_count > 0
+        # no budget → full 1 + max_retry amplification
+        assert uncapped_attempts >= 24, uncapped_attempts
+        assert uncapped_attempts > capped_attempts * 2
+    finally:
+        p.close()
+
+
+def test_flapping_backend_trips_breaker_from_raw_lane():
+    """The pinned raw lane (call_raw) has no LB in the path, yet its
+    outcomes must feed the GLOBAL circuit breaker when the channel opts
+    in — a flapping backend observed only through raw calls still gets
+    isolated for every cluster channel sharing it."""
+    from brpc_tpu.client.channel import RpcError
+    from brpc_tpu.client.circuit_breaker import global_circuit_breaker_map
+    from brpc_tpu.server.service import raw_method
+
+    class RawSvc(Service):
+        @raw_method
+        def REcho(self, payload, attachment):
+            return bytes(payload), attachment
+
+    m = global_circuit_breaker_map()
+    m.reset()
+    srv = Server()
+    srv.add_service(RawSvc(), name="RW")
+    assert srv.start("127.0.0.1:0") == 0
+    ep = srv.listen_endpoint
+    try:
+        co = ChannelOptions()
+        co.timeout_ms = 1000
+        co.enable_circuit_breaker = True
+        ch = Channel(co)
+        ch.init(str(ep))
+        for _ in range(2):
+            r, _a = ch.call_raw("RW.REcho", b"warm", timeout_ms=1000)
+            assert bytes(r) == b"warm"
+        srv.stop()
+        fails = 0
+        deadline = time.time() + 10
+        while fails < 12 and time.time() < deadline:
+            try:
+                ch.call_raw("RW.REcho", b"down", timeout_ms=300)
+            except RpcError:
+                fails += 1
+        assert fails >= 12
+        assert m.isolated(ep), "raw-lane failures never tripped the breaker"
+        # and an LB consulting the shared map skips the dead node: only
+        # the live server survives candidate filtering
+        from brpc_tpu.client.load_balancer import LoadBalancer
+        from brpc_tpu.client.naming_service import ServerNode
+
+        class _RR(LoadBalancer):
+            def select(self, nodes, cntl):
+                return nodes[0]
+
+        live = Server()
+        live.add_service(RawSvc(), name="RW")
+        assert live.start("127.0.0.1:0") == 0
+        try:
+            lb = _RR()
+            lb.use_circuit_breaker = True
+            lb.reset_servers([ServerNode(endpoint=ep),
+                              ServerNode(endpoint=live.listen_endpoint)])
+            cand = lb.candidates(Controller())
+            assert [n.endpoint for n in cand] == [live.listen_endpoint]
+        finally:
+            live.stop()
+    finally:
+        m.reset()
+        srv.stop()
