@@ -30,7 +30,22 @@ from collections import deque
 _errno_EAGAIN = _errno_mod.EAGAIN
 from typing import Iterable, List, Optional, Tuple, Union
 
+from . import copy_audit as _audit
+
 DEFAULT_BLOCK_SIZE = 8192
+
+# file-backed blocks (shm-ring slots) at/above this size leave a TCP
+# socket via os.sendfile instead of a userspace read of the mapping
+SENDFILE_MIN = 64 * 1024
+
+
+def _is_tls(sock) -> bool:
+    try:
+        import ssl as _ssl
+    except ImportError:             # pragma: no cover
+        return False
+    return isinstance(sock, _ssl.SSLSocket) or isinstance(
+        sock, getattr(_ssl, "SSLObject", ()))
 
 
 class Block:
@@ -39,13 +54,18 @@ class Block:
     ``size`` is the filled prefix; only the filled prefix may be referenced.
     """
 
-    __slots__ = ("data", "size", "capacity", "pool", "__weakref__")
+    __slots__ = ("data", "size", "capacity", "pool", "file_ref",
+                 "__weakref__")
 
-    def __init__(self, data, size: int, pool: Optional["BlockPool"] = None):
+    def __init__(self, data, size: int, pool: Optional["BlockPool"] = None,
+                 file_ref: Optional[Tuple[int, int]] = None):
         self.data = data
         self.size = size
         self.capacity = len(data)
         self.pool = pool
+        # (fd, base_offset): the block aliases a file-backed mapping
+        # (shm-ring slot) — the TCP spill path ships it via os.sendfile
+        self.file_ref = file_ref
 
     @property
     def left_space(self) -> int:
@@ -233,11 +253,27 @@ class IOBuf:
         self._size = 0
 
     def append(self, data: Union[BytesLike, "IOBuf"]) -> None:
-        if isinstance(data, bytes) and len(data) > DEFAULT_BLOCK_SIZE:
-            # large immutable payloads attach zero-copy instead of being
-            # chopped into pool blocks (bytes can never mutate under us)
-            self.append_user_data(data)
-            return
+        if len(data) > DEFAULT_BLOCK_SIZE:
+            if isinstance(data, bytes):
+                # large immutable payloads attach zero-copy instead of
+                # being chopped into pool blocks (bytes never mutate)
+                self.append_user_data(data)
+                return
+            if isinstance(data, memoryview) and data.readonly \
+                    and data.c_contiguous \
+                    and isinstance(data.obj, bytes):
+                # a large view EXPORTED BY bytes is as safe as bytes:
+                # no writer exists anywhere (readonly alone is not
+                # enough — it blocks writes through the view, not
+                # through a bytearray/ndarray owner, and append's
+                # contract is copy semantics).  Response serialization
+                # of sliced bytes payloads was paying a block-by-block
+                # copy here (ISSUE 6 satellite); callers that own a
+                # no-mutate contract for OTHER storage attach it
+                # explicitly via append_user_data.
+                self.append_user_data(
+                    data if data.format == "B" else data.cast("B"))
+                return
         self._append_copy(data)
 
     def _append_copy(self, data: Union[BytesLike, "IOBuf"]) -> None:
@@ -249,6 +285,8 @@ class IOBuf:
         n = len(data)
         if n == 0:
             return
+        if _audit.enabled and n >= _audit.AUDIT_FLOOR:
+            _audit.record("ingest", n)
         mv = memoryview(data) if not isinstance(data, memoryview) else data
         pos = 0
         while pos < n:
@@ -261,14 +299,16 @@ class IOBuf:
             pos += take
         self._size += n
 
-    def append_user_data(self, data) -> None:
+    def append_user_data(self, data, file_ref=None) -> None:
         """Zero-copy attach of an external buffer (≈ append_user_data,
         /root/reference/src/butil/iobuf.h — user block, not pool-owned).
-        The caller must not mutate ``data`` afterwards."""
+        The caller must not mutate ``data`` afterwards.  ``file_ref`` =
+        (fd, base_offset) marks a file-backed mapping (shm-ring slot)
+        eligible for the sendfile spill in :meth:`cut_into_socket`."""
         n = len(data)
         if n == 0:
             return
-        blk = Block(data, n, None)
+        blk = Block(data, n, None, file_ref=file_ref)
         self._refs.append([blk, 0, n])
         self._size += n
 
@@ -373,9 +413,37 @@ class IOBuf:
 
     # ---- reading without consuming ----
 
+    def as_contiguous(self) -> Tuple[memoryview, bool]:
+        """The whole buffer as ONE contiguous view: ``(view, copied)``.
+        Single-block buffers (the native ingest shape) return a
+        zero-copy view into the backing block; chained buffers gather
+        once (the audited scatter-gather join) — the receive-side
+        landing path (attachment → numpy → device) uses this instead of
+        ``to_bytes`` so the common case materializes nothing."""
+        if len(self._refs) == 1:
+            blk, off, ln = self._refs[0]
+            if off == 0 and ln == blk.size \
+                    and isinstance(blk.data, memoryview):
+                # full-span user block: hand back the ORIGINAL buffer
+                # object, not a fresh slice — identity survives handler
+                # round trips (the shm echo-by-reference check compares
+                # block storage by identity)
+                return blk.data, False
+            return blk.view(off, ln), False
+        if _audit.enabled and self._size >= _audit.AUDIT_FLOOR:
+            _audit.record("gather", self._size)
+        out = bytearray(self._size)
+        pos = 0
+        for blk, off, ln in self._refs:
+            out[pos:pos + ln] = blk.view(off, ln)
+            pos += ln
+        return memoryview(out), True
+
     def fetch(self, n: int) -> bytes:
         """Peek first n bytes (copies n bytes, does not consume)."""
         n = min(n, self._size)
+        if _audit.enabled and n >= _audit.AUDIT_FLOOR:
+            _audit.record("materialize", n)
         out = bytearray(n)
         pos = 0
         for blk, off, ln in self._refs:
@@ -421,7 +489,32 @@ class IOBuf:
 
     def cut_into_socket(self, sock, max_bytes: Optional[int] = None) -> int:
         """Vectored send (≈ cut_into_file_descriptor,
-        /root/reference/src/butil/iobuf.h:160). Consumes what was sent."""
+        /root/reference/src/butil/iobuf.h:160). Consumes what was sent.
+
+        A file-backed block (shm-ring slot spilling onto the TCP lane)
+        at the queue head ships via ``os.sendfile`` — the kernel pulls
+        straight from the page cache/tmpfs pages, never re-reading the
+        mapping through userspace.  Never on a TLS socket: sendfile
+        writes beneath the SSL record layer (plaintext on the wire);
+        those blocks take the encrypted send path below."""
+        if self._refs:
+            blk, off, ln = self._refs[0]
+            if blk.file_ref is not None and ln >= SENDFILE_MIN \
+                    and not _is_tls(sock):
+                import os as _os
+                fd, base = blk.file_ref
+                want = ln if max_bytes is None else min(ln, max_bytes)
+                try:
+                    sent = _os.sendfile(sock.fileno(), fd, base + off,
+                                        want)
+                except BlockingIOError:
+                    raise
+                except OSError:
+                    pass        # no sendfile on this fd/sandbox: fall
+                                # through to the sendmsg view path
+                else:
+                    self.pop_front(sent)
+                    return sent
         views = self.backing_views()
         if max_bytes is not None:
             clipped, acc = [], 0

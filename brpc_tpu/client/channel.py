@@ -322,7 +322,10 @@ class Channel:
         server): bytes in → ``(response_view, attachment_view)`` out,
         zero-copy views into the response frame.  No Controller in the
         path; raises RpcError on failure.  One attempt — resilience
-        (retries, backup requests, LB) lives on call_method."""
+        (retries, backup requests, LB) lives on call_method.  Lifetime:
+        an attachment view that rode the shm lane aliases a ring slot
+        recycled at THIS thread's next call on the channel (the socket
+        is thread-pinned) — consume or copy it before then."""
         return fast_call.run_raw(self, method_full, payload, attachment,
                                  timeout_ms)
 

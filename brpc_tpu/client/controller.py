@@ -71,7 +71,7 @@ class Controller(LazyAttachmentsMixin):
         "_attempt_sids", "_inflight_marks", "attempt_remotes",
         "_stream_to_create",
         "_channel", "_lb_ctx", "trace_id", "span_id", "_direct_ok",
-        "_client_span",
+        "_client_span", "_shm_slot", "_shm_offered", "_shm_retired",
     )
 
     def __init__(self):
@@ -121,6 +121,11 @@ class Controller(LazyAttachmentsMixin):
         self.trace_id = 0
         self.span_id = 0
         self._client_span = None         # rpcz Span for a forced trace
+        self._shm_slot = None            # staged shm ring slot (request)
+        self._shm_offered = False        # this attempt carried the offer
+        self._shm_retired = None         # earlier attempts' slots; freed
+        #                                  only at call end (descriptors
+        #                                  may still be live on the wire)
 
     # -- lazy hot-path members ---------------------------------------------
     # attachments: LazyAttachmentsMixin.  The Event is also lazy: a sync
@@ -477,7 +482,37 @@ class Controller(LazyAttachmentsMixin):
                 combined.append_iobuf(attachment)
                 combined.append_iobuf(tail)
                 attachment = combined
-        frame = pack_frame(meta, payload, attachment=attachment)
+        # shm data plane: a same-host attachment ≥ threshold rides a
+        # descriptor into this process's tx ring instead of the frame
+        # (negotiation/credit TLVs splice into the meta region verbatim)
+        shm_extra = b""
+        multi_attempt = False
+        if self._shm_slot is not None:
+            # a backup/retry attempt starts while the previous attempt's
+            # on-wire descriptor may still be unread by the server (a
+            # backup's primary is STILL LIVE): the slot must not be
+            # freed — retire it, settled once the call ends
+            # (_signal_ended), and keep later attempts off the shm lane
+            # (their early settle would have the same hazard)
+            if self._shm_retired is None:
+                self._shm_retired = []
+            self._shm_retired.append(self._shm_slot)
+            self._shm_slot = None
+            multi_attempt = True
+        self._shm_offered = False
+        na = len(attachment) if attachment is not None else 0
+        if na or getattr(sock, "shm", None) is not None:
+            from ..transport import shm_ring as _shm
+            shm_extra, wire_att, slot, offered = _shm.client_prepare(
+                sock, attachment if na else None,
+                device=self.request_device_attachment is not None,
+                multi_attempt=multi_attempt)
+            self._shm_slot = slot
+            self._shm_offered = offered
+            if na and wire_att is None:
+                attachment = None       # the attachment rides shm
+        frame = pack_frame(meta, payload, attachment=attachment,
+                           extra_meta=shm_extra)
         # exactly-once failure notification by inflight-set ownership:
         # the id is NOT passed to write (its refused-enqueue path could
         # double-notify an id set_failed's drain already errored); whoever
@@ -634,6 +669,30 @@ class Controller(LazyAttachmentsMixin):
                 ack_unused(msg.meta, msg.socket_id or self._sending_sid)
             _idp.unlock(self._cid_base)      # stale attempt's response
             return
+        shm_view = shm_settle = None
+        m = msg.meta
+        if m.shm_offer or m.shm_accept or m.shm_desc or self._shm_offered \
+                or self._shm_slot is not None:
+            # shm data plane: learn accepts/offers, settle the staged
+            # request slot, resolve a response descriptor (error
+            # responses prove nothing about capability — offered_now
+            # only on success)
+            from ..transport import shm_ring as _shm
+            s = Socket.address(msg.socket_id or self._sending_sid)
+            if s is not None:
+                try:
+                    shm_view, shm_settle = _shm.client_on_response_meta(
+                        s, m, offered_now=(self._shm_offered
+                                           and not m.error_code),
+                        staged_slot=self._shm_slot,
+                        retired=self._shm_retired)
+                except _shm.ShmDescriptorError as e:
+                    # peer protocol violation — fail loudly, never hand
+                    # user code a silently empty attachment
+                    self._shm_slot = None
+                    self._finish_locked(int(Errno.ERESPONSE), str(e))
+                    return
+                self._shm_slot = None
         code = msg.meta.error_code
         if code != 0:
             if self._retry_locked(version, code):
@@ -666,6 +725,15 @@ class Controller(LazyAttachmentsMixin):
             attachment, self.response_device_attachment = \
                 split_device_attachment(msg.meta, attachment,
                                         msg.socket_id or self._sending_sid)
+        if shm_view is not None:
+            # the response attachment rode shared memory: wrap the
+            # resolved zero-copy view (the frame carried no att bytes).
+            # LIFETIME: the backing ring slot is recycled when this
+            # IOBuf is dropped (finalizer-bound settle) — raw views
+            # extracted via backing_views()/as_contiguous() must not
+            # outlive the attachment IOBuf
+            from ..transport import shm_ring as _shm
+            attachment = _shm.wrap_view_iobuf(shm_view, shm_settle)
         raw = msg.payload.to_bytes()
         if msg.meta.compress_type:
             raw = compress_mod.decompress(raw, msg.meta.compress_type)
@@ -690,6 +758,22 @@ class Controller(LazyAttachmentsMixin):
         self._error_code = int(code)
         self._error_text = text
         self.latency_us = monotonic_us() - self._begin_us
+        if self._shm_slot is not None or self._shm_retired:
+            # settle the staged slot when the call ended without
+            # response-meta processing (timeout, cancel, socket
+            # failure), plus slots retired by backup/retry restages —
+            # the call's end is the earliest point their on-wire
+            # descriptors are plausibly quiescent (the one remaining
+            # window: an orphaned attempt's frame still unread when its
+            # slot is recycled — narrowed by later attempts declining
+            # the shm lane, see client_prepare multi_attempt)
+            from ..transport import shm_ring as _shm
+            _shm.client_complete(self._shm_slot)
+            self._shm_slot = None
+            if self._shm_retired:
+                for s in self._shm_retired:
+                    _shm.client_complete(s)
+                self._shm_retired = None
         if self._stream_to_create is not None and (
                 code != 0
                 or not self._stream_to_create._established.is_set()):

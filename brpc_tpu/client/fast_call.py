@@ -421,12 +421,31 @@ def run(channel, cntl, method_full: str, request: Any,
                     if cntl.span_id:
                         tail += TLV_SPAN \
                             + struct.pack("<Q", cntl.span_id)
-                if att_len and len(att_parts) > 1:
-                    att_buf = att.to_bytes()
-                elif att_len:
-                    att_buf = att_parts[0]
-                else:
+                shm_slot = None
+                shm_offered = False
+                shm_took = False
+                if att_len or psock.shm is not None:
+                    # shm data plane: eligible same-host attachments
+                    # stage into the tx ring and ride as a descriptor
+                    # TLV in the tail (negotiation/credit TLVs too).
+                    # Retry attempts decline the lane (multi_attempt):
+                    # the failed attempt's descriptor may still be
+                    # unread on a server whose socket died under us —
+                    # restaging could recycle the slot it names
+                    from ..transport import shm_ring as _shm
+                    extra, wire_att, shm_slot, shm_offered = \
+                        _shm.client_prepare(psock,
+                                            att if att_len else None,
+                                            multi_attempt=nretry > 0)
+                    if extra:
+                        tail = tail + extra
+                    shm_took = bool(att_len) and wire_att is None
+                if not att_len or shm_took:
                     att_buf = None
+                elif len(att_parts) > 1:
+                    att_buf = att.to_bytes()
+                else:
+                    att_buf = att_parts[0]
                 left_ms = 0
                 if deadline_us is not None:
                     left_ms = max(1, (deadline_us - _mono_ns() // 1000)
@@ -439,12 +458,18 @@ def run(channel, cntl, method_full: str, request: Any,
                         psock.fd.fileno(), tail, payload_b,
                         att_buf, int(left_ms), cid, ack0)
                 except TimeoutError:
+                    if shm_slot is not None:
+                        from ..transport import shm_ring as _shm
+                        _shm.client_complete(shm_slot)
                     psock.set_failed(Errno.ERPCTIMEDOUT, "rpc timeout")
                     psock.release()
                     _finish(channel, cntl, Errno.ERPCTIMEDOUT,
                             f"deadline {timeout_ms}ms exceeded")
                     return
                 except (ConnectionError, ValueError, OSError) as e:
+                    if shm_slot is not None:
+                        from ..transport import shm_ring as _shm
+                        _shm.client_complete(shm_slot)
                     psock.set_failed(Errno.EFAILEDSOCKET, str(e))
                     psock.release()
                     code = int(Errno.EFAILEDSOCKET)
@@ -453,6 +478,14 @@ def run(channel, cntl, method_full: str, request: Any,
                     if acks:
                         _ici_process_ack(acks, psock)
                     if ok:
+                        if shm_slot is not None or shm_offered:
+                            # plain success response: settle the slot;
+                            # an unanswered offer marks the peer
+                            # capability-less
+                            from ..transport import shm_ring as _shm
+                            _shm.client_complete(shm_slot)
+                            if shm_offered:
+                                _shm.client_saw_plain_response(psock)
                         if dom:
                             psock.ici_peer_domain = dom
                         body = memoryview(buf)
@@ -471,12 +504,13 @@ def run(channel, cntl, method_full: str, request: Any,
                         cntl.response_attachment = attachment
                         _finish(channel, cntl, 0, "")
                         return
-                    # unusual response (error / controller-tier tags):
-                    # full decode; socket stays pinned (healthy frames
-                    # leave the connection usable)
+                    # unusual response (error / controller-tier tags /
+                    # shm descriptor): full decode; socket stays pinned
+                    # (healthy frames leave the connection usable)
                     done, code, text = _handle_response(
                         channel, cntl, psock, psid, pooled, buf, nval,
-                        cid, response_type, put_back=_noop)
+                        cid, response_type, put_back=_noop,
+                        shm_slot=shm_slot, shm_offered=shm_offered)
                     if done:
                         return
                 if _retry_or_finish(code, text):
@@ -504,11 +538,30 @@ def run(channel, cntl, method_full: str, request: Any,
             _slow_path(channel, cntl, method_full, request, response_type)
             return
 
+        shm_slot = None
+        shm_offered = False
         if code == 0:
             # device attachment: post to the window per attempt; the
             # descriptor TLV rides the frame, an inline tail (host-staged
             # fallback) extends the attachment region
             a_len, a_parts = att_len, att_parts
+            shm_extra = b""
+            if att_len or sock.shm is not None:
+                # shm data plane: the user attachment (never the device
+                # tail — device frames decline with a named reason)
+                # stages into the tx ring and rides as a descriptor.
+                # Retry attempts decline the lane (multi_attempt): the
+                # failed attempt's descriptor may still be unread on a
+                # server whose socket died under us
+                from ..transport import shm_ring as _shm
+                shm_extra, _wire_att, shm_slot, shm_offered = \
+                    _shm.client_prepare(
+                        sock, att if att_len else None,
+                        device=cntl.request_device_attachment
+                        is not None,
+                        multi_attempt=nretry > 0)
+                if att_len and _wire_att is None:
+                    a_len, a_parts = 0, ()
             dev_desc = b""
             if domain:
                 # the conn nonce must exist BEFORE any descriptor post
@@ -563,6 +616,8 @@ def run(channel, cntl, method_full: str, request: Any,
             if a_len:
                 mb += _ATT_TAG + struct.pack("<I", a_len)
             mb += method_tlvs
+            if shm_extra:
+                mb += shm_extra
             if dev_desc:
                 mb += encode_tlv(TAG_ICI_DESC, dev_desc)
             if auth and getattr(sock, "app_data", None) is None:
@@ -611,12 +666,18 @@ def run(channel, cntl, method_full: str, request: Any,
                 # usually reached the server, whose in-flight handler
                 # may still redeem it — settle/TTL own reclamation
                 # (same semantics as the Controller slow path)
+                if shm_slot is not None:
+                    from ..transport import shm_ring as _shm
+                    _shm.client_complete(shm_slot)
                 sock.set_failed(Errno.ERPCTIMEDOUT, "rpc timeout")
                 sock.release()
                 _finish(channel, cntl, Errno.ERPCTIMEDOUT,
                         f"deadline {timeout_ms}ms exceeded")
                 return
             except (ConnectionError, ValueError, OSError) as e:
+                if shm_slot is not None:
+                    from ..transport import shm_ring as _shm
+                    _shm.client_complete(shm_slot)
                 sock.set_failed(Errno.EFAILEDSOCKET, str(e))
                 sock.release()
                 code, text = int(Errno.EFAILEDSOCKET), str(e)
@@ -624,7 +685,8 @@ def run(channel, cntl, method_full: str, request: Any,
         if code == 0:
             done, code, text = _handle_response(
                 channel, cntl, sock, sid, pooled, buf, meta_size, cid,
-                response_type)
+                response_type, shm_slot=shm_slot,
+                shm_offered=shm_offered)
             if done:
                 return
 
@@ -636,11 +698,14 @@ def run(channel, cntl, method_full: str, request: Any,
 
 def _handle_response(channel, cntl, sock, sid: int, pooled: bool, buf,
                      meta_size: int, cid: int, response_type: Any,
-                     put_back=None) -> Tuple[bool, int, str]:
+                     put_back=None, shm_slot=None,
+                     shm_offered: bool = False) -> Tuple[bool, int, str]:
     """Decode one response frame.  Returns (done, code, text); done=False
     means a retriable failure the caller's loop should handle.
     ``put_back`` overrides how a healthy socket is handed back (the
-    pinned-socket lane passes a no-op: the pin IS the checkout)."""
+    pinned-socket lane passes a no-op: the pin IS the checkout).
+    ``shm_slot``/``shm_offered``: the request's shm data-plane state —
+    settled here (every exit path) against the response meta."""
     if put_back is not None:
         _put_back = put_back
     else:
@@ -670,6 +735,13 @@ def _handle_response(channel, cntl, sock, sid: int, pooled: bool, buf,
     if scan is not None:
         # success response with nothing controller-tier in the meta:
         # skip the RpcMeta object entirely (the common echo shape)
+        if shm_slot is not None or shm_offered:
+            # plain success response: settle the staged slot; an offer
+            # answered without an accept marks the peer capability-less
+            from ..transport import shm_ring as _shm
+            _shm.client_complete(shm_slot)
+            if shm_offered:
+                _shm.client_saw_plain_response(sock)
         rcid, natt, dom = scan
         if rcid != cid:
             sock.set_failed(Errno.ERESPONSE, "response cid mismatch")
@@ -690,9 +762,25 @@ def _handle_response(channel, cntl, sock, sid: int, pooled: bool, buf,
         return _complete(bytes(body), attachment)
     meta = RpcMeta.decode(bytes(mv[:meta_size]))
     if meta is None or meta.correlation_id != cid:
+        if shm_slot is not None:
+            from ..transport import shm_ring as _shm
+            _shm.client_complete(shm_slot)
         sock.set_failed(Errno.ERESPONSE, "undecodable response meta")
         sock.release()
         return False, int(Errno.EFAILEDSOCKET), "undecodable response"
+    shm_view = shm_settle = None
+    if (meta.shm_offer or meta.shm_accept or meta.shm_desc
+            or shm_offered or shm_slot is not None):
+        from ..transport import shm_ring as _shm
+        try:
+            shm_view, shm_settle = _shm.client_on_response_meta(
+                sock, meta,
+                offered_now=shm_offered and not meta.error_code,
+                staged_slot=shm_slot)
+        except _shm.ShmDescriptorError as e:
+            sock.set_failed(Errno.ERESPONSE, str(e))
+            sock.release()
+            return False, int(Errno.ERESPONSE), str(e)
     if meta.ici_domain:
         sock.ici_peer_domain = meta.ici_domain
     if meta.error_code:
@@ -700,7 +788,12 @@ def _handle_response(channel, cntl, sock, sid: int, pooled: bool, buf,
         _put_back()
         return False, meta.error_code, meta.error_text
     body = mv[meta_size:]
-    attachment = IOBuf()
+    if shm_view is not None:
+        # response attachment resolved from shared memory (zero-copy);
+        # the ring slot recycles when this buffer is dropped
+        attachment = _shm.wrap_view_iobuf(shm_view, shm_settle)
+    else:
+        attachment = IOBuf()
     if meta.attachment_size:
         n = meta.attachment_size
         if n > len(body):
@@ -1374,39 +1467,80 @@ def _raw_pinned(opts, payload, attachment, timeout_ms, sid, sock, tlv):
         # writes, reads, and scans the response meta — Python's
         # per-call work is one counter bump and one tuple unpack.
         # (The rare first-call-with-auth case keeps the classic build.)
+        shm_slot = None
+        shm_offered = False
+        wire_att = attachment if attachment is not None \
+            and len(attachment) else None
+        if wire_att is not None or sock.shm is not None:
+            # shm data plane: eligible same-host attachments ride a
+            # descriptor TLV appended to the tail the engine
+            # serializes verbatim; the byte region stays empty
+            from ..transport import shm_ring as _shm
+            extra, wire_att, shm_slot, shm_offered = \
+                _shm.client_prepare(sock, wire_att)
+            if extra:
+                tlv = tlv + extra
         ack0 = sock._take_ack_frame() if sock._pending_acks else None
         try:
             ok, buf, nval, dom, acks = nat.raw_call(
-                sock.fd.fileno(), tlv, payload,
-                attachment if attachment is not None
-                and len(attachment) else None,
+                sock.fd.fileno(), tlv, payload, wire_att,
                 int(timeout_ms) if timeout_ms and timeout_ms > 0 else 0,
                 cid, ack0)
         except TimeoutError:
+            if shm_slot is not None:
+                from ..transport import shm_ring as _shm
+                _shm.client_complete(shm_slot)
             sock.set_failed(Errno.ERPCTIMEDOUT, "rpc timeout")
             sock.release()
             raise RpcError(int(Errno.ERPCTIMEDOUT),
                            f"deadline {timeout_ms}ms exceeded") from None
         except (ConnectionError, ValueError, OSError) as e:
+            if shm_slot is not None:
+                from ..transport import shm_ring as _shm
+                _shm.client_complete(shm_slot)
             sock.set_failed(Errno.EFAILEDSOCKET, str(e))
             sock.release()
             raise RpcError(int(Errno.EFAILEDSOCKET), str(e)) from None
         if acks:
             _ici_process_ack(acks, sock)
         if ok:
+            if shm_slot is not None or shm_offered:
+                # plain success response: settle the staged slot; an
+                # unanswered offer marks the peer capability-less
+                from ..transport import shm_ring as _shm
+                _shm.client_complete(shm_slot)
+                if shm_offered:
+                    _shm.client_saw_plain_response(sock)
             if dom is not None:
                 sock.ici_peer_domain = dom
             body = memoryview(buf)
             if nval:
                 return body[:len(body) - nval], body[len(body) - nval:]
             return body, memoryview(b"")
-        # unusual response: full decode (errors, controller-tier tags)
+        # unusual response: full decode (errors, controller-tier tags,
+        # shm negotiation/descriptor TLVs)
         mv = memoryview(buf)
         meta = RpcMeta.decode(bytes(mv[:nval]))
         if meta is None or meta.correlation_id != cid:
+            if shm_slot is not None:
+                from ..transport import shm_ring as _shm
+                _shm.client_complete(shm_slot)
             sock.set_failed(Errno.ERESPONSE, "undecodable response meta")
             sock.release()
             raise RpcError(int(Errno.ERESPONSE), "undecodable response")
+        shm_view = shm_settle = None
+        if (meta.shm_offer or meta.shm_accept or meta.shm_desc
+                or shm_offered or shm_slot is not None):
+            from ..transport import shm_ring as _shm
+            try:
+                shm_view, shm_settle = _shm.client_on_response_meta(
+                    sock, meta,
+                    offered_now=shm_offered and not meta.error_code,
+                    staged_slot=shm_slot)
+            except _shm.ShmDescriptorError as e:
+                sock.set_failed(Errno.ERESPONSE, str(e))
+                sock.release()
+                raise RpcError(int(Errno.ERESPONSE), str(e)) from None
         if meta.error_code:
             raise RpcError(meta.error_code, meta.error_text)
         natt = meta.attachment_size
@@ -1414,6 +1548,14 @@ def _raw_pinned(opts, payload, attachment, timeout_ms, sid, sock, tlv):
             sock.ici_peer_domain = meta.ici_domain
         body = mv[nval:]
         ratt = memoryview(b"")
+        if shm_view is not None:
+            # the response attachment rode shared memory.  NOTE (raw
+            # lane contract): this view aliases a ring slot recycled at
+            # the NEXT call on this channel from this thread (the
+            # socket is thread-pinned, so no other caller can trigger
+            # it) — consume or copy the view before then.
+            _shm.defer_settle(sock, shm_settle)
+            ratt = shm_view
         if natt:
             if natt > len(body):
                 sock.set_failed(Errno.ERESPONSE,
@@ -1425,11 +1567,21 @@ def _raw_pinned(opts, payload, attachment, timeout_ms, sid, sock, tlv):
             body = body[:len(body) - natt]
         return body, ratt
 
+    shm_slot = None
+    shm_offered = False
+    shm_extra = b""
+    wire_att = attachment if attachment is not None \
+        and len(attachment) else None
+    if wire_att is not None or sock.shm is not None:
+        from ..transport import shm_ring as _shm
+        shm_extra, wire_att, shm_slot, shm_offered = \
+            _shm.client_prepare(sock, wire_att)
+    attachment = wire_att
     na = len(attachment) if attachment is not None else 0
     mb = _CID_TAG + struct.pack("<Q", cid)
     if na:
         mb += _ATT_TAG + struct.pack("<I", na)
-    mb += tlv
+    mb += tlv + shm_extra
     if opts.auth_data and getattr(sock, "app_data", None) is None:
         mb += encode_tlv(TAG_AUTH, opts.auth_data)
         sock.app_data = "authed"
@@ -1449,11 +1601,17 @@ def _raw_pinned(opts, payload, attachment, timeout_ms, sid, sock, tlv):
         else:
             res = _py_sync_call(sock, b"".join(parts), timeout_s)
     except TimeoutError:
+        if shm_slot is not None:
+            from ..transport import shm_ring as _shm
+            _shm.client_complete(shm_slot)
         sock.set_failed(Errno.ERPCTIMEDOUT, "rpc timeout")
         sock.release()
         raise RpcError(int(Errno.ERPCTIMEDOUT),
                        f"deadline {timeout_ms}ms exceeded") from None
     except (ConnectionError, ValueError, OSError) as e:
+        if shm_slot is not None:
+            from ..transport import shm_ring as _shm
+            _shm.client_complete(shm_slot)
         sock.set_failed(Errno.EFAILEDSOCKET, str(e))
         sock.release()
         raise RpcError(int(Errno.EFAILEDSOCKET), str(e)) from None
@@ -1462,22 +1620,49 @@ def _raw_pinned(opts, payload, attachment, timeout_ms, sid, sock, tlv):
         _ici_process_ack(res[2], sock)
     mv = memoryview(buf)
     scan = _scan_raw_resp(mv[:meta_size])
+    shm_view = shm_settle = None
     if scan is None:
-        # error tags / unexpected tags: full decode for the error text
+        # error tags / unexpected tags (incl. shm negotiation and
+        # descriptor TLVs): full decode
         meta = RpcMeta.decode(bytes(mv[:meta_size]))
         if meta is None or meta.correlation_id != cid:
+            if shm_slot is not None:
+                from ..transport import shm_ring as _shm
+                _shm.client_complete(shm_slot)
             sock.set_failed(Errno.ERESPONSE, "undecodable response meta")
             sock.release()
             raise RpcError(int(Errno.ERESPONSE), "undecodable response")
+        if (meta.shm_offer or meta.shm_accept or meta.shm_desc
+                or shm_offered or shm_slot is not None):
+            from ..transport import shm_ring as _shm
+            try:
+                shm_view, shm_settle = _shm.client_on_response_meta(
+                    sock, meta,
+                    offered_now=shm_offered and not meta.error_code,
+                    staged_slot=shm_slot)
+            except _shm.ShmDescriptorError as e:
+                sock.set_failed(Errno.ERESPONSE, str(e))
+                sock.release()
+                raise RpcError(int(Errno.ERESPONSE), str(e)) from None
         if meta.error_code:
             raise RpcError(meta.error_code, meta.error_text)
         rcid, natt = meta.correlation_id, meta.attachment_size
     else:
         rcid, natt, _dom = scan
         if rcid != cid:
+            if shm_slot is not None:
+                from ..transport import shm_ring as _shm
+                _shm.client_complete(shm_slot)
             sock.set_failed(Errno.ERESPONSE, "response cid mismatch")
             sock.release()
             raise RpcError(int(Errno.ERESPONSE), "response cid mismatch")
+        if shm_slot is not None or shm_offered:
+            # plain success response: settle; an unanswered offer marks
+            # the peer capability-less
+            from ..transport import shm_ring as _shm
+            _shm.client_complete(shm_slot)
+            if shm_offered:
+                _shm.client_saw_plain_response(sock)
         if _dom:
             # learn the peer's device-fabric domain on the classic lane
             # too — otherwise a pure-Python install never enables the
@@ -1485,6 +1670,13 @@ def _raw_pinned(opts, payload, attachment, timeout_ms, sid, sock, tlv):
             sock.ici_peer_domain = _dom
     body = mv[meta_size:]
     ratt = memoryview(b"")
+    if shm_view is not None:
+        # response attachment resolved from shared memory (see the raw
+        # lane view-lifetime note above: slot recycles at this thread's
+        # next call on the pinned socket)
+        from ..transport import shm_ring as _shm
+        _shm.defer_settle(sock, shm_settle)
+        ratt = shm_view
     if natt:
         if natt > len(body):
             sock.set_failed(Errno.ERESPONSE, "attachment size exceeds body")

@@ -279,7 +279,10 @@ def split_device_attachment(meta, attachment: IOBuf, socket_id: int
             return attachment, None      # malformed; drop the handle
         keep = len(attachment) - nbytes
         user_part = attachment.cutn(keep)    # device tail stays behind
-        host_bytes = attachment.to_bytes()
+        # zero-copy landing: a single-block tail (the native ingest
+        # shape) passes a view straight through to np.frombuffer —
+        # the only copy left on the inline path is the device put
+        host_bytes = attachment.as_contiguous()[0]
         attachment = user_part
     return attachment, DeviceAttachment(
         kind, desc_id, nbytes, dtype, shape, socket_id=socket_id,

@@ -114,15 +114,25 @@ def embedding_bag(table, ids):
     return _embedding_bag_fn()(table, ids)
 
 
-def tensor_bytes(x) -> Tuple[bytes, str, Tuple[int, ...]]:
-    """Device/host array → (raw bytes, dtype str, shape) for shipping as
-    an RPC attachment (zero serializer in the path)."""
-    host = np.asarray(x)
-    return host.tobytes(), str(host.dtype), tuple(host.shape)
+def tensor_bytes(x) -> Tuple[memoryview, str, Tuple[int, ...]]:
+    """Device/host array → (raw buffer, dtype str, shape) for shipping
+    as an RPC attachment (zero serializer in the path).  The buffer is
+    a read-only view over the host array's storage — no tobytes copy;
+    the view keeps the array alive.  CONTRACT: when ``x`` is already a
+    host numpy array, the view ALIASES it (readonly blocks writes
+    through the view, not through the array) — the caller must not
+    mutate ``x`` until the RPC's write completes; device arrays are
+    immune (``np.asarray`` lands them in a fresh host copy)."""
+    host = np.ascontiguousarray(np.asarray(x))
+    return memoryview(host).cast("B").toreadonly(), \
+        str(host.dtype), tuple(host.shape)
 
 
-def bytes_to_tensor(data: bytes, dtype: str, shape: Tuple[int, ...],
+def bytes_to_tensor(data, dtype: str, shape: Tuple[int, ...],
                     device=None):
+    """Wire buffer (bytes or any contiguous view) → host/device tensor.
+    np.frombuffer aliases the storage — the landing copy is the device
+    put (or nothing, for host consumers)."""
     arr = np.frombuffer(data, dtype=np.dtype(dtype)).reshape(shape)
     if device is None:
         return arr

@@ -36,6 +36,13 @@ _T_ICI_DESC = 16        # bytes: device attachment descriptor (ici/)
 _T_ICI_CONN = 17        # bytes: initiator's connection nonce — the
                         # conn identity descriptor binding uses (address
                         # pairs disagree across proxies/NAT)
+# shm data plane (transport/shm_ring.py — same-host attachments by
+# descriptor instead of bytes, ≈ the reference's RDMA rkey exchange)
+_T_SHM_OFFER = 18       # bytes: sender's ring spec (capability offer)
+_T_SHM_ACCEPT = 19      # bytes: ring id the sender has mapped (confirm)
+_T_SHM_RELEASE = 20     # bytes: slot credits returned to the ring owner
+_T_SHM_DESC = 21        # bytes: (ring_id, slot, offset, len) — the
+                        # attachment rides shared memory, not the frame
 
 
 class CompressType:
@@ -63,6 +70,10 @@ TAG_AUTH = _T_AUTH
 TAG_ICI_DOMAIN = _T_ICI_DOMAIN
 TAG_ICI_DESC = _T_ICI_DESC
 TAG_ICI_CONN = _T_ICI_CONN
+TAG_SHM_OFFER = _T_SHM_OFFER
+TAG_SHM_ACCEPT = _T_SHM_ACCEPT
+TAG_SHM_RELEASE = _T_SHM_RELEASE
+TAG_SHM_DESC = _T_SHM_DESC
 
 
 class RpcMeta:
@@ -70,7 +81,8 @@ class RpcMeta:
                  "service_name", "method_name", "error_code", "error_text",
                  "auth_data", "trace_id", "span_id", "parent_span_id",
                  "stream_id", "timeout_ms", "stream_window",
-                 "ici_domain", "ici_desc", "ici_conn", "timeout_present")
+                 "ici_domain", "ici_desc", "ici_conn", "timeout_present",
+                 "shm_offer", "shm_accept", "shm_release", "shm_desc")
 
     def __init__(self):
         self.correlation_id = 0
@@ -94,6 +106,10 @@ class RpcMeta:
         self.ici_domain = b""
         self.ici_desc = b""
         self.ici_conn = b""
+        self.shm_offer = b""
+        self.shm_accept = b""
+        self.shm_release = b""
+        self.shm_desc = b""
 
     @property
     def is_request(self) -> bool:
@@ -143,6 +159,14 @@ class RpcMeta:
             put(_T_ICI_DESC, self.ici_desc)
         if self.ici_conn:
             put(_T_ICI_CONN, self.ici_conn)
+        if self.shm_offer:
+            put(_T_SHM_OFFER, self.shm_offer)
+        if self.shm_accept:
+            put(_T_SHM_ACCEPT, self.shm_accept)
+        if self.shm_release:
+            put(_T_SHM_RELEASE, self.shm_release)
+        if self.shm_desc:
+            put(_T_SHM_DESC, self.shm_desc)
         return bytes(out)
 
     @staticmethod
@@ -193,6 +217,14 @@ class RpcMeta:
                     m.ici_desc = field
                 elif tag == _T_ICI_CONN:
                     m.ici_conn = field
+                elif tag == _T_SHM_OFFER:
+                    m.shm_offer = field
+                elif tag == _T_SHM_ACCEPT:
+                    m.shm_accept = field
+                elif tag == _T_SHM_RELEASE:
+                    m.shm_release = field
+                elif tag == _T_SHM_DESC:
+                    m.shm_desc = field
                 # unknown tags are skipped: forward compatibility
         except (struct.error, IndexError, UnicodeDecodeError):
             return None

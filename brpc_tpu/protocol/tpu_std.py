@@ -94,13 +94,19 @@ def parse(source: IOBuf, sock, read_eof: bool, arg) -> ParseResult:
 
 
 def pack_frame(meta: RpcMeta, payload: IOBuf,
-               attachment: Optional[IOBuf] = None) -> IOBuf:
+               attachment: Optional[IOBuf] = None,
+               extra_meta: bytes = b"") -> IOBuf:
     """Frame one message. ``attachment`` is appended after the payload and
     its size recorded in the meta (zero-copy: the attachment IOBuf's
-    blocks are shared, not copied)."""
+    blocks are shared, not copied).  ``extra_meta`` is pre-encoded TLV
+    bytes appended verbatim inside the meta region (the shm data plane
+    encodes its offer/accept/release/descriptor TLVs once and every
+    lane splices them in — meta.decode parses them back into fields)."""
     if attachment is not None and len(attachment) > 0:
         meta.attachment_size = len(attachment)
     meta_bytes = meta.encode()
+    if extra_meta:
+        meta_bytes += extra_meta
     body_size = len(meta_bytes) + len(payload) + meta.attachment_size
     out = IOBuf(MAGIC + struct.pack("<II", body_size, len(meta_bytes)))
     out.append(meta_bytes)

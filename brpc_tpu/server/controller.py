@@ -33,6 +33,7 @@ class ServerController(LazyAttachmentsMixin):
         "_accepted_stream_window", "span", "grpc_stream",
         "http_method", "http_path", "http_unresolved_path",
         "_session_data", "_progressive", "deadline_us",
+        "_shm_handle", "_shm_extra",
     )
 
     def __init__(self, request_meta: RpcMeta,
@@ -70,6 +71,8 @@ class ServerController(LazyAttachmentsMixin):
         self.http_unresolved_path = ""   # restful /* remainder
         self._session_data = None        # borrowed SimpleDataPool object
         self._progressive = None         # ProgressiveAttachment when used
+        self._shm_handle = None          # request shm descriptor handle
+        self._shm_extra = b""            # shm accept/offer TLVs to answer
         # absolute monotonic-µs deadline from the request's propagated
         # remaining budget (tpu_std TLV 13 / grpc-timeout / x-deadline-ms),
         # anchored at arrival; 0 = the request carries no deadline.  The

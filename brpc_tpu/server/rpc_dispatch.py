@@ -12,6 +12,7 @@ from typing import Any
 
 from time import monotonic_ns as _mono_ns
 
+from ..butil.flags import get_flag
 from ..butil.iobuf import IOBuf
 from ..butil.logging_util import LOG
 from ..butil.status import Errno
@@ -76,6 +77,42 @@ def _send_response(server, entry, cntl: ServerController,
         # never redeemed (handler ignored it / failed early) ⇒ settle
         # acks it now.  Handlers must redeem before finishing the RPC.
         cntl.request_device_attachment.settle()
+    # shm data plane, response side: negotiation TLVs the response MUST
+    # carry (capability accept + our ring spec), and — when the peer can
+    # resolve our descriptors — the response attachment re-described
+    # into shared memory instead of riding the frame (echo-class
+    # responses re-describe the REQUEST's slot: zero data motion).
+    # Descriptor staging is DEFERRED until the response is guaranteed
+    # to leave (after serialization succeeds): staging first would leak
+    # the tx-ring slot when a later step downgrades to an error frame.
+    shm_extra = cntl._shm_extra
+    shm_desc = b""
+
+    def _shm_describe():
+        nonlocal shm_desc
+        if (cntl.failed or cntl._resp_att is None
+                or not len(cntl._resp_att)):
+            return
+        from ..transport import shm_ring
+        if (getattr(sock, "shm", None) is not None
+                and not cntl.response_compress_type
+                and cntl.response_device_attachment is None):
+            shm_desc, _wire_att = shm_ring.describe_response_att(
+                sock, cntl._resp_att, cntl._shm_handle)
+            if shm_desc:
+                cntl._resp_att = _wire_att  # None: attachment rides shm
+        elif (shm_ring.lane_enabled() and len(cntl._resp_att)
+                >= int(get_flag("rpc_shm_threshold"))):
+            # an otherwise-eligible attachment kept off the lane by the
+            # response's shape — name the reason (error responses are
+            # not data-plane traffic and stay uncounted)
+            if cntl.response_compress_type:
+                shm_ring.count_fallback("shm_compressed")
+            elif cntl.response_device_attachment is not None:
+                shm_ring.count_fallback("shm_device_combo")
+            else:                       # peer never spoke a shm TLV
+                shm_ring.count_fallback("shm_peer_no_cap")
+
     if cntl.span is not None:
         cntl.span.finish(cntl.error_code)
     elif (not cntl.failed and sock is not None
@@ -83,7 +120,10 @@ def _send_response(server, entry, cntl: ServerController,
             and not cntl.response_compress_type
             and cntl.response_device_attachment is None
             and isinstance(response, (bytes, bytearray, memoryview))):
-        # echo-class fast path: flat TLV meta, no IOBuf/RpcMeta churn
+        # echo-class fast path: flat TLV meta, no IOBuf/RpcMeta churn.
+        # The response is bytes already — nothing can fail past here,
+        # so staging is safe now
+        _shm_describe()
         att = cntl._resp_att
         na = len(att) if att is not None else 0
         mb = _CID_TAG + _struct.pack("<Q", cntl.request_meta.correlation_id)
@@ -92,6 +132,8 @@ def _send_response(server, entry, cntl: ServerController,
         if cntl.request_meta.ici_domain:
             # answer the device-fabric domain exchange (cached TLV)
             mb += _domain_tlv()
+        if shm_extra or shm_desc:
+            mb += shm_extra + shm_desc
         head = (b"TRPC"
                 + _struct.pack("<II", len(mb) + len(response) + na, len(mb))
                 + mb)
@@ -122,14 +164,16 @@ def _send_response(server, entry, cntl: ServerController,
     if cntl.failed:
         meta.error_code = cntl.error_code
         meta.error_text = cntl.error_text
-        sock.write(pack_frame(meta, IOBuf()))
+        # negotiation facts still ride error responses (a lost accept
+        # would make the client misread the peer as capability-less)
+        sock.write(pack_frame(meta, IOBuf(), extra_meta=shm_extra))
         return
     try:
         payload = serialize_payload(response)
     except TypeError as e:
         meta.error_code = int(Errno.EINTERNAL)
         meta.error_text = f"response serialization failed: {e}"
-        sock.write(pack_frame(meta, IOBuf()))
+        sock.write(pack_frame(meta, IOBuf(), extra_meta=shm_extra))
         return
     if cntl.response_compress_type:
         compressed = compress_mod.compress(payload.to_bytes(),
@@ -137,6 +181,10 @@ def _send_response(server, entry, cntl: ServerController,
         if compressed is not None:
             meta.compress_type = cntl.response_compress_type
             payload = IOBuf(compressed)
+    # serialization (the last fallible step before prepare_send, whose
+    # failure frame carries no descriptor either way) succeeded: the
+    # attachment may stage into the ring now without leak risk
+    _shm_describe()
     attachment = cntl.response_attachment
     if cntl.response_device_attachment is not None:
         from ..ici.endpoint import ici_enabled, local_domain_id, prepare_send
@@ -148,7 +196,7 @@ def _send_response(server, entry, cntl: ServerController,
         except RuntimeError as e:
             meta.error_code = int(Errno.EOVERCROWDED)
             meta.error_text = str(e)
-            sock.write(pack_frame(meta, IOBuf()))
+            sock.write(pack_frame(meta, IOBuf(), extra_meta=shm_extra))
             return
         if tail is not None:
             combined = IOBuf()
@@ -157,7 +205,8 @@ def _send_response(server, entry, cntl: ServerController,
             attachment = combined
     if cntl.span is not None:
         cntl.span.response_size = len(payload) + len(attachment)
-    sock.write(pack_frame(meta, payload, attachment=attachment))
+    sock.write(pack_frame(meta, payload, attachment=attachment,
+                          extra_meta=shm_extra + shm_desc))
 
 
 def process_rpc_request(msg: RpcMessage, sock: Socket, server) -> None:
@@ -215,6 +264,31 @@ def process_rpc_request(msg: RpcMessage, sock: Socket, server) -> None:
         from ..ici.endpoint import split_device_attachment
         cntl.request_attachment, cntl.request_device_attachment = \
             split_device_attachment(meta, cntl.request_attachment, sock.id)
+    if meta.shm_offer or meta.shm_accept or meta.shm_release \
+            or meta.shm_desc:
+        # shm data plane: process ring negotiation/credit TLVs and
+        # resolve a request descriptor into a zero-copy view of the
+        # client's ring (the attachment never rode the frame)
+        from ..transport import shm_ring
+        view, handle, accept = shm_ring.server_on_request_meta(sock, meta)
+        cntl._shm_extra = accept
+        cntl._shm_handle = handle
+        if view is not None:
+            ab = IOBuf()
+            # file_ref lets this block spill via os.sendfile if user
+            # code forwards it onto a TCP byte lane (proxy shapes)
+            ab.append_user_data(view, file_ref=handle.file_ref)
+            cntl.request_attachment = ab
+        elif meta.shm_desc:
+            # the client believes the attachment lives at this
+            # descriptor; failing loudly beats handing user code an
+            # empty attachment
+            entry.status.on_responded(int(Errno.EREQUEST), 0)
+            server.on_request_out()
+            _send_error(sock, cid, Errno.EREQUEST,
+                        "unresolvable shm attachment descriptor",
+                        request_meta=meta)
+            return
     cntl.span = start_server_span(entry.status.full_name, meta,
                                   sock.remote_side)
     if cntl.span is not None:
@@ -262,8 +336,13 @@ def process_rpc_request(msg: RpcMessage, sock: Socket, server) -> None:
             cntl.finish(None)
             return
 
-    # payload → request object
-    raw = msg.payload.to_bytes()
+    # payload → request object.  Raw methods consume the payload as-is:
+    # a single-block buffer (the native ingest shape) passes through as
+    # a zero-copy view instead of a to_bytes materialization.
+    if entry.raw_fn is not None and not meta.compress_type:
+        raw, _ = msg.payload.as_contiguous()
+    else:
+        raw = msg.payload.to_bytes()
     if meta.compress_type:
         raw = compress_mod.decompress(raw, meta.compress_type)
         if raw is None:
@@ -284,7 +363,9 @@ def process_rpc_request(msg: RpcMessage, sock: Socket, server) -> None:
         # carrying controller-tier features): same (payload, attachment)
         # handler contract, adapted from the parsed message
         att_buf = cntl.request_attachment
-        att = memoryview(att_buf.to_bytes()) if len(att_buf) else None
+        # zero-copy attachment view: single-block buffers (native
+        # ingest, shm descriptors) materialize nothing here
+        att = att_buf.as_contiguous()[0] if len(att_buf) else None
         try:
             out = entry.raw_fn(memoryview(raw), att)
             resp, ratt = out if type(out) is tuple else (out, None)

@@ -204,7 +204,10 @@ def make_slim_handler(bridge, server, entry, svc: str, mth: str):
             span.response_size = len(response) + na_resp
             span.finish(0)
         if na_resp:
-            return response, ratt.to_bytes()
+            # zero-copy handoff: the engine pins the returned buffer
+            # (Py_buffer) for the writev — a single-block attachment
+            # (echoes, user views) materializes nothing here
+            return response, ratt.as_contiguous()[0]
         return response
 
     return slim

@@ -413,6 +413,14 @@ class NativeBridge:
         add(_PassiveDim(("reason",),
                         lambda c=cache: c.get()["fallbacks"],
                         name="native_engine_fallback_total"))
+        add(_PassiveDim(("stage",),
+                        lambda c=cache: c.get().get("data_plane_copies",
+                                                    {}),
+                        name="native_engine_data_plane_copies"))
+        add(_PassiveDim(("stage",),
+                        lambda c=cache: c.get().get(
+                            "data_plane_copy_bytes", {}),
+                        name="native_engine_data_plane_copy_bytes"))
         add(_PassiveDim(("lane",), lambda c=cache: {
             ln: d["handled"]
             for ln, d in c.get()["lanes"].items()},

@@ -114,7 +114,7 @@ class Socket:
         "_pending_acks", "_ack_flush_scheduled",
         "_inflight_ids", "_inflight_lock",
         "_reconnect_lock", "_last_reconnect_at",
-        "_cntl_tails",
+        "_cntl_tails", "shm",
     )
 
     # -- lifecycle ---------------------------------------------------------
@@ -173,6 +173,7 @@ class Socket:
         self._inflight_lock = threading.Lock()
         self._reconnect_lock = threading.Lock()
         self._last_reconnect_at = 0.0
+        self.shm = None                   # lazy ShmSockState (shm data plane)
 
     @staticmethod
     def create(options: SocketOptions) -> int:
@@ -400,6 +401,13 @@ class Socket:
     def release(self) -> None:
         """Destroy the socket id (returns slot to pool, bumps version)."""
         self.set_failed(Errno.ECLOSE, "released")
+        if self.shm is not None:
+            # this conn consumed peer-visible shm slots whose release
+            # TLVs will never arrive now: sweep by owner key
+            from . import shm_ring
+            shm_ring.on_socket_closed(("resp", self.id))
+            shm_ring.on_socket_closed(("req", self.id))
+            self.shm = None
         _pool.release(self.id)
 
     # -- ICI ack piggybacking ----------------------------------------------

@@ -113,7 +113,24 @@ def test_plain_raw_method_rides_engine_kind2(native_server):
 
 def test_native_large_attachment_zero_copy_path(native_server):
     """A 1MB attachment exercises the engine's direct-read completion
-    (the zero-copy response path referencing the request buffer)."""
+    (the zero-copy response path referencing the request buffer).
+    The shm data plane is gated off: this test pins the BYTE lane's
+    all-C++ path (an eligible shm attachment would ride a descriptor
+    through the Python dispatch instead — tests/test_data_plane.py
+    owns that lane)."""
+    from brpc_tpu.butil.flags import get_flag, set_flag
+    from brpc_tpu.transport import shm_ring  # noqa: F401 — defines the
+    #                          flag; set_flag on an undefined flag no-ops
+    saved = get_flag("rpc_shm_data_plane")
+    assert saved is not None
+    set_flag("rpc_shm_data_plane", False)
+    try:
+        _run_large_attachment_check(native_server)
+    finally:
+        set_flag("rpc_shm_data_plane", saved)
+
+
+def _run_large_attachment_check(native_server):
     srv, svc = native_server
     ch = _ch(srv)
     att = bytes(1 << 20)
