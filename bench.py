@@ -1094,6 +1094,185 @@ def bench_robustness(extra: dict) -> None:
         round(amplification(0.0), 3)
 
 
+def bench_overload_fairness(extra: dict) -> None:
+    """§12 overload plane: (a) multi-tenant fairness — paired
+    interleaved A/B with the hot tenant offering 10x its fair share,
+    fair admission ON vs OFF, measuring the victim tenant's goodput
+    and p99 ("one hot tenant cannot starve the rest"); (b)
+    auto_limit_converged — AutoLimiter sanity on a synthetic latency
+    curve (converges to a finite limit, shrinks under blow-up)."""
+    import threading
+
+    from brpc_tpu.butil.flags import set_flag, get_flag
+    from brpc_tpu.butil.status import Errno
+    from brpc_tpu.client import Channel, ChannelOptions, Controller
+    from brpc_tpu.server import Server, ServerOptions, Service
+
+    ELIMIT = int(Errno.ELIMIT)
+
+    class Work(Service):
+        def Spin(self, cntl, request):
+            time.sleep(0.05)            # 50ms of "handler work": hot
+            return b"done"              # calls block server-side, not
+    #                                     on this 1-core box's GIL
+
+    opts = ServerOptions()
+    # fiber-pool server: real concurrent handlers — the contention the
+    # tenant scheduler divides.  Capacity is sized WELL BELOW what one
+    # Python client can offer on this 1-core box (~400 calls/s): tenant
+    # capacity 2 at 50ms ≈ 40/s, so the hot tenant's ~300/s offered
+    # load is ~7-15x its 1-slot (~20/s) fair share.  Fairness OFF:
+    # FCFS on the server cap — a freed slot is re-taken by the hot
+    # stream within a few ms, and the victim's modest-rate arrivals
+    # mostly find it full.  Fairness ON: the hot tenant is held near
+    # its weighted share and the victim's guaranteed slot always
+    # admits.
+    opts.max_concurrency = 3
+    opts.tenant_fair_capacity = 2
+    # enough fiber workers that ADMISSION is the only queue: an
+    # admitted victim must run promptly, not sit behind hot handlers
+    # in the worker pool (that queue is what CoDel/limiters manage,
+    # not what this A/B measures)
+    opts.num_workers = 16
+    srv = Server(opts)
+    srv.add_service(Work(), name="OV")
+    assert srv.start("127.0.0.1:0") == 0
+    addr = str(srv.listen_endpoint)
+    HOT_WINDOW = 24                     # pipelined in-flight frames
+    stop_evt = threading.Event()
+
+    def hot_client():
+        """Raw pipelined byte-lane flood with the hot tenant's TLV: a
+        window of 24 frames, one fresh frame per response read.  A
+        rejected frame bounces back in ~1ms and is immediately
+        re-offered, so a freed slot is re-taken within ~1-2ms — real
+        oversubscription pressure without 20 Controller threads
+        burning this 1-core box's GIL against the victim's client."""
+        import socket as pysock
+        import struct
+        from brpc_tpu.protocol.meta import (TLV_CORRELATION, encode_tlv)
+
+        ep = srv.listen_endpoint
+        mtlv = (encode_tlv(4, b"OV") + encode_tlv(5, b"Spin")
+                + encode_tlv(22, b"hot"))
+
+        def frame(cid):
+            mb = TLV_CORRELATION + struct.pack("<Q", cid) + mtlv
+            return b"TRPC" + struct.pack("<II", len(mb), len(mb)) + mb
+
+        while not stop_evt.is_set():
+            try:
+                with pysock.create_connection(
+                        (str(ep.host), ep.port), timeout=5) as c:
+                    c.settimeout(5)
+                    cid = 1
+                    c.sendall(b"".join(frame(cid + i)
+                                       for i in range(HOT_WINDOW)))
+                    cid += HOT_WINDOW
+                    buf = b""
+                    while not stop_evt.is_set():
+                        while True:
+                            if len(buf) >= 12:
+                                (bl,) = struct.unpack_from("<I", buf, 4)
+                                if len(buf) >= 12 + bl:
+                                    break
+                            buf += c.recv(65536)
+                        (bl,) = struct.unpack_from("<I", buf, 4)
+                        buf = buf[12 + bl:]
+                        c.sendall(frame(cid))
+                        cid += 1
+            except OSError:
+                if not stop_evt.is_set():
+                    time.sleep(0.05)
+
+    def victim_window(secs: float):
+        """Serial victim at its own modest pace (~40/s offered — it IS
+        the well-behaved tenant; hammering retries would just measure
+        a GIL race against the hot client's offer loop): returns
+        (goodput_qps, p99_ms of the successful calls).  With fairness
+        off its goodput is the probability a FCFS slot happens to be
+        free at its arrival instant; with fairness on its guaranteed
+        share admits it regardless of the hot tenant's pressure."""
+        co = ChannelOptions()
+        co.timeout_ms = 2000
+        co.max_retry = 0
+        co.connection_type = "pooled"
+        co.tenant = "victim"
+        ch = Channel(co)
+        ch.init(addr)
+        good, lats = 0, []
+        t_end = time.perf_counter() + secs
+        while time.perf_counter() < t_end:
+            cntl = Controller()
+            cntl.timeout_ms = 2000
+            t0 = time.perf_counter()
+            c = ch.call_method("OV.Spin", b"", cntl=cntl)
+            if not c.failed:
+                good += 1
+                lats.append((time.perf_counter() - t0) * 1e3)
+            time.sleep(0.015)
+        lats.sort()
+        p99 = lats[int(len(lats) * 0.99)] if lats else None
+        return good / secs, p99
+
+    prev_fair = get_flag("enable_fair_admission", True)
+    hot = threading.Thread(target=hot_client, daemon=True)
+    try:
+        hot.start()
+        time.sleep(0.3)                 # hot load reaches steady state
+        on_q, off_q, on_p, off_p = [], [], [], []
+        for r in range(6):              # interleaved, alternating order
+            arms = [(True, on_q, on_p), (False, off_q, off_p)]
+            if r % 2:
+                arms.reverse()
+            for fair, q_acc, p_acc in arms:
+                set_flag("enable_fair_admission", fair)
+                time.sleep(0.15)        # in-flight mix turns over
+                q, p99 = victim_window(1.2)
+                q_acc.append(q)
+                if p99 is not None:
+                    p_acc.append(p99)
+    finally:
+        set_flag("enable_fair_admission", prev_fair)
+        stop_evt.set()
+        hot.join(5)
+        srv.stop()
+    on_med = statistics.median(on_q)
+    off_med = statistics.median(off_q)
+    extra["overload_fairness_victim_qps_fair_on"] = round(on_med, 1)
+    extra["overload_fairness_victim_qps_fair_off"] = round(off_med, 1)
+    extra["overload_fairness_victim_goodput"] = \
+        round(on_med / max(off_med, 0.1), 3)
+    if on_p:
+        extra["overload_fairness_victim_p99_ms"] = \
+            round(statistics.median(on_p), 2)
+    if off_p:
+        extra["overload_fairness_victim_p99_ms_fair_off"] = \
+            round(statistics.median(off_p), 2)
+
+    # (b) AutoLimiter convergence sanity: synthetic steady curve then a
+    # 20x blow-up — converged finite limit that shrinks under overload
+    from brpc_tpu.policy.concurrency_limiter import AutoLimiter
+    lim = AutoLimiter(min_limit=2, sample_window_s=0.01,
+                      min_sample_count=10)
+
+    def feed(n, lat_us, batches):
+        for _ in range(batches):
+            for _ in range(n):
+                lim.on_responded(0, lat_us)
+            time.sleep(0.012)
+            lim.on_responded(0, lat_us)
+
+    feed(25, 2_000, 10)
+    steady = lim.max_concurrency()
+    feed(25, 40_000, 10)
+    shrunk = lim.max_concurrency()
+    extra["auto_limit_steady"] = steady
+    extra["auto_limit_overloaded"] = shrunk
+    extra["auto_limit_converged"] = \
+        1.0 if (2 <= steady <= 256 and shrunk < steady) else 0.0
+
+
 def bench_grpc(extra: dict) -> None:
     """gRPC unary 1KB echo: a real grpcio client against our server ON
     THE NATIVE PORT (h2 rides the engine's passthrough lane — native
@@ -1730,6 +1909,7 @@ def main() -> None:
                      ("http", bench_http),
                      ("trace", bench_trace),
                      ("robustness", bench_robustness),
+                     ("overload_fairness", bench_overload_fairness),
                      ("grpc", bench_grpc)):
         if not budget_left():
             extra[f"{name}_skipped"] = "bench budget spent"

@@ -26,7 +26,7 @@ class ChannelOptions:
 
     __slots__ = ("timeout_ms", "connect_timeout_ms", "max_retry",
                  "backup_request_ms", "connection_type", "protocol",
-                 "request_compress_type", "auth_data",
+                 "request_compress_type", "auth_data", "tenant",
                  "enable_circuit_breaker",
                  "retry_budget_max", "retry_budget_ratio",
                  "retry_backoff_ms", "retry_backoff_max_ms",
@@ -41,6 +41,12 @@ class ChannelOptions:
         self.protocol = "tpu_std"
         self.request_compress_type = CompressType.NONE
         self.auth_data = b""
+        # overload plane: this channel's tenant identity (API key /
+        # team name).  Stamped on every request — tpu_std meta TLV 22,
+        # the x-tenant header on HTTP/1.1 and gRPC/h2 — and keyed by
+        # the server's per-tenant weighted fair admission, so one hot
+        # tenant degrades alone instead of starving the rest.
+        self.tenant = ""
         self.enable_circuit_breaker = False
         # retry hardening (deadline plane): every retry AND backup
         # attempt on this channel draws from one gRPC-style token
@@ -198,7 +204,8 @@ class Channel:
             tlv = self._method_tlvs.get(method_full)
             if tlv is None:
                 tlv = self._method_tlvs[method_full] = \
-                    fast_call.method_tlv(method_full)
+                    fast_call.method_tlv(method_full,
+                                         self.options.tenant)
             try:
                 fast_call.run(self, c, method_full, request, response_type,
                               tlv)
@@ -263,6 +270,11 @@ class Channel:
             from ..rpcz import format_traceparent
             metadata = [("traceparent",
                          format_traceparent(c.trace_id, c.span_id))]
+        if self.options.tenant:
+            # tenant identity: x-tenant over HPACK is TLV 22's gRPC
+            # spelling (overload plane fair admission)
+            metadata = (metadata or []) + [("x-tenant",
+                                            self.options.tenant)]
         begin = monotonic_us()
         status, message, body = grpc_connection(remote).unary_call(
             f"/{svc}/{mth}", payload, timeout_s=timeout_s,
@@ -339,7 +351,7 @@ class Channel:
         tlv = self._method_tlvs.get(method_full)
         if tlv is None:
             tlv = self._method_tlvs[method_full] = \
-                fast_call.method_tlv(method_full)
+                fast_call.method_tlv(method_full, self.options.tenant)
         if not self._initialized:
             raise RpcError(2001, "channel not initialized")
         if self.options.protocol != "tpu_std" or self.ssl_ctx() is not None:

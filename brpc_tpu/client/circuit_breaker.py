@@ -12,6 +12,7 @@ import time
 from typing import Dict, Optional
 
 from ..butil.endpoint import EndPoint
+from ..butil.status import Errno
 
 # window/threshold shapes mirror the reference defaults
 _SHORT_ALPHA = 0.3        # fast window EMA
@@ -22,6 +23,14 @@ _MIN_SAMPLES = 8
 _BASE_ISOLATION_S = 0.1
 _MAX_ISOLATION_S = 30.0
 _DOUBLE_WINDOW_S = 30.0   # re-trip within this doubles the duration
+# overload plane: an ELIMIT bounce is the server WORKING AS DESIGNED
+# under overload — health feedback at reduced weight keeps a merely
+# busy (not broken) replica from tripping isolation and shrinking the
+# healthy pool exactly when capacity is scarcest; sustained admission
+# rejection still trips eventually (0.3 x rate crosses the long
+# window's 0.2 threshold)
+_ELIMIT_WEIGHT = 0.3
+_ELIMIT = int(Errno.ELIMIT)
 
 
 class _NodeBreaker:
@@ -37,8 +46,10 @@ class _NodeBreaker:
         self.last_trip = 0.0
         self.lock = threading.Lock()
 
-    def on_call(self, error: bool) -> None:
-        e = 1.0 if error else 0.0
+    def on_call(self, error) -> None:
+        """``error``: bool, or a float error weight in [0, 1] (the
+        overload plane feeds ELIMIT bounces at reduced weight)."""
+        e = float(error)
         with self.lock:
             self.samples += 1
             self.short_ema += (e - self.short_ema) * _SHORT_ALPHA
@@ -83,7 +94,13 @@ class CircuitBreakerMap:
                 latency_us: float) -> None:
         if not self.enabled:
             return
-        self._node(ep).on_call(error_code != 0)
+        if error_code == 0:
+            e = 0.0
+        elif error_code == _ELIMIT:
+            e = _ELIMIT_WEIGHT      # busy, not broken: reduced weight
+        else:
+            e = 1.0
+        self._node(ep).on_call(e)
 
     def isolated(self, ep: EndPoint) -> bool:
         if not self.enabled:

@@ -45,9 +45,19 @@ _EV_CREATE_LOCK = threading.Lock()
 # /root/reference/src/brpc/retry_policy.cpp)
 _RETRIABLE = {int(Errno.EFAILEDSOCKET), int(Errno.EEOF),
               int(Errno.ELOGOFF), int(Errno.EUNUSED)}
+_ELIMIT = int(Errno.ELIMIT)
 
 
 def default_retry_policy(cntl: "Controller", error_code: int) -> bool:
+    if error_code == _ELIMIT:
+        # brpc-style fail-fast (≈ -server_fail_fast consumer side): an
+        # overloaded server answered ELIMIT in microseconds precisely
+        # so the caller can try a DIFFERENT replica immediately — so
+        # retry only when a load balancer can actually pick another
+        # one (the failed server lands in excluded_servers; the retry
+        # is still token-bucket bounded and skips backoff)
+        ch = getattr(cntl, "_channel", None)
+        return ch is not None and ch.load_balancer is not None
     return error_code in _RETRIABLE
 
 
@@ -413,6 +423,11 @@ class Controller(LazyAttachmentsMixin):
                 from ..rpcz import format_traceparent
                 headers.append(("traceparent", format_traceparent(
                     self.trace_id, self.span_id)))
+            if self._channel.options.tenant:
+                # tenant identity: the x-tenant header is TLV 22's
+                # HTTP/1.1 spelling (overload plane fair admission)
+                headers.append(("x-tenant",
+                                self._channel.options.tenant))
             frame = build_request("POST", f"/{svc}/{mth}", body=body,
                                   host=str(remote),
                                   headers=headers or None)
@@ -438,6 +453,10 @@ class Controller(LazyAttachmentsMixin):
             # credentials ride every frame; the server verifies on the
             # connection's first message (≈ Protocol::verify)
             meta.auth_data = self._channel.options.auth_data
+        if self._channel is not None and self._channel.options.tenant:
+            # tenant identity (TLV 22): the overload plane's per-tenant
+            # fair-admission key, stamped on every attempt
+            meta.tenant = self._channel.options.tenant.encode()
         if self._stream_to_create is not None:
             meta.stream_id = self._stream_to_create.id
             meta.stream_window = \
@@ -553,7 +572,10 @@ class Controller(LazyAttachmentsMixin):
             self.retried_count = self._nretry
             self._live_versions.add(self._nretry)
             delay_ms = 0.0
-            if ch is not None:
+            if ch is not None and code != _ELIMIT:
+                # fail-fast: an ELIMIT bounce retries IMMEDIATELY on a
+                # different replica — backing off would waste exactly
+                # the time the server's microsecond rejection saved
                 delay_ms = _backoff_ms(ch.options.retry_backoff_ms,
                                        self._nretry,
                                        ch.options.retry_backoff_max_ms)

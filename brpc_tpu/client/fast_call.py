@@ -38,7 +38,7 @@ from ..transport.socket import Socket
 from ..transport.socket_map import (pooled_socket, return_pooled_socket,
                                     short_socket)
 
-from ..protocol.meta import (RpcMeta, TAG_AUTH, TAG_ICI_CONN,
+from ..protocol.meta import (RpcMeta, TAG_AUTH, TAG_ICI_CONN, TAG_TENANT,
                              TAG_ICI_DESC,
                              TAG_ICI_DOMAIN, TAG_METHOD,
                              TAG_SERVICE, TLV_ATTACHMENT, TLV_CORRELATION,
@@ -152,11 +152,16 @@ def _reserve_cids(n: int) -> int:
     return base
 
 
-def method_tlv(method_full: str) -> bytes:
-    """Pre-encoded service+method TLV bytes (cached on the Channel)."""
+def method_tlv(method_full: str, tenant: str = "") -> bytes:
+    """Pre-encoded service+method (+ tenant identity, TLV 22) bytes
+    (cached on the Channel) — tenant riding the cached prefix means the
+    overload plane's fair-admission key costs nothing per call."""
     svc, _, mth = method_full.rpartition(".")
-    return (encode_tlv(TAG_SERVICE, svc.encode())
-            + encode_tlv(TAG_METHOD, mth.encode()))
+    out = (encode_tlv(TAG_SERVICE, svc.encode())
+           + encode_tlv(TAG_METHOD, mth.encode()))
+    if tenant:
+        out += encode_tlv(TAG_TENANT, tenant.encode())
+    return out
 
 
 def eligible(channel, cntl) -> bool:
@@ -273,6 +278,8 @@ def run(channel, cntl, method_full: str, request: Any,
     latency, LB feedback) and sets ``cntl._ended``.  Raises TypeError
     for unserializable requests (caller maps it to EREQUEST)."""
     opts = channel.options
+    cntl._channel = channel      # retry policies (ELIMIT fail-fast)
+    #                              consult the channel's LB
     if cntl.timeout_ms is None:
         cntl.timeout_ms = opts.timeout_ms
     # deadline inheritance: inside a deadline'd handler the downstream
@@ -346,8 +353,12 @@ def run(channel, cntl, method_full: str, request: Any,
                 return False
             nretry += 1
             cntl.retried_count = nretry
-            delay_ms = _backoff_ms(opts.retry_backoff_ms, nretry,
-                                   opts.retry_backoff_max_ms)
+            # fail-fast: ELIMIT bounces retry immediately on another
+            # replica (excluded_servers steers the LB away) — no
+            # backoff, that's the whole point of the fast rejection
+            delay_ms = 0.0 if code == int(Errno.ELIMIT) else \
+                _backoff_ms(opts.retry_backoff_ms, nretry,
+                            opts.retry_backoff_max_ms)
             if delay_ms > 0:
                 if deadline_us is not None:
                     delay_ms = min(delay_ms, max(
@@ -400,8 +411,11 @@ def run(channel, cntl, method_full: str, request: Any,
                 # identical wire content to the classic build below,
                 # cached per socket+method so steady-state calls reuse
                 # the encoded bytes
+                # keyed on (method, tenant): the pinned socket is
+                # shared across channels, whose tenant TLVs differ
+                tail_key = (method_full, opts.tenant)
                 tails = getattr(psock, "_cntl_tails", None)
-                tail = tails.get(method_full) if tails is not None \
+                tail = tails.get(tail_key) if tails is not None \
                     else None
                 if tail is None:
                     tail = method_tlvs
@@ -411,7 +425,7 @@ def run(channel, cntl, method_full: str, request: Any,
                                              _conn_nonce_of(psock)))
                     if tails is None:
                         tails = psock._cntl_tails = {}
-                    tails[method_full] = tail
+                    tails[tail_key] = tail
                 if cntl.trace_id:
                     # per-call trace TLVs after the cached tail (never
                     # cached: ids differ per call) — the engine writes
@@ -933,7 +947,7 @@ def run_scatter(branches, timeout_ms: Optional[int]) -> bool:
         tlv = channel._method_tlvs.get(method_full)
         if tlv is None:
             tlv = channel._method_tlvs[method_full] = \
-                method_tlv(method_full)
+                method_tlv(method_full, channel.options.tenant)
         cid = _next_cid()
         mb = _CID_TAG + struct.pack("<Q", cid) + tlv
         if cntl.timeout_ms and cntl.timeout_ms > 0:
@@ -1051,19 +1065,23 @@ def _scatter_native(branches, timeout_ms: Optional[int], nat) -> bool:
     items = []
     timeout_s = 0.001
     for channel, cntl, sock, sid, method_full, request, rtype in screened:
+        # the socket tail cache keys on (method, tenant): sockets are
+        # shared across channels, and two channels naming different
+        # tenants must never reuse each other's cached TLV prefix
+        tail_key = (method_full, channel.options.tenant)
         tails = getattr(sock, "_cntl_tails", None)
-        tail = tails.get(method_full) if tails is not None else None
+        tail = tails.get(tail_key) if tails is not None else None
         if tail is None:
             tail = channel._method_tlvs.get(method_full)
             if tail is None:
                 tail = channel._method_tlvs[method_full] = \
-                    method_tlv(method_full)
+                    method_tlv(method_full, channel.options.tenant)
             if domain:
                 tail = (tail + _domain_tlv(domain)
                         + encode_tlv(TAG_ICI_CONN, _conn_nonce_of(sock)))
             if tails is None:
                 tails = sock._cntl_tails = {}
-            tails[method_full] = tail
+            tails[tail_key] = tail
         if cntl.trace_id:
             # per-branch trace TLVs after the cached tail (never
             # cached: each branch's span id is unique) — scatter_call
@@ -1425,7 +1443,13 @@ def run_raw(channel, method_full: str, payload, attachment=b"",
         return _full_path()
     tlv = channel._method_tlvs.get(method_full)
     if tlv is None:
-        tlv = channel._method_tlvs[method_full] = method_tlv(method_full)
+        # include the tenant TLV like every other populator of this
+        # shared method-keyed cache: whichever lane caches first pins
+        # the prefix for all of them, and a tenant-less run_raw entry
+        # would silently strip TLV 22 from later call_method traffic
+        # (the raw server kinds tolerate-and-ignore the tag)
+        tlv = channel._method_tlvs[method_full] = \
+            method_tlv(method_full, opts.tenant)
     sid, sock = _raw_socket(remote)
     if sock is None:
         # connect failures are health signal too: without this feed a

@@ -403,10 +403,11 @@ static inline void dp_copy(Loop* lp, DpStage stage, size_t n) {
 // engine scans the meta, batches eligible requests, and enters Python
 // ONCE per read burst calling
 // handler(payload, att, cid, conn_id, dom, nonce, recv_ns, trace,
-// timeout_ms) —
+// timeout_ms, tenant) —
 // trace is None or the request's (trace_id, span_id, parent_id);
 // timeout_ms is TLV 13's remaining budget (None = absent; 0 =
-// expired at arrival) —
+// expired at arrival); tenant is None or TLV 22's identity bytes
+// (per-tenant fair admission) —
 // admission,
 // MethodStatus accounting and rpcz span sampling live in that shim
 // (server/slim_dispatch.py).  A buffer return is framed
@@ -469,6 +470,10 @@ struct PyRawItem {
   // arrival) from an absent deadline
   uint32_t timeout_ms = 0;
   bool timeout_present = false;
+  // kind 3: tenant identity bytes (TLV 22) — the shim's admission
+  // stage keys per-tenant fair admission off it (overload plane)
+  const char* ten = nullptr;
+  uint32_t ten_len = 0;
   // kind-4 slim-HTTP fields (hroute != nullptr selects the lane)
   HttpRoute* hroute = nullptr;
   const char* query = nullptr;  // bytes after '?' in the request target
@@ -481,6 +486,8 @@ struct PyRawItem {
   uint32_t tplen = 0;
   const char* dl = nullptr;     // x-deadline-ms header value (raw) —
   uint32_t dllen = 0;           // the shim sheds queue-expired requests
+  const char* xt = nullptr;     // x-tenant header value (raw) — the
+  uint32_t xtlen = 0;           // shim's fair-admission tenant key
   // telemetry: CLOCK_MONOTONIC ns at frame parse (comparable with
   // Python's time.monotonic_ns — the shims backdate rpcz spans with it)
   int64_t t_parse = 0;
@@ -714,6 +721,11 @@ struct MetaScan {
   // negotiation and descriptor resolution live in Python — the frame
   // takes the classic path under the NAMED rpc_shm_lane reason
   bool shm = false;
+  // tag 22 (tenant identity): the SLIM lane forwards it to the shim's
+  // admission stage (per-tenant fair admission, overload plane); raw
+  // kinds ignore it — same lane contract as the deadline tag 13
+  const char* ten = nullptr;
+  uint32_t ten_len = 0;
 };
 
 // Mirror of native_bridge._scan_request_meta: collect cid/att/svc/mth
@@ -778,6 +790,10 @@ static bool scan_request_meta(const char* p, size_t len, MetaScan* out) {
       case 21:
         out->shm = true;    // shm data plane: classic path, named
         break;              // reason (ring state lives in Python)
+      case 22:
+        out->ten = p + off;  // tenant identity: enforced by the kind-3
+        out->ten_len = ln;   // shim's admission stage; raw kinds ignore
+        break;
       default:
         return false;       // controller-tier tag: Python path
     }
@@ -906,14 +922,18 @@ static void http_slim_item(Loop* lp, Conn* c, PyRawItem& it) {
       ? PyBytes_FromStringAndSize(it.tp, it.tplen) : nullptr;
   PyObject* dl = it.dl
       ? PyBytes_FromStringAndSize(it.dl, it.dllen) : nullptr;
+  PyObject* xt = it.xt
+      ? PyBytes_FromStringAndSize(it.xt, it.xtlen) : nullptr;
   PyObject* r = nullptr;
   if (body && conn && rcv && (!it.query || q) && (!it.ctype || ct)
-      && (!it.attsz || asz) && (!it.tp || tp) && (!it.dl || dl))
+      && (!it.attsz || asz) && (!it.tp || tp) && (!it.dl || dl)
+      && (!it.xt || xt))
     r = PyObject_CallFunctionObjArgs(it.hroute->handler, body,
                                      q ? q : Py_None, ct ? ct : Py_None,
                                      asz ? asz : Py_None, conn, rcv,
                                      tp ? tp : Py_None,
-                                     dl ? dl : Py_None, nullptr);
+                                     dl ? dl : Py_None,
+                                     xt ? xt : Py_None, nullptr);
   Py_XDECREF(body);
   Py_XDECREF(q);
   Py_XDECREF(ct);
@@ -922,6 +942,7 @@ static void http_slim_item(Loop* lp, Conn* c, PyRawItem& it) {
   Py_XDECREF(rcv);
   Py_XDECREF(tp);
   Py_XDECREF(dl);
+  Py_XDECREF(xt);
   if (!r) {
     // shim raised (or OOM building args): answer a plain 500 with the
     // exception text, keeping the keep-alive conn in sync
@@ -1032,16 +1053,22 @@ static void raw_slim_item(Loop* lp, Conn* c, PyRawItem& it) {
       // sheds queue-expired requests before user code runs
       PyObject* tmo = it.timeout_present
           ? PyLong_FromUnsignedLong(it.timeout_ms) : nullptr;
+      // tenant identity (TLV 22): the shim's admission stage keys
+      // per-tenant fair admission off it — None on the common
+      // untenanted path (no per-call bytes churn there)
+      PyObject* ten = it.ten_len
+          ? PyBytes_FromStringAndSize(it.ten, it.ten_len) : nullptr;
       if (pb && (it.att == 0 || ab) && cid && conn && rcv
           && (!it.timeout_present || tmo)
           && (it.dom_len == 0 || dom) && (it.conn_len == 0 || nonce)
-          && (it.trace_id == 0 || tr))
+          && (it.trace_id == 0 || tr) && (it.ten_len == 0 || ten))
         r = PyObject_CallFunctionObjArgs(it.m->handler, pb,
                                          ab ? ab : Py_None, cid, conn,
                                          dom ? dom : Py_None,
                                          nonce ? nonce : Py_None,
                                          rcv, tr ? tr : Py_None,
-                                         tmo ? tmo : Py_None, nullptr);
+                                         tmo ? tmo : Py_None,
+                                         ten ? ten : Py_None, nullptr);
       Py_XDECREF(pb);
       Py_XDECREF(ab);
       Py_XDECREF(cid);
@@ -1051,6 +1078,7 @@ static void raw_slim_item(Loop* lp, Conn* c, PyRawItem& it) {
       Py_XDECREF(rcv);
       Py_XDECREF(tr);
       Py_XDECREF(tmo);
+      Py_XDECREF(ten);
       if (r == Py_None) {
         // handled out-of-band: the shim completed (or will complete)
         // the RPC through the classic Python send path
@@ -1259,6 +1287,8 @@ static bool native_try_handle(EngineImpl* eng, Loop* lp, Conn* c,
       pi.parent_id = s.parent_id;
       pi.timeout_ms = s.timeout_ms;
       pi.timeout_present = s.timeout_present;
+      pi.ten = s.ten;
+      pi.ten_len = s.ten_len;
       pi.t_parse = now_ns();
       batch->push_back(pi);
       break;
@@ -1658,6 +1688,8 @@ static bool http_slim_match(EngineImpl* eng, Loop* lp, const char* p,
   uint32_t tplen = 0;
   const char* dl = nullptr;
   uint32_t dllen = 0;
+  const char* xt = nullptr;
+  uint32_t xtlen = 0;
   const char* line = nl + 1;
   while (line < he) {
     const char* leol =
@@ -1681,6 +1713,12 @@ static bool http_slim_match(EngineImpl* eng, Loop* lp, const char* p,
       case 7:
         if (strncasecmp(line, "upgrade", 7) == 0)
           return route_fb(FB_HTTP_UPGRADE, RFB_UPGRADE);
+        break;
+      case 8:
+        if (strncasecmp(line, "x-tenant", 8) == 0) {
+          xt = v;                               // tenant identity —
+          xtlen = (uint32_t)vlen;               // the shim's admission
+        }                                       // stage keys off it
         break;
       case 10:
         if (strncasecmp(line, "connection", 10) == 0) {
@@ -1737,6 +1775,8 @@ static bool http_slim_match(EngineImpl* eng, Loop* lp, const char* p,
   out->tplen = tplen;
   out->dl = dl;
   out->dllen = dllen;
+  out->xt = xt;
+  out->xtlen = xtlen;
   return true;
 }
 
@@ -2625,7 +2665,7 @@ static PyObject* Engine_set_native_dispatch(EngineObj* self,
 // SLIM HTTP LANE (kind 4): eligible HTTP/1.1 requests matching
 // METHOD+path are parsed in C++, burst-batched, and dispatched to the
 // shim as handler(body, query, content_type, att_size, conn_id,
-// recv_ns, traceparent, x_deadline_ms); a
+// recv_ns, traceparent, x_deadline_ms, x_tenant); a
 // (status, header_block, body) return is serialized natively, bytes
 // are appended verbatim (pre-built classic escalations), None means
 // the shim completed out-of-band.

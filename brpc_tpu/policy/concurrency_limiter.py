@@ -22,6 +22,8 @@ class ConcurrencyLimiter:
     """Plugin interface: max_concurrency() read per-request;
     on_responded(error_code, latency_us) feeds the controller."""
 
+    kind = "custom"          # portal label ("auto"/"timeout"/"constant")
+
     def max_concurrency(self) -> int:
         raise NotImplementedError
 
@@ -30,6 +32,8 @@ class ConcurrencyLimiter:
 
 
 class ConstantLimiter(ConcurrencyLimiter):
+    kind = "constant"
+
     def __init__(self, limit: int):
         self._limit = int(limit)
 
@@ -41,6 +45,8 @@ class AutoLimiter(ConcurrencyLimiter):
     """Adaptive limit ≈ auto_concurrency_limiter.h: sampling windows of
     (qps, latency); min-latency EMA as the no-load estimate; limit =
     peak_qps × min_latency × (1 + alpha) with shrink on latency blow-up."""
+
+    kind = "auto"
 
     def __init__(self,
                  min_limit: int = 8,
@@ -83,10 +89,30 @@ class AutoLimiter(ConcurrencyLimiter):
                 self._peak_qps = max(self._peak_qps * 0.98, qps)
                 if self._nolat_ema is None or avg_lat < self._nolat_ema:
                     self._nolat_ema = avg_lat
-                else:   # slow drift up so the estimate can recover
+                elif avg_lat <= self._nolat_ema * (1.0 + self._alpha):
+                    # quiet window: drift up slowly so the estimate can
+                    # track a genuinely shifted baseline.  An OVERLOADED
+                    # window must NOT meaningfully feed the no-load
+                    # estimate — that drift would launder queueing delay
+                    # into "normal" and the limit would never shrink
+                    # under sustained overload (the reference
+                    # re-measures min latency in non-overloaded windows
+                    # for the same reason)
                     self._nolat_ema += (avg_lat - self._nolat_ema) * 0.02
+                else:
+                    # overloaded window: a 20x-slower RE-MEASUREMENT
+                    # path so the estimate is not frozen forever when
+                    # the baseline genuinely shifted past (1+alpha)x
+                    # (slower dependency, not queueing) — a real shift
+                    # re-learns over ~hundreds of windows, while
+                    # transient overload moves the estimate by well
+                    # under a percent before the shrink drains it
+                    self._nolat_ema += (avg_lat - self._nolat_ema) * 0.001
                 base = self._peak_qps * (self._nolat_ema / 1e6)
                 if avg_lat > self._nolat_ema * (1.0 + self._alpha):
+                    # overload: shrink — with peak_qps decaying 2% per
+                    # window, sustained overload keeps ratcheting the
+                    # limit down until latency returns to baseline
                     new_limit = base * (1.0 - self._alpha / 2)
                 else:
                     new_limit = base * (1.0 + self._alpha)
@@ -107,6 +133,8 @@ class TimeoutLimiter(ConcurrencyLimiter):
     (failures counted at the full timeout) drives the bound, so a slow
     backend sheds load it could never answer in time instead of queueing
     doomed requests."""
+
+    kind = "timeout"
 
     def __init__(self, timeout_ms: float = 500.0,
                  min_limit: int = 2, max_limit: int = 4096,

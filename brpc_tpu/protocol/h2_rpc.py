@@ -330,8 +330,12 @@ class H2ServerConn:
                         live._on_rst()
         for stream, ctx in spawn_live:
             from ..fiber import runtime as fiber_runtime
+            # arrival anchor = now (the headers completed in THIS feed
+            # batch): fiber queueing between here and admission counts
+            # toward the CoDel sojourn
             fiber_runtime.spawn(_run_streaming_handler, stream, ctx[0],
                                 ctx[1], self._sock, self.server,
+                                monotonic_us(),
                                 name="grpc_stream")
 
     def _streaming_entry(self, headers):
@@ -433,23 +437,30 @@ def parse(source: IOBuf, sock, read_eof: bool, arg) -> ParseResult:
 
 
 def _run_streaming_handler(stream: GrpcServerStream, entry, headers,
-                           sock, server) -> None:
+                           sock, server, recv_us=None) -> None:
     """Fiber body for a @grpc_streaming method: admission, handler,
     trailers.  The handler sees (cntl, stream)."""
     from ..server.controller import ServerController
     from ..protocol.meta import RpcMeta
     from ..protocol.tpu_std import serialize_payload
 
-    if not server.on_request_in():
-        stream._finish(8, "server max_concurrency")
-        return
-    if not entry.status.on_requested():
-        server.on_request_out()
-        stream._finish(8, "method max_concurrency")
+    from ..server.admission import admit as _admit
+    # overload plane: the shared admission stage (tenant from the
+    # x-tenant HPACK header); rejections are RESOURCE_EXHAUSTED
+    tenant_h = None
+    for k, v in headers:
+        if k == "x-tenant":
+            tenant_h = v
+            break
+    rej = _admit(server, entry, "grpc", tenant_h, recv_us or None)
+    if rej is not None:
+        stream._finish(8, rej.text)
         return
     meta = RpcMeta()
     meta.service_name = entry.status.full_name.rsplit(".", 1)[0]
     meta.method_name = entry.method_name
+    if tenant_h:
+        meta.tenant = tenant_h.encode("utf-8", "replace")
     begin = monotonic_us()
     cntl = ServerController(meta, sock.remote_side, sock.id,
                             send_response=lambda c, r: None)
@@ -462,8 +473,11 @@ def _run_streaming_handler(stream: GrpcServerStream, entry, headers,
                       entry.status.full_name)
         cntl.set_failed(Errno.EINTERNAL, f"{type(e).__name__}: {e}")
         ret = None
-    entry.status.on_responded(cntl.error_code, monotonic_us() - begin)
-    server.on_request_out()
+    latency_us = monotonic_us() - begin
+    entry.status.on_responded(cntl.error_code, latency_us)
+    server.on_request_out(tenant=meta.tenant,
+                          error_code=cntl.error_code,
+                          latency_us=latency_us)
     if cntl.failed:
         stream._finish(grpc_status_of(cntl.error_code), cntl.error_text)
         return
@@ -528,16 +542,21 @@ def _process_grpc(req: H2Request, sock, server) -> None:
         with req.conn.lock:
             req.conn.live[req.stream_id] = stream
         stream._on_data(req.body, True)
-        _run_streaming_handler(stream, entry, req.headers, sock, server)
+        _run_streaming_handler(stream, entry, req.headers, sock, server,
+                               recv_us=getattr(req, "recv_us", 0))
         return
-    if not server.on_request_in():
+    from ..server.admission import admit as _admit
+    # overload plane: the shared admission stage — server cap, adaptive
+    # method cap, CoDel sojourn (anchored at stream assembly), tenant
+    # fair admission; rejections answer grpc-status 8
+    # RESOURCE_EXHAUSTED (the ELIMIT row of the status map) before the
+    # body is even unpacked
+    tenant_h = req.header("x-tenant") or None
+    rej = _admit(server, entry, "grpc", tenant_h,
+                 getattr(req, "recv_us", 0) or None)
+    if rej is not None:
         req.conn.send_grpc_response(sock, req.stream_id, None, 8,
-                                    "server max_concurrency")
-        return
-    if not entry.status.on_requested():
-        server.on_request_out()
-        req.conn.send_grpc_response(sock, req.stream_id, None, 8,
-                                    "method max_concurrency")
+                                    rej.text)
         return
 
     buf = bytearray(req.body)
@@ -545,7 +564,7 @@ def _process_grpc(req: H2Request, sock, server) -> None:
         messages = unpack_grpc_messages(buf)
     except H2Error as e:
         entry.status.on_responded(int(Errno.EREQUEST), 0)
-        server.on_request_out()
+        server.on_request_out(tenant=tenant_h or b"")
         req.conn.send_grpc_response(sock, req.stream_id, None, 12, str(e))
         return
     payload = messages[0] if messages else b""
@@ -553,6 +572,8 @@ def _process_grpc(req: H2Request, sock, server) -> None:
     meta = RpcMeta()
     meta.service_name = entry.status.full_name.rsplit(".", 1)[0]
     meta.method_name = entry.method_name
+    if tenant_h:
+        meta.tenant = tenant_h.encode("utf-8", "replace")
     tp_header = req.header("traceparent")
     if tp_header:
         from ..rpcz import parse_traceparent
@@ -572,7 +593,9 @@ def _process_grpc(req: H2Request, sock, server) -> None:
     def send(cntl: ServerController, response) -> None:
         latency_us = monotonic_us() - cntl.begin_time_us
         entry.status.on_responded(cntl.error_code, latency_us)
-        server.on_request_out()
+        server.on_request_out(tenant=meta.tenant,
+                              error_code=cntl.error_code,
+                              latency_us=latency_us)
         span = cntl.span
         if cntl.failed:
             if span is not None:

@@ -43,6 +43,12 @@ _T_SHM_ACCEPT = 19      # bytes: ring id the sender has mapped (confirm)
 _T_SHM_RELEASE = 20     # bytes: slot credits returned to the ring owner
 _T_SHM_DESC = 21        # bytes: (ring_id, slot, offset, len) — the
                         # attachment rides shared memory, not the frame
+_T_TENANT = 22          # utf-8: caller's tenant identity (API key /
+                        # ChannelOptions.tenant) — the overload plane's
+                        # per-tenant fair-admission key.  Tolerated by
+                        # every native lane (raw kinds ignore it, the
+                        # slim shims enforce it — same contract as the
+                        # remaining-deadline tag 13)
 
 
 class CompressType:
@@ -74,6 +80,7 @@ TAG_SHM_OFFER = _T_SHM_OFFER
 TAG_SHM_ACCEPT = _T_SHM_ACCEPT
 TAG_SHM_RELEASE = _T_SHM_RELEASE
 TAG_SHM_DESC = _T_SHM_DESC
+TAG_TENANT = _T_TENANT
 
 
 class RpcMeta:
@@ -82,7 +89,8 @@ class RpcMeta:
                  "auth_data", "trace_id", "span_id", "parent_span_id",
                  "stream_id", "timeout_ms", "stream_window",
                  "ici_domain", "ici_desc", "ici_conn", "timeout_present",
-                 "shm_offer", "shm_accept", "shm_release", "shm_desc")
+                 "shm_offer", "shm_accept", "shm_release", "shm_desc",
+                 "tenant")
 
     def __init__(self):
         self.correlation_id = 0
@@ -110,6 +118,7 @@ class RpcMeta:
         self.shm_accept = b""
         self.shm_release = b""
         self.shm_desc = b""
+        self.tenant = b""
 
     @property
     def is_request(self) -> bool:
@@ -167,6 +176,8 @@ class RpcMeta:
             put(_T_SHM_RELEASE, self.shm_release)
         if self.shm_desc:
             put(_T_SHM_DESC, self.shm_desc)
+        if self.tenant:
+            put(_T_TENANT, self.tenant)
         return bytes(out)
 
     @staticmethod
@@ -225,6 +236,8 @@ class RpcMeta:
                     m.shm_release = field
                 elif tag == _T_SHM_DESC:
                     m.shm_desc = field
+                elif tag == _T_TENANT:
+                    m.tenant = field
                 # unknown tags are skipped: forward compatibility
         except (struct.error, IndexError, UnicodeDecodeError):
             return None

@@ -103,6 +103,11 @@ def _status(server, msg, rest):
             "latency_us_p99": round(st.latency.p99(), 1),
             "errors": st.errors.get_value(),
             "inflight": st.inflight,
+            # the limit admission actually enforces: an installed
+            # adaptive limiter's LIVE value (a static 0 next to an
+            # AutoLimiter used to read as "unlimited")
+            "max_concurrency": st.live_max_concurrency(),
+            "concurrency_limiter": st.limiter_kind(),
         }
     return 200, "application/json", json.dumps(out, indent=1)
 
@@ -372,6 +377,57 @@ def _native(server, msg, rest):
     return 200, "application/json", json.dumps(out, indent=1)
 
 
+def _overload(server, msg, rest):
+    """/overload — the admission plane's live state: per-(tenant,
+    verdict) admission counters (closed verdict enum, no "unknown"
+    bucket), per-tenant in-flight concurrency, the fair-admission
+    configuration, per-method CoDel queue state, and every method's
+    LIVE concurrency limit (adaptive limiters report their current
+    value, not the static field)."""
+    from ...butil.flags import get_flag
+    from ..admission import admission_counters, tenant_inflight_snapshot
+
+    ctl = server.admission
+    methods = {}
+    for (svc, mth), entry in sorted(server.methods.items()):
+        st = entry.status
+        methods[f"{svc}.{mth}"] = {
+            "limiter": st.limiter_kind(),
+            "max_concurrency": st.live_max_concurrency(),
+            "inflight": st.inflight,
+        }
+    lim = server.server_limiter()
+    mc = server.options.max_concurrency
+    out = {
+        "admission_total": {f"{t}|{v}": n for (t, v), n
+                            in sorted(admission_counters().items())},
+        "tenant_inflight": tenant_inflight_snapshot(),
+        "fair_admission": {
+            "enabled": bool(get_flag("enable_fair_admission", True)),
+            "capacity": getattr(server.options, "tenant_fair_capacity",
+                                0),
+            "weights": dict(getattr(server.options, "tenant_weights",
+                                    None) or {}),
+        },
+        "codel": {
+            "enabled": bool(get_flag("enable_codel_shed", False)),
+            "target_ms": get_flag("overload_codel_target_ms", 5.0),
+            "interval_ms": get_flag("overload_codel_interval_ms", 100.0),
+            "methods": ctl.codel_state(),
+        },
+        "server": {
+            "max_concurrency": mc if isinstance(mc, int) else str(mc),
+            "limiter": getattr(lim, "kind", None) if lim is not None
+            else None,
+            "live_limit": lim.max_concurrency() if lim is not None
+            else (mc if isinstance(mc, int) else 0),
+            "inflight": server.inflight,
+        },
+        "methods": methods,
+    }
+    return 200, "application/json", json.dumps(out, indent=1)
+
+
 def _hotspots(server, msg, rest):
     """/hotspots/{cpu,contention,growth,heap,device,engine} — profilers.
     ≈ hotspots_service.cpp:35-40 (CPU/heap/growth/contention); device
@@ -512,7 +568,11 @@ def _protobufs(server, msg, rest):
             "request_type": getattr(rt, "__name__", str(rt))
             if rt is not None else "bytes",
             "grpc_streaming": bool(getattr(entry, "grpc_streaming", False)),
-            "max_concurrency": entry.status.max_concurrency or 0,
+            # live limiter value, not the static field: with an
+            # adaptive limiter installed the static max_concurrency is
+            # 0 and used to (wrongly) report "unlimited" here
+            "max_concurrency": entry.status.live_max_concurrency(),
+            "concurrency_limiter": entry.status.limiter_kind(),
         }
     return 200, "application/json", json.dumps(out, indent=1)
 
@@ -590,3 +650,4 @@ register_builtin("connections", _connections)
 register_builtin("fibers", _fibers)
 register_builtin("rpcz", _rpcz)
 register_builtin("native", _native)
+register_builtin("overload", _overload)

@@ -23,6 +23,8 @@ from ..deadline import parse_deadline_ms as _parse_deadline_ms
 from ..protocol.http import HttpMessage, build_response
 from ..protocol.meta import RpcMeta
 from ..transport.socket import Socket
+from .admission import admit as _admit
+from .admission import http_reject
 from .controller import ServerController
 
 
@@ -160,19 +162,24 @@ def handle_http_request(msg: HttpMessage, sock, server) -> None:
 
 def _bridge_rpc(msg: HttpMessage, sock, server, svc: str,
                 mth: str, entry, unresolved: str = "") -> None:
-    if not server.on_request_in():
-        sock.write(build_response(503, b"server max_concurrency",
-                                  keep_alive=msg.keep_alive))
-        return
-    if not entry.status.on_requested():
-        server.on_request_out()
-        sock.write(build_response(503, b"method max_concurrency",
+    # overload plane: the shared admission stage; a rejection answers
+    # 503 with Retry-After and a reason body/header distinguishing
+    # server-cap vs method-cap vs CoDel vs tenant-quota (shared with
+    # the kind-4 slim lane so the two stay byte-identical)
+    tenant = msg.headers.get("x-tenant")
+    rej = _admit(server, entry, "http", tenant,
+                 getattr(msg, "recv_us", 0) or None)
+    if rej is not None:
+        status_code, body, extra = http_reject(rej)
+        sock.write(build_response(status_code, body, headers=extra,
                                   keep_alive=msg.keep_alive))
         return
 
     meta = RpcMeta()
     meta.service_name = svc
     meta.method_name = mth
+    if tenant:
+        meta.tenant = tenant.encode("utf-8", "replace")
     tp_header = msg.headers.get("traceparent")
     if tp_header:
         from ..rpcz import parse_traceparent
@@ -192,7 +199,9 @@ def _bridge_rpc(msg: HttpMessage, sock, server, svc: str,
     def send(cntl: ServerController, response: Any) -> None:
         latency_us = monotonic_us() - cntl.begin_time_us
         entry.status.on_responded(cntl.error_code, latency_us)
-        server.on_request_out()
+        server.on_request_out(tenant=meta.tenant,
+                              error_code=cntl.error_code,
+                              latency_us=latency_us)
         span = cntl.span
         s = Socket.address(cntl.socket_id)
         if s is None:

@@ -13,8 +13,10 @@ Kind 4 removes all of it from the eligible path: the C++ engine parses
 the request line + headers itself, batches every eligible HTTP/1.1
 request of a read burst, and enters Python ONCE calling the per-route
 shim built below as ``handler(body, query, content_type, att_size,
-conn_id, recv_ns, traceparent, deadline)`` (bytes-or-None for the
-middle three, ``traceparent`` and ``deadline``; ``recv_ns`` is the
+conn_id, recv_ns, traceparent, deadline, tenant)`` (bytes-or-None for
+the middle three, ``traceparent``, ``deadline`` and ``tenant`` — the
+last is the raw ``x-tenant`` header, the fair-admission key;
+``recv_ns`` is the
 engine's CLOCK_MONOTONIC parse timestamp, used to backdate rpcz spans
 so they cover native queueing).  ``traceparent`` is the raw W3C
 trace-context header value the engine captured — explicitly traced
@@ -26,9 +28,12 @@ native batch — 500 + ``x-rpc-error-code: ERPCTIMEDOUT``, handler
 never runs (deadline plane).  The shim is the whole per-call Python
 cost of the lane:
 
-    admission   server.on_request_in + MethodStatus.on_requested —
-                503 answers ride the slim serializer, byte-identical
-                with the classic ``build_response`` output
+    admission   the SHARED overload-plane stage (server/admission.py):
+                server cap, adaptive method cap, CoDel against the
+                engine parse stamp, per-tenant fair admission — 503 +
+                Retry-After answers ride the slim serializer,
+                byte-identical with the classic ``build_response``
+                output
     sampling    rpcz spans keep their per-second budget via
                 start_server_span; traced requests always record and
                 the slim lane records real sizes inline
@@ -76,6 +81,8 @@ from ..protocol.http import build_response
 from ..protocol.meta import RpcMeta
 from ..rpcz import backdate_span, parse_traceparent, start_server_span
 from ..transport.socket import Socket
+from .admission import admit as _admit
+from .admission import http_reject
 from .controller import ServerController
 from .http_dispatch import _encode_http_body, http_status_for_error
 
@@ -84,10 +91,6 @@ _EINTERNAL = int(Errno.EINTERNAL)
 
 _CT = b"Content-Type: "
 _CRLF = b"\r\n"
-_503_SERVER = (503, b"Content-Type: text/plain\r\n",
-               b"server max_concurrency")
-_503_METHOD = (503, b"Content-Type: text/plain\r\n",
-               b"method max_concurrency")
 
 
 def _hdr_block(ctype: str, extra) -> bytes:
@@ -125,19 +128,26 @@ def make_http_slim_handler(bridge, server, entry, svc: str, mth: str,
     is_get = http_method in ("GET", "HEAD")
 
     def slim(body, query, ctype, attsz, conn_id, recv_ns,
-             traceparent=None, deadline=None):
+             traceparent=None, deadline=None, tenant=None):
         sock = socks.get(conn_id)
         if sock is None:
             return None          # connection died mid-burst
-        if not server.on_request_in():
-            return _503_SERVER
-        if not status.on_requested():
-            server.on_request_out()
-            return _503_METHOD
+        # overload plane: the SHARED admission stage — CoDel sojourn
+        # and the method limiters measure from the ENGINE's parse
+        # stamp; rejections serialize natively with the burst as a
+        # 503 + Retry-After tuple byte-identical with the classic
+        # bridge's build_response output
+        rej = _admit(server, entry, "http_slim", tenant,
+                     recv_ns // 1000)
+        if rej is not None:
+            st, rbody, extra = http_reject(rej)
+            return st, _hdr_block("text/plain", extra), rbody
 
         meta = RpcMeta()
         meta.service_name = svc
         meta.method_name = mth
+        if tenant is not None:
+            meta.tenant = tenant     # fair-admission slot release keys
         if traceparent is not None:
             tp = parse_traceparent(traceparent)
             if tp is not None:
@@ -174,7 +184,9 @@ def make_http_slim_handler(bridge, server, entry, svc: str, mth: str,
         def send(cntl, response):
             latency_us = monotonic_us() - cntl.begin_time_us
             status.on_responded(cntl.error_code, latency_us)
-            server.on_request_out()
+            server.on_request_out(tenant=meta.tenant,
+                                  error_code=cntl.error_code,
+                                  latency_us=latency_us)
             span = cntl.span
             if cntl.failed:
                 if cntl._progressive is not None:
@@ -221,6 +233,9 @@ def make_http_slim_handler(bridge, server, entry, svc: str, mth: str,
 
         cntl = ServerController(meta, sock.remote_side, sock.id, send)
         cntl.server = server
+        # latency anchored at the ENGINE's parse stamp, not shim entry:
+        # limiter/MethodStatus samples include native batch queueing
+        cntl.begin_time_us = recv_ns // 1000
         cntl.http_method = http_method
         cntl.http_path = path
         cntl.http_unresolved_path = ""

@@ -38,6 +38,31 @@ class MethodStatus:
             self._inflight += 1
             return True
 
+    def undo_requested(self) -> None:
+        """Back out one on_requested that a LATER admission layer
+        (CoDel / tenant quota) vetoed: the request never ran, so no
+        latency/error sample reaches the limiter."""
+        with self._inflight_lock:
+            if self._inflight > 0:
+                self._inflight -= 1
+
+    def live_max_concurrency(self) -> int:
+        """The limit admission actually enforces right now: the
+        adaptive limiter's live value when one is installed, else the
+        static cap (0 = unlimited).  The /status page reports this —
+        a static 0 next to an installed AutoLimiter used to read as
+        'unlimited'."""
+        if self.limiter is not None:
+            return self.limiter.max_concurrency()
+        return self.max_concurrency
+
+    def limiter_kind(self) -> str:
+        """'auto' / 'timeout' / 'constant' when a limiter is installed,
+        'constant' for a bare max_concurrency cap, 'unlimited' else."""
+        if self.limiter is not None:
+            return getattr(self.limiter, "kind", "custom")
+        return "constant" if self.max_concurrency > 0 else "unlimited"
+
     def on_responded(self, error_code: int, latency_us: float) -> None:
         with self._inflight_lock:
             if self._inflight > 0:

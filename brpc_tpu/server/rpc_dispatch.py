@@ -24,6 +24,7 @@ from ..protocol.tpu_std import RpcMessage, pack_frame, parse_payload, serialize_
 from ..rpcz import start_server_span
 from ..tools import rpc_dump as _rpc_dump
 from ..transport.socket import Socket
+from .admission import admit as _admit
 from .controller import ServerController
 
 
@@ -69,7 +70,9 @@ def _send_response(server, entry, cntl: ServerController,
     sock = Socket.address(cntl.socket_id)
     latency_us = _mono_ns() // 1000 - cntl.begin_time_us
     entry.status.on_responded(cntl.error_code, latency_us)
-    server.on_request_out()
+    server.on_request_out(tenant=cntl.request_meta.tenant,
+                          error_code=cntl.error_code,
+                          latency_us=latency_us)
     if cntl.request_device_attachment is not None:
         # invariant the client's sync fast lane relies on: the credit-
         # return for a request descriptor always PRECEDES the response
@@ -230,15 +233,13 @@ def process_rpc_request(msg: RpcMessage, sock: Socket, server) -> None:
         _send_error(sock, cid, Errno.ELOGOFF, "server is stopping",
                     request_meta=meta)
         return
-    if not server.on_request_in():
-        _send_error(sock, cid, Errno.ELIMIT, "server max_concurrency",
-                    request_meta=meta)
-        return
-    if not entry.status.on_requested():
-        server.on_request_out()
-        _send_error(sock, cid, Errno.ELIMIT,
-                    f"{entry.status.full_name} max_concurrency",
-                    request_meta=meta)
+    # overload plane: the shared admission stage (server cap, adaptive
+    # method cap, CoDel queue discipline, per-tenant fair admission) —
+    # a rejected request is answered ELIMIT before auth/parse/handler
+    rej = _admit(server, entry, "tpu_std", meta.tenant,
+                 getattr(msg, "recv_us", 0) or None)
+    if rej is not None:
+        _send_error(sock, cid, rej.code, rej.text, request_meta=meta)
         return
 
     cntl = ServerController(
@@ -249,7 +250,7 @@ def process_rpc_request(msg: RpcMessage, sock: Socket, server) -> None:
         cntl.request_attachment = msg.split_attachment()
     except ValueError as e:
         entry.status.on_responded(int(Errno.EREQUEST), 0)
-        server.on_request_out()
+        server.on_request_out(tenant=meta.tenant)
         _send_error(sock, cid, Errno.EREQUEST, str(e), request_meta=meta)
         return
     if meta.ici_domain:
@@ -284,7 +285,7 @@ def process_rpc_request(msg: RpcMessage, sock: Socket, server) -> None:
             # descriptor; failing loudly beats handing user code an
             # empty attachment
             entry.status.on_responded(int(Errno.EREQUEST), 0)
-            server.on_request_out()
+            server.on_request_out(tenant=meta.tenant)
             _send_error(sock, cid, Errno.EREQUEST,
                         "unresolvable shm attachment descriptor",
                         request_meta=meta)

@@ -34,14 +34,28 @@ class ServerOptions:
                  "internal_port", "server_info_name",
                  "native", "native_loops", "usercode_inline",
                  "ssl_cert", "ssl_key", "ssl_context",
-                 "restful_mappings", "session_local_data_factory")
+                 "restful_mappings", "session_local_data_factory",
+                 "tenant_fair_capacity", "tenant_weights")
 
     def __init__(self):
         self.num_workers = 0            # 0 = leave fiber runtime defaults
-        self.max_concurrency = 0        # server-wide in-flight cap (0 = off)
+        # server-wide in-flight cap: an int (0 = off), OR a make_limiter
+        # spec ("auto" / "timeout[:ms]" / "constant:N") / a
+        # ConcurrencyLimiter instance — the whole server's admission
+        # then adapts to measured latency (overload plane, ≈ brpc
+        # -max_concurrency taking AdaptiveMaxConcurrency)
+        self.max_concurrency: Any = 0
         # "Service.Method" -> int cap, "auto", "constant:N", or a
-        # ConcurrencyLimiter instance
+        # ConcurrencyLimiter instance; the "*" key is the default spec
+        # applied to every method without its own entry
         self.method_max_concurrency: Dict[str, Any] = {}
+        # overload plane, per-tenant fair admission: total concurrency
+        # the tenant scheduler divides (0 = tenant layer accounts but
+        # never rejects).  Weighted guaranteed shares come from
+        # tenant_weights (default weight 1); capacity beyond the
+        # guarantees is a shared free pool.
+        self.tenant_fair_capacity = 0
+        self.tenant_weights: Dict[str, float] = {}
         self.auth: Optional[Any] = None          # .verify(auth_data, cntl)
         self.interceptor: Optional[Callable] = None  # (cntl) -> (ok, code, text)
         self.idle_timeout_s = -1
@@ -110,6 +124,9 @@ class Server:
         self.version = ""
         self._restful = []           # parsed (segments, has_rest, entry_key)
         self._session_pool = None    # SimpleDataPool when factory set
+        self._admission = None       # lazy AdmissionControl (overload plane)
+        self._server_limiter = None  # adaptive server-wide cap (spec'd
+        self._server_limiter_spec = None   # max_concurrency), parsed lazily
 
     # -- registry ----------------------------------------------------------
 
@@ -139,9 +156,19 @@ class Server:
         self._services[sname] = service
         from ..policy.concurrency_limiter import (ConcurrencyLimiter,
                                                   make_limiter)
+        default_mc = self.options.method_max_concurrency.get("*", 0)
+        if isinstance(default_mc, ConcurrencyLimiter):
+            # one INSTANCE as the default would be shared by reference
+            # across every method — mixed latencies feeding one
+            # adaptive state make the limit meaningless for all of
+            # them.  Spec strings get a fresh limiter per method.
+            LOG.error("method_max_concurrency['*'] must be a spec "
+                      "(e.g. \"auto\"), not a limiter instance")
+            del self._services[sname]
+            return -1
         for mname, fn in methods.items():
             full = f"{sname}.{mname}"
-            mc = self.options.method_max_concurrency.get(full, 0)
+            mc = self.options.method_max_concurrency.get(full, default_mc)
             limiter = None
             if isinstance(mc, ConcurrencyLimiter):
                 limiter, mc = mc, 0
@@ -218,20 +245,65 @@ class Server:
     def methods(self):
         return self._methods
 
-    # -- server-wide concurrency ------------------------------------------
+    # -- server-wide concurrency + admission (overload plane) -------------
+
+    @property
+    def admission(self):
+        """This server's AdmissionControl (lazy) — the ONE admission
+        stage all five dispatch paths run (server/admission.py)."""
+        ctl = self._admission
+        if ctl is None:
+            from .admission import AdmissionControl
+            with self._inflight_lock:
+                if self._admission is None:
+                    self._admission = AdmissionControl(self)
+                ctl = self._admission
+        return ctl
+
+    def server_limiter(self):
+        """The adaptive server-wide concurrency limiter when
+        ``options.max_concurrency`` is a spec/instance (None for the
+        classic int cap).  Parsed lazily and re-parsed when the option
+        object changes, so tests/operators may set it any time before
+        traffic."""
+        mc = self.options.max_concurrency
+        if isinstance(mc, int):
+            return None
+        if mc is not self._server_limiter_spec:
+            from ..policy.concurrency_limiter import (ConcurrencyLimiter,
+                                                      make_limiter)
+            self._server_limiter = mc if isinstance(mc, ConcurrencyLimiter) \
+                else make_limiter(mc)
+            self._server_limiter_spec = mc
+        return self._server_limiter
 
     def on_request_in(self) -> bool:
-        limit = self.options.max_concurrency
+        lim = self.server_limiter()
+        if lim is not None:
+            limit = lim.max_concurrency()
+        else:
+            limit = self.options.max_concurrency
         with self._inflight_lock:
             if limit > 0 and self._inflight >= limit:
                 return False
             self._inflight += 1
             return True
 
-    def on_request_out(self) -> None:
+    def on_request_out(self, tenant=None, error_code: int = 0,
+                       latency_us: float = 0.0) -> None:
+        """Settle one admitted request.  The five dispatch lanes pass
+        the request's tenant (fair-admission slot release) and the
+        measured outcome (the adaptive server-wide limiter's feed);
+        legacy/error paths may still call it bare."""
         with self._inflight_lock:
             if self._inflight > 0:
                 self._inflight -= 1
+        if error_code or latency_us:
+            lim = self._server_limiter
+            if lim is not None:
+                lim.on_responded(error_code, latency_us)
+        if tenant is not None and self._admission is not None:
+            self._admission.release(tenant)
 
     @property
     def inflight(self) -> int:

@@ -6,7 +6,7 @@ The reference runs the ENTIRE request path to the user callback in C++
 the C++ engine scans the meta TLV, batches every eligible unary request
 of a read burst, and enters Python ONCE calling the shim built below as
 ``handler(payload: bytes, att: bytes | None, cid: int, conn_id: int,
-dom, nonce, recv_ns: int, trace, timeout_ms)`` — ``recv_ns`` is the
+dom, nonce, recv_ns: int, trace, timeout_ms, tenant)`` — ``recv_ns`` is the
 engine's CLOCK_MONOTONIC frame-parse timestamp, used to backdate rpcz
 spans so they cover native queueing; ``trace`` is None or the request's
 ``(trace_id, span_id, parent_id)`` meta TLVs, so explicitly traced
@@ -15,12 +15,17 @@ observed; ``timeout_ms`` is TLV 13's propagated remaining budget
 (None = no deadline on the wire; an explicit 0 means expired at
 arrival) — anchored at ``recv_ns``, the shim SHEDS requests whose
 budget expired while they sat in the native batch (deadline plane:
-the handler never runs; the client gets ``ERPCTIMEDOUT``).  The shim is
-the whole per-call Python cost of the lane:
+the handler never runs; the client gets ``ERPCTIMEDOUT``); ``tenant``
+is TLV 22's identity bytes (None = untenanted), the fair-admission
+key.  The shim is the whole per-call Python cost of the lane:
 
-    admission   server.on_request_in + MethodStatus.on_requested (the
-                concurrency-limiter path — NOT dropped; ELIMIT answers
-                are sent through the classic error builder)
+    admission   the SHARED overload-plane stage (server/admission.py):
+                server cap, adaptive per-method concurrency, CoDel
+                queue discipline against the engine parse stamp, and
+                per-tenant fair admission — ELIMIT answers are sent
+                through the classic error builder (byte-identical);
+                the method limiters are fed parse-stamp latencies, so
+                native batch queueing counts against the limit
     sampling    rpcz spans keep their per-second budget via
                 start_server_span; traced requests (non-zero trace
                 context) always record; span sizes are recorded INLINE
@@ -63,6 +68,7 @@ from ..deadline import inherit_deadline, maybe_shed
 from ..protocol.meta import RpcMeta
 from ..protocol.tpu_std import parse_payload
 from ..rpcz import backdate_span, start_server_span
+from .admission import admit as _admit_rpc
 from .controller import ServerController
 from .rpc_dispatch import _send_error, _send_response
 
@@ -88,12 +94,14 @@ def make_slim_handler(bridge, server, entry, svc: str, mth: str):
         _send_response(_server, _entry, cntl, response)
 
     def slim(payload, att, cid, conn_id, dom, nonce, recv_ns,
-             trace=None, tmo=None,
-             _server=server, _status=status, _fn=fn, _rt=req_type,
+             trace=None, tmo=None, tenant=None,
+             _server=server, _entry=entry, _status=status, _fn=fn,
+             _rt=req_type,
              _svc=svc, _mth=mth, _send=_send, _socks=socks,
              _ns=_mono_ns, _sample=start_server_span,
              _backdate=backdate_span, _shed=maybe_shed,
-             _inherit=inherit_deadline, _arm=arm_deadline):
+             _inherit=inherit_deadline, _arm=arm_deadline,
+             _admit=_admit_rpc):
         sock = _socks.get(conn_id)
         if sock is None:
             return None          # connection died mid-burst: drop, like
@@ -101,13 +109,15 @@ def make_slim_handler(bridge, server, entry, svc: str, mth: str):
         if not _server.running:
             _send_error(sock, cid, _ELOGOFF, "server is stopping")
             return None
-        if not _server.on_request_in():
-            _send_error(sock, cid, _ELIMIT, "server max_concurrency")
-            return None
-        if not _status.on_requested():
-            _server.on_request_out()
-            _send_error(sock, cid, _ELIMIT,
-                        f"{_status.full_name} max_concurrency")
+        # overload plane: the SHARED admission stage — CoDel sojourn
+        # and the method limiters both measure from the ENGINE's
+        # CLOCK_MONOTONIC parse stamp, so time spent in the native
+        # batch counts (that queue is where an overloaded server's
+        # latency lives); ELIMIT rejections ride the classic error
+        # builder, byte-identical with the classic path's
+        rej = _admit(_server, _entry, "slim", tenant, recv_ns // 1000)
+        if rej is not None:
+            _send_error(sock, cid, rej.code, rej.text)
             return None
         if dom is not None:
             # learn the peer's device-fabric domain; the engine answers
@@ -131,11 +141,19 @@ def make_slim_handler(bridge, server, entry, svc: str, mth: str):
             # None = TLV 13 absent; an explicit on-wire 0 means
             # expired-at-arrival (real clients stamp >= 1)
             meta.timeout_ms = tmo
+        if tenant is not None:
+            meta.tenant = tenant     # fair-admission slot release keys
         na = len(att) if att is not None else 0
         if na:
             meta.attachment_size = na
         cntl = ServerController(meta, sock.remote_side, sock.id, _send)
         cntl.server = _server
+        # latency measured from the ENGINE's frame-parse stamp, not
+        # shim entry: MethodStatus/limiter samples (and every
+        # completion path's latency) then include native batch
+        # queueing — the signal an adaptive concurrency limit exists
+        # to react to
+        cntl.begin_time_us = recv_ns // 1000
         if tmo is not None:
             # deadline anchored at the ENGINE's frame-parse time, not
             # shim entry: native batching queueing counts against the
@@ -187,8 +205,9 @@ def make_slim_handler(bridge, server, entry, svc: str, mth: str):
         # ---- slim fast completion: accounting + native frame build ----
         if not cntl._mark_finished_if_first():
             return None
-        _status.on_responded(0, _ns() // 1000 - cntl.begin_time_us)
-        _server.on_request_out()
+        latency_us = _ns() // 1000 - cntl.begin_time_us
+        _status.on_responded(0, latency_us)
+        _server.on_request_out(tenant=meta.tenant, latency_us=latency_us)
         if cntl._session_data is not None \
                 and _server._session_pool is not None:
             _server._session_pool.give_back(cntl._session_data)

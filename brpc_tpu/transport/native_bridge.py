@@ -579,14 +579,17 @@ class NativeBridge:
     @staticmethod
     def _scan_request_meta(data):
         """Minimal TLV walk for the raw lane: (cid, service, method,
-        att_size, timeout_ms, ici_domain, ici_conn, timeout_present) —
+        att_size, timeout_ms, ici_domain, ici_conn, timeout_present,
+        tenant) —
         or None when the
         meta carries any controller-tier tag (compress=2, error=6/7,
         auth=8, trace=9, span=10/11 — raw handlers have no span
         machinery, so traced requests take the full path; the NATIVE
         slim lanes carry trace context through their shims instead —
         stream=12/14, ici desc=16) or is malformed, meaning the full
-        RpcMeta path must run.  ~3x cheaper
+        RpcMeta path must run.  The tenant tag (22) is tolerated like
+        the deadline tag: raw handlers ignore it, the full/slim-meta
+        path forwards it to the admission stage.  ~3x cheaper
         than RpcMeta.decode for the echo-class frame; a successful scan
         also lets the FULL method path build its RpcMeta from these
         fields without re-walking (slim-meta path in _on_message)."""
@@ -594,7 +597,7 @@ class NativeBridge:
         svc = mth = None
         att = tmo = 0
         tmo_seen = False
-        dom = nonce = b""
+        dom = nonce = ten = b""
         off, end = 0, len(data)
         try:
             while off < end:
@@ -618,6 +621,8 @@ class NativeBridge:
                     dom = _bytes(data[off:off + ln])
                 elif tag == 17:
                     nonce = _bytes(data[off:off + ln])
+                elif tag == 22:
+                    ten = _bytes(data[off:off + ln])
                 else:
                     return None   # controller-tier tag: full path
                 off += ln
@@ -625,7 +630,7 @@ class NativeBridge:
             return None
         if svc is None or mth is None:
             return None
-        return cid, svc, mth, att, tmo, dom, nonce, tmo_seen
+        return cid, svc, mth, att, tmo, dom, nonce, tmo_seen, ten
 
     def _on_message(self, conn_id: int, buf, meta_size: int) -> None:
         sock = self._sock(conn_id)
@@ -654,7 +659,7 @@ class NativeBridge:
             meta = RpcMeta()
             (meta.correlation_id, meta.service_name, meta.method_name,
              meta.attachment_size, meta.timeout_ms, meta.ici_domain,
-             meta.ici_conn, meta.timeout_present) = scan
+             meta.ici_conn, meta.timeout_present, meta.tenant) = scan
         else:
             meta = RpcMeta.decode(bytes(mv[:meta_size]))
         if meta is None:

@@ -61,6 +61,10 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers",
         "soak: long mixed-workload soak (duration via SOAK_SECONDS env)")
+    config.addinivalue_line(
+        "markers",
+        "slow: long-running stress/soak tests excluded from tier-1 "
+        "(-m 'not slow')")
 
 
 # -- shared wire-format helpers for the native adversarial suites --------

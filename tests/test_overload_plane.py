@@ -1,0 +1,822 @@
+"""Overload plane — the shared admission stage on all five dispatch
+paths (ISSUE 7 acceptance matrix).
+
+Mirrors test_deadline_plane's shape: each of the four rejection causes
+— server cap, adaptive/static method cap, CoDel queue discipline, and
+per-tenant fair-admission quota — is observed on every server dispatch
+path (classic tpu_std, the slim kind-3 native lane, classic HTTP/1.1,
+the kind-4 slim HTTP lane, gRPC over h2) with the correct error
+(ELIMIT frame / 503 + Retry-After / grpc-status 8), rejected BEFORE
+user code runs, and counted in ``overload_admission_total`` under a
+closed verdict enum (no "unknown" bucket possible).  Tenant-stamped
+traffic must keep riding the native lanes with zero new fallbacks.
+"""
+
+import socket as pysock
+import struct
+import threading
+import time
+
+import pytest
+
+from brpc_tpu.butil.flags import get_flag, set_flag
+from brpc_tpu.butil.status import Errno
+from brpc_tpu.client import Channel, ChannelOptions, Controller
+from brpc_tpu.protocol.meta import (RpcMeta, TLV_CORRELATION, encode_tlv)
+from brpc_tpu.server import Server, ServerOptions, Service
+from brpc_tpu.server.admission import (ADMITTED, CODEL, METHOD_CAP,
+                                       SERVER_CAP, TENANT_QUOTA, VERDICTS,
+                                       admission_counters,
+                                       normalize_tenant)
+
+from conftest import require_native  # noqa: E402
+
+ELIMIT = int(Errno.ELIMIT)
+
+
+class OvSvc(Service):
+    def __init__(self):
+        self.echo_calls = []
+        self.parked = []
+        self._plock = threading.Lock()
+
+    def Echo(self, cntl, request):
+        self.echo_calls.append(bytes(request))
+        return b"ok:" + bytes(request)
+
+    def Park(self, cntl, request):
+        """Async occupancy: holds one admission slot until released —
+        works on single-loop inline native servers, where a blocking
+        handler would stall the probe itself."""
+        cntl.begin_async()
+        with self._plock:
+            self.parked.append(cntl)
+        return None
+
+    def release_parked(self):
+        with self._plock:
+            parked, self.parked = self.parked, []
+        for c in parked:
+            c.finish(b"released")
+
+
+def _server(native: bool, **opt_kv):
+    opts = ServerOptions()
+    if native:
+        opts.native = True
+        opts.usercode_inline = True
+        opts.native_loops = 1
+    for k, v in opt_kv.items():
+        setattr(opts, k, v)
+    svc = OvSvc()
+    srv = Server(opts)
+    srv.add_service(svc, name="OV")
+    assert srv.start("127.0.0.1:0") == 0
+    return srv, svc
+
+
+def _frame(cid: int, mth: bytes, payload: bytes = b"",
+           tenant: bytes = b"") -> bytes:
+    mb = TLV_CORRELATION + struct.pack("<Q", cid)
+    mb += encode_tlv(4, b"OV") + encode_tlv(5, mth)
+    if tenant:
+        mb += encode_tlv(22, tenant)
+    body = mb + payload
+    return b"TRPC" + struct.pack("<II", len(body), len(mb)) + body
+
+
+def _read_frames(c: pysock.socket, n: int, timeout=10.0):
+    c.settimeout(timeout)
+    buf = b""
+    out = {}
+    while len(out) < n:
+        while True:
+            if len(buf) >= 12:
+                (blen,) = struct.unpack_from("<I", buf, 4)
+                if len(buf) >= 12 + blen:
+                    break
+            buf += c.recv(65536)
+        (blen,) = struct.unpack_from("<I", buf, 4)
+        (mlen,) = struct.unpack_from("<I", buf, 8)
+        meta = RpcMeta.decode(buf[12:12 + mlen])
+        assert meta is not None
+        out[meta.correlation_id] = meta
+        buf = buf[12 + blen:]
+    return out
+
+
+def _park(srv, ep, n: int = 1, tenant: bytes = b""):
+    """Occupy n admission slots via async Park requests on one
+    dedicated connection; returns the open socket (keep it alive)."""
+    c = pysock.create_connection((str(ep.host), ep.port), timeout=10)
+    base = srv.inflight
+    for i in range(n):
+        c.sendall(_frame(900 + i, b"Park", tenant=tenant))
+    deadline = time.time() + 5
+    while srv.inflight < base + n and time.time() < deadline:
+        time.sleep(0.005)
+    assert srv.inflight >= base + n, "Park requests not admitted in time"
+    return c
+
+
+def _http_exchange(ep, request: bytes):
+    with pysock.create_connection((str(ep.host), ep.port), timeout=10) as c:
+        c.sendall(request)
+        c.settimeout(10)
+        buf = b""
+        while b"\r\n\r\n" not in buf:
+            buf += c.recv(65536)
+        head, _, rest = buf.partition(b"\r\n\r\n")
+        lines = head.decode("latin1").split("\r\n")
+        status = int(lines[0].split()[1])
+        headers = {}
+        for ln in lines[1:]:
+            k, _, v = ln.partition(":")
+            headers[k.strip().lower()] = v.strip()
+        clen = int(headers.get("content-length", "0"))
+        while len(rest) < clen:
+            rest += c.recv(65536)
+        return status, headers, rest[:clen]
+
+
+def _http_req(path: bytes, body: bytes, tenant: str = "",
+              close=False) -> bytes:
+    h = [b"POST " + path + b" HTTP/1.1", b"Host: x",
+         b"Content-Length: " + str(len(body)).encode()]
+    if tenant:
+        h.append(b"x-tenant: " + tenant.encode())
+    if close:
+        h.append(b"Connection: close")
+    return b"\r\n".join(h) + b"\r\n\r\n" + body
+
+
+def _grpc_call(ep, payload: bytes = b"x", tenant: str = ""):
+    """One gRPC unary Echo over raw h2; returns grpc-status str."""
+    from brpc_tpu.protocol.h2_rpc import pack_grpc_message
+    from brpc_tpu.protocol.h2_session import H2Session
+
+    sess = H2Session(is_server=False)
+    sess.start()
+    sid = sess.next_stream_id()
+    hdrs = [(":method", "POST"), (":path", "/OV/Echo"),
+            (":scheme", "http"), (":authority", "t"),
+            ("content-type", "application/grpc"), ("te", "trailers")]
+    if tenant:
+        hdrs.append(("x-tenant", tenant))
+    sess.send_headers(sid, hdrs)
+    sess.send_data(sid, pack_grpc_message(payload), end_stream=True)
+    grpc_status = None
+    with pysock.create_connection((str(ep.host), ep.port),
+                                  timeout=10) as c:
+        c.sendall(sess.take_output())
+        c.settimeout(10)
+        deadline = time.time() + 10
+        while grpc_status is None and time.time() < deadline:
+            data = c.recv(65536)
+            if not data:
+                break
+            for ev in sess.feed(data):
+                if ev[0] == "headers":
+                    for k, v in ev[2]:
+                        if k == "grpc-status":
+                            grpc_status = v
+            out = sess.take_output()
+            if out:
+                c.sendall(out)
+    return grpc_status
+
+
+def _delta(before, tenant, verdict):
+    after = admission_counters()
+    return after.get((tenant, verdict), 0) \
+        - before.get((tenant, verdict), 0)
+
+
+def _saturate_method(srv, mth="Echo"):
+    status = srv.find_method("OV", mth).status
+    status.max_concurrency = 1
+    status._inflight = 1
+    return status
+
+
+def _unsaturate_method(status):
+    status.max_concurrency = 0
+    status._inflight = 0
+
+
+# ---------------------------------------------------------------------------
+# server-cap x five lanes (async Park occupies the only slot)
+# ---------------------------------------------------------------------------
+
+def _probe_tpu_std(srv, svc, ep, expect_reject: bool, cid=50,
+                   tenant: bytes = b""):
+    with pysock.create_connection((str(ep.host), ep.port),
+                                  timeout=10) as c:
+        c.sendall(_frame(cid, b"Echo", b"probe", tenant=tenant))
+        metas = _read_frames(c, 1)
+    if expect_reject:
+        assert metas[cid].error_code == ELIMIT, metas[cid].error_code
+        assert b"probe" not in [x for x in svc.echo_calls]
+    else:
+        assert metas[cid].error_code == 0
+
+
+def test_server_cap_classic_tpu_std():
+    srv, svc = _server(native=False, max_concurrency=1)
+    try:
+        before = admission_counters()
+        sock = _park(srv, srv.listen_endpoint)
+        _probe_tpu_std(srv, svc, srv.listen_endpoint, True)
+        assert _delta(before, "-", SERVER_CAP) == 1
+        svc.release_parked()
+        _read_frames(sock, 1)          # the parked response
+        sock.close()
+        _probe_tpu_std(srv, svc, srv.listen_endpoint, False, cid=51)
+    finally:
+        srv.stop()
+
+
+def test_server_cap_slim_kind3():
+    require_native()
+    srv, svc = _server(native=True, max_concurrency=1)
+    try:
+        before = admission_counters()
+        sock = _park(srv, srv.listen_endpoint)
+        _probe_tpu_std(srv, svc, srv.listen_endpoint, True)
+        assert _delta(before, "-", SERVER_CAP) == 1
+        svc.release_parked()
+        _read_frames(sock, 1)
+        sock.close()
+    finally:
+        srv.stop()
+
+
+def test_server_cap_http_classic_and_retry_after():
+    srv, svc = _server(native=False, max_concurrency=1)
+    try:
+        sock = _park(srv, srv.listen_endpoint)
+        status, headers, body = _http_exchange(
+            srv.listen_endpoint, _http_req(b"/OV/Echo", b"p", close=True))
+        assert status == 503
+        # satellite: 503s carry Retry-After and a reason telling
+        # server-cap apart from method-cap/CoDel/tenant-quota
+        assert headers.get("retry-after")
+        assert headers.get("x-overload-reason") == SERVER_CAP
+        assert b"server max_concurrency" in body
+        assert svc.echo_calls == []
+        svc.release_parked()
+        sock.close()
+    finally:
+        srv.stop()
+
+
+def test_server_cap_http_slim_kind4():
+    require_native()
+    srv, svc = _server(native=True, max_concurrency=1)
+    try:
+        sock = _park(srv, srv.listen_endpoint)
+        status, headers, body = _http_exchange(
+            srv.listen_endpoint, _http_req(b"/OV/Echo", b"p"))
+        assert status == 503
+        assert headers.get("retry-after")
+        assert headers.get("x-overload-reason") == SERVER_CAP
+        assert svc.echo_calls == []
+        svc.release_parked()
+        sock.close()
+    finally:
+        srv.stop()
+
+
+def test_server_cap_grpc_h2():
+    srv, svc = _server(native=False, max_concurrency=1)
+    try:
+        sock = _park(srv, srv.listen_endpoint)
+        assert _grpc_call(srv.listen_endpoint) == "8"  # RESOURCE_EXHAUSTED
+        assert svc.echo_calls == []
+        svc.release_parked()
+        sock.close()
+    finally:
+        srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# method-cap x five lanes (saturated MethodStatus, the deterministic
+# idiom test_slim_dispatch/test_http_slim already pin byte-identity on)
+# ---------------------------------------------------------------------------
+
+def test_method_cap_all_lanes():
+    for native, lanes in ((False, ("tpu_std", "http", "grpc")),
+                          (True, ("slim", "http_slim"))):
+        if native:
+            require_native()
+        srv, svc = _server(native=native)
+        try:
+            status = _saturate_method(srv)
+            before = admission_counters()
+            for lane in lanes:
+                if lane in ("tpu_std", "slim"):
+                    _probe_tpu_std(srv, svc, srv.listen_endpoint, True)
+                elif lane in ("http", "http_slim"):
+                    st, headers, body = _http_exchange(
+                        srv.listen_endpoint,
+                        _http_req(b"/OV/Echo", b"p", close=not native))
+                    assert st == 503
+                    assert headers.get("x-overload-reason") == METHOD_CAP
+                    assert headers.get("retry-after")
+                    assert b"method max_concurrency" in body
+                else:
+                    assert _grpc_call(srv.listen_endpoint) == "8"
+            assert svc.echo_calls == []
+            assert _delta(before, "-", METHOD_CAP) == len(lanes)
+            _unsaturate_method(status)
+            # the lane recovers once the cap clears
+            _probe_tpu_std(srv, svc, srv.listen_endpoint, False, cid=60)
+        finally:
+            srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# CoDel x five lanes (degenerate target/interval = 0: the first
+# above-target request arms the interval, the second head-drops)
+# ---------------------------------------------------------------------------
+
+class _codel_flags:
+    def __enter__(self):
+        self.prev = (get_flag("enable_codel_shed", False),
+                     get_flag("overload_codel_target_ms", 5.0),
+                     get_flag("overload_codel_interval_ms", 100.0))
+        set_flag("enable_codel_shed", True)
+        set_flag("overload_codel_target_ms", 0)
+        set_flag("overload_codel_interval_ms", 0)
+        return self
+
+    def __exit__(self, *exc):
+        set_flag("enable_codel_shed", self.prev[0])
+        set_flag("overload_codel_target_ms", self.prev[1])
+        set_flag("overload_codel_interval_ms", self.prev[2])
+        return False
+
+
+def test_codel_classic_tpu_std():
+    srv, svc = _server(native=False)
+    try:
+        with _codel_flags():
+            before = admission_counters()
+            with pysock.create_connection(
+                    (str(srv.listen_endpoint.host),
+                     srv.listen_endpoint.port), timeout=10) as c:
+                c.sendall(_frame(70, b"Echo", b"one"))
+                _read_frames(c, 1)
+                c.sendall(_frame(71, b"Echo", b"two"))
+                metas = _read_frames(c, 1)
+            assert metas[71].error_code == ELIMIT
+            assert b"two" not in svc.echo_calls
+            assert _delta(before, "-", CODEL) >= 1
+        # with the flag back off the lane admits again
+        _probe_tpu_std(srv, svc, srv.listen_endpoint, False, cid=72)
+    finally:
+        srv.stop()
+
+
+def test_codel_slim_kind3():
+    require_native()
+    srv, svc = _server(native=True)
+    try:
+        with _codel_flags():
+            before = admission_counters()
+            with pysock.create_connection(
+                    (str(srv.listen_endpoint.host),
+                     srv.listen_endpoint.port), timeout=10) as c:
+                c.sendall(_frame(73, b"Echo", b"one"))
+                _read_frames(c, 1)
+                c.sendall(_frame(74, b"Echo", b"two"))
+                metas = _read_frames(c, 1)
+            assert metas[74].error_code == ELIMIT
+            assert b"two" not in svc.echo_calls
+            assert _delta(before, "-", CODEL) >= 1
+    finally:
+        srv.stop()
+
+
+def test_codel_http_both_lanes():
+    for native in (False, True):
+        if native:
+            require_native()
+        srv, svc = _server(native=native)
+        try:
+            with _codel_flags():
+                st1, _, _ = _http_exchange(
+                    srv.listen_endpoint,
+                    _http_req(b"/OV/Echo", b"one", close=not native))
+                assert st1 == 200
+                st2, headers, body = _http_exchange(
+                    srv.listen_endpoint,
+                    _http_req(b"/OV/Echo", b"two", close=not native))
+                assert st2 == 503
+                assert headers.get("x-overload-reason") == CODEL
+                assert headers.get("retry-after")
+                assert b"codel" in body
+                assert b"two" not in svc.echo_calls
+        finally:
+            srv.stop()
+
+
+def test_codel_grpc_h2():
+    srv, svc = _server(native=False)
+    try:
+        with _codel_flags():
+            assert _grpc_call(srv.listen_endpoint, b"one") == "0"
+            assert _grpc_call(srv.listen_endpoint, b"two") == "8"
+            assert b"two" not in svc.echo_calls
+    finally:
+        srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# tenant quota x five lanes: the hot tenant saturates capacity and is
+# rejected; the victim's guaranteed share still admits
+# ---------------------------------------------------------------------------
+
+def _tenant_servers(native):
+    return _server(native=native, tenant_fair_capacity=2)
+
+
+def test_tenant_quota_classic_tpu_std():
+    srv, svc = _server(native=False, tenant_fair_capacity=2)
+    try:
+        before = admission_counters()
+        sock = _park(srv, srv.listen_endpoint, n=2, tenant=b"hot")
+        # hot is at its whole-capacity guarantee (sole active tenant)
+        # AND the pool is contended: reject
+        _probe_tpu_std(srv, svc, srv.listen_endpoint, True, cid=80,
+                       tenant=b"hot")
+        assert _delta(before, "hot", TENANT_QUOTA) == 1
+        # the victim's guaranteed share (cap * 1/2 = 1) still admits
+        _probe_tpu_std(srv, svc, srv.listen_endpoint, False, cid=81,
+                       tenant=b"victim")
+        assert _delta(before, "victim", ADMITTED) == 1
+        svc.release_parked()
+        _read_frames(sock, 2)
+        sock.close()
+    finally:
+        srv.stop()
+
+
+def test_tenant_quota_slim_kind3():
+    require_native()
+    srv, svc = _server(native=True, tenant_fair_capacity=2)
+    try:
+        before = admission_counters()
+        sock = _park(srv, srv.listen_endpoint, n=2, tenant=b"hot")
+        _probe_tpu_std(srv, svc, srv.listen_endpoint, True, cid=82,
+                       tenant=b"hot")
+        assert _delta(before, "hot", TENANT_QUOTA) == 1
+        _probe_tpu_std(srv, svc, srv.listen_endpoint, False, cid=83,
+                       tenant=b"victim")
+        svc.release_parked()
+        _read_frames(sock, 2)
+        sock.close()
+    finally:
+        srv.stop()
+
+
+def test_tenant_quota_http_both_lanes():
+    for native in (False, True):
+        if native:
+            require_native()
+        srv, svc = _server(native=native, tenant_fair_capacity=2)
+        try:
+            sock = _park(srv, srv.listen_endpoint, n=2, tenant=b"hot")
+            st, headers, body = _http_exchange(
+                srv.listen_endpoint,
+                _http_req(b"/OV/Echo", b"hp", tenant="hot",
+                          close=not native))
+            assert st == 503
+            assert headers.get("x-overload-reason") == TENANT_QUOTA
+            assert headers.get("retry-after")
+            assert b"tenant hot quota" in body
+            st2, _, b2 = _http_exchange(
+                srv.listen_endpoint,
+                _http_req(b"/OV/Echo", b"vp", tenant="victim",
+                          close=not native))
+            assert st2 == 200 and b2 == b"ok:vp"
+            svc.release_parked()
+            sock.close()
+        finally:
+            srv.stop()
+
+
+def test_tenant_quota_grpc_h2():
+    srv, svc = _server(native=False, tenant_fair_capacity=2)
+    try:
+        sock = _park(srv, srv.listen_endpoint, n=2, tenant=b"hot")
+        assert _grpc_call(srv.listen_endpoint, b"hp", tenant="hot") == "8"
+        assert _grpc_call(srv.listen_endpoint, b"vp",
+                          tenant="victim") == "0"
+        svc.release_parked()
+        sock.close()
+    finally:
+        srv.stop()
+
+
+def test_tenant_quota_respects_fair_admission_flag():
+    """enable_fair_admission=False (the bench A/B switch) lets the hot
+    tenant through its quota."""
+    srv, svc = _server(native=False, tenant_fair_capacity=2)
+    try:
+        prev = get_flag("enable_fair_admission", True)
+        set_flag("enable_fair_admission", False)
+        try:
+            sock = _park(srv, srv.listen_endpoint, n=2, tenant=b"hot")
+            _probe_tpu_std(srv, svc, srv.listen_endpoint, False, cid=85,
+                           tenant=b"hot")
+            svc.release_parked()
+            sock.close()
+        finally:
+            set_flag("enable_fair_admission", prev)
+    finally:
+        srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# accounting invariants
+# ---------------------------------------------------------------------------
+
+def test_counters_closed_enum_and_inflight_drain():
+    """No 'unknown' bucket is POSSIBLE (closed verdict set) and every
+    admitted request settles its tenant slot."""
+    from brpc_tpu.server.admission import tenant_inflight_snapshot
+    srv, svc = _server(native=False, tenant_fair_capacity=2)
+    try:
+        sock = _park(srv, srv.listen_endpoint, n=2, tenant=b"hot")
+        _probe_tpu_std(srv, svc, srv.listen_endpoint, True, cid=86,
+                       tenant=b"hot")
+        assert tenant_inflight_snapshot().get("hot") == 2
+        svc.release_parked()
+        _read_frames(sock, 2)
+        sock.close()
+        deadline = time.time() + 5
+        while tenant_inflight_snapshot().get("hot") and \
+                time.time() < deadline:
+            time.sleep(0.01)
+        assert not tenant_inflight_snapshot().get("hot")
+        assert srv.inflight == 0
+    finally:
+        srv.stop()
+    for (tenant, verdict) in admission_counters():
+        assert verdict in VERDICTS, f"unknown verdict bucket {verdict!r}"
+
+
+def test_tenant_cardinality_bounded():
+    """A client stamping a fresh random tenant per request must not
+    grow the per-tenant tables (or the label family) without bound:
+    past the cap, new names pool into the overflow bucket."""
+    from brpc_tpu.server.admission import _MAX_TENANTS, TENANT_OVERFLOW
+    srv, svc = _server(native=False)
+    try:
+        ctl = srv.admission
+        entry = srv.find_method("OV", "Echo")
+        for i in range(_MAX_TENANTS + 64):
+            t = f"rnd-{i}"
+            assert ctl.admit(entry, "tpu_std", t, None) is None
+            srv.on_request_out(tenant=t)
+            entry.status.on_responded(0, 1)
+        assert len(ctl._tenant_inflight) <= _MAX_TENANTS + 1
+        assert TENANT_OVERFLOW in ctl._tenant_inflight
+        # every overflow acquire found its matching release
+        assert ctl._tenant_inflight[TENANT_OVERFLOW] == 0
+        assert srv.inflight == 0
+        # the REJECTION path must hit the same bound: a server-cap
+        # flood of fresh random tenant names (the overload case the
+        # bound exists for) must not grow the admission counters —
+        # rejected tenants never reach the inflight table, so the
+        # registry has to count observations, not admissions
+        before_rows = len(admission_counters())
+        srv.options.max_concurrency = 1
+        entry.status._inflight = 0
+        with srv._inflight_lock:
+            srv._inflight = 1           # saturate the server cap
+        try:
+            for i in range(128):
+                rej = ctl.admit(entry, "tpu_std", f"flood-{i}", None)
+                assert rej is not None and rej.reason == SERVER_CAP
+        finally:
+            with srv._inflight_lock:
+                srv._inflight = 0
+            srv.options.max_concurrency = 0
+        grown = len(admission_counters()) - before_rows
+        # one (~other, server_cap) row at most — not 128 tenant rows
+        assert grown <= 1, grown
+    finally:
+        srv.stop()
+
+
+def test_normalize_tenant():
+    assert normalize_tenant(None) == "-"
+    assert normalize_tenant(b"") == "-"
+    assert normalize_tenant("  ") == "-"
+    assert normalize_tenant(b"team-a") == "team-a"
+    assert normalize_tenant("team-a") == "team-a"
+    assert normalize_tenant(memoryview(b"k")) == "k"
+
+
+def test_server_wide_adaptive_limiter_spec():
+    """ServerOptions.max_concurrency accepts a make_limiter spec: the
+    server-wide cap then adapts (and /status-level accounting holds)."""
+    srv, svc = _server(native=False, max_concurrency="timeout:50")
+    try:
+        lim = srv.server_limiter()
+        assert lim is not None and lim.kind == "timeout"
+        ch = Channel()
+        ch.init(str(srv.listen_endpoint))
+        for i in range(30):
+            assert ch.call("OV.Echo", b"x") == b"ok:x"
+        # 30 fast echoes: the timeout limiter converged to a sane
+        # non-zero limit fed by real latencies
+        assert lim.max_concurrency() >= 1
+    finally:
+        srv.stop()
+
+
+def test_default_method_spec_star():
+    """method_max_concurrency['*'] installs a limiter on every method
+    without its own entry."""
+    srv, svc = _server(native=False,
+                       method_max_concurrency={"*": "auto",
+                                               "OV.Park": 7})
+    try:
+        assert srv.find_method("OV", "Echo").status.limiter_kind() \
+            == "auto"
+        park = srv.find_method("OV", "Park").status
+        assert park.limiter_kind() == "constant"
+        assert park.live_max_concurrency() == 7
+    finally:
+        srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# pinned: tenant-stamped traffic stays on the native lanes — the
+# admission stage introduces ZERO new fallback reasons
+# ---------------------------------------------------------------------------
+
+def test_no_new_fallbacks_with_tenant_and_rejections():
+    require_native()
+    srv, svc = _server(native=True, tenant_fair_capacity=8)
+    try:
+        eng = srv._native_bridge.engine
+        t0 = eng.telemetry()
+        ep = srv.listen_endpoint
+        # tenant-stamped tpu_std rides the slim kind-3 lane
+        with pysock.create_connection((str(ep.host), ep.port),
+                                      timeout=10) as c:
+            c.sendall(_frame(90, b"Echo", b"t1", tenant=b"team-a"))
+            metas = _read_frames(c, 1)
+            assert metas[90].error_code == 0
+            # an ELIMIT rejection must ALSO stay on the lane
+            status = _saturate_method(srv)
+            c.sendall(_frame(91, b"Echo", b"t2", tenant=b"team-a"))
+            metas = _read_frames(c, 1)
+            assert metas[91].error_code == ELIMIT
+            _unsaturate_method(status)
+        # tenant-stamped HTTP rides the slim kind-4 lane
+        st, _, body = _http_exchange(
+            ep, _http_req(b"/OV/Echo", b"h", tenant="team-a"))
+        assert st == 200 and body == b"ok:h"
+        t1 = eng.telemetry()
+        assert sum(t1["fallbacks"].values()) == \
+            sum(t0["fallbacks"].values()), t1["fallbacks"]
+        assert t1["lanes"]["slim"]["handled"] \
+            >= t0["lanes"]["slim"]["handled"] + 2
+        assert t1["lanes"]["http"]["handled"] \
+            >= t0["lanes"]["http"]["handled"] + 1
+    finally:
+        srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# client side: ELIMIT fail-fast failover
+# ---------------------------------------------------------------------------
+
+def test_client_elimit_fails_over_immediately():
+    """An ELIMIT bounce from a saturated replica retries IMMEDIATELY
+    (no backoff) on the other replica of an LB channel and succeeds."""
+    busy_srv, busy_svc = _server(native=False, max_concurrency=1)
+    free_srv, free_svc = _server(native=False)
+    sock = _park(busy_srv, busy_srv.listen_endpoint)
+    try:
+        co = ChannelOptions()
+        co.timeout_ms = 3000
+        co.max_retry = 2
+        co.retry_backoff_ms = 2000      # would blow the elapsed assert
+        co.connection_type = "pooled"   # if ELIMIT ever backed off
+        ch = Channel(co)
+        assert ch.init(
+            f"list://{busy_srv.listen_endpoint},"
+            f"{free_srv.listen_endpoint}", "rr") == 0
+        ok = retried = 0
+        t0 = time.monotonic()
+        for i in range(6):
+            cntl = Controller()
+            cntl.timeout_ms = 3000
+            c = ch.call_method("OV.Echo", b"x", cntl=cntl)
+            if not c.failed:
+                ok += 1
+            retried += c.retried_count
+        elapsed = time.monotonic() - t0
+        assert ok == 6, "fail-fast failover must reach the free replica"
+        assert retried >= 1          # at least one call bounced off busy
+        assert elapsed < 1.5, f"ELIMIT retries must skip backoff " \
+                              f"({elapsed:.2f}s)"
+    finally:
+        busy_svc.release_parked()
+        sock.close()
+        busy_srv.stop()
+        free_srv.stop()
+
+
+def test_run_raw_keeps_tenant_in_tlv_cache():
+    """The per-channel method-TLV cache is shared by every client lane:
+    a call_raw that populates it first must include the tenant TLV, or
+    later call_method traffic silently loses its fair-admission key."""
+    srv, svc = _server(native=False)
+    try:
+        co = ChannelOptions()
+        co.tenant = "acme"
+        co.connection_type = "pooled"
+        ch = Channel(co)
+        ch.init(str(srv.listen_endpoint))
+        try:
+            ch.call_raw("OV.Echo", b"x")
+        except Exception:
+            pass                      # reply shape irrelevant here
+        tlv = ch._method_tlvs.get("OV.Echo")
+        assert tlv is not None
+        assert encode_tlv(22, b"acme") in tlv
+        # and the round trip through call_method is attributed to acme
+        before = admission_counters()
+        assert ch.call("OV.Echo", b"y") == b"ok:y"
+        assert _delta(before, "acme", ADMITTED) == 1
+    finally:
+        srv.stop()
+
+
+def test_breaker_feeds_elimit_at_reduced_weight():
+    from brpc_tpu.client.circuit_breaker import CircuitBreakerMap
+    from brpc_tpu.butil.endpoint import EndPoint
+    m = CircuitBreakerMap()
+    ep = EndPoint(host="10.0.0.9", port=1)
+    # 20 straight ELIMIT bounces: short EMA converges to 0.3 < 0.6 trip
+    for _ in range(20):
+        m.on_call(ep, ELIMIT, 100)
+    assert not m.isolated(ep)
+    # 20 straight REAL errors trip isolation
+    for _ in range(20):
+        m.on_call(ep, 2001, 100)
+    assert m.isolated(ep)
+
+
+# ---------------------------------------------------------------------------
+# slow soak: sustained mixed-tenant overload leaks nothing
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_overload_soak_no_leaks():
+    from brpc_tpu.server.admission import tenant_inflight_snapshot
+    srv, svc = _server(native=False, tenant_fair_capacity=4,
+                       max_concurrency=8)
+    try:
+        stop = time.time() + 6.0
+        errs = []
+
+        def client(tenant):
+            co = ChannelOptions()
+            co.timeout_ms = 2000
+            co.max_retry = 0
+            co.connection_type = "pooled"
+            co.tenant = tenant
+            ch = Channel(co)
+            ch.init(str(srv.listen_endpoint))
+            while time.time() < stop:
+                cntl = Controller()
+                cntl.timeout_ms = 2000
+                c = ch.call_method("OV.Echo", b"s", cntl=cntl)
+                if c.failed and c.error_code != ELIMIT:
+                    errs.append(c.error_code)
+
+        threads = [threading.Thread(target=client,
+                                    args=(f"t{i % 3}",))
+                   for i in range(9)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(30)
+        assert not errs, f"non-ELIMIT failures under overload: {errs[:5]}"
+        deadline = time.time() + 5
+        while (srv.inflight or any(tenant_inflight_snapshot().values())) \
+                and time.time() < deadline:
+            time.sleep(0.02)
+        assert srv.inflight == 0
+        snap = tenant_inflight_snapshot()
+        assert not any(snap.values()), snap
+    finally:
+        srv.stop()
