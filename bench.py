@@ -250,25 +250,49 @@ def bench_headline_and_sweep(extra: dict) -> float:
         # pipelined small-message QPS (batch fast lane: one vectored
         # write per 256 calls, responses matched by correlation id —
         # the reference measures QPS with deep async pipelines too).
-        # The raw-method variant is the headline pipelined number; the
-        # controller-method variant is kept alongside.
+        # Best-of-3 windows per lane (the PR-6 raw-sweep discipline:
+        # one unlucky scheduler phase must not stand in for a lane),
+        # measured PAIRED and INTERLEAVED — each round runs both lanes
+        # back-to-back on the same connection with the order
+        # alternating, so `cntl_vs_raw_gap` (median per-round
+        # raw/cntl ratio, the ISSUE-8 acceptance key) is phase-immune
+        # on this throttled box even when the absolute numbers swing.
         reqs = [b"x" * 64] * 256
-        for mth, key in (("Bench.EchoRaw", "sweep_64b_pipelined_qps"),
-                         ("Bench.Echo", "sweep_64b_pipelined_cntl_qps")):
+
+        def batch_window(mth: str, secs: float = 1.5) -> float:
+            t0 = time.perf_counter()
+            n = 0
+            while time.perf_counter() - t0 < secs:
+                try:
+                    ch.call_batch(mth, reqs)
+                    n += len(reqs)
+                except Exception:
+                    pass                  # window failure ≠ bench death
+            return n / (time.perf_counter() - t0)
+
+        for mth in ("Bench.EchoRaw", "Bench.Echo"):
             for _ in range(3):
                 try:
                     ch.call_batch(mth, reqs)
                 except Exception:
                     pass                    # warmup failure ≠ bench death
-            t0 = time.perf_counter()
-            n = 0
-            while time.perf_counter() - t0 < 3.0:
-                try:
-                    ch.call_batch(mth, reqs)
-                    n += len(reqs)
-                except Exception:
-                    pass
-            extra[key] = round(n / (time.perf_counter() - t0), 1)
+        best_raw = best_cntl = 0.0
+        gaps = []
+        for rnd in range(3):
+            order = ("Bench.EchoRaw", "Bench.Echo") if rnd % 2 == 0 \
+                else ("Bench.Echo", "Bench.EchoRaw")
+            vals = {}
+            for mth in order:
+                vals[mth] = batch_window(mth)
+            best_raw = max(best_raw, vals["Bench.EchoRaw"])
+            best_cntl = max(best_cntl, vals["Bench.Echo"])
+            if vals["Bench.Echo"] > 0:
+                gaps.append(vals["Bench.EchoRaw"] / vals["Bench.Echo"])
+        extra["sweep_64b_pipelined_qps"] = round(best_raw, 1)
+        extra["sweep_64b_pipelined_cntl_qps"] = round(best_cntl, 1)
+        if gaps:
+            gaps.sort()
+            extra["cntl_vs_raw_gap"] = round(gaps[len(gaps) // 2], 2)
 
         # 1KB sync latency distribution — best of 3 windows, SAME count
         # for both lanes so the raw-vs-cntl delta stays a fair read
@@ -337,6 +361,10 @@ def bench_headline_and_sweep(extra: dict) -> float:
         if p50 < float("inf"):
             extra["echo_1kb_cntl_p50_us"] = round(p50, 1)
             extra["echo_1kb_cntl_p99_us"] = round(p99, 1)
+            # ISSUE-8 tracking key: the full-Controller unary tail
+            # latency the client lane is accountable for (same value,
+            # the name the acceptance/perf-guard tables key on)
+            extra["cntl_echo_p99_us"] = round(p99, 1)
         return headline
     finally:
         srv.stop()
@@ -511,42 +539,76 @@ def bench_fanout(extra: dict) -> None:
         def Get(self, cntl, request):
             return request
 
-    def run(native: bool, cntl_method: bool):
+    def start_servers(native: bool, both: bool):
         servers = []
         for _ in range(3):
             o = ServerOptions()
             if native:
                 o.native, o.usercode_inline, o.native_loops = True, True, 1
             s = Server(o)
-            s.add_service(PartCntl() if cntl_method else Part(), name="P")
+            s.add_service(PartCntl(), name="PC")
+            if both:
+                s.add_service(Part(), name="P")
             assert s.start("127.0.0.1:0") == 0
             servers.append(s)
-        try:
-            pc = ParallelChannel()
-            for s in servers:
-                sub = Channel()
-                sub.init(str(s.listen_endpoint))
-                pc.add_channel(sub)
-            for _ in range(5):
-                pc.call_method("P.Get", b"x")
-            t0 = time.perf_counter()
-            n = 0
-            while time.perf_counter() - t0 < 2.0:
-                c = pc.call_method("P.Get", b"x")
-                if not c.failed:
-                    n += 1
-            return n / (time.perf_counter() - t0)
-        finally:
-            for s in servers:
-                s.stop()
+        pc = ParallelChannel()
+        for s in servers:
+            sub = Channel()
+            sub.init(str(s.listen_endpoint))
+            pc.add_channel(sub)
+        return servers, pc
 
-    qps = run(native=True, cntl_method=False)
-    extra["fanout_qps"] = round(qps, 1)
-    extra["fanout_subcalls_qps"] = round(3 * qps, 1)
-    qps = run(native=True, cntl_method=True)
-    extra["fanout_cntl_qps"] = round(qps, 1)
-    qps = run(native=False, cntl_method=True)
-    extra["fanout_cntl_pytransport_qps"] = round(qps, 1)
+    def window(pc, mth: str, secs: float = 1.5) -> float:
+        t0 = time.perf_counter()
+        n = 0
+        while time.perf_counter() - t0 < secs:
+            c = pc.call_method(mth, b"x")
+            if not c.failed:
+                n += 1
+        return n / (time.perf_counter() - t0)
+
+    # PAIRED INTERLEAVED A/B on ONE server set (both services live on
+    # every sub-server): raw fan-out (native-echo parts via pinned
+    # scatter) vs the FULL-Controller fan-out (slim kind-3 parts via
+    # the same scatter) alternate within each round, best-of-3 windows
+    # per lane — `fanout_cntl_vs_raw_gap` (median per-round ratio) is
+    # the phase-immune read of the remaining client-bookkeeping gap.
+    servers, pc = start_servers(native=True, both=True)
+    try:
+        for _ in range(5):
+            pc.call_method("P.Get", b"x")
+            pc.call_method("PC.Get", b"x")
+        best_raw = best_cntl = 0.0
+        gaps = []
+        for rnd in range(3):
+            order = ("P.Get", "PC.Get") if rnd % 2 == 0 \
+                else ("PC.Get", "P.Get")
+            vals = {}
+            for mth in order:
+                vals[mth] = window(pc, mth)
+            best_raw = max(best_raw, vals["P.Get"])
+            best_cntl = max(best_cntl, vals["PC.Get"])
+            if vals["PC.Get"] > 0:
+                gaps.append(vals["P.Get"] / vals["PC.Get"])
+    finally:
+        for s in servers:
+            s.stop()
+    extra["fanout_qps"] = round(best_raw, 1)
+    extra["fanout_subcalls_qps"] = round(3 * best_raw, 1)
+    extra["fanout_cntl_qps"] = round(best_cntl, 1)
+    if gaps:
+        gaps.sort()
+        extra["fanout_cntl_vs_raw_gap"] = round(gaps[len(gaps) // 2], 2)
+
+    servers, pc = start_servers(native=False, both=False)
+    try:
+        for _ in range(5):
+            pc.call_method("PC.Get", b"x")
+        extra["fanout_cntl_pytransport_qps"] = round(
+            window(pc, "PC.Get", 2.0), 1)
+    finally:
+        for s in servers:
+            s.stop()
 
 
 def bench_http(extra: dict) -> None:

@@ -316,16 +316,27 @@ class Channel:
     def call(self, method_full: str, request: Any,
              response_type: Any = None, **kw) -> Any:
         if kw:
-            cntl = kw.pop("cntl", None) or Controller()
+            user_cntl = kw.pop("cntl", None)
+            cntl = user_cntl or Controller.obtain()
             if "timeout_ms" in kw:
                 cntl.timeout_ms = kw.pop("timeout_ms")
+            pooled = user_cntl is None and kw.get("done") is None
             c = self.call_method(method_full, request, response_type,
                                  cntl=cntl, **kw)
         else:
-            c = self.call_method(method_full, request, response_type)
-        if c.failed:
-            raise RpcError(c.error_code, c.error_text)
-        return c.response
+            # the controller is internal and synchronous here: obtain
+            # it from the free list and recycle after the results are
+            # extracted (user code never sees it)
+            pooled = True
+            c = self.call_method(method_full, request, response_type,
+                                 cntl=Controller.obtain())
+        failed, code, text = c.failed, c.error_code, c.error_text
+        response = c.response
+        if pooled:
+            c.recycle()
+        if failed:
+            raise RpcError(code, text)
+        return response
 
     def call_raw(self, method_full: str, payload,
                  attachment=b"",

@@ -17,7 +17,9 @@ Rendezvous design (the reference's, re-expressed):
 
 from __future__ import annotations
 
+import struct
 import threading
+from collections import deque
 from typing import Any, Callable, Optional, Set, Tuple
 
 from ..butil.iobuf import IOBuf, LazyAttachmentsMixin
@@ -29,13 +31,25 @@ from ..deadline import cap_timeout_ms as _cap_timeout_ms
 from ..fiber.timer_thread import global_timer_thread
 from ..fiber.versioned_id import global_id_pool
 from ..protocol import compress as compress_mod
-from ..protocol.meta import CompressType, RpcMeta
+from ..protocol.meta import (CompressType, RpcMeta, TLV_CORRELATION,
+                             TLV_SPAN, TLV_TIMEOUT, TLV_TRACE)
 from ..protocol.tpu_std import RpcMessage, pack_frame, parse_payload
+from ..transport.client_lane import lane_cancel, lane_expect
 from ..transport.socket import Socket
 from ..transport.socket_map import (global_socket_map, pooled_socket,
                                     return_pooled_socket, short_socket)
 
 _idp = global_id_pool()
+
+# Pooled Controllers: a free-list of reset-on-reuse instances for the
+# INTERNAL call sites that create controllers per call (ParallelChannel
+# legs, SelectiveChannel attempts, Channel.call sugar).  Reset is a full
+# __init__ re-run — every slot re-assigned, so NO state (tenant, trace,
+# deadline, attachment views, shm leases) can leak across calls; the
+# pooling saves the allocation + GC churn, which at fan-out rates is a
+# measurable slice of the per-leg cost.  deque ops are GIL-atomic.
+_cntl_pool: "deque[Controller]" = deque()
+_CNTL_POOL_MAX = 256
 
 # guards lazy creation of per-controller completion Events (rare: only
 # async joins ever create one; sync fast-path calls complete inline)
@@ -137,6 +151,29 @@ class Controller(LazyAttachmentsMixin):
         #                                  only at call end (descriptors
         #                                  may still be live on the wire)
 
+    # -- pooled controllers ------------------------------------------------
+
+    @classmethod
+    def obtain(cls) -> "Controller":
+        """A controller from the free list (or a fresh one).  ONLY for
+        internal call sites that also :meth:`recycle` — user-facing
+        controllers are never pooled (callers may hold them forever)."""
+        try:
+            return _cntl_pool.popleft()
+        except IndexError:
+            return cls()
+
+    def recycle(self) -> None:
+        """Return an internally-owned, FINISHED controller to the free
+        list.  Reset is a full ``__init__`` re-run: every slot is
+        re-assigned, so nothing — tenant, trace ids, deadline, response
+        views, shm leases, excluded servers — survives into the next
+        call (pinned by tests/test_client_lane.py)."""
+        if len(_cntl_pool) >= _CNTL_POOL_MAX:
+            return
+        self.__init__()
+        _cntl_pool.append(self)
+
     # -- lazy hot-path members ---------------------------------------------
     # attachments: LazyAttachmentsMixin.  The Event is also lazy: a sync
     # unary call never touches it (completed inline on the caller).
@@ -159,9 +196,11 @@ class Controller(LazyAttachmentsMixin):
     def _signal_ended(self) -> None:
         """Completion signal: flag first, then wake any created Event.
         Also unhooks every attempt's correlation id from its socket's
-        in-flight set — a call that ends without a response (timeout,
-        cancel, abandoned retry) must not leave its id pinned on a
-        long-lived connection."""
+        in-flight set (and the native client lane's demux table) — a
+        call that ends without a response (timeout, cancel, abandoned
+        retry) must not leave its id pinned on a long-lived connection.
+        The common fast-lane completion (no span, no Event, no marks)
+        is three attribute reads."""
         span = self._client_span
         if span is not None:
             self._client_span = None
@@ -171,11 +210,15 @@ class Controller(LazyAttachmentsMixin):
         ev = self._ended
         if ev is not None:
             ev.set()
-        for sid, cid in self._inflight_marks:
-            s = Socket.address(sid) if sid else None
-            if s is not None:
-                s.remove_inflight(cid)
-        self._inflight_marks.clear()
+        marks = self._inflight_marks
+        if marks:
+            for sid, cid in marks:
+                s = Socket.address(sid) if sid else None
+                if s is not None:
+                    s.remove_inflight(cid)
+                    if s.lane_token:
+                        lane_cancel(s, cid)
+            marks.clear()
 
     def _ended_event(self) -> threading.Event:
         """The completion Event, created on first wait (double-checked
@@ -373,6 +416,12 @@ class Controller(LazyAttachmentsMixin):
         attempt_id = self._cid_base + self._nretry
         ctype = self.connection_type or "single"
         ssl_ctx = self._channel.ssl_ctx() if self._channel else None
+        wire = self._channel.options.protocol if self._channel else "tpu_std"
+        # client-lane eligibility: tpu_std plaintext responses can ride
+        # the native demux; streams keep the dispatcher (their chunk
+        # frames would each pay a lane fallback hop)
+        lane_ok = (wire == "tpu_std" and ssl_ctx is None
+                   and self._stream_to_create is None)
         if ctype == "pooled":
             sid, rc = pooled_socket(remote, ssl_context=ssl_ctx)
             self._attempt_sids.append(sid)
@@ -380,14 +429,19 @@ class Controller(LazyAttachmentsMixin):
             sid, rc = short_socket(remote, ssl_context=ssl_ctx)
             self._attempt_sids.append(sid)
         else:
-            sid, rc = global_socket_map().get_socket(remote,
-                                                     ssl_context=ssl_ctx)
+            sid, rc = global_socket_map().get_socket(
+                remote, ssl_context=ssl_ctx, prefer_lane=lane_ok)
         self._sending_sid = sid
         sock = Socket.address(sid)
         if sock is not None and sock.direct_read and not self._direct_ok:
-            # async/backup/stream call on a fast-path connection: hand
-            # its reads to the dispatcher permanently
-            sock.ensure_dispatched()
+            # async/backup call on a fast-path connection: hand its
+            # reads to the NATIVE CLIENT LANE (engine-side response
+            # demux; the classic dispatcher conversion is the fallback
+            # and the only path for streams/TLS/non-tpu_std wires)
+            if lane_ok:
+                sock.ensure_client_lane()
+            else:
+                sock.ensure_dispatched()
         if sock is None or (rc != 0 and sock.failed):
             # connection failed synchronously: deliver through the id so
             # the retry path is uniform
@@ -395,7 +449,6 @@ class Controller(LazyAttachmentsMixin):
                        f"connect to {remote} failed")
             return
         svc, mth = self._method_full.rsplit(".", 1)
-        wire = self._channel.options.protocol if self._channel else "tpu_std"
         if wire == "http":
             # HTTP/1 has no multiplexing: the in-flight call rides the
             # connection itself (correlation_id on the socket), so the
@@ -440,6 +493,56 @@ class Controller(LazyAttachmentsMixin):
                 sock.remove_inflight(attempt_id)
             rc = sock.write(frame)
             if rc and sock.remove_inflight(attempt_id):
+                _idp.error(attempt_id, rc,
+                           sock.error_text or f"write to {remote} failed")
+            return
+        # -- precompiled call template (flat frame build) ------------------
+        # The run_raw TLV-prefix cache extended to the full-Controller
+        # path: for the plain request shape (no compression, stream,
+        # device/shm attachment, wire attachment or per-frame auth) the
+        # frame is cid TLV + the per-(socket, method, tenant) cached
+        # tail (service/method/tenant TLVs + ici domain/nonce) +
+        # per-attempt deadline/trace TLVs + payload views — no RpcMeta
+        # object, no pack_frame walk, byte-compatible with the classic
+        # build (same TLVs, fast-lane order).
+        na0 = len(self._req_att) if self._req_att is not None else 0
+        if (not self.request_compress_type
+                and self._stream_to_create is None
+                and self.request_device_attachment is None
+                and self._shm_slot is None and not self._shm_retired
+                and na0 == 0 and sock.shm is None
+                and not (self._channel is not None
+                         and self._channel.options.auth_data)):
+            mb = bytearray(TLV_CORRELATION)
+            mb += struct.pack("<Q", attempt_id)
+            mb += self._flat_tail(sock)
+            if self.timeout_ms and self.timeout_ms > 0:
+                elapsed_ms = (monotonic_us() - self._begin_us) // 1000
+                mb += TLV_TIMEOUT + struct.pack(
+                    "<I", max(1, int(self.timeout_ms - elapsed_ms)))
+            if self.trace_id:
+                mb += TLV_TRACE + struct.pack("<Q", self.trace_id)
+                if self.span_id:
+                    mb += TLV_SPAN + struct.pack("<Q", self.span_id)
+            payload = self._request_payload
+            plen = len(payload) if payload is not None else 0
+            header = b"TRPC" + struct.pack("<II", len(mb) + plen,
+                                           len(mb))
+            parts = (header, bytes(mb))
+            if plen:
+                parts = parts + tuple(payload.backing_views())
+            sock.add_inflight(attempt_id)
+            self._inflight_marks.append((sid, attempt_id))
+            if sock.lane_token:
+                # native demux rendezvous: registered BEFORE the write
+                # (mirrors add_inflight's ordering contract)
+                lane_expect(sock, attempt_id)
+            if self._ended_flag:
+                sock.remove_inflight(attempt_id)
+                lane_cancel(sock, attempt_id)
+            rc = sock.write_parts(parts)
+            if rc and sock.remove_inflight(attempt_id):
+                lane_cancel(sock, attempt_id)
                 _idp.error(attempt_id, rc,
                            sock.error_text or f"write to {remote} failed")
             return
@@ -538,16 +641,49 @@ class Controller(LazyAttachmentsMixin):
         # claims the id from the set delivers its one outcome
         sock.add_inflight(attempt_id)
         self._inflight_marks.append((sid, attempt_id))
+        if sock.lane_token:
+            lane_expect(sock, attempt_id)
         if self._ended_flag:
             # the call ended while this send was mid-launch (timeout or
             # cancel racing the issuing thread): _signal_ended's drain
             # may have run before our append and will not run again —
             # unhook the id ourselves or it pins the long-lived socket
             sock.remove_inflight(attempt_id)
+            lane_cancel(sock, attempt_id)
         rc = sock.write(frame)
         if rc and sock.remove_inflight(attempt_id):
+            lane_cancel(sock, attempt_id)
             _idp.error(attempt_id, rc,
                        sock.error_text or f"write to {remote} failed")
+
+    def _flat_tail(self, sock) -> bytes:
+        """The per-(socket, method, tenant) cached meta-TLV tail of the
+        precompiled call template: service/method (+ tenant) TLVs plus,
+        with ici on, this process's domain TLV and the socket's conn
+        nonce — the same cache (``sock._cntl_tails``) and wire content
+        the pinned fast lane uses, so the two paths can never drift."""
+        from . import fast_call as _fc
+        opts = self._channel.options
+        tail_key = (self._method_full, opts.tenant)
+        tails = getattr(sock, "_cntl_tails", None)
+        tail = tails.get(tail_key) if tails is not None else None
+        if tail is None:
+            ch = self._channel
+            tlv = ch._method_tlvs.get(self._method_full)
+            if tlv is None:
+                tlv = ch._method_tlvs[self._method_full] = \
+                    _fc.method_tlv(self._method_full, opts.tenant)
+            tail = tlv
+            from ..ici.endpoint import (conn_nonce_of, ici_enabled,
+                                        local_domain_id)
+            if ici_enabled():
+                from ..protocol.meta import TAG_ICI_CONN, encode_tlv
+                tail = (tail + _fc._domain_tlv(local_domain_id())
+                        + encode_tlv(TAG_ICI_CONN, conn_nonce_of(sock)))
+            if tails is None:
+                tails = sock._cntl_tails = {}
+            tails[tail_key] = tail
+        return tail
 
     # -- asynchronous events (timers / socket failures / cancel) ----------
 
@@ -765,6 +901,45 @@ class Controller(LazyAttachmentsMixin):
                 return
         try:
             self.response = parse_payload(raw, self._response_type)
+        except Exception as e:
+            self._finish_locked(Errno.ERESPONSE,
+                                f"response parse failed: {e}")
+            return
+        self.response_attachment = attachment
+        self._finish_locked(0, "")
+
+    def _on_plain_response(self, cid: int, buf, natt: int, dom,
+                           sock) -> None:
+        """Native-lane completion of a PLAIN success response (cid /
+        attachment-size / ici-domain meta only — the engine's demux
+        guarantees the shape).  Runs with the id LOCKED; mirrors
+        ``_on_response``'s success arm minus everything a plain meta
+        cannot carry (errors, stream grants, descriptors, compression,
+        shm tags — those fall back to the classic demux wholesale)."""
+        version = cid - self._cid_base
+        if version not in self._live_versions:
+            _idp.unlock(self._cid_base)      # stale attempt's response
+            return
+        if self._shm_offered or self._shm_slot is not None:
+            # a plain success answers this attempt's staged slot/offer
+            # exactly like the blocking lanes' plain path: settle the
+            # slot; an unanswered offer marks the peer capability-less
+            from ..transport import shm_ring as _shm
+            _shm.client_complete(self._shm_slot)
+            self._shm_slot = None
+            if self._shm_offered:
+                _shm.client_saw_plain_response(sock)
+        if dom:
+            sock.ici_peer_domain = dom
+        body = memoryview(buf)
+        attachment = IOBuf()
+        if natt:
+            # the engine already bounded natt <= len(body)
+            attachment.append_user_data(body[len(body) - natt:])
+            body = body[:len(body) - natt]
+        try:
+            self.response = parse_payload(bytes(body),
+                                          self._response_type)
         except Exception as e:
             self._finish_locked(Errno.ERESPONSE,
                                 f"response parse failed: {e}")

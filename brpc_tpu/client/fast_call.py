@@ -933,14 +933,31 @@ def run_scatter(branches, timeout_ms: Optional[int]) -> bool:
         remote = channel.single_server
         cntl.remote_side = remote
         pooled = cntl.connection_type == "pooled"
-        sid, rc = pooled_socket(remote) if pooled else short_socket(remote)
-        sock = Socket.address(sid)
-        if sock is None or (rc != 0 and sock.failed) \
-                or (sock.fd is None and sock.connect_if_not() != 0) \
-                or not sock.direct_read or not sock.read_portal.empty() \
-                or not sock.write_path_idle():
-            if sock is not None:
-                sock.release()
+        sock = None
+        for _redraw in range(2):
+            sid, rc = pooled_socket(remote) if pooled \
+                else short_socket(remote)
+            s = Socket.address(sid)
+            if s is None or (rc != 0 and s.failed) \
+                    or (s.fd is None and s.connect_if_not() != 0):
+                if s is not None:
+                    s.release()
+                break                      # real connect failure
+            if not s.direct_read:
+                # a dispatcher/lane-converted connection drifted back
+                # into the pool (an async call used it): it can never
+                # serve the sync scatter lanes again — retire it and
+                # draw a fresh one instead of failing the branch
+                s.release()
+                continue
+            if not s.read_portal.empty() or not s.write_path_idle():
+                # carries buffered state another path owns: hand it
+                # back untouched, fail the branch like before
+                s.release()
+                break
+            sock = s
+            break
+        if sock is None:
             _finish(channel, cntl, Errno.EFAILEDSOCKET,
                     f"connect to {remote} failed")
             continue

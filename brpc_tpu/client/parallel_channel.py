@@ -126,7 +126,11 @@ class ParallelChannel:
             sub_cntls = []
             scatter = []
             for i, sub, mapped in branches:
-                sc = Controller()
+                # POOLED leg controllers (reset-on-reuse): the legs are
+                # internal — completed, read and recycled inside this
+                # call, so the fan-out stops paying an allocation + GC
+                # churn per branch per call
+                sc = Controller.obtain()
                 # legs share the fan-out's remaining budget, not a
                 # fresh copy of the full timeout
                 sc.timeout_ms = left
@@ -158,6 +162,10 @@ class ParallelChannel:
                              for sc in sub_cntls])
                     except Exception as e:
                         c.set_failed(Errno.EINTERNAL, f"merger raised: {e}")
+                for sc in sub_cntls:
+                    # responses/errors extracted above: the legs are
+                    # dead weight now — back to the free list
+                    sc.recycle()
                 c._signal_ended()
                 return c
 

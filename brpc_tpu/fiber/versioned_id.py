@@ -116,6 +116,23 @@ class IdPool:
                     return True, slot.data
                 slot.cond.wait()
 
+    def try_lock(self, call_id: int) -> Tuple[int, Any]:
+        """Non-blocking :meth:`lock`: (1, data) = locked, (0, None) =
+        currently held by another owner (caller must not wait here),
+        (-1, None) = stale/destroyed.  The client lane's demux thread
+        uses this so one contended id (a backup-request handler mid-
+        connect) can never stall every connection's completions."""
+        slot, version = self._resolve(call_id)
+        if slot is None:
+            return -1, None
+        with slot.cond:
+            if not self._valid_locked(slot, version):
+                return -1, None
+            if slot.locked:
+                return 0, None
+            slot.locked = True
+            return 1, slot.data
+
     def unlock(self, call_id: int) -> None:
         """Release the lock; if errors were queued while locked, run the
         handler for the next one (still holding the logical id lock)."""

@@ -32,6 +32,7 @@
 #include <netinet/in.h>
 #include <netinet/tcp.h>
 #include <string>
+#include <unordered_set>
 #include <sys/epoll.h>
 #include <sys/eventfd.h>
 #include <sys/socket.h>
@@ -529,6 +530,11 @@ struct EngineImpl {
   // HTTP body limit (mirrors protocol/http.py max_body_size; the
   // bridge syncs it at listen time and on live flag flips)
   std::atomic<size_t> http_max_body{64u * 1024u * 1024u};
+  // optional per-burst epilogue: called ONCE after each flush_py_batch
+  // item loop (GIL already held) so the Python shims can flush
+  // per-burst aggregated accounting (admitted counts, method samples)
+  // instead of paying locked counters per item
+  PyObject* burst_end = nullptr;
 };
 
 static int64_t now_ms() {
@@ -1194,6 +1200,14 @@ static void flush_py_batch(Loop* lp, Conn* c,
     int64_t t1 = now_ns();
     lp->tel.shim[lane].add((uint64_t)((t1 - t0) / 1000));
     lp->tel.resid[lane].add((uint64_t)((t1 - it.t_parse) / 1000));
+  }
+  if (lp->eng->burst_end != nullptr) {
+    // per-burst accounting epilogue (one call per batched GIL entry)
+    PyObject* r = PyObject_CallNoArgs(lp->eng->burst_end);
+    if (!r)
+      PyErr_WriteUnraisable(lp->eng->burst_end);
+    else
+      Py_DECREF(r);
   }
   PyGILState_Release(gs);
   batch.clear();
@@ -2653,6 +2667,29 @@ static PyObject* Engine_register_native_method(EngineObj* self,
   Py_RETURN_NONE;
 }
 
+// set_burst_end(callable_or_None) — per-burst accounting epilogue for
+// the batched shim lanes; pre-listen only (loops read it lock-free)
+static PyObject* Engine_set_burst_end(EngineObj* self, PyObject* args) {
+  PyObject* cb;
+  if (!PyArg_ParseTuple(args, "O", &cb)) return nullptr;
+  if (self->eng->started) {
+    PyErr_SetString(PyExc_RuntimeError,
+                    "burst_end must be set before listen()");
+    return nullptr;
+  }
+  if (cb != Py_None && !PyCallable_Check(cb)) {
+    PyErr_SetString(PyExc_TypeError, "burst_end must be callable");
+    return nullptr;
+  }
+  Py_XDECREF(self->eng->burst_end);
+  self->eng->burst_end = nullptr;
+  if (cb != Py_None) {
+    Py_INCREF(cb);
+    self->eng->burst_end = cb;
+  }
+  Py_RETURN_NONE;
+}
+
 static PyObject* Engine_set_native_dispatch(EngineObj* self,
                                             PyObject* args) {
   int on;
@@ -3189,6 +3226,7 @@ static void Engine_dealloc(EngineObj* self) {
       delete kv.second;
     }
     Py_XDECREF(self->eng->dispatch);
+    Py_XDECREF(self->eng->burst_end);
     delete self->eng;
   }
   Py_TYPE(self)->tp_free((PyObject*)self);
@@ -3215,6 +3253,9 @@ static PyMethodDef Engine_methods[] = {
      "method in C++ (kind 0=echo, 1=const); pre-listen only"},
     {"set_native_dispatch", (PyCFunction)Engine_set_native_dispatch,
      METH_VARARGS, "enable/disable GIL-free native dispatch at runtime"},
+    {"set_burst_end", (PyCFunction)Engine_set_burst_end, METH_VARARGS,
+     "set_burst_end(callable|None) — per-burst accounting epilogue "
+     "called once after each batched shim entry; pre-listen only"},
     {"register_http_route", (PyCFunction)Engine_register_http_route,
      METH_VARARGS,
      "register_http_route(method, path, handler) — slim HTTP lane "
@@ -4645,6 +4686,688 @@ static PyObject* call_batch(PyObject*, PyObject* args) {
   return Py_BuildValue("(NN)", out_list, acks);
 }
 
+// ---------------------------------------------------------------------------
+// ClientDemux — the native CLIENT completion lane (the client-side twin
+// of the server's kind-3 slim lane).  The full-Controller async path
+// used to pay, per response: one dispatcher wakeup, a fiber spawn, a
+// Python frame cut, a full RpcMeta decode and a dict lookup.  Here a
+// dedicated epoll loop owns the read side of attached client sockets,
+// parses response frames off the read burst in C++, correlates them by
+// cid against a native in-flight table (registered at send time from
+// controller._issue_rpc), and delivers a whole burst of completions to
+// Python in ONE batched callback:
+//
+//     callback(token, status, completions, fallbacks, acks)
+//
+//     status       0 = burst, 1 = peer EOF, 2 = transport/protocol error
+//     completions  [(cid, payload_buf, att_size, dom_or_None), ...] —
+//                  PLAIN success responses only (cid/att/ici-domain
+//                  meta tags), payload_buf = NativeBuf(payload ++ att)
+//     fallbacks    [(reason, raw_frame_buf), ...] — anything the scan
+//                  cannot resolve natively, delivered as the EXACT wire
+//                  bytes (header included) for the classic Python demux
+//                  (byte-identical by construction).  ``reason`` indexes
+//                  the closed CliFb enum below — no "unknown" bucket.
+//     acks         TICI credit-return ids interleaved in the burst
+//
+// The in-flight table is the rendezvous: expect(token, cid) BEFORE the
+// request write, cancel(token, cid) at call end (mirrors the Python
+// socket's add_inflight/remove_inflight, which stays authoritative for
+// failure notification).  A response whose meta carries anything
+// controller-tier (errors, compression, shm, descriptors, stream
+// grants) keeps its table entry and falls back whole — the classic
+// path completes it and call teardown cancels the entry.
+// ---------------------------------------------------------------------------
+
+// closed client-lane fallback reason enum (mirrors FbReason's
+// discipline: every frame routed OFF the native demux increments
+// exactly one of these)
+enum CliFb : int {
+  CFB_UNKNOWN_CID = 0,   // cid not in the in-flight table (stale /
+                         // cancelled / foreign response)
+  CFB_META_UNPARSED,     // no cid tag found / malformed meta walk
+  CFB_META_TAGS,         // controller-tier response meta (error codes,
+                         // compression, shm, descriptors, stream
+                         // grants): full RpcMeta decode in Python
+  CFB_STREAM_FRAME,      // TSTR stream frame on a lane socket
+  CFB_UNKNOWN_MAGIC,     // not TRPC/TICI/TSTR: sticky passthrough —
+                         // the Python protocol registry owns the conn
+  CFB_REASONS
+};
+static const char* kCliFbNames[CFB_REASONS] = {
+    "cli_unknown_cid", "cli_meta_unparsed", "cli_meta_tags",
+    "cli_stream_frame", "cli_unknown_magic",
+};
+
+struct CliConn {
+  int fd = -1;            // demux-owned dup() of the Python socket's fd
+                          // (a Python-side close can never strand a
+                          // recv on a reused fd number)
+  uint64_t token = 0;
+  bool dead = false;      // detach() marks; only the loop frees
+  bool passthrough = false;  // unknown magic seen: forward everything
+  std::string acc;        // unconsumed wire bytes across reads
+  std::unordered_set<uint64_t> inflight;  // guarded by DemuxImpl::mu
+};
+
+struct CliTelemetry {
+  uint64_t completions = 0;      // natively-demuxed responses
+  uint64_t fallbacks[CFB_REASONS] = {};
+  uint64_t acks = 0;
+  uint64_t bursts = 0;           // batched callbacks delivered
+  uint64_t bytes_in = 0;
+  Hist comp_burst;               // completions per batched callback
+};
+
+struct DemuxImpl {
+  PyObject* callback = nullptr;
+  int epfd = -1;
+  int wakefd = -1;
+  std::atomic<bool> stopping{false};
+  std::atomic<bool> running{false};
+  // one mutex guards the conn map, every conn's inflight set and the
+  // reap list: expect/cancel are sub-microsecond ops from GIL-holding
+  // issuer threads, the loop touches the tables only around lookups
+  std::mutex mu;
+  std::unordered_map<uint64_t, CliConn*> conns;
+  std::vector<uint64_t> reap;
+  std::atomic<uint64_t> next_token{1};
+  CliTelemetry tel;              // loop-thread writes; racy reads OK
+};
+
+typedef struct {
+  PyObject_HEAD DemuxImpl* d;
+} DemuxObj;
+
+static void demux_wake(DemuxImpl* d) {
+  uint64_t one = 1;
+  ssize_t r = write(d->wakefd, &one, 8);
+  (void)r;
+}
+
+// one parsed completion / fallback span into CliConn::acc
+struct CliComp {
+  uint64_t cid;
+  size_t pay_off, pay_len;
+  uint32_t att;
+  size_t dom_off;
+  uint32_t dom_len;
+};
+struct CliFbSpan {
+  int reason;
+  size_t off, len;
+};
+
+// Parse as many complete frames as possible from c->acc starting at 0;
+// classifies each against the in-flight table.  Returns consumed bytes;
+// *hard_err set on protocol-fatal framing (bad sizes).  Runs on the
+// loop thread WITHOUT the GIL; takes d->mu only around table lookups.
+static size_t cli_parse(DemuxImpl* d, CliConn* c,
+                        std::vector<CliComp>& comps,
+                        std::vector<CliFbSpan>& fbs,
+                        std::vector<uint64_t>& acks, bool* hard_err) {
+  const std::string& a = c->acc;
+  size_t off = 0;
+  while (a.size() - off >= 4) {
+    const char* p = a.data() + off;
+    size_t avail = a.size() - off;
+    if (c->passthrough) {
+      fbs.push_back({CFB_UNKNOWN_MAGIC, off, avail});
+      off = a.size();
+      break;
+    }
+    if (memcmp(p, "TICI", 4) == 0) {
+      if (avail < 8) break;
+      uint32_t cnt = 0;
+      memcpy(&cnt, p + 4, 4);
+      if (cnt > (1u << 20)) {
+        *hard_err = true;
+        break;
+      }
+      size_t total = 8 + 8ul * cnt;
+      if (avail < total) break;
+      for (uint32_t i = 0; i < cnt; i++) {
+        uint64_t id;
+        memcpy(&id, p + 8 + 8ul * i, 8);
+        acks.push_back(id);
+      }
+      off += total;
+      continue;
+    }
+    if (memcmp(p, "TRPC", 4) == 0) {
+      if (avail < kHeaderSize) break;
+      uint32_t body = 0, meta = 0;
+      memcpy(&body, p + 4, 4);
+      memcpy(&meta, p + 8, 4);
+      if (body > kMaxBody || meta > body) {
+        *hard_err = true;
+        break;
+      }
+      size_t total = kHeaderSize + (size_t)body;
+      if (avail < total) break;
+      // response meta walk: cid + plain-success classification (the
+      // same shape scan_plain_resp applies on the blocking lanes)
+      uint64_t cid = 0;
+      bool got_cid = false, plain = true;
+      uint32_t att = 0;
+      size_t dom_off = 0;
+      uint32_t dom_len = 0;
+      const char* mp = p + kHeaderSize;
+      size_t mo = 0;
+      while (mo + 5 <= meta) {
+        uint8_t tag = (uint8_t)mp[mo];
+        uint32_t ln;
+        memcpy(&ln, mp + mo + 1, 4);
+        mo += 5;
+        if (mo + ln > meta) {
+          got_cid = false;       // malformed walk: meta_unparsed
+          break;
+        }
+        if (tag == 1 && ln == 8) {
+          memcpy(&cid, mp + mo, 8);
+          got_cid = true;
+        } else if (tag == 3 && ln == 4) {
+          memcpy(&att, mp + mo, 4);
+        } else if (tag == 15) {
+          dom_off = off + kHeaderSize + mo;
+          dom_len = ln;
+        } else {
+          plain = false;
+        }
+        mo += ln;
+      }
+      if (!got_cid) {
+        fbs.push_back({CFB_META_UNPARSED, off, total});
+        off += total;
+        continue;
+      }
+      bool eligible = plain && (size_t)att <= (size_t)body - meta;
+      bool known, taken = false;
+      {
+        std::lock_guard<std::mutex> g(d->mu);
+        known = c->inflight.count(cid) != 0;
+        if (known && eligible) {
+          c->inflight.erase(cid);
+          taken = true;
+        }
+        // non-eligible shapes keep their entry: the classic demux
+        // completes them and call teardown cancels the table row
+      }
+      if (taken) {
+        comps.push_back({cid, off + kHeaderSize + meta,
+                         (size_t)body - meta, att, dom_off, dom_len});
+      } else if (!known) {
+        fbs.push_back({CFB_UNKNOWN_CID, off, total});
+      } else {
+        fbs.push_back({CFB_META_TAGS, off, total});
+      }
+      off += total;
+      continue;
+    }
+    if (memcmp(p, "TSTR", 4) == 0) {
+      if (avail < 17) break;
+      uint32_t len = 0;
+      memcpy(&len, p + 13, 4);
+      if (len > kMaxBody) {
+        *hard_err = true;
+        break;
+      }
+      size_t total = 4 + 13 + (size_t)len;
+      if (avail < total) break;
+      fbs.push_back({CFB_STREAM_FRAME, off, total});
+      off += total;
+      continue;
+    }
+    // unknown magic: STICKY passthrough — from here on every byte of
+    // this connection belongs to the Python protocol registry (the
+    // Python side detaches and converts to dispatcher reads)
+    c->passthrough = true;
+    fbs.push_back({CFB_UNKNOWN_MAGIC, off, avail});
+    off = a.size();
+    break;
+  }
+  return off;
+}
+
+// deliver one batched callback (ONE GIL entry per read burst) — the
+// client-side mirror of flush_py_batch's discipline
+static void cli_deliver(DemuxImpl* d, CliConn* c, int status,
+                        std::vector<CliComp>& comps,
+                        std::vector<CliFbSpan>& fbs,
+                        std::vector<uint64_t>& acks) {
+  if (status == 0 && comps.empty() && fbs.empty() && acks.empty())
+    return;
+  const std::string& a = c->acc;
+  PyGILState_STATE gs = PyGILState_Ensure();
+  PyObject* pc = Py_None;
+  PyObject* pf = Py_None;
+  PyObject* pa = Py_None;
+  bool ok = true;
+  if (!comps.empty()) {
+    pc = PyList_New((Py_ssize_t)comps.size());
+    ok = pc != nullptr;
+    for (size_t i = 0; ok && i < comps.size(); i++) {
+      CliComp& cm = comps[i];
+      NativeBuf* b = nativebuf_new((Py_ssize_t)cm.pay_len);
+      if (!b) {
+        ok = false;
+        break;
+      }
+      if (cm.pay_len) memcpy(b->data, a.data() + cm.pay_off, cm.pay_len);
+      PyObject* dom;
+      if (cm.dom_len) {
+        dom = PyBytes_FromStringAndSize(a.data() + cm.dom_off,
+                                        (Py_ssize_t)cm.dom_len);
+        if (!dom) {
+          Py_DECREF((PyObject*)b);
+          ok = false;
+          break;
+        }
+      } else {
+        dom = Py_None;
+        Py_INCREF(Py_None);
+      }
+      PyObject* t = Py_BuildValue("(KNkN)", (unsigned long long)cm.cid,
+                                  (PyObject*)b, (unsigned long)cm.att,
+                                  dom);
+      if (!t) {
+        ok = false;
+        break;
+      }
+      PyList_SET_ITEM(pc, (Py_ssize_t)i, t);
+    }
+  }
+  if (ok && !fbs.empty()) {
+    pf = PyList_New((Py_ssize_t)fbs.size());
+    ok = pf != nullptr;
+    for (size_t i = 0; ok && i < fbs.size(); i++) {
+      CliFbSpan& f = fbs[i];
+      NativeBuf* b = nativebuf_new((Py_ssize_t)f.len);
+      if (!b) {
+        ok = false;
+        break;
+      }
+      if (f.len) memcpy(b->data, a.data() + f.off, f.len);
+      PyObject* t = Py_BuildValue("(iN)", f.reason, (PyObject*)b);
+      if (!t) {
+        ok = false;
+        break;
+      }
+      PyList_SET_ITEM(pf, (Py_ssize_t)i, t);
+    }
+  }
+  if (ok && !acks.empty()) {
+    pa = PyList_New((Py_ssize_t)acks.size());
+    ok = pa != nullptr;
+    for (size_t i = 0; ok && i < acks.size(); i++) {
+      PyObject* v = PyLong_FromUnsignedLongLong(acks[i]);
+      if (!v) {
+        ok = false;
+        break;
+      }
+      PyList_SET_ITEM(pa, (Py_ssize_t)i, v);
+    }
+  }
+  if (ok) {
+    d->tel.bursts++;
+    d->tel.completions += comps.size();
+    d->tel.comp_burst.add((uint64_t)comps.size());
+    for (auto& f : fbs) d->tel.fallbacks[f.reason]++;
+    d->tel.acks += acks.size();
+    PyObject* r = PyObject_CallFunction(
+        d->callback, "KiOOO", (unsigned long long)c->token, status,
+        pc == nullptr ? Py_None : pc, pf == nullptr ? Py_None : pf,
+        pa == nullptr ? Py_None : pa);
+    if (!r)
+      PyErr_WriteUnraisable(d->callback);
+    else
+      Py_DECREF(r);
+  } else {
+    PyErr_WriteUnraisable(d->callback);
+  }
+  if (pc != Py_None) Py_XDECREF(pc);
+  if (pf != Py_None) Py_XDECREF(pf);
+  if (pa != Py_None) Py_XDECREF(pa);
+  PyGILState_Release(gs);
+}
+
+// one readable event on a lane conn: drain the socket, parse, deliver
+static void cli_readable(DemuxImpl* d, CliConn* c) {
+  int status = 0;
+  for (;;) {
+    char tmp[65536];
+    ssize_t r = recv(c->fd, tmp, sizeof tmp, 0);
+    if (r > 0) {
+      c->acc.append(tmp, (size_t)r);
+      d->tel.bytes_in += (uint64_t)r;
+      // bound one burst's accumulation; level-triggered epoll re-fires
+      // for whatever the kernel still holds
+      if (c->acc.size() >= (8u << 20)) break;
+      continue;
+    }
+    if (r == 0) {
+      status = 1;                       // peer EOF
+      break;
+    }
+    if (errno == EAGAIN || errno == EWOULDBLOCK) break;
+    if (errno == EINTR) continue;
+    status = 2;                         // transport error
+    break;
+  }
+  std::vector<CliComp> comps;
+  std::vector<CliFbSpan> fbs;
+  std::vector<uint64_t> acks;
+  bool hard_err = false;
+  size_t used = cli_parse(d, c, comps, fbs, acks, &hard_err);
+  if (hard_err && status == 0) status = 2;   // bad framing: fail conn
+  cli_deliver(d, c, status, comps, fbs, acks);
+  c->acc.erase(0, used);
+  if (status != 0) {
+    // stop polling a dying conn; the Python side detaches (reap frees)
+    c->dead = true;
+    epoll_ctl(d->epfd, EPOLL_CTL_DEL, c->fd, nullptr);
+  }
+}
+
+static void demux_run(DemuxImpl* d) {
+  struct epoll_event evs[64];
+  while (!d->stopping.load()) {
+    int n = epoll_wait(d->epfd, evs, 64, 200);
+    if (n < 0) {
+      if (errno == EINTR) continue;
+      break;
+    }
+    // reap detached conns (only the loop frees — an issuer thread must
+    // never pull a CliConn out from under a recv)
+    {
+      std::vector<CliConn*> gone;
+      {
+        std::lock_guard<std::mutex> g(d->mu);
+        for (uint64_t tok : d->reap) {
+          auto it = d->conns.find(tok);
+          if (it == d->conns.end()) continue;
+          gone.push_back(it->second);
+          d->conns.erase(it);
+        }
+        d->reap.clear();
+      }
+      for (CliConn* c : gone) {
+        close(c->fd);
+        delete c;
+      }
+    }
+    for (int i = 0; i < n; i++) {
+      uint64_t tok = evs[i].data.u64;
+      if (tok == 0) {
+        uint64_t drain;
+        while (read(d->wakefd, &drain, 8) > 0) {
+        }
+        continue;
+      }
+      CliConn* c = nullptr;
+      {
+        std::lock_guard<std::mutex> g(d->mu);
+        auto it = d->conns.find(tok);
+        if (it != d->conns.end() && !it->second->dead) c = it->second;
+      }
+      if (c == nullptr) continue;
+      if (evs[i].events & (EPOLLHUP | EPOLLERR)) {
+        // drain what the kernel still holds first (a peer close right
+        // after the last response must deliver that response)
+        cli_readable(d, c);
+        if (!c->dead) {
+          std::vector<CliComp> e1;
+          std::vector<CliFbSpan> e2;
+          std::vector<uint64_t> e3;
+          cli_deliver(d, c, 1, e1, e2, e3);
+          c->dead = true;
+          epoll_ctl(d->epfd, EPOLL_CTL_DEL, c->fd, nullptr);
+        }
+        continue;
+      }
+      if (evs[i].events & EPOLLIN) cli_readable(d, c);
+    }
+  }
+  d->running.store(false);
+}
+
+static PyObject* Demux_new(PyTypeObject* type, PyObject* args,
+                           PyObject* kwds) {
+  PyObject* callback;
+  static const char* kwlist[] = {"callback", nullptr};
+  if (!PyArg_ParseTupleAndKeywords(args, kwds, "O", (char**)kwlist,
+                                   &callback))
+    return nullptr;
+  if (!PyCallable_Check(callback)) {
+    PyErr_SetString(PyExc_TypeError, "callback must be callable");
+    return nullptr;
+  }
+  DemuxObj* self = (DemuxObj*)type->tp_alloc(type, 0);
+  if (!self) return nullptr;
+  self->d = new DemuxImpl();
+  Py_INCREF(callback);
+  self->d->callback = callback;
+  self->d->epfd = epoll_create1(EPOLL_CLOEXEC);
+  self->d->wakefd = eventfd(0, EFD_NONBLOCK | EFD_CLOEXEC);
+  struct epoll_event ev;
+  ev.events = EPOLLIN;
+  ev.data.u64 = 0;
+  epoll_ctl(self->d->epfd, EPOLL_CTL_ADD, self->d->wakefd, &ev);
+  return (PyObject*)self;
+}
+
+// run_loop() — the demux loop body, called from a Python thread (its
+// resident frame pins the datastack chunk, so per-burst callbacks skip
+// the cold-eval mmap churn a C thread pays).  Blocks until stop().
+static PyObject* Demux_run_loop(DemuxObj* self, PyObject*) {
+  DemuxImpl* d = self->d;
+  d->running.store(true);
+  Py_BEGIN_ALLOW_THREADS;
+  demux_run(d);
+  Py_END_ALLOW_THREADS;
+  Py_RETURN_NONE;
+}
+
+// attach(fd) -> token.  The demux dup()s the fd: reads belong to the
+// lane from here on (the Python socket keeps the write side).  The fd
+// is NOT armed yet — the caller finishes its token -> socket
+// bookkeeping first and then calls arm(token), so the very first
+// burst/EOF callback can never race the registration and be dropped.
+static PyObject* Demux_attach(DemuxObj* self, PyObject* args) {
+  int fd;
+  if (!PyArg_ParseTuple(args, "i", &fd)) return nullptr;
+  DemuxImpl* d = self->d;
+  int dupfd = dup(fd);
+  if (dupfd < 0) {
+    PyErr_SetFromErrno(PyExc_OSError);
+    return nullptr;
+  }
+  CliConn* c = new CliConn();
+  c->fd = dupfd;
+  c->token = d->next_token++;
+  {
+    std::lock_guard<std::mutex> g(d->mu);
+    d->conns[c->token] = c;
+  }
+  return PyLong_FromUnsignedLongLong(c->token);
+}
+
+// arm(token) -> bool: register the attached fd with epoll (reads start
+// flowing).  Call AFTER the Python-side routing state is in place.
+static PyObject* Demux_arm(DemuxObj* self, PyObject* args) {
+  unsigned long long token;
+  if (!PyArg_ParseTuple(args, "K", &token)) return nullptr;
+  DemuxImpl* d = self->d;
+  CliConn* c = nullptr;
+  {
+    std::lock_guard<std::mutex> g(d->mu);
+    auto it = d->conns.find(token);
+    if (it != d->conns.end() && !it->second->dead) c = it->second;
+  }
+  if (c == nullptr) Py_RETURN_FALSE;
+  struct epoll_event ev;
+  ev.events = EPOLLIN;
+  ev.data.u64 = c->token;
+  if (epoll_ctl(d->epfd, EPOLL_CTL_ADD, c->fd, &ev) != 0)
+    Py_RETURN_FALSE;
+  Py_RETURN_TRUE;
+}
+
+static PyObject* Demux_detach(DemuxObj* self, PyObject* args) {
+  unsigned long long token;
+  if (!PyArg_ParseTuple(args, "K", &token)) return nullptr;
+  DemuxImpl* d = self->d;
+  {
+    std::lock_guard<std::mutex> g(d->mu);
+    auto it = d->conns.find(token);
+    if (it != d->conns.end()) {
+      it->second->dead = true;
+      epoll_ctl(d->epfd, EPOLL_CTL_DEL, it->second->fd, nullptr);
+      d->reap.push_back(token);
+    }
+  }
+  if (d->running.load())
+    demux_wake(d);
+  else {
+    // loop not running (teardown order): reap inline
+    std::vector<CliConn*> gone;
+    {
+      std::lock_guard<std::mutex> g(d->mu);
+      for (uint64_t tok : d->reap) {
+        auto it = d->conns.find(tok);
+        if (it == d->conns.end()) continue;
+        gone.push_back(it->second);
+        d->conns.erase(it);
+      }
+      d->reap.clear();
+    }
+    for (CliConn* c : gone) {
+      close(c->fd);
+      delete c;
+    }
+  }
+  Py_RETURN_NONE;
+}
+
+// expect(token, cid) -> bool: register one in-flight correlation id
+// BEFORE the request write (a response racing the registration would
+// otherwise demux as unknown_cid)
+static PyObject* Demux_expect(DemuxObj* self, PyObject* args) {
+  unsigned long long token, cid;
+  if (!PyArg_ParseTuple(args, "KK", &token, &cid)) return nullptr;
+  DemuxImpl* d = self->d;
+  std::lock_guard<std::mutex> g(d->mu);
+  auto it = d->conns.find(token);
+  if (it == d->conns.end() || it->second->dead) Py_RETURN_FALSE;
+  it->second->inflight.insert(cid);
+  Py_RETURN_TRUE;
+}
+
+// cancel(token, cid) -> bool: drop a registration (call teardown);
+// True when the entry was still present
+static PyObject* Demux_cancel(DemuxObj* self, PyObject* args) {
+  unsigned long long token, cid;
+  if (!PyArg_ParseTuple(args, "KK", &token, &cid)) return nullptr;
+  DemuxImpl* d = self->d;
+  std::lock_guard<std::mutex> g(d->mu);
+  auto it = d->conns.find(token);
+  if (it == d->conns.end()) Py_RETURN_FALSE;
+  if (it->second->inflight.erase(cid)) Py_RETURN_TRUE;
+  Py_RETURN_FALSE;
+}
+
+static PyObject* Demux_stop(DemuxObj* self, PyObject*) {
+  self->d->stopping.store(true);
+  demux_wake(self->d);
+  Py_RETURN_NONE;
+}
+
+// telemetry() -> the client lane's observability table (same racy-read
+// discipline as Engine.telemetry)
+static PyObject* Demux_telemetry(DemuxObj* self, PyObject*) {
+  DemuxImpl* d = self->d;
+  PyObject* out = PyDict_New();
+  if (!out) return nullptr;
+  PyObject* fbd = PyDict_New();
+  bool ok = fbd != nullptr;
+  uint64_t fb_total = 0;
+  for (int i = 0; ok && i < CFB_REASONS; i++) {
+    fb_total += d->tel.fallbacks[i];
+    ok = set_u64(fbd, kCliFbNames[i], d->tel.fallbacks[i]) == 0;
+  }
+  if (ok) ok = PyDict_SetItemString(out, "fallbacks", fbd) == 0;
+  Py_XDECREF(fbd);
+  if (ok) ok = set_u64(out, "completions", d->tel.completions) == 0;
+  if (ok) ok = set_u64(out, "fallback_total", fb_total) == 0;
+  if (ok) ok = set_u64(out, "acks", d->tel.acks) == 0;
+  if (ok) ok = set_u64(out, "bursts", d->tel.bursts) == 0;
+  if (ok) ok = set_u64(out, "bytes_in", d->tel.bytes_in) == 0;
+  if (ok) ok = set_hist(out, "comp_burst", d->tel.comp_burst) == 0;
+  if (ok) {
+    size_t n;
+    {
+      std::lock_guard<std::mutex> g(d->mu);
+      n = d->conns.size();
+    }
+    ok = set_u64(out, "attached", (uint64_t)n) == 0;
+  }
+  if (!ok) {
+    Py_DECREF(out);
+    return nullptr;
+  }
+  return out;
+}
+
+static void Demux_dealloc(DemuxObj* self) {
+  if (self->d) {
+    self->d->stopping.store(true);
+    demux_wake(self->d);
+    // give a still-running loop a moment to exit (the bridge joins its
+    // thread before dropping the object; this is belt-and-braces)
+    Py_BEGIN_ALLOW_THREADS;
+    for (int i = 0; i < 100 && self->d->running.load(); i++) {
+      struct timespec ts{0, 10 * 1000 * 1000};
+      nanosleep(&ts, nullptr);
+    }
+    Py_END_ALLOW_THREADS;
+    for (auto& kv : self->d->conns) {
+      close(kv.second->fd);
+      delete kv.second;
+    }
+    close(self->d->epfd);
+    close(self->d->wakefd);
+    Py_XDECREF(self->d->callback);
+    delete self->d;
+  }
+  Py_TYPE(self)->tp_free((PyObject*)self);
+}
+
+static PyMethodDef Demux_methods[] = {
+    {"run_loop", (PyCFunction)Demux_run_loop, METH_NOARGS,
+     "run the demux loop on the calling (Python) thread until stop()"},
+    {"attach", (PyCFunction)Demux_attach, METH_VARARGS,
+     "attach(fd) -> token: the lane dup()s and owns the read side "
+     "(unarmed until arm(token))"},
+    {"arm", (PyCFunction)Demux_arm, METH_VARARGS,
+     "arm(token) -> bool: start demuxing an attached fd (call after "
+     "the caller's token routing is in place)"},
+    {"detach", (PyCFunction)Demux_detach, METH_VARARGS,
+     "detach(token): stop demuxing; the dup'd fd closes on the loop"},
+    {"expect", (PyCFunction)Demux_expect, METH_VARARGS,
+     "expect(token, cid) -> bool: register an in-flight response"},
+    {"cancel", (PyCFunction)Demux_cancel, METH_VARARGS,
+     "cancel(token, cid) -> bool: drop a registration at call end"},
+    {"stop", (PyCFunction)Demux_stop, METH_NOARGS, nullptr},
+    {"telemetry", (PyCFunction)Demux_telemetry, METH_NOARGS,
+     "client-lane counters: completions, reason-coded fallbacks, "
+     "completions-per-burst histogram, acks, attached conns"},
+    {nullptr, nullptr, 0, nullptr},
+};
+
+static PyTypeObject DemuxType = {
+    PyVarObject_HEAD_INIT(nullptr, 0)
+};
+
 static PyMethodDef module_methods[] = {
     {"sync_call", (PyCFunction)sync_call, METH_VARARGS,
      "sync_call(fd, parts, timeout_s) -> (buf, meta_size): write request "
@@ -4694,12 +5417,32 @@ PyMODINIT_FUNC PyInit__native(void) {
   EngineType.tp_doc = "epoll IO engine: C++ read/frame/write, Python dispatch";
   if (PyType_Ready(&EngineType) < 0) return nullptr;
 
+  DemuxType.tp_name = "brpc_tpu.native.ClientDemux";
+  DemuxType.tp_basicsize = sizeof(DemuxObj);
+  DemuxType.tp_dealloc = (destructor)Demux_dealloc;
+  DemuxType.tp_flags = Py_TPFLAGS_DEFAULT;
+  DemuxType.tp_methods = Demux_methods;
+  DemuxType.tp_new = Demux_new;
+  DemuxType.tp_doc =
+      "native client completion lane: epoll demux of response frames, "
+      "cid-correlated against an in-flight table, batched completion "
+      "delivery (one GIL entry per read burst)";
+  if (PyType_Ready(&DemuxType) < 0) return nullptr;
+
   PyObject* m = PyModule_Create(&native_module);
   if (!m) return nullptr;
   Py_INCREF(&EngineType);
   PyModule_AddObject(m, "Engine", (PyObject*)&EngineType);
   Py_INCREF(&NativeBufType);
   PyModule_AddObject(m, "NativeBuf", (PyObject*)&NativeBufType);
+  Py_INCREF(&DemuxType);
+  PyModule_AddObject(m, "ClientDemux", (PyObject*)&DemuxType);
+  // client-lane fallback reason codes (closed enum; Python mirrors)
+  PyModule_AddIntConstant(m, "CFB_UNKNOWN_CID", CFB_UNKNOWN_CID);
+  PyModule_AddIntConstant(m, "CFB_META_UNPARSED", CFB_META_UNPARSED);
+  PyModule_AddIntConstant(m, "CFB_META_TAGS", CFB_META_TAGS);
+  PyModule_AddIntConstant(m, "CFB_STREAM_FRAME", CFB_STREAM_FRAME);
+  PyModule_AddIntConstant(m, "CFB_UNKNOWN_MAGIC", CFB_UNKNOWN_MAGIC);
   PyModule_AddIntConstant(m, "EV_OPEN", EV_OPEN);
   PyModule_AddIntConstant(m, "EV_MESSAGE", EV_MESSAGE);
   PyModule_AddIntConstant(m, "EV_ACK", EV_ACK);

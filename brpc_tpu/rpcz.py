@@ -374,6 +374,29 @@ def rpcz_enabled() -> bool:
     return bool(get_flag("enable_rpcz", True))
 
 
+# flag-cached mirror of rpcz_enabled for the per-request fast paths
+# (one list read instead of a flags-table lookup per call); resynced by
+# the watcher on every live flip
+from .butil.flags import watch_flag as _watch_flag
+
+_rpcz_live = [bool(get_flag("enable_rpcz", True))]
+_watch_flag("enable_rpcz",
+            lambda v: _rpcz_live.__setitem__(0, bool(v)))
+
+
+def passive_server_span(full_method: str, remote_side) -> Optional["Span"]:
+    """The slim fast template's span gate for UNTRACED requests: same
+    budgeted passive sampling as :func:`start_server_span`, with the
+    enabled check flag-cached (traced requests never reach this — the
+    shim routes them through the full gate, which always records)."""
+    if not _rpcz_live[0] or not _passive_sample_gate():
+        return None
+    span = Span(full_method, trace_id=0, parent_span_id=0,
+                is_server=True)
+    span.remote_side = str(remote_side or "")
+    return span
+
+
 _sample_window = [0.0, 0, 1000]    # window start (s), taken, budget
 
 

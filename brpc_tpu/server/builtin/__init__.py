@@ -354,9 +354,30 @@ def _native(server, msg, rest):
         })
     from ...client.fast_call import scatter_fallback_counters
     from ...deadline import shed_counters
+    from ...transport.client_lane import client_lane_telemetry
+    # CLIENT LANE section: this process's native response demux
+    # (process-global — any channel in this process may ride it).
+    # completions vs reason-coded fallbacks plus the completions-per-
+    # burst histogram; empty when no socket ever attached.
+    cl = client_lane_telemetry()
+    client_lane = {}
+    if cl:
+        client_lane = {
+            "completions": cl.get("completions", 0),
+            "fallback_total": cl.get("fallback_total", 0),
+            "fallbacks": {k: v for k, v in cl.get("fallbacks",
+                                                  {}).items() if v},
+            "bursts": cl.get("bursts", 0),
+            "attached": cl.get("attached", 0),
+            "acks": cl.get("acks", 0),
+            "completions_per_burst": _hist_view(
+                cl["comp_burst"], cl["comp_burst_count"],
+                cl["comp_burst_sum"]),
+        }
     out = {
         "lanes": lanes,
         "fallbacks": dict(top_fallbacks),
+        "client_lane": client_lane,
         "scatter_fallbacks": scatter_fallback_counters(),
         # deadline plane: per-(lane, method) doomed-work sheds — a
         # non-zero count means callers' budgets are dying in queue

@@ -33,7 +33,7 @@ class ServerController(LazyAttachmentsMixin):
         "_accepted_stream_window", "span", "grpc_stream",
         "http_method", "http_path", "http_unresolved_path",
         "_session_data", "_progressive", "deadline_us",
-        "_shm_handle", "_shm_extra",
+        "_shm_handle", "_shm_extra", "_slim_fast",
     )
 
     def __init__(self, request_meta: RpcMeta,
@@ -73,6 +73,10 @@ class ServerController(LazyAttachmentsMixin):
         self._progressive = None         # ProgressiveAttachment when used
         self._shm_handle = None          # request shm descriptor handle
         self._shm_extra = b""            # shm accept/offer TLVs to answer
+        # trivial-shape slim fast item: admission counters were settled
+        # per-burst, so completion feeds the recorders only (see
+        # slim_dispatch's fast template + rpc_dispatch._send_response)
+        self._slim_fast = False
         # absolute monotonic-µs deadline from the request's propagated
         # remaining budget (tpu_std TLV 13 / grpc-timeout / x-deadline-ms),
         # anchored at arrival; 0 = the request carries no deadline.  The
@@ -81,6 +85,44 @@ class ServerController(LazyAttachmentsMixin):
         # LATEST possible arrival, so this default is conservative.
         tmo = request_meta.timeout_ms
         self.deadline_us = self.begin_time_us + tmo * 1000 if tmo > 0 else 0
+
+    def reset_slim(self, remote_side, socket_id: int) -> None:
+        """Reset-on-reuse for the slim lane's pooled controllers: every
+        mutable slot back to its constructed state (``request_meta``,
+        ``_send_response`` and ``_finish_lock`` are per-entry constants
+        the pool preserves; the meta's own reset is the caller's job).
+        NO state — attachments, errors, deadline, spans, session data,
+        shm handles — survives into the next request (pinned by
+        tests/test_client_lane.py)."""
+        self.remote_side = remote_side
+        self.socket_id = socket_id
+        self._req_att = None
+        self._resp_att = None
+        self.request_device_attachment = None
+        self.response_device_attachment = None
+        self.response_compress_type = CompressType.NONE
+        self._error_code = 0
+        self._error_text = ""
+        self._async = False
+        self._finished = False
+        self.begin_time_us = 0
+        self.trace_id = 0
+        self.span_id = 0
+        self.auth_context = None
+        self._remote_stream_id = 0
+        self._accepted_stream_id = 0
+        self._accepted_stream_window = 0
+        self.span = None
+        self.grpc_stream = None
+        self.http_method = ""
+        self.http_path = ""
+        self.http_unresolved_path = ""
+        self._session_data = None
+        self._progressive = None
+        self.deadline_us = 0
+        self._shm_handle = None
+        self._shm_extra = b""
+        self._slim_fast = False
 
     # -- deadline plane ----------------------------------------------------
 

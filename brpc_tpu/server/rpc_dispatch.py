@@ -69,10 +69,22 @@ def _send_response(server, entry, cntl: ServerController,
                    response: Any) -> None:
     sock = Socket.address(cntl.socket_id)
     latency_us = _mono_ns() // 1000 - cntl.begin_time_us
-    entry.status.on_responded(cntl.error_code, latency_us)
-    server.on_request_out(tenant=cntl.request_meta.tenant,
-                          error_code=cntl.error_code,
-                          latency_us=latency_us)
+    if cntl._slim_fast:
+        # trivial-shape slim fast item escalated here: no admission
+        # layer is configured and its in-flight counts were never taken
+        # (net-zero within the burst; admitted verdicts flush per burst)
+        # — feed the per-method recorders only, symmetric with the slim
+        # template's own completion
+        cntl._slim_fast = False
+        if cntl.error_code == 0:
+            entry.status.latency << latency_us
+        else:
+            entry.status.errors << 1
+    else:
+        entry.status.on_responded(cntl.error_code, latency_us)
+        server.on_request_out(tenant=cntl.request_meta.tenant,
+                              error_code=cntl.error_code,
+                              latency_us=latency_us)
     if cntl.request_device_attachment is not None:
         # invariant the client's sync fast lane relies on: the credit-
         # return for a request descriptor always PRECEDES the response

@@ -58,6 +58,7 @@ through ``trace`` and the span completes on the lane.
 
 from __future__ import annotations
 
+import sys
 from time import monotonic_ns as _mono_ns
 
 from ..butil.iobuf import IOBuf
@@ -67,10 +68,41 @@ from ..deadline import arm as arm_deadline
 from ..deadline import inherit_deadline, maybe_shed
 from ..protocol.meta import RpcMeta
 from ..protocol.tpu_std import parse_payload
-from ..rpcz import backdate_span, start_server_span
+from ..rpcz import backdate_span, passive_server_span, start_server_span
 from .admission import admit as _admit_rpc
+from .admission import count_admitted_burst, trivial_shape
 from .controller import ServerController
 from .rpc_dispatch import _send_error, _send_response
+
+# per-entry pooled-controller cap: enough to cover a whole engine read
+# burst of in-flight fast completions without unbounded retention
+_SC_POOL_MAX = 64
+
+# Per-burst aggregated accounting (the ISSUE-8 "per-burst aggregates
+# where semantics allow"): each engine loop thread accumulates its
+# burst's admitted-verdict count here and the engine's burst_end hook
+# (NativeBridge registers flush_burst_accounting) folds it into the
+# module-global admission counters under ONE lock per burst.  Thread-
+# local: engine loops never race each other's accumulator.
+import threading as _threading
+
+_burst_tls = _threading.local()
+
+
+def _burst_cell() -> list:
+    cell = getattr(_burst_tls, "admitted", None)
+    if cell is None:
+        cell = _burst_tls.admitted = [0]
+    return cell
+
+
+def flush_burst_accounting() -> None:
+    """Engine burst_end hook: flush this loop thread's aggregated
+    fast-path accounting (called once per batched GIL entry)."""
+    cell = getattr(_burst_tls, "admitted", None)
+    if cell is not None and cell[0]:
+        count_admitted_burst(cell[0])
+        cell[0] = 0
 
 _EINTERNAL = int(Errno.EINTERNAL)
 _EREQUEST = int(Errno.EREQUEST)
@@ -93,6 +125,13 @@ def make_slim_handler(bridge, server, entry, svc: str, mth: str):
     def _send(cntl, response, _server=server, _entry=entry):
         _send_response(_server, _entry, cntl, response)
 
+    # fast-template state: a reset-on-reuse (ServerController + RpcMeta)
+    # free list.  The meta's service/method names are per-entry
+    # constants set once; reuse resets every field the fast path can
+    # touch (cid, attachment size, deadline, ici domain) and
+    # reset_slim() restores the controller wholesale.
+    sc_pool: list = []
+
     def slim(payload, att, cid, conn_id, dom, nonce, recv_ns,
              trace=None, tmo=None, tenant=None,
              _server=server, _entry=entry, _status=status, _fn=fn,
@@ -101,7 +140,9 @@ def make_slim_handler(bridge, server, entry, svc: str, mth: str):
              _ns=_mono_ns, _sample=start_server_span,
              _backdate=backdate_span, _shed=maybe_shed,
              _inherit=inherit_deadline, _arm=arm_deadline,
-             _admit=_admit_rpc):
+             _admit=_admit_rpc, _pool=sc_pool,
+             _trivial=trivial_shape, _refs=sys.getrefcount,
+             _cell=_burst_cell, _pspan=passive_server_span):
         sock = _socks.get(conn_id)
         if sock is None:
             return None          # connection died mid-burst: drop, like
@@ -109,6 +150,131 @@ def make_slim_handler(bridge, server, entry, svc: str, mth: str):
         if not _server.running:
             _send_error(sock, cid, _ELOGOFF, "server is stopping")
             return None
+        # ---- precompiled fast template (the per-call cost collapse the
+        # client lane's acceptance keys measure): for the hot request
+        # shape — no trace/tenant TLVs — on a method with NO admission
+        # layer configured, the per-call RpcMeta build, the four-layer
+        # admit() walk and the ServerController construction are
+        # replaced by pooled reset-on-reuse objects, and admission
+        # accounting aggregates per BURST (admitted verdicts flush in
+        # the engine's burst_end hook; in-flight gauges are net-zero
+        # across a synchronously-completing item and are not touched —
+        # they stay exact whenever any admission layer is configured).
+        # Every escalation shape (async, errors, compressed/device/
+        # stream responses, non-bytes returns) leaves through the
+        # UNCHANGED classic completion, and the escalated controller is
+        # simply not recycled.
+        if trace is None and tenant is None \
+                and _trivial(_server, _status):
+            _cell()[0] += 1
+            try:
+                # pop-then-handle: several engine loops may run this
+                # entry's shim concurrently, and a check-then-pop pair
+                # could both pass on one pooled item
+                cntl = _pool.pop()
+            except IndexError:
+                cntl = None
+            if cntl is not None:
+                meta = cntl.request_meta
+                meta.correlation_id = cid
+                meta.attachment_size = 0
+                meta.timeout_ms = 0
+                meta.ici_domain = b""
+                cntl.reset_slim(sock.remote_side, sock.id)
+            else:
+                meta = RpcMeta()
+                meta.correlation_id = cid
+                meta.service_name = _svc
+                meta.method_name = _mth
+                cntl = ServerController(meta, sock.remote_side, sock.id,
+                                        _send)
+            cntl.server = _server
+            cntl.begin_time_us = recv_ns // 1000
+            cntl._slim_fast = True      # escalations settle recorder-
+            #                             only (no counts were taken)
+            if dom is not None:
+                sock.ici_peer_domain = dom
+                meta.ici_domain = dom
+            if nonce is not None and sock.ici_conn_token is None:
+                sock.ici_conn_token = nonce
+            if tmo is not None:
+                meta.timeout_ms = tmo
+                _arm(cntl, tmo, recv_ns // 1000)
+            na = len(att) if att is not None else 0
+            if na:
+                meta.attachment_size = na
+                ab = IOBuf()
+                ab.append_user_data(att)
+                cntl._req_att = ab
+            span = _pspan(_status.full_name, sock.remote_side)
+            if span is not None:
+                span.request_size = len(payload) + na
+                _backdate(span, recv_ns)
+                cntl.span = span
+            if tmo is not None and _shed(cntl, "slim",
+                                         _status.full_name):
+                # doomed work: the budget expired in the native batch —
+                # ERPCTIMEDOUT via the classic completion, user code
+                # never runs (identical to the classic slim path)
+                cntl.finish(None)
+                return None
+            try:
+                request = parse_payload(payload, _rt)
+            except Exception as e:
+                cntl.set_failed(Errno.EREQUEST,
+                                f"request parse failed: {e}")
+                cntl.finish(None)
+                return None
+            try:
+                with _inherit(cntl):
+                    response = _fn(cntl, request)
+            except Exception as e:
+                LOG.exception("method %s raised", _status.full_name)
+                cntl.set_failed(Errno.EINTERNAL,
+                                f"{type(e).__name__}: {e}")
+                cntl.finish(None)
+                return None
+            if cntl.is_async:
+                return None
+            if (cntl.failed or cntl._accepted_stream_id
+                    or cntl.response_compress_type
+                    or cntl.response_device_attachment is not None
+                    or not isinstance(response,
+                                      (bytes, bytearray, memoryview))):
+                cntl.finish(response)
+                return None
+            if not cntl._mark_finished_if_first():
+                return None
+            cntl._slim_fast = False
+            latency_us = _ns() // 1000 - cntl.begin_time_us
+            _status.latency << latency_us
+            if cntl._session_data is not None \
+                    and _server._session_pool is not None:
+                _server._session_pool.give_back(cntl._session_data)
+                cntl._session_data = None
+            ratt = cntl._resp_att
+            na_resp = len(ratt) if ratt is not None else 0
+            span = cntl.span
+            if span is not None:
+                span.response_size = len(response) + na_resp
+                span.finish(0)
+            if na_resp:
+                out = (response, ratt.as_contiguous()[0])
+            else:
+                out = response
+            # recycle only a controller NOTHING else references (a
+            # handler that stored it keeps it — reuse must never mutate
+            # state under a live reference): refs here are the local
+            # binding + getrefcount's argument.  The heavy references
+            # (attachment views pin engine buffers; spans) are dropped
+            # NOW, not at next reuse — an idle pool must not retain
+            # request payloads
+            if len(_pool) < _SC_POOL_MAX and _refs(cntl) == 2:
+                cntl._req_att = None
+                cntl._resp_att = None
+                cntl.span = None
+                _pool.append(cntl)
+            return out
         # overload plane: the SHARED admission stage — CoDel sojourn
         # and the method limiters both measure from the ENGINE's
         # CLOCK_MONOTONIC parse stamp, so time spent in the native

@@ -1,0 +1,334 @@
+"""Client completion lane — the Python half of the engine's ClientDemux.
+
+The full-Controller async/multiplexed response path used to cost, per
+response: one dispatcher wakeup, a fiber spawn, a Python frame cut, a
+full ``RpcMeta`` decode and an id-pool dict lookup.  With the lane, an
+attached client socket's reads belong to ONE native epoll loop
+(``native.ClientDemux``): the engine parses response frames off the
+read burst in C++, correlates them by cid against a native in-flight
+table (registered at send time from ``controller._issue_rpc``), and
+delivers the whole burst in ONE batched callback — the client-side twin
+of the server's one-GIL-entry-per-burst slim lanes.
+
+Division of labor per burst item:
+
+* **plain success** (cid/attachment/ici-domain meta only) — completed
+  here natively: no ``RpcMeta`` object, no frame cut, one id-pool lock.
+  Sync completions run inline on the demux thread (they end in an event
+  set); calls carrying a ``done`` callback finish on a fiber worker —
+  user code must never block the demux loop (the dispatcher path ran
+  done on a fiber too).
+* **anything else** — error responses, compressed/shm/descriptor
+  shapes, stream grants, stream frames, unknown cids — falls back to
+  the classic Python demux BYTE-IDENTICALLY: the engine hands the exact
+  wire bytes over under a NAMED reason (closed enum, no "unknown"
+  bucket), and they flow through ``sock.read_portal`` +
+  ``client_messenger()`` exactly like dispatcher-read bytes, serialized
+  per connection on an ExecutionQueue.
+* **unknown magic** (h2/redis/HTTP response on a lane socket) — sticky
+  conversion: the lane detaches and the classic dispatcher takes over,
+  with every buffered byte re-played through the portal first.
+
+The lane is process-global (client side), guarded by the
+``rpc_native_client_lane`` flag; with the flag off — or the native
+module absent — every socket takes the classic dispatcher path and
+behavior is unchanged by construction.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, Optional
+
+from ..butil.flags import define_flag, get_flag
+from ..butil.logging_util import LOG
+from ..butil.status import Errno
+from ..bvar.multi_dimension import PassiveDimension
+from ..bvar.passive_status import PassiveStatus
+from ..fiber import runtime as fiber_runtime
+
+define_flag("rpc_native_client_lane", True,
+            "route eligible client sockets' response demux through the "
+            "native engine's ClientDemux (batched completion delivery); "
+            "off = classic Python dispatcher demux for every socket",
+            validator=lambda v: isinstance(v, bool))
+
+# closed fallback reason enum — MUST mirror engine.cpp's CliFb order
+REASONS = ("cli_unknown_cid", "cli_meta_unparsed", "cli_meta_tags",
+           "cli_stream_frame", "cli_unknown_magic")
+
+_lane: Optional["ClientLane"] = None
+_lane_lock = threading.Lock()
+_lane_failed = False
+
+
+def global_client_lane(create: bool = True) -> Optional["ClientLane"]:
+    """The process-wide client lane, created on first eligible attach
+    (``create=False`` returns the existing one only — failure paths
+    must not boot a demux loop)."""
+    global _lane, _lane_failed
+    if _lane is not None or not create or _lane_failed:
+        return _lane
+    with _lane_lock:
+        if _lane is None and not _lane_failed:
+            try:
+                from ..native import load
+                mod = load()
+                if not hasattr(mod, "ClientDemux"):
+                    raise RuntimeError("native module has no ClientDemux")
+                _lane = ClientLane(mod)
+            except Exception:
+                _lane_failed = True
+                return None
+    return _lane
+
+
+def lane_expect(sock, cid: int) -> None:
+    """Register an in-flight cid for a lane-attached socket (no-op
+    otherwise).  Call BEFORE the request write — a response racing the
+    registration would demux as ``cli_unknown_cid``."""
+    if sock.lane_token:
+        lane = _lane
+        if lane is not None:
+            lane.expect(sock, cid)
+
+
+def lane_cancel(sock, cid: int) -> None:
+    """Drop an in-flight registration at call teardown (no-op when the
+    socket is not lane-attached)."""
+    if sock.lane_token:
+        lane = _lane
+        if lane is not None:
+            lane.cancel(sock, cid)
+
+
+def client_lane_telemetry() -> dict:
+    """Snapshot of the lane's native counters (empty dict when the lane
+    was never created) — the /native portal's client section and the
+    ``native_client_*`` bvars read this."""
+    lane = _lane
+    if lane is None:
+        return {}
+    try:
+        return lane._demux.telemetry()
+    except Exception:
+        return {}
+
+
+# eager bvar registration (the families must exist in /vars//metrics
+# from the first scrape, fallback or not — mirrors fast_call's scatter
+# counters)
+_fallback_var = PassiveDimension(
+    ("reason",),
+    lambda: client_lane_telemetry().get(
+        "fallbacks", {r: 0 for r in REASONS}),
+    name="native_client_fallback_total")
+_completions_var = PassiveStatus(
+    lambda: client_lane_telemetry().get("completions", 0),
+    name="native_client_completions")
+_bursts_var = PassiveStatus(
+    lambda: client_lane_telemetry().get("bursts", 0),
+    name="native_client_bursts")
+
+
+class ClientLane:
+    """Owns the ClientDemux, its loop thread, and the token → socket
+    routing state."""
+
+    def __init__(self, mod):
+        self._m = mod
+        self._demux = mod.ClientDemux(self._on_burst)
+        self._socks: Dict[int, int] = {}     # token -> socket id
+        self._queues: Dict[int, Any] = {}    # token -> ExecutionQueue
+        self._lock = threading.Lock()
+        # the loop runs on a Python thread: resident frames pin the
+        # datastack chunk, so per-burst callbacks skip cold-eval mmap
+        # churn (same rationale as the server bridge's external loops)
+        self._thread = threading.Thread(target=self._demux.run_loop,
+                                        name="client-lane", daemon=True)
+        self._thread.start()
+
+    # -- attach / detach ---------------------------------------------------
+
+    def attach(self, sock) -> bool:
+        """Take over the read side of ``sock``.  False = ineligible
+        (no fd, TLS, flag off, attach failure) — the caller falls back
+        to the classic dispatcher."""
+        if sock.fd is None or sock.ssl_context is not None \
+                or sock.failed:
+            return False
+        if not get_flag("rpc_native_client_lane", True):
+            return False
+        try:
+            token = self._demux.attach(sock.fd.fileno())
+        except (OSError, ValueError):
+            return False
+        # routing state BEFORE arming: the very first burst (or an
+        # immediate EOF on an already-closed peer) must find the socket
+        with self._lock:
+            self._socks[token] = sock.id
+        sock.lane_token = token
+        sock._lane_pref = True
+        if not self._demux.arm(token):
+            self.detach(sock)
+            return False
+        return True
+
+    def detach(self, sock, _stop_queue: bool = True) -> None:
+        token = sock.lane_token
+        if not token:
+            return
+        sock.lane_token = 0
+        with self._lock:
+            self._socks.pop(token, None)
+            q = self._queues.pop(token, None)
+        self._demux.detach(token)
+        if q is not None and _stop_queue:
+            q.stop()
+
+    def expect(self, sock, cid: int) -> None:
+        self._demux.expect(sock.lane_token, cid)
+
+    def cancel(self, sock, cid: int) -> None:
+        self._demux.cancel(sock.lane_token, cid)
+
+    # -- burst delivery (runs on the demux loop thread, GIL held) ----------
+
+    def _on_burst(self, token: int, status: int, comps, fbs, acks
+                  ) -> None:
+        from .socket import Socket
+        with self._lock:
+            sid = self._socks.get(token)
+        sock = Socket.address(sid) if sid is not None else None
+        if sock is None or sock.lane_token != token:
+            return                    # detached under us: nothing to own
+        try:
+            if acks:
+                from ..ici.endpoint import _process_ack
+                _process_ack(acks, sock)
+            if comps:
+                self._complete_burst(sock, comps)
+            if fbs or status:
+                self._enqueue_classic(token, sock, fbs, status)
+        except Exception:
+            LOG.exception("client lane burst delivery failed")
+
+    def _complete_burst(self, sock, comps) -> None:
+        """Finish a burst of PLAIN successes in arrival order.  Sync
+        calls complete inline (their tail is an event set + cheap
+        feedback); ``done``-bearing calls — and any call whose id is
+        momentarily HELD (a timer/backup handler may be mid-connect
+        under it) — hop to a fiber worker, so neither user code nor a
+        contended id can ever stall the one demux loop."""
+        from ..fiber.versioned_id import global_id_pool
+        idp = global_id_pool()
+        for cid, buf, att, dom in comps:
+            sock.remove_inflight(cid)
+            st, cntl = idp.try_lock(cid)
+            if st < 0:
+                continue              # already finished (timeout/cancel)
+            if st == 0:
+                # id busy: the fiber blocks in lock(), not this thread
+                fiber_runtime.spawn(self._complete_on_fiber, cid, buf,
+                                    att, dom, sock.id, name="lane_busy")
+                continue
+            if cntl is None:
+                idp.unlock(cid)
+                continue
+            if cntl._done is not None:
+                idp.unlock(cid)
+                fiber_runtime.spawn(self._complete_on_fiber, cid, buf,
+                                    att, dom, sock.id, name="lane_done")
+                continue
+            cntl._on_plain_response(cid, buf, att, dom, sock)
+
+    @staticmethod
+    def _complete_on_fiber(cid, buf, att, dom, sid) -> None:
+        from ..fiber.versioned_id import global_id_pool
+        from .socket import Socket
+        sock = Socket.address(sid)
+        if sock is None:
+            return
+        idp = global_id_pool()
+        ok, cntl = idp.lock(cid)
+        if not ok:
+            return
+        if cntl is None:
+            idp.unlock(cid)
+            return
+        cntl._on_plain_response(cid, buf, att, dom, sock)
+
+    # -- classic fallback (byte-identical demux) ---------------------------
+
+    def _queue_for(self, token: int, sock):
+        with self._lock:
+            q = self._queues.get(token)
+            if q is not None:
+                return q
+        from ..fiber.execution_queue import ExecutionQueue
+
+        def executor(it, _sock=sock, _self=self):
+            for kind, payload in it:
+                try:
+                    if kind == 0:          # raw frame bytes
+                        _sock.read_portal.append_user_data(
+                            memoryview(payload))
+                        _self._messenger()._cut_and_process(_sock)
+                    elif kind == 1:        # convert to dispatcher reads
+                        _self._convert_to_dispatcher(_sock)
+                    else:                  # terminal socket failure
+                        code, text = payload
+                        _sock.set_failed(code, text)
+                except Exception:
+                    LOG.exception("client lane fallback dispatch failed")
+
+        q = ExecutionQueue(executor, name=f"client_lane_{token}")
+        with self._lock:
+            # racing creators: first one in wins, extras are dropped
+            q = self._queues.setdefault(token, q)
+        return q
+
+    @staticmethod
+    def _messenger():
+        from .input_messenger import client_messenger
+        return client_messenger()
+
+    def _enqueue_classic(self, token: int, sock, fbs, status: int
+                         ) -> None:
+        """Route fallback frames (exact wire bytes) through the classic
+        demux, serialized per connection; terminal status rides the SAME
+        queue so a response already on the wire wins against the EOF
+        that followed it (classic gulp ordering)."""
+        q = self._queue_for(token, sock)
+        convert = False
+        if fbs:
+            for reason, raw in fbs:
+                if reason == self._m.CFB_UNKNOWN_MAGIC:
+                    convert = True
+                q.execute((0, raw))
+        if convert:
+            # sticky passthrough: the protocol registry owns this conn.
+            # Detach FIRST (we are ON the demux thread — no further lane
+            # reads can race this), then hand reads to the dispatcher
+            # strictly after the queued bytes are processed.  The queue
+            # must keep accepting the tail items below, so it is not
+            # stopped here (it auto-quits once drained).
+            self.detach(sock, _stop_queue=False)
+            q.execute((1, None))
+        if status:
+            code = int(Errno.EEOF) if status == 1 \
+                else int(Errno.EFAILEDSOCKET)
+            text = "remote closed connection" if status == 1 \
+                else "client lane transport error"
+            q.execute((2, (code, text)))
+            if not convert:
+                self.detach(sock)
+
+    @staticmethod
+    def _convert_to_dispatcher(sock) -> None:
+        if sock.failed or sock.fd is None:
+            return
+        from .event_dispatcher import global_dispatcher
+        disp = global_dispatcher()
+        sock.attach_dispatcher(disp)
+        disp.add_consumer(sock.fd, sock.start_input_event)

@@ -483,6 +483,11 @@ class NativeBridge:
         # the engine so slim responses carry it natively
         from ..server.rpc_dispatch import _domain_tlv
         self.engine.set_domain_tlv(_domain_tlv())
+        # per-burst accounting epilogue: the slim fast template
+        # aggregates admitted-verdict counts per engine read burst and
+        # this hook flushes them under one lock per burst
+        from ..server.slim_dispatch import flush_burst_accounting
+        self.engine.set_burst_end(flush_burst_accounting)
         self.engine.listen(listen_socket.fileno())
         import threading
         for i in range(self._nloops):

@@ -115,6 +115,7 @@ class Socket:
         "_inflight_ids", "_inflight_lock",
         "_reconnect_lock", "_last_reconnect_at",
         "_cntl_tails", "shm",
+        "lane_token", "_lane_pref",
     )
 
     # -- lifecycle ---------------------------------------------------------
@@ -174,6 +175,11 @@ class Socket:
         self._reconnect_lock = threading.Lock()
         self._last_reconnect_at = 0.0
         self.shm = None                   # lazy ShmSockState (shm data plane)
+        # native client completion lane (transport/client_lane.py): a
+        # non-zero token means the engine's ClientDemux owns this
+        # socket's reads; _lane_pref makes revival re-attach
+        self.lane_token = 0
+        self._lane_pref = False
 
     @staticmethod
     def create(options: SocketOptions) -> int:
@@ -277,6 +283,16 @@ class Socket:
                 self._dispatcher.remove_consumer(self.fd)
             except Exception:
                 pass
+        if self.lane_token:
+            # release the native demux's dup'd fd and routing state
+            from .client_lane import global_client_lane
+            lane = global_client_lane(create=False)
+            if lane is not None:
+                try:
+                    lane.detach(self)
+                except Exception:
+                    pass
+            self.lane_token = 0
         if self.fd is not None:
             try:
                 self.fd.close()
@@ -397,6 +413,17 @@ class Socket:
         self.revive()
         if self._dispatcher is not None:
             self._dispatcher.add_consumer(fd, self.start_input_event)
+        elif self._lane_pref:
+            # the old fd rode the native client lane: re-attach the
+            # fresh one (dispatcher-managed reads are the fallback —
+            # a revived socket must never be read by nobody)
+            from .client_lane import global_client_lane
+            lane = global_client_lane()
+            if lane is None or not lane.attach(self):
+                from .event_dispatcher import global_dispatcher
+                disp = global_dispatcher()
+                self.attach_dispatcher(disp)
+                disp.add_consumer(fd, self.start_input_event)
 
     def release(self) -> None:
         """Destroy the socket id (returns slot to pool, bumps version)."""
@@ -663,6 +690,26 @@ class Socket:
             disp = global_dispatcher()
             self.attach_dispatcher(disp)
             disp.add_consumer(self.fd, self.start_input_event)
+
+    def ensure_client_lane(self) -> None:
+        """One-way conversion of a direct-read socket to NATIVE-LANE
+        demuxed reads (transport/client_lane.py): the engine's
+        ClientDemux parses + correlates responses and delivers batched
+        completions.  Falls back to :meth:`ensure_dispatched` whenever
+        the lane is unavailable (no native module, TLS, flag off)."""
+        attached = False
+        with self._dispatch_lock:
+            if not self.direct_read:
+                return
+            if self.fd is not None and not self._failed:
+                from .client_lane import global_client_lane
+                lane = global_client_lane()
+                if lane is not None and lane.attach(self):
+                    attached = True
+            if attached:
+                self.direct_read = False
+        if not attached:
+            self.ensure_dispatched()
 
     def start_input_event(self) -> None:
         """≈ Socket::StartInputEvent (socket.cpp:2111): first event spawns

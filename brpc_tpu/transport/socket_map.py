@@ -32,13 +32,19 @@ DEFAULT_HEALTH_CHECK_INTERVAL_S = 3.0   # reference socket_map.cpp:33
 def _new_connection(remote: EndPoint,
                     health_check_interval_s: float = 0.0,
                     direct_read: bool = False,
-                    ssl_context=None) -> Tuple[int, int]:
+                    ssl_context=None,
+                    prefer_lane: bool = False) -> Tuple[int, int]:
     """Create+connect a client Socket wired for responses.
     Returns (socket_id, error_code).
 
     ``direct_read`` skips dispatcher registration: the synchronous
     caller reads responses itself (pooled/short fast path); an async
-    user later converts via ``ensure_dispatched()``."""
+    user later converts via ``ensure_dispatched()``.
+
+    ``prefer_lane`` routes the read side through the NATIVE client
+    completion lane (tpu_std multiplexed connections — the "single"
+    connection type's demux); the classic dispatcher is the fallback
+    whenever the lane declines (TLS, flag off, no native module)."""
     sid = Socket.create(SocketOptions(
         remote_side=remote,
         on_edge_triggered_events=client_messenger().on_new_messages,
@@ -51,6 +57,11 @@ def _new_connection(remote: EndPoint,
     if direct_read:
         s.direct_read = True
         return sid, 0
+    if prefer_lane and ssl_context is None:
+        from .client_lane import global_client_lane
+        lane = global_client_lane()
+        if lane is not None and lane.attach(s):
+            return sid, 0
     disp = global_dispatcher()
     s.attach_dispatcher(disp)
     disp.add_consumer(s.fd, s.start_input_event)
@@ -74,18 +85,22 @@ class SocketMap:
                         DEFAULT_HEALTH_CHECK_INTERVAL_S)
 
     def get_socket(self, remote: EndPoint,
-                   ssl_context=None) -> Tuple[int, int]:
+                   ssl_context=None,
+                   prefer_lane: bool = False) -> Tuple[int, int]:
         """Return (socket_id, 0) for the shared connection to ``remote``,
         creating it on first use. A failed socket stays in the map —
         health check revives it in place, exactly the reference behavior
-        (callers see EFAILEDSOCKET meanwhile and may retry elsewhere)."""
+        (callers see EFAILEDSOCKET meanwhile and may retry elsewhere).
+        ``prefer_lane`` applies only when THIS call creates the
+        connection (first caller wins the demux mode)."""
         key = (remote, ssl_context is not None)
         with self._lock:
             sid = self._map.get(key)
             s = Socket.address(sid) if sid is not None else None
             if s is None:
                 sid, rc = _new_connection(remote, self._hc_interval(),
-                                          ssl_context=ssl_context)
+                                          ssl_context=ssl_context,
+                                          prefer_lane=prefer_lane)
                 if rc == 0 or Socket.address(sid) is not None:
                     self._map[key] = sid
                 return sid, rc
