@@ -144,6 +144,39 @@ _edges: Dict[str, Set[str]] = {}        # held -> then-acquired
 _warned_cycles: Set[Tuple[str, str]] = set()
 _tls = threading.local()
 
+# flag cache: DebugLock now sits on hot paths (the fiber ExecutionQueue
+# backing the socket write pump), so the per-acquire check must be one
+# list read, not a flags-table lookup (same pattern as admission's
+# CoDel cache)
+from .flags import watch_flag as _watch_flag
+
+_order_live = [bool(get_flag("debug_lock_order", False))]
+_watch_flag("debug_lock_order",
+            lambda v: _order_live.__setitem__(0, bool(v)))
+
+# warning-count bvar on /vars (satellite: the count was test-only).
+# Registered at module import below, with a DebugLock-construction
+# retry hook: if an import-order edge ever defers the bvar package,
+# the next DebugLock re-attempts instead of latching the var off.
+_warn_var = None
+_warn_var_lock = threading.Lock()
+
+
+def _ensure_warning_var() -> None:
+    global _warn_var
+    if _warn_var is not None:
+        return
+    with _warn_var_lock:
+        if _warn_var is not None:
+            return
+        try:
+            from ..bvar.passive_status import PassiveStatus
+            _warn_var = PassiveStatus(
+                lambda: lock_order_warnings(),
+                name="sanitizer_lock_order_warnings")
+        except Exception:       # deferred: retried on next DebugLock
+            pass
+
 
 def _has_path(src: str, dst: str) -> bool:
     seen: Set[str] = set()
@@ -161,17 +194,22 @@ def _has_path(src: str, dst: str) -> bool:
 
 class DebugLock:
     """threading.Lock with lock-order recording (under the
-    ``debug_lock_order`` flag; a plain pass-through otherwise)."""
+    ``debug_lock_order`` flag; a plain pass-through otherwise).
+
+    Also a drop-in Condition backing: the fiber ExecutionQueue wires
+    its queue lock through this class, so ABBA inversions between
+    queue roles and application locks show up in the order graph."""
 
     __slots__ = ("name", "_lock")
 
     def __init__(self, name: str):
         self.name = name
         self._lock = threading.Lock()
+        _ensure_warning_var()
 
     def acquire(self, blocking: bool = True,
                 timeout: float = -1) -> bool:
-        if get_flag("debug_lock_order", False):
+        if _order_live[0]:
             held: List[str] = getattr(_tls, "held", None) or []
             with _order_lock:
                 for h in held:
@@ -191,13 +229,15 @@ class DebugLock:
                             h, self.name,
                             "".join(traceback.format_stack(limit=8)))
                     _edges.setdefault(h, set()).add(self.name)
-        ok = self._lock.acquire(blocking, timeout)
-        if ok:
-            held = getattr(_tls, "held", None)
-            if held is None:
-                held = _tls.held = []
-            held.append(self.name)
-        return ok
+            ok = self._lock.acquire(blocking, timeout)
+            if ok:
+                held = getattr(_tls, "held", None)
+                if held is None:
+                    held = _tls.held = []
+                held.append(self.name)
+            return ok
+        # flag off: pure pass-through — no TLS bookkeeping on hot paths
+        return self._lock.acquire(blocking, timeout)
 
     def release(self) -> None:
         held = getattr(_tls, "held", None)
@@ -234,3 +274,6 @@ def reset_for_tests() -> None:
     with _waits_lock:
         _waits.clear()
         _reported.clear()
+
+
+_ensure_warning_var()

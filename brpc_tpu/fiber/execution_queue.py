@@ -13,10 +13,12 @@ be handled as cancelled (mirrors TaskIterator doc, execution_queue.h:78).
 
 from __future__ import annotations
 
+import re
 import threading
 from collections import deque
 from typing import Any, Callable, Deque, Iterator, Optional
 
+from ..butil.sanitizers import DebugLock
 from .runtime import TaskRuntime, global_runtime
 
 
@@ -39,7 +41,14 @@ class ExecutionQueue:
         self._executor = executor
         self._runtime = runtime or global_runtime()
         self._name = name
-        self._lock = threading.Lock()
+        # lock-order-instrumented queue lock (butil/sanitizers): under
+        # the debug_lock_order flag, ABBA inversions between queue
+        # ROLES (instance digits stripped — per-conn queues must not
+        # grow the order graph without bound) and other DebugLocks
+        # warn before the timing ever deadlocks; flag off = plain Lock
+        # pass-through
+        self._lock = DebugLock(
+            "execq:" + (re.sub(r"[_0-9]+$", "", name) or "execq"))
         self._queue: Deque = deque()
         self._high: Deque = deque()
         self._running = False

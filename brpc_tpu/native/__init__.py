@@ -28,20 +28,30 @@ _tried = False
 
 def load() -> Optional[object]:
     """The compiled engine module, building it if needed (None if the
-    build fails — callers fall back to the Python transport)."""
+    build fails — callers fall back to the Python transport).
+
+    With ``BRPC_TPU_NATIVE_ASAN=1`` in the environment the sanitizer-
+    hardened build (``make asan`` → ``_native_asan.so``) is loaded
+    instead — the host python must have libasan LD_PRELOADed (the
+    sanitizer stress test's subprocess arranges this; see
+    tests/asan_driver.py)."""
     global _module, _tried
     with _lock:
         if _module is not None or _tried:
             return _module
         _tried = True
-        so = os.path.join(_DIR, "_native.so")
+        asan = os.environ.get("BRPC_TPU_NATIVE_ASAN") == "1"
+        so = os.path.join(_DIR,
+                          "_native_asan.so" if asan else "_native.so")
         src = os.path.join(_DIR, "src", "engine.cpp")
         try:
             if (not os.path.exists(so)
                     or os.path.getmtime(so) < os.path.getmtime(src)):
-                LOG.info("building native engine (_native.so)...")
-                subprocess.run(["make", "-C", _DIR], check=True,
-                               capture_output=True, timeout=120)
+                LOG.info("building native engine (%s)...",
+                         os.path.basename(so))
+                target = ["asan"] if asan else []
+                subprocess.run(["make", "-C", _DIR] + target, check=True,
+                               capture_output=True, timeout=240)
             import importlib.util
             spec = importlib.util.spec_from_file_location(
                 "brpc_tpu.native._native", so)

@@ -213,6 +213,10 @@ static const char* kLaneNames[kLanes] = {"raw", "slim", "http"};
 // Python path increments exactly one of these.  The Python-side
 // scatter_call screening keeps its own named counters
 // (client/fast_call.py) — client lanes never reach the engine loops.
+// CONTRACT (machine-checked): kFbNames below and the bridge's
+// FB_REASON_NAMES mirror must track this enum member-for-member, and
+// every name needs a test pin — `python -m brpc_tpu.tools.check`
+// (tools/check/contracts.py) gates all three in tier-1.
 enum FbReason : int {
   FB_RPC_DISPATCH_OFF = 0,   // native dispatch gated off (rpc_dump live)
   FB_RPC_META_TAG,           // controller-tier TLV / malformed meta
@@ -738,7 +742,9 @@ struct MetaScan {
 // plus the trace context (9/10/11 — slim lane carries it through),
 // tolerate timeout/ici-domain/conn-nonce (13/15/17), flag the shm
 // data-plane tags (18-21), bail on anything controller-tier
-// (compress, errors, auth, stream, desc).
+// (compress, errors, auth, stream, desc).  CONTRACT (machine-checked):
+// every case label and its `ln !=` width guard must match
+// protocol/meta.py's _T_* registry — tools/check gates it in tier-1.
 static bool scan_request_meta(const char* p, size_t len, MetaScan* out) {
   size_t off = 0;
   while (off < len) {
@@ -4721,7 +4727,8 @@ static PyObject* call_batch(PyObject*, PyObject* args) {
 
 // closed client-lane fallback reason enum (mirrors FbReason's
 // discipline: every frame routed OFF the native demux increments
-// exactly one of these)
+// exactly one of these).  CONTRACT (machine-checked): kCliFbNames and
+// client_lane.REASONS must track this enum — tools/check gates both.
 enum CliFb : int {
   CFB_UNKNOWN_CID = 0,   // cid not in the in-flight table (stale /
                          // cancelled / foreign response)

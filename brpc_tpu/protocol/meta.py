@@ -17,6 +17,10 @@ import struct
 from typing import Optional
 
 # field tags (u8). 0 terminates.
+# CONTRACT (machine-checked): engine.cpp's meta scans and the
+# pre-encoded TLV_* prefixes below must agree with this registry (tag
+# numbers AND fixed field widths) — `python -m brpc_tpu.tools.check`
+# (tools/check/contracts.py) gates renumbering in tier-1.
 _T_CORRELATION = 1      # u64
 _T_COMPRESS = 2         # u8
 _T_ATTACHMENT = 3       # u32 size of attachment tail within payload

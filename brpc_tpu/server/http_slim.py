@@ -127,6 +127,8 @@ def make_http_slim_handler(bridge, server, entry, svc: str, mth: str,
     socks = bridge._socks          # conn_id -> NativeSocket (live dict)
     is_get = http_method in ("GET", "HEAD")
 
+    # ARITY CONTRACT (machine-checked): the engine's kind-4 call site
+    # passes exactly these nine params — tools/check gates both sides
     def slim(body, query, ctype, attsz, conn_id, recv_ns,
              traceparent=None, deadline=None, tenant=None):
         sock = socks.get(conn_id)

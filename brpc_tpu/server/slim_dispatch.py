@@ -132,6 +132,9 @@ def make_slim_handler(bridge, server, entry, svc: str, mth: str):
     # reset_slim() restores the controller wholesale.
     sc_pool: list = []
 
+    # ARITY CONTRACT (machine-checked): the engine's kind-3 call site
+    # passes exactly the public params below (privates are the
+    # underscore-prefixed default binds) — tools/check gates both sides
     def slim(payload, att, cid, conn_id, dom, nonce, recv_ns,
              trace=None, tmo=None, tenant=None,
              _server=server, _entry=entry, _status=status, _fn=fn,

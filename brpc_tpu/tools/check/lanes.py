@@ -1,0 +1,229 @@
+"""Analyzer 2 — five-lane invariant linter.
+
+Until the interceptor-pipeline refactor (ROADMAP item 5) lands, the
+mandatory request stages are hand-replicated across all five server
+dispatch paths.  This AST pass asserts, per lane:
+
+1. the SHARED admission stage runs, and runs BEFORE user code;
+2. deadline shedding (``maybe_shed``) runs before user code;
+3. trace extraction happens (``start_server_span`` family /
+   ``parse_traceparent``);
+4. the MethodStatus settle (``on_responded``) is present in the lane
+   (directly or in its completion closure);
+5. rejection serialization goes through the SHARED helpers — both HTTP
+   lanes through ``http_reject``, tpu_std lanes through the classic
+   error builder with the rejection's code, the gRPC lane through
+   grpc-status 8 (RESOURCE_EXHAUSTED) — so a new lane cannot invent a
+   private (and drifting) rejection wire shape.
+
+"User code" is the ``entry.fn`` / ``entry.raw_fn`` invocation (the
+slim shims call it through their ``_fn`` closure binding).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Sequence, Set
+
+from .base import Finding, Tree, call_name
+
+# per-lane spec: module, path to the lane function, which names count
+# as each stage, and how a rejection must serialize
+LANES = (
+    {
+        "lane": "tpu_std",
+        "path": "brpc_tpu/server/rpc_dispatch.py",
+        "func": ["process_rpc_request"],
+        "reject": {"kind": "call", "names": {"_send_error"}},
+    },
+    {
+        "lane": "slim",
+        "path": "brpc_tpu/server/slim_dispatch.py",
+        "func": ["make_slim_handler", "slim"],
+        "reject": {"kind": "call", "names": {"_send_error"}},
+    },
+    {
+        "lane": "http",
+        "path": "brpc_tpu/server/http_dispatch.py",
+        "func": ["_bridge_rpc"],
+        "reject": {"kind": "call", "names": {"http_reject"}},
+    },
+    {
+        "lane": "http_slim",
+        "path": "brpc_tpu/server/http_slim.py",
+        "func": ["make_http_slim_handler", "slim"],
+        "reject": {"kind": "call", "names": {"http_reject"}},
+    },
+    {
+        "lane": "grpc",
+        "path": "brpc_tpu/protocol/h2_rpc.py",
+        "func": ["_process_grpc"],
+        "reject": {"kind": "grpc8"},
+    },
+    {
+        # fully-buffered requests on @grpc_streaming methods ride this
+        # fiber body instead of _process_grpc's unary path; no span
+        # machinery there (streams are not traced), so trace/shed are
+        # not required — admission + settle + grpc-status 8 are
+        "lane": "grpc_streaming",
+        "path": "brpc_tpu/protocol/h2_rpc.py",
+        "func": ["_run_streaming_handler"],
+        "reject": {"kind": "grpc8"},
+        "optional": {"trace", "shed"},
+    },
+)
+
+ADMIT_NAMES = {"admit", "_admit", "_admit_rpc", "_trivial",
+               "trivial_shape"}
+SHED_NAMES = {"maybe_shed", "_maybe_shed", "_shed"}
+TRACE_NAMES = {"start_server_span", "passive_server_span",
+               "parse_traceparent", "_sample", "_pspan"}
+SETTLE_NAMES = {"on_responded"}
+USER_FN_NAMES = {"fn", "_fn", "raw_fn"}
+
+
+def _fail(findings, path, line, lane, msg):
+    findings.append(Finding("lanes", path, line, f"[{lane}] {msg}"))
+
+
+def _find_func(mod: ast.Module, qualpath: Sequence[str]
+               ) -> Optional[ast.FunctionDef]:
+    scope: Sequence[ast.stmt] = mod.body
+    node = None
+    for name in qualpath:
+        node = None
+        for n in scope:
+            if isinstance(n, (ast.FunctionDef, ast.ClassDef)) \
+                    and n.name == name:
+                node = n
+                break
+        if node is None:
+            return None
+        scope = node.body
+    return node if isinstance(node, ast.FunctionDef) else None
+
+
+
+
+def _calls(func: ast.FunctionDef) -> List[ast.Call]:
+    return [n for n in ast.walk(func) if isinstance(n, ast.Call)]
+
+
+def _first_line(calls: List[ast.Call], names: Set[str]
+                ) -> Optional[int]:
+    lines = [c.lineno for c in calls if call_name(c) in names]
+    return min(lines) if lines else None
+
+
+def _rejection_blocks(func: ast.FunctionDef) -> List[ast.If]:
+    """``if rej is not None:``-shaped guards (any If whose test reads a
+    name ending in ``rej``)."""
+    out = []
+    for n in ast.walk(func):
+        if isinstance(n, ast.If):
+            for sub in ast.walk(n.test):
+                if isinstance(sub, ast.Name) and sub.id.endswith("rej"):
+                    out.append(n)
+                    break
+    return out
+
+
+def _block_has_call(block: ast.If, names: Set[str]) -> bool:
+    return any(isinstance(n, ast.Call) and call_name(n) in names
+               for stmt in block.body for n in ast.walk(stmt))
+
+
+def _block_has_grpc8(block: ast.If) -> bool:
+    """A send_grpc_response(..., 8, ...) / _finish(8, ...) call —
+    RESOURCE_EXHAUSTED is the one legal admission-rejection status."""
+    for stmt in block.body:
+        for n in ast.walk(stmt):
+            if isinstance(n, ast.Call) \
+                    and call_name(n) in ("send_grpc_response",
+                                          "_finish"):
+                if any(isinstance(a, ast.Constant) and a.value == 8
+                       for a in n.args):
+                    return True
+    return False
+
+
+def check_lanes(tree: Tree) -> List[Finding]:
+    findings: List[Finding] = []
+    for spec in LANES:
+        lane, path = spec["lane"], spec["path"]
+        optional = spec.get("optional", set())
+        try:
+            mod = ast.parse(tree.text(path))
+        except SyntaxError as e:
+            _fail(findings, path, e.lineno or 1, lane,
+                  f"syntax error: {e.msg}")
+            continue
+        func = _find_func(mod, spec["func"])
+        if func is None:
+            _fail(findings, path, 1, lane,
+                  f"lane function {'.'.join(spec['func'])} not found")
+            continue
+        calls = _calls(func)
+        admit_at = _first_line(calls, ADMIT_NAMES)
+        shed_at = _first_line(calls, SHED_NAMES)
+        trace_at = _first_line(calls, TRACE_NAMES)
+        settle_at = _first_line(calls, SETTLE_NAMES)
+        user_at = _first_line(calls, USER_FN_NAMES)
+
+        if user_at is None:
+            _fail(findings, path, func.lineno, lane,
+                  "no user-code invocation (entry.fn/raw_fn) found — "
+                  "lane shape changed, update the linter spec")
+            continue
+        if admit_at is None:
+            _fail(findings, path, func.lineno, lane,
+                  "mandatory admission stage (server/admission.admit) "
+                  "is missing")
+        elif admit_at > user_at:
+            _fail(findings, path, admit_at, lane,
+                  f"admission runs at line {admit_at}, AFTER user code "
+                  f"at line {user_at} — admission must be first")
+        if "shed" not in optional:
+            if shed_at is None:
+                _fail(findings, path, func.lineno, lane,
+                      "deadline shed (deadline.maybe_shed) is missing — "
+                      "queue-expired requests would reach user code")
+            elif shed_at > user_at:
+                _fail(findings, path, shed_at, lane,
+                      f"deadline shed at line {shed_at} runs after "
+                      f"user code at line {user_at}")
+            if admit_at is not None and shed_at is not None \
+                    and admit_at > shed_at:
+                _fail(findings, path, admit_at, lane,
+                      "admission must precede the deadline shed "
+                      "(rejections are cheaper than armed deadlines)")
+        if "trace" not in optional and trace_at is None:
+            _fail(findings, path, func.lineno, lane,
+                  "trace extraction (start_server_span family) is "
+                  "missing — requests on this lane would drop their "
+                  "trace context")
+        if settle_at is None:
+            _fail(findings, path, func.lineno, lane,
+                  "MethodStatus settle (on_responded) is missing — "
+                  "admission in-flight counts would leak")
+
+        # rejection serialization through the shared helpers
+        blocks = _rejection_blocks(func)
+        if admit_at is not None and not blocks:
+            _fail(findings, path, func.lineno, lane,
+                  "no `if rej is not None` rejection guard found — "
+                  "admission verdicts are not being honored")
+        rj = spec["reject"]
+        for block in blocks:
+            if rj["kind"] == "grpc8":
+                ok = _block_has_grpc8(block)
+                want = "grpc-status 8 (RESOURCE_EXHAUSTED)"
+            else:
+                ok = _block_has_call(block, rj["names"])
+                want = " / ".join(sorted(rj["names"]))
+            if not ok:
+                _fail(findings, path, block.lineno, lane,
+                      f"rejection block does not serialize through the "
+                      f"shared helper ({want}) — lanes must not invent "
+                      "private rejection wire shapes")
+    return findings

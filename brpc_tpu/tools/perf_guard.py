@@ -10,7 +10,12 @@ Usage (the documented post-bench step)::
 
     python bench.py | tee /tmp/bench.out
     python -m brpc_tpu.tools.perf_guard /tmp/bench.out \
-        --baseline BENCH_r05.json --tolerance 0.5
+        --baseline BENCH_r05.json --tolerance 0.5 --check
+
+``--check`` additionally runs the static-analysis suite
+(``brpc_tpu.tools.check`` — contract drift, lane invariants, closed
+enums/flags, loop-thread blocking calls), so the one documented
+post-bench invocation gates both perf and contracts.
 
 Direction is inferred from the key name (``*_qps``/``*_gbps``/... are
 higher-is-better; ``*_us``/``*_ms`` are lower-is-better; ratio keys on
@@ -180,7 +185,33 @@ def main(argv=None) -> int:
                          "phase-immune (default 0.25)")
     ap.add_argument("--watch", action="append", default=[],
                     help="extra key to score (higher-is-better)")
+    ap.add_argument("--check", action="store_true",
+                    help="also run the static-analysis suite "
+                         "(python -m brpc_tpu.tools.check): the "
+                         "post-bench step then gates perf AND "
+                         "contracts in one invocation")
     args = ap.parse_args(argv)
+
+    check_rc = 0
+    if args.check:
+        # a suite ERROR must not masquerade as findings nor skip the
+        # perf comparison below — same 0/1/2 contract as the check CLI
+        try:
+            from .check import run_all
+            findings = run_all()
+        except Exception as e:
+            print(f"perf_guard --check: suite error: "
+                  f"{type(e).__name__}: {e}", file=sys.stderr)
+            findings = None
+            check_rc = 2
+        if findings:
+            for f in findings:
+                print(f"{f.path}:{f.line}: [{f.analyzer}] {f.message}")
+            print(f"perf_guard --check: {len(findings)} static "
+                  "finding(s)", file=sys.stderr)
+            check_rc = 1
+        elif findings is not None:
+            print("perf_guard --check: static suite clean")
 
     new = load_metrics(args.new)
     if not new:
@@ -208,7 +239,7 @@ def main(argv=None) -> int:
         return 1
     print(f"perf_guard: {sum(1 for r in rows if r[3] == 'ok')} keys "
           "within band")
-    return 0
+    return check_rc
 
 
 if __name__ == "__main__":

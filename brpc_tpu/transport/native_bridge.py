@@ -105,6 +105,22 @@ class NativeSocket(Socket):
 
 _NATIVE_KINDS = {"echo": 0, "const": 1}
 
+# Closed fallback reason-name mirror — MUST match engine.cpp's kFbNames
+# order exactly (the static contract checker, tools/check, pins it).
+# Pre-seeds the native_engine_fallback_total family so every reason row
+# exists in /vars and /metrics from the first scrape, fallback traffic
+# or not — the same eager-registration discipline as client_lane's
+# REASONS tuple.
+FB_REASON_NAMES = (
+    "rpc_dispatch_off", "rpc_meta_tag", "rpc_no_method",
+    "rpc_att_over_cap", "rpc_large_frame", "rpc_trace_raw_lane",
+    "rpc_shm_lane",
+    "http_slim_off", "http_malformed_line", "http_version",
+    "http_no_route", "http_expect", "http_upgrade", "http_connection",
+    "http_transfer_encoding", "http_bad_header", "http_large_body",
+    "http_chunk_stream",
+)
+
 
 # ---------------------------------------------------------------------------
 # Engine telemetry plumbing: ONE engine.telemetry() snapshot per
@@ -411,7 +427,9 @@ class NativeBridge:
         add(PassiveStatus(lambda c=cache: c.get()["inbuf_hwm"],
                           name="native_engine_inbuf_hwm"))
         add(_PassiveDim(("reason",),
-                        lambda c=cache: c.get()["fallbacks"],
+                        lambda c=cache: {
+                            **{r: 0 for r in FB_REASON_NAMES},
+                            **c.get()["fallbacks"]},
                         name="native_engine_fallback_total"))
         add(_PassiveDim(("stage",),
                         lambda c=cache: c.get().get("data_plane_copies",

@@ -1,0 +1,86 @@
+"""Closed-reason-enum pins.
+
+Every reason name the process can export — engine fallback reasons,
+client-lane demux reasons, the client scatter screening literals — is
+pinned HERE as a literal: renaming, removing or adding a reason fails
+this file until the change is acknowledged on both sides.  The static
+suite (tools/check) enforces that every such name has a pin under
+tests/; this module is where the names that have no behavioral test of
+their own get their literal anchor (the behavioral suites pin the rest:
+test_native_telemetry, test_client_lane, test_trace_propagation).
+"""
+
+import ast
+import os
+
+# engine server-lane fallback reasons — must equal engine.cpp kFbNames
+# and the bridge's FB_REASON_NAMES mirror, in order
+ENGINE_FB_REASONS = (
+    "rpc_dispatch_off", "rpc_meta_tag", "rpc_no_method",
+    "rpc_att_over_cap", "rpc_large_frame", "rpc_trace_raw_lane",
+    "rpc_shm_lane",
+    "http_slim_off", "http_malformed_line", "http_version",
+    "http_no_route", "http_expect", "http_upgrade", "http_connection",
+    "http_transfer_encoding", "http_bad_header", "http_large_body",
+    "http_chunk_stream",
+)
+
+# client demux lane reasons — must equal engine.cpp kCliFbNames
+CLIENT_LANE_REASONS = (
+    "cli_unknown_cid", "cli_meta_unparsed", "cli_meta_tags",
+    "cli_stream_frame", "cli_unknown_magic",
+)
+
+# scatter_call screening reasons — the closed set of
+# _scatter_fallback("...") literals in client/fast_call.py
+SCATTER_REASONS = {
+    "ineligible_cntl", "load_balancer", "device_attachment",
+    "nonbytes_request", "auth_on_first", "oversized_request",
+    "mixed_deadlines", "no_single_server", "connect_failed",
+    "socket_busy", "repeated_remote",
+}
+
+
+def test_bridge_mirror_matches_pins():
+    from brpc_tpu.transport.native_bridge import FB_REASON_NAMES
+    assert FB_REASON_NAMES == ENGINE_FB_REASONS
+
+
+def test_client_lane_reasons_match_pins():
+    from brpc_tpu.transport.client_lane import REASONS
+    assert REASONS == CLIENT_LANE_REASONS
+
+
+def test_engine_tables_match_pins():
+    """The C++ source's name tables equal the pinned literals (source
+    scan — no toolchain needed, so the pin holds even where the engine
+    cannot build)."""
+    from brpc_tpu.tools.check import cppscan
+    src = os.path.join(os.path.dirname(__file__), "..", "brpc_tpu",
+                       "native", "src", "engine.cpp")
+    with open(src, "r", encoding="utf-8", errors="replace") as f:
+        text = f.read()
+    assert tuple(cppscan.parse_string_array(text, "kFbNames")) \
+        == ENGINE_FB_REASONS
+    assert tuple(cppscan.parse_string_array(text, "kCliFbNames")) \
+        == CLIENT_LANE_REASONS
+
+
+def test_scatter_screening_set_matches_pins():
+    """The set of screening literals in fast_call.py is exactly the
+    pinned closed set — a new screening site must register its reason
+    here (and thereby in the telemetry family's documented values)."""
+    src = os.path.join(os.path.dirname(__file__), "..", "brpc_tpu",
+                       "client", "fast_call.py")
+    with open(src, "r", encoding="utf-8") as f:
+        mod = ast.parse(f.read())
+    found = set()
+    for node in ast.walk(mod):
+        if isinstance(node, ast.Call):
+            fn = node.func
+            name = fn.id if isinstance(fn, ast.Name) else (
+                fn.attr if isinstance(fn, ast.Attribute) else None)
+            if name == "_scatter_fallback" and node.args \
+                    and isinstance(node.args[0], ast.Constant):
+                found.add(node.args[0].value)
+    assert found == SCATTER_REASONS

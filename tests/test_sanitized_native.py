@@ -1,0 +1,64 @@
+"""Sanitizer-hardened native build (``make asan``) + stress test.
+
+brpc keeps its C++ core honest with ASAN/UBSAN CI; the engine gets the
+same discipline: ``_native_asan.so`` is the identical translation unit
+under ``-fsanitize=address,undefined -fno-omit-frame-pointer``, loaded
+into a subprocess (libasan LD_PRELOADed) that drives burst dispatch,
+the HTTP slim lane, client demux, scatter and the shm slot lifecycle
+(tests/asan_driver.py).  The test fails on ANY sanitizer report.
+
+slow-marked: the instrumented build + run costs ~1-2 minutes, so it
+rides the stress tier, not tier-1.
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_NATIVE = os.path.join(_DIR, "..", "brpc_tpu", "native")
+
+pytestmark = pytest.mark.slow
+
+
+def _lib(name: str) -> str:
+    out = subprocess.run(["g++", f"-print-file-name={name}"],
+                         capture_output=True, text=True, timeout=30)
+    path = out.stdout.strip()
+    return path if os.path.isabs(path) else ""
+
+
+def test_asan_build_and_stress():
+    asan = _lib("libasan.so")
+    if not asan:
+        pytest.skip("no libasan in this toolchain")
+    build = subprocess.run(["make", "-C", _NATIVE, "asan"],
+                           capture_output=True, text=True, timeout=300)
+    assert build.returncode == 0, build.stdout + build.stderr
+
+    env = dict(os.environ)
+    env["BRPC_TPU_NATIVE_ASAN"] = "1"
+    # libasan must initialize before CPython; leak detection off (the
+    # interpreter's arena behavior floods it with false positives) —
+    # use-after-free / overflow / UB detection is the point here
+    preload = asan
+    ubsan = _lib("libubsan.so")
+    if ubsan:
+        preload += ":" + ubsan
+    env["LD_PRELOAD"] = preload
+    env["ASAN_OPTIONS"] = ("detect_leaks=0:abort_on_error=1:"
+                           "disable_coredump=1")
+    env["UBSAN_OPTIONS"] = "print_stacktrace=1:halt_on_error=1"
+    env["PYTHONPATH"] = os.path.abspath(os.path.join(_DIR, "..")) \
+        + os.pathsep + env.get("PYTHONPATH", "")
+
+    r = subprocess.run(
+        [sys.executable, os.path.join(_DIR, "asan_driver.py")],
+        capture_output=True, text=True, timeout=420, env=env)
+    out = r.stdout + r.stderr
+    assert "AddressSanitizer" not in out, out[-8000:]
+    assert "runtime error:" not in out, out[-8000:]
+    assert r.returncode == 0, out[-8000:]
+    assert "ASAN_DRIVER_OK" in r.stdout, out[-8000:]

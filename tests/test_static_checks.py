@@ -1,0 +1,199 @@
+"""Static-analysis suite: tier-1 green gate + seeded-drift negatives.
+
+The first half runs all four analyzers over the real tree and demands
+ZERO findings — the contract/lane/enum/blocking invariants are tier-1
+gates from this round on.  The second half is the linter's own test:
+each required drift class is seeded into a COPY of the relevant source
+(via the suite's override hook) and the responsible analyzer must
+catch it — a linter nobody tests is a linter free to rot.
+"""
+
+import subprocess
+import sys
+
+import pytest
+
+from brpc_tpu.tools.check import (ANALYZERS, run_all, check_blocking,
+                                  check_contracts, check_enums,
+                                  check_lanes, Tree)
+
+ENGINE = "brpc_tpu/native/src/engine.cpp"
+META = "brpc_tpu/protocol/meta.py"
+HTTP_DISPATCH = "brpc_tpu/server/http_dispatch.py"
+FAST_CALL = "brpc_tpu/client/fast_call.py"
+CLIENT_LANE = "brpc_tpu/transport/client_lane.py"
+SLIM = "brpc_tpu/server/slim_dispatch.py"
+
+
+def _mutate(rel: str, old: str, new: str) -> dict:
+    """Override dict with one seeded edit; asserts the anchor exists
+    (a moved anchor must fail the negative test loudly, not skip it)."""
+    text = Tree().text(rel)
+    assert old in text, f"mutation anchor vanished from {rel}: {old!r}"
+    return {rel: text.replace(old, new)}
+
+
+# -- green gate --------------------------------------------------------------
+
+def test_tree_is_clean():
+    findings = run_all()
+    assert findings == [], "\n".join(repr(f) for f in findings)
+
+
+@pytest.mark.parametrize("name,fn", ANALYZERS, ids=[n for n, _ in ANALYZERS])
+def test_each_analyzer_clean(name, fn):
+    findings = fn(Tree())
+    assert findings == [], "\n".join(repr(f) for f in findings)
+
+
+def test_cli_exit_codes():
+    r = subprocess.run([sys.executable, "-m", "brpc_tpu.tools.check",
+                        "--quiet"], capture_output=True, text=True,
+                       timeout=120)
+    assert r.returncode == 0, r.stdout + r.stderr
+    r = subprocess.run([sys.executable, "-m", "brpc_tpu.tools.check",
+                        "-a", "contracts", "--fail-fast"],
+                       capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, r.stdout + r.stderr
+
+
+# -- seeded drifts: the five required classes --------------------------------
+
+def test_drift_enum_member_removed():
+    """Deleting a FbReason member breaks BOTH the name-table count and
+    every surviving call site that still bumps the counter."""
+    ov = _mutate(
+        ENGINE,
+        "FB_RPC_SHM_LANE,           // frame carries shm data-plane TLVs",
+        "// member removed by seeded-drift test")
+    findings = check_contracts(Tree(overrides=ov))
+    assert any("kFbNames" in f.message for f in findings), findings
+    findings = check_enums(Tree(overrides=ov))
+    assert any("FB_RPC_SHM_LANE" in f.message for f in findings), findings
+
+
+def test_drift_tlv_tag_renumbered():
+    """Renumbering a meta.py tag leaves the engine scanning the OLD
+    number and the pre-encoded prefix carrying the OLD byte."""
+    ov = _mutate(META, "_T_TIMEOUT_MS = 13", "_T_TIMEOUT_MS = 23")
+    findings = check_contracts(Tree(overrides=ov))
+    assert any("tag 13" in f.message for f in findings), findings
+    # the pre-encoded TLV_TIMEOUT prefix still says 0x0d
+    assert any("TLV_TIMEOUT" in f.message for f in findings), findings
+
+
+def test_drift_shim_arity_changed():
+    """Dropping one arg from the engine's kind-3 call (the 'grew one
+    arg in two separate rounds' class, in reverse)."""
+    ov = _mutate(ENGINE, "ten ? ten : Py_None, nullptr);", "nullptr);")
+    findings = check_contracts(Tree(overrides=ov))
+    assert any("kind-3" in f.message and "9 args" in f.message
+               for f in findings), findings
+
+
+def test_drift_shim_arity_changed_python_side():
+    """The same class seeded on the Python side: the shim def grows a
+    public parameter the engine never passes."""
+    ov = _mutate(SLIM, "trace=None, tmo=None, tenant=None,",
+                 "trace=None, tmo=None, tenant=None, extra=None,")
+    findings = check_contracts(Tree(overrides=ov))
+    assert any("kind-3" in f.message and "takes 11" in f.message
+               for f in findings), findings
+
+
+def test_drift_admission_deleted_from_one_lane():
+    """Removing the shared admission call from the classic HTTP lane
+    (rename → the stage is simply no longer invoked)."""
+    ov = _mutate(HTTP_DISPATCH, 'rej = _admit(server, entry, "http", tenant,',
+                 'rej = _noadmit(server, entry, "http", tenant,')
+    findings = check_lanes(Tree(overrides=ov))
+    assert any("[http]" in f.message and "admission" in f.message
+               for f in findings), findings
+
+
+def test_drift_unregistered_fallback_reason():
+    """(a) a C++ counter bump under a member the enum never declared;
+    (b) a Python screening site inventing a reason no test pins."""
+    ov = _mutate(ENGINE, "lp->tel.fallbacks[FB_RPC_DISPATCH_OFF]++;",
+                 "lp->tel.fallbacks[FB_TOTALLY_NEW_REASON]++;")
+    findings = check_enums(Tree(overrides=ov))
+    assert any("FB_TOTALLY_NEW_REASON" in f.message
+               for f in findings), findings
+
+    # the seeded name is assembled at runtime: a literal here would
+    # itself count as a test pin (the checker scans tests/ as text)
+    unpinned = "reason_nobody_" + "anchored"
+    ov = _mutate(FAST_CALL, '_scatter_fallback("ineligible_cntl")',
+                 f'_scatter_fallback("{unpinned}")')
+    findings = check_enums(Tree(overrides=ov))
+    assert any(unpinned in f.message for f in findings), findings
+
+
+# -- further drift classes (beyond the required five) ------------------------
+
+def test_drift_stale_reason_name_table():
+    """A renamed kFbNames string with the enum untouched: the bridge
+    mirror no longer matches (the 'stale telemetry mirror' suspect)."""
+    ov = _mutate(ENGINE, '"rpc_dispatch_off",', '"rpc_dispatch_gone",')
+    findings = check_contracts(Tree(overrides=ov))
+    assert any("FB_REASON_NAMES" in f.message for f in findings), findings
+
+
+def test_drift_shed_after_user_code():
+    """Deadline shed deleted from the grpc lane → doomed work reaches
+    the handler."""
+    ov = _mutate("brpc_tpu/protocol/h2_rpc.py",
+                 'if _maybe_shed(cntl, "grpc", entry.status.full_name):',
+                 'if False and _nothing(cntl):')
+    findings = check_lanes(Tree(overrides=ov))
+    assert any("[grpc]" in f.message and "shed" in f.message
+               for f in findings), findings
+
+
+def test_drift_private_rejection_shape():
+    """A lane serializing rejections around the shared helper."""
+    ov = _mutate(HTTP_DISPATCH,
+                 "status_code, body, extra = http_reject(rej)",
+                 "status_code, body, extra = 503, b'busy', []")
+    findings = check_lanes(Tree(overrides=ov))
+    assert any("[http]" in f.message and "shared helper" in f.message
+               for f in findings), findings
+
+
+def test_drift_undeclared_flag():
+    ov = _mutate(CLIENT_LANE, 'get_flag("rpc_native_client_lane", True)',
+                 'get_flag("rpc_native_client_lane_v2", True)')
+    findings = check_enums(Tree(overrides=ov))
+    assert any("rpc_native_client_lane_v2" in f.message
+               for f in findings), findings
+
+
+def test_drift_blocking_call_on_loop_thread():
+    ov = _mutate(CLIENT_LANE, "idp = global_id_pool()",
+                 "idp = global_id_pool(); time.sleep(0.01)")
+    # the mutated module must still import time for the AST resolver
+    ov[CLIENT_LANE] = ov[CLIENT_LANE].replace(
+        "import threading", "import threading\nimport time", 1)
+    findings = check_blocking(Tree(overrides=ov))
+    assert any("sleep" in f.message for f in findings), findings
+
+
+def test_drift_untimed_wait_on_loop_thread():
+    ov = _mutate(
+        CLIENT_LANE,
+        "sock = Socket.address(sid) if sid is not None else None",
+        "sock = Socket.address(sid) if sid is not None else None\n"
+        "        self._drained.wait()")
+    findings = check_blocking(Tree(overrides=ov))
+    assert any(".wait()" in f.message for f in findings), findings
+
+
+def test_allow_marker_suppresses():
+    """The reviewed-exception escape hatch works (and is line-scoped)."""
+    ov = _mutate(
+        CLIENT_LANE,
+        "sock = Socket.address(sid) if sid is not None else None",
+        "sock = Socket.address(sid) if sid is not None else None\n"
+        "        self._drained.wait()  # static-check: allow")
+    findings = check_blocking(Tree(overrides=ov))
+    assert findings == [], findings
