@@ -370,6 +370,190 @@ def bench_headline_and_sweep(extra: dict) -> float:
         srv.stop()
 
 
+def bench_loop_scaling(extra: dict) -> None:
+    """Multi-core engine scaling (ISSUE 11): the SO_REUSEPORT-sharded
+    per-core loops against the one-loop baseline.
+
+    - sweep_64b_pipelined_qps_4loop  pipelined 64B echo over one conn
+                                     per loop on a 4-loop engine (all-
+                                     C++ kind-0 dispatch: the engine's
+                                     capacity, not the client's)
+    - loop_scaling_efficiency        median over PAIRED INTERLEAVED
+                                     rounds of qps(2) / (2 * qps(1)) —
+                                     the phase-immune acceptance key
+                                     (≈1/N is the expected floor when
+                                     loops outnumber cores; see PERF
+                                     §14 for the 1-core caveat)
+    - loop_scaling_efficiency_4loop  same at N=4
+    - sweep_64b_pipelined_4loop_p99_us  sync per-call p99 on a probe
+                                     conn while every loop serves
+                                     pipelined load (full-core tail)
+    """
+    import socket as pysock
+    import struct as _struct
+    import threading as _threading
+
+    def _tlv(tag, data):
+        return bytes([tag]) + _struct.pack("<I", len(data)) + data
+
+    def _frame(cid, payload):
+        meta = (_tlv(1, _struct.pack("<Q", cid)) + _tlv(4, b"Bench")
+                + _tlv(5, b"EchoRaw"))
+        return (b"TRPC" + _struct.pack(
+            "<II", len(meta) + len(payload), len(meta)) + meta + payload)
+
+    BURST = 128
+    blast = b"".join(_frame(i + 1, b"x" * 64) for i in range(BURST))
+
+    def _drain(sock, want, buf):
+        seen = 0
+        while seen < want:
+            chunk = sock.recv(262144)
+            if not chunk:
+                raise ConnectionError("peer closed mid-burst")
+            buf += chunk
+            seen = 0
+            off = 0
+            while off + 12 <= len(buf):
+                (blen,) = _struct.unpack_from("<I", buf, off + 4)
+                if off + 12 + blen > len(buf):
+                    break
+                off += 12 + blen
+                seen += 1
+        del buf[:]
+        return seen
+
+    def _conn_window(port, secs, out, idx):
+        """One pipelined connection: blast/drain bursts for `secs`,
+        record completed frames."""
+        try:
+            s = pysock.create_connection(("127.0.0.1", port), timeout=10)
+            s.setsockopt(pysock.IPPROTO_TCP, pysock.TCP_NODELAY, 1)
+            buf = bytearray()
+            s.sendall(blast)            # warmup burst
+            _drain(s, BURST, buf)
+            n = 0
+            t0 = time.perf_counter()
+            while time.perf_counter() - t0 < secs:
+                s.sendall(blast)
+                _drain(s, BURST, buf)
+                n += BURST
+            out[idx] = n / (time.perf_counter() - t0)
+            s.close()
+        except Exception:
+            out[idx] = 0.0
+
+    def measure(port, nconns, secs=1.2, probe_lats=None):
+        """nconns pipelined conns in parallel threads; optional probe
+        thread measuring sync per-call latency on its own conn."""
+        out = [0.0] * nconns
+        threads = [_threading.Thread(target=_conn_window,
+                                     args=(port, secs, out, i))
+                   for i in range(nconns)]
+        stop = _threading.Event()
+
+        def _probe():
+            try:
+                s = pysock.create_connection(("127.0.0.1", port),
+                                             timeout=10)
+                s.setsockopt(pysock.IPPROTO_TCP, pysock.TCP_NODELAY, 1)
+                buf = bytearray()
+                one = _frame(7, b"p" * 64)
+                while not stop.is_set():
+                    t0 = time.perf_counter()
+                    s.sendall(one)
+                    _drain(s, 1, buf)
+                    probe_lats.append((time.perf_counter() - t0) * 1e6)
+                s.close()
+            except Exception:
+                pass
+
+        pt = None
+        if probe_lats is not None:
+            pt = _threading.Thread(target=_probe)
+        for t in threads:
+            t.start()
+        if pt is not None:
+            pt.start()
+        for t in threads:
+            t.join()
+        stop.set()
+        if pt is not None:
+            pt.join(timeout=10)
+        return sum(out)
+
+    from brpc_tpu.server import Server, ServerOptions, Service
+    from brpc_tpu.server.service import raw_method
+
+    class EchoN(Service):
+        @raw_method(native="echo")
+        def EchoRaw(self, payload, attachment):
+            return payload, attachment
+
+    def _mk(loops):
+        opts = ServerOptions()
+        opts.native = True
+        opts.usercode_inline = True
+        opts.native_loops = loops
+        srv = Server(opts)
+        srv.add_service(EchoN(), name="Bench")
+        assert srv.start("127.0.0.1:0") == 0
+        return srv
+
+    servers = {}
+    try:
+        # all three configs live through every round so the paired
+        # interleaved A/B runs same-phase (the cntl_vs_raw discipline)
+        for n in (1, 2, 4):
+            servers[n] = _mk(n)
+        ports = {n: servers[n].listen_endpoint.port for n in (1, 2, 4)}
+        # warm every config once outside the scored rounds
+        for n in (1, 2, 4):
+            measure(ports[n], n, secs=0.3)
+        eff2, eff4 = [], []
+        best = {1: 0.0, 2: 0.0, 4: 0.0}
+        for rnd in range(3):
+            order = (1, 2, 4) if rnd % 2 == 0 else (4, 2, 1)
+            qps = {}
+            for n in order:
+                qps[n] = measure(ports[n], n)
+            for n in (1, 2, 4):
+                best[n] = max(best[n], qps[n])
+            if qps[1] > 0:
+                eff2.append(qps[2] / (2.0 * qps[1]))
+                eff4.append(qps[4] / (4.0 * qps[1]))
+        extra["sweep_64b_pipelined_qps_1loop"] = round(best[1], 1)
+        extra["sweep_64b_pipelined_qps_2loop"] = round(best[2], 1)
+        extra["sweep_64b_pipelined_qps_4loop"] = round(best[4], 1)
+        if eff2:
+            eff2.sort()
+            eff4.sort()
+            extra["loop_scaling_efficiency"] = \
+                round(eff2[len(eff2) // 2], 3)
+            extra["loop_scaling_efficiency_4loop"] = \
+                round(eff4[len(eff4) // 2], 3)
+        # p99 under full-core pipelined load: every loop of the 4-loop
+        # engine saturated by a pipelined conn, a probe conn measures
+        # sync per-call latency through the same loops
+        lats: list = []
+        measure(ports[4], 4, secs=1.5, probe_lats=lats)
+        if len(lats) >= 20:
+            lats.sort()
+            extra["sweep_64b_pipelined_4loop_p99_us"] = \
+                round(lats[int(len(lats) * 0.99)], 1)
+            extra["sweep_64b_pipelined_4loop_p50_us"] = \
+                round(lats[len(lats) // 2], 1)
+        # scaling diagnostics: windowed busy imbalance of the 4-loop
+        # engine right after load (the /native smoking-gun number)
+        bridge = servers[4]._native_bridge
+        if bridge is not None:
+            extra["loop_busy_imbalance_4loop"] = round(
+                bridge.telemetry.loop_busy_imbalance(), 4)
+    finally:
+        for srv in servers.values():
+            srv.stop()
+
+
 def bench_data_plane(extra: dict) -> None:
     """The zero-copy tensor data plane (ISSUE 6):
 
@@ -1965,7 +2149,8 @@ def main() -> None:
         headline = bench_headline_and_sweep(extra)  # the metric: always
     except Exception as e:                          # the JSON still prints
         extra["headline_error"] = f"{type(e).__name__}: {e}"[:160]
-    for name, fn in (("data_plane", bench_data_plane),
+    for name, fn in (("loop_scaling", bench_loop_scaling),
+                     ("data_plane", bench_data_plane),
                      ("streaming", bench_streaming),
                      ("fanout", bench_fanout),
                      ("http", bench_http),

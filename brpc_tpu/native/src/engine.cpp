@@ -298,8 +298,14 @@ struct LoopTelemetry {
   Hist burst;           // batched items per flush_py_batch
   Hist wiov;            // iovs coalesced per writev in conn_flush
   uint64_t busy_ns = 0; // loop body time (callbacks, parsing, writes)
-  uint64_t idle_ns = 0; // time blocked in epoll_wait
+  uint64_t idle_ns = 0; // time blocked in epoll_wait (busy-poll spin
+                        // included: spinning is waiting, not work)
   uint64_t polls = 0;   // epoll_wait returns
+  uint64_t spin_polls = 0;  // busy-poll spins that harvested events
+                            // before the blocking epoll_wait
+  uint64_t accepts = 0;     // conns accepted AND pinned by this loop
+  uint64_t frames = 0;      // complete messages parsed by this loop
+  uint64_t handoffs = 0;    // cross-loop handoff nodes consumed
   uint64_t wq_hwm = 0;  // write-queue items high-water mark
   uint64_t inbuf_hwm = 0;  // inbuf fill high-water mark (bytes)
 };
@@ -360,7 +366,13 @@ struct Conn {
   bool want_out = false;
   bool closing = false;
   bool dead = false;
-  bool flush_queued = false;  // guarded by loop->mu: coalesced flush pending
+  // coalesced cross-loop flush pending: CAS false->true gates the
+  // handoff post (one node per conn per loop iteration); the owning
+  // loop resets it before flushing so a racing send re-posts
+  std::atomic<bool> flush_queued{false};
+  // frames parsed on this conn (owning-loop writes; racy reads from
+  // telemetry are fine) — the loop-pinning tests key on it
+  uint64_t frames = 0;
 
   // native-dispatch responses accumulated during the current read burst
   // (loop thread only); flushed as ONE owned WriteItem before any
@@ -369,18 +381,36 @@ struct Conn {
   std::string native_out;
 };
 
+// Cross-loop completion handoff: a mutex-free MPSC Treiber stack per
+// loop.  Producers (GIL-holding completion threads — fiber completions,
+// scatter/fan-out results, close requests — and foreign accept loops)
+// CAS-push a node and wake the consumer loop; the consumer exchanges
+// the whole head once per iteration, reverses for FIFO, and processes
+// without ever taking a lock.  This replaces the round-9
+// mutex+vector pending_out/pending_close pair: with one loop per core
+// a contended mutex on every cross-loop response serializes exactly
+// the path per-core sharding exists to unshare.
+enum HandoffOp : int { HO_FLUSH = 0, HO_CLOSE = 1, HO_ADOPT = 2 };
+
+struct HandoffNode {
+  HandoffNode* next;
+  uint64_t id;
+  int op;
+};
+
 struct Loop {
   int epfd = -1;
   int wakefd = -1;
   std::thread thr;
   struct EngineImpl* eng = nullptr;
   int index = 0;
+  // sharded-accept listener owned by THIS loop (SO_REUSEPORT path);
+  // -1 = no own listener (single shared fd on loop 0, rr placement)
+  int listen_fd = -1;
   // connections owned by this loop
   std::unordered_map<uint64_t, Conn*> conns;
-  // cross-thread requests
-  std::mutex mu;
-  std::vector<uint64_t> pending_out;    // conns needing EPOLLOUT attention
-  std::vector<uint64_t> pending_close;
+  // cross-loop handoff inbox (lock-free MPSC; see HandoffNode above)
+  std::atomic<HandoffNode*> handoff_head{nullptr};
   // conns in close-after-flush linger (owned-loop state, no lock)
   std::vector<uint64_t> lingering;
   // conns holding a sniffed-HTTP prefix not yet committed by the
@@ -525,6 +555,11 @@ struct EngineImpl {
   // bridge before listen(); read-only afterwards.
   std::string domain_tlv;
   bool started = false;
+  // optional busy-poll spin (us) before each blocking epoll_wait: the
+  // loop burns its core polling for new events instead of paying the
+  // sleep/wake scheduler round trip — the latency-tail knob
+  // (engine_busy_poll_us flag; runtime-settable, relaxed reads)
+  std::atomic<int> busy_poll_us{0};
   // true = the loops run on Python-created threads (bridge calls
   // run_loop from threading.Thread).  A thread whose datastack
   // carries a resident Python frame never munmaps its chunk, so the
@@ -545,6 +580,12 @@ static int64_t now_ms() {
   struct timespec ts;
   clock_gettime(CLOCK_MONOTONIC, &ts);
   return (int64_t)ts.tv_sec * 1000 + ts.tv_nsec / 1000000;
+}
+
+static inline void count_msg(EngineImpl* eng, Loop* lp, Conn* c) {
+  eng->nmessages++;
+  lp->tel.frames++;
+  c->frames++;
 }
 
 // close-after-flush bound: a conn that cannot drain its write queue to
@@ -585,6 +626,24 @@ static void loop_wake(Loop* lp) {
   ssize_t r = write(lp->wakefd, &one, 8);
   (void)r;
 }
+
+// push one handoff node onto lp's MPSC stack and wake it.  Safe from
+// any thread; the release CAS publishes the node's fields to the
+// consumer's acquire exchange.
+static void loop_post(Loop* lp, uint64_t id, int op) {
+  HandoffNode* n = new (std::nothrow) HandoffNode{nullptr, id, op};
+  if (!n) return;                       // OOM: drop; linger/close sweeps
+  HandoffNode* h = lp->handoff_head.load(std::memory_order_relaxed);
+  do {
+    n->next = h;
+  } while (!lp->handoff_head.compare_exchange_weak(
+      h, n, std::memory_order_release, std::memory_order_relaxed));
+  loop_wake(lp);
+}
+
+// one complete message parsed on lp for conn c — the single site the
+// engine-wide, per-loop and per-conn (loop-pinning) counters share
+static inline void count_msg(EngineImpl* eng, Loop* lp, Conn* c);
 
 static void call_dispatch(EngineImpl* eng, Loop* lp, int event, uint64_t id,
                           PyObject* obj /* stolen or null */, long extra) {
@@ -1875,7 +1934,7 @@ static bool parse_frames_inner(EngineImpl* eng, Loop* lp, Conn* c,
       }
       PyGILState_Release(gs);
     }
-    eng->nmessages++;
+    count_msg(eng, lp, c);
     delete c->chunk;
     c->chunk = nullptr;
     if (!ok) return false;
@@ -2016,7 +2075,7 @@ static bool parse_frames_inner(EngineImpl* eng, Loop* lp, Conn* c,
                               &hit)) {
             hit.t_parse = now_ns();
             c->in_start += (size_t)hr;
-            eng->nmessages++;
+            count_msg(eng, lp, c);
             batch.push_back(hit);
             continue;
           }
@@ -2027,7 +2086,7 @@ static bool parse_frames_inner(EngineImpl* eng, Loop* lp, Conn* c,
         flush_py_batch(lp, c, batch);   // wire order vs earlier frames
         if (!c->native_out.empty() && !native_flush(lp, c)) return false;
         c->in_start += (size_t)hr;
-        eng->nmessages++;
+        count_msg(eng, lp, c);
         bool ok;
         {
           PyGILState_STATE gs = PyGILState_Ensure();
@@ -2094,7 +2153,7 @@ static bool parse_frames_inner(EngineImpl* eng, Loop* lp, Conn* c,
     size_t total = hdr + (size_t)body;
     if (avail >= total) {
       c->in_start += total;
-      eng->nmessages++;
+      count_msg(eng, lp, c);
       // native dispatch first: echo-class frames never leave C++ (the
       // response rides c->native_out, coalesced across the burst);
       // kind=2 Python raw handlers are BATCHED into one GIL entry
@@ -2208,7 +2267,7 @@ static bool conn_readable(EngineImpl* eng, Loop* lp, Conn* c) {
         NativeBuf* b = c->msg;
         c->msg = nullptr;
         c->msg_filled = 0;
-        eng->nmessages++;
+        count_msg(eng, lp, c);
         // native echo on the large-frame path: respond zero-copy out of
         // the received NativeBuf (header+meta owned; body is a view)
         MetaScan s;
@@ -2319,10 +2378,17 @@ static bool conn_readable(EngineImpl* eng, Loop* lp, Conn* c) {
 }
 
 static void accept_conns(EngineImpl* eng, Loop* lp) {
+  // SHARDED ACCEPT (SO_REUSEPORT): each loop accepts off its OWN
+  // listen fd and pins the conn to itself for life — no rr handoff, no
+  // adopt round trip, no cross-loop state on the whole read→shim→writev
+  // path (brpc's one-EventDispatcher-per-core discipline).  The shared
+  // single-fd path (lp->listen_fd < 0 — platforms/configs without
+  // REUSEPORT) keeps the round-robin + adopt-eventfd placement.
+  int lfd = lp->listen_fd >= 0 ? lp->listen_fd : eng->listen_fd;
   for (;;) {
     struct sockaddr_in addr;
     socklen_t alen = sizeof(addr);
-    int fd = accept4(eng->listen_fd, (struct sockaddr*)&addr, &alen,
+    int fd = accept4(lfd, (struct sockaddr*)&addr, &alen,
                      SOCK_NONBLOCK);
     if (fd < 0) return;
     int one = 1;
@@ -2335,8 +2401,10 @@ static void accept_conns(EngineImpl* eng, Loop* lp) {
     inet_ntop(AF_INET, &addr.sin_addr, ip, sizeof(ip));
     c->peer_ip = ip;
     c->peer_port = ntohs(addr.sin_port);
-    // assign round-robin
-    Loop* target = eng->loops[eng->rr++ % eng->loops.size()];
+    // placement: own-listener accepts pin to the accepting loop;
+    // shared-fd accepts assign round-robin (fallback path)
+    Loop* target = lp->listen_fd >= 0
+        ? lp : eng->loops[eng->rr++ % eng->loops.size()];
     c->loop = target;
     {
       std::lock_guard<std::mutex> g(eng->cmu);
@@ -2359,15 +2427,14 @@ static void accept_conns(EngineImpl* eng, Loop* lp) {
       PyGILState_Release(gs);
     }
     if (target == lp) {
+      lp->tel.accepts++;
       lp->conns[c->id] = c;
       struct epoll_event ev;
       ev.events = EPOLLIN;
       ev.data.u64 = c->id;
       epoll_ctl(lp->epfd, EPOLL_CTL_ADD, fd, &ev);
     } else {
-      std::lock_guard<std::mutex> g(target->mu);
-      target->pending_out.push_back(c->id | (1ull << 63));  // adopt marker
-      loop_wake(target);
+      loop_post(target, c->id, HO_ADOPT);
     }
   }
 }
@@ -2381,9 +2448,22 @@ static void loop_run(Loop* lp) {
   while (!eng->stopping.load()) {
     // busy/idle split: time blocked in epoll_wait is idle, everything
     // else in the iteration (callbacks, parsing, writes) is busy —
-    // the loop-thread analogue of /hotspots for the C++ data plane
+    // the loop-thread analogue of /hotspots for the C++ data plane.
+    // With engine_busy_poll_us set, the loop first SPINS on zero-
+    // timeout polls for that long: events harvested in the spin skip
+    // the sleep/wake scheduler round trip (the latency-tail knob; the
+    // spin window is accounted idle — spinning is waiting, not work).
     int64_t t_pre = now_ns();
-    int n = epoll_wait(lp->epfd, evs, 128, 200);
+    int n = 0;
+    int spin_us = eng->busy_poll_us.load(std::memory_order_relaxed);
+    if (spin_us > 0) {
+      int64_t spin_end = t_pre + (int64_t)spin_us * 1000;
+      do {
+        n = epoll_wait(lp->epfd, evs, 128, 0);
+      } while (n == 0 && now_ns() < spin_end && !eng->stopping.load());
+      if (n > 0) lp->tel.spin_polls++;
+    }
+    if (n == 0) n = epoll_wait(lp->epfd, evs, 128, 200);
     int64_t t_wake = now_ns();
     lp->tel.idle_ns += (uint64_t)(t_wake - t_pre);
     lp->tel.polls++;
@@ -2396,17 +2476,29 @@ static void loop_run(Loop* lp) {
       if (errno == EINTR) continue;
       break;
     }
-    // cross-thread requests
+    // cross-loop handoff drain: take the whole MPSC stack in ONE
+    // acquire exchange (no lock), reverse it for FIFO processing, and
+    // run each node — flush requests from completion threads, close
+    // requests, rr-fallback adopts.  Producers never block; this loop
+    // never locks: the per-core lanes share nothing on the hot path.
     {
-      std::vector<uint64_t> outs, closes;
-      {
-        std::lock_guard<std::mutex> g(lp->mu);
-        outs.swap(lp->pending_out);
-        closes.swap(lp->pending_close);
+      HandoffNode* head =
+          lp->handoff_head.exchange(nullptr, std::memory_order_acquire);
+      HandoffNode* rev = nullptr;
+      while (head) {
+        HandoffNode* nx = head->next;
+        head->next = rev;
+        rev = head;
+        head = nx;
       }
-      for (uint64_t raw : outs) {
-        if (raw & (1ull << 63)) {  // adopt a freshly accepted conn
-          uint64_t id = raw & ~(1ull << 63);
+      while (rev) {
+        HandoffNode* node = rev;
+        rev = rev->next;
+        lp->tel.handoffs++;
+        uint64_t id = node->id;
+        int op = node->op;
+        delete node;
+        if (op == HO_ADOPT) {            // adopt a freshly accepted conn
           Conn* c = nullptr;
           {
             std::lock_guard<std::mutex> g(eng->cmu);
@@ -2414,6 +2506,7 @@ static void loop_run(Loop* lp) {
             if (it != eng->by_id.end()) c = it->second;
           }
           if (c) {
+            lp->tel.accepts++;
             lp->conns[id] = c;
             struct epoll_event ev;
             ev.events = EPOLLIN;
@@ -2422,17 +2515,19 @@ static void loop_run(Loop* lp) {
           }
           continue;
         }
-        auto it = lp->conns.find(raw);
-        if (it != lp->conns.end()) {
-          {
-            std::lock_guard<std::mutex> g(lp->mu);
-            it->second->flush_queued = false;
+        if (op == HO_FLUSH) {
+          auto it = lp->conns.find(id);
+          if (it != lp->conns.end()) {
+            // reset BEFORE flushing: a send racing in after this sees
+            // queued bytes and posts a fresh node
+            it->second->flush_queued.store(false,
+                                           std::memory_order_release);
+            if (!conn_flush(lp, it->second))
+              conn_destroy(eng, lp, it->second, true);
           }
-          if (!conn_flush(lp, it->second))
-            conn_destroy(eng, lp, it->second, true);
+          continue;
         }
-      }
-      for (uint64_t id : closes) {
+        // HO_CLOSE
         auto it = lp->conns.find(id);
         if (it == lp->conns.end()) continue;
         Conn* c = it->second;
@@ -2520,10 +2615,28 @@ static void loop_run(Loop* lp) {
       lp->lingering.swap(keep);
     }
   }
-  // teardown: close all conns owned by this loop
+  // teardown: close all conns owned by this loop, then drain any
+  // handoff nodes posted after the last iteration (an un-adopted conn
+  // must still be destroyed — its fd is open and it is in by_id)
   std::vector<Conn*> cs;
   for (auto& kv : lp->conns) cs.push_back(kv.second);
   for (Conn* c : cs) conn_destroy(eng, lp, c, false);
+  HandoffNode* head =
+      lp->handoff_head.exchange(nullptr, std::memory_order_acquire);
+  while (head) {
+    HandoffNode* nx = head->next;
+    if (head->op == HO_ADOPT) {
+      Conn* c = nullptr;
+      {
+        std::lock_guard<std::mutex> g(eng->cmu);
+        auto it = eng->by_id.find(head->id);
+        if (it != eng->by_id.end()) c = it->second;
+      }
+      if (c) conn_destroy(eng, lp, c, false);
+    }
+    delete head;
+    head = nx;
+  }
 }
 
 // ---------------------------------------------------------------------------
@@ -2592,6 +2705,64 @@ static PyObject* Engine_listen(EngineObj* self, PyObject* args) {
       if (!lp->thr.joinable()) lp->thr = std::thread(loop_run, lp);
     }
   }
+  Py_RETURN_NONE;
+}
+
+// listen_sharded(fds) — the SO_REUSEPORT sharded-accept path: exactly
+// one bound+listening fd per loop; each loop accepts its own
+// connections and pins them to itself for life (no rr handoff, no
+// adopt round trip).  The single-fd listen() above remains the
+// fallback for platforms/configs without REUSEPORT.
+static PyObject* Engine_listen_sharded(EngineObj* self, PyObject* args) {
+  PyObject* fds;
+  if (!PyArg_ParseTuple(args, "O", &fds)) return nullptr;
+  EngineImpl* eng = self->eng;
+  PyObject* seq = PySequence_Fast(fds, "fds must be a sequence");
+  if (!seq) return nullptr;
+  Py_ssize_t n = PySequence_Fast_GET_SIZE(seq);
+  if ((size_t)n != eng->loops.size()) {
+    Py_DECREF(seq);
+    PyErr_SetString(PyExc_ValueError,
+                    "listen_sharded needs exactly one fd per loop");
+    return nullptr;
+  }
+  for (Py_ssize_t i = 0; i < n; i++) {
+    long fd = PyLong_AsLong(PySequence_Fast_GET_ITEM(seq, i));
+    if (fd == -1 && PyErr_Occurred()) {
+      Py_DECREF(seq);
+      return nullptr;
+    }
+    Loop* lp = eng->loops[(size_t)i];
+    lp->listen_fd = (int)fd;
+    struct epoll_event ev;
+    ev.events = EPOLLIN;
+    ev.data.u64 = UINT64_MAX;
+    if (epoll_ctl(lp->epfd, EPOLL_CTL_ADD, (int)fd, &ev) != 0) {
+      Py_DECREF(seq);
+      PyErr_SetFromErrno(PyExc_OSError);
+      return nullptr;
+    }
+  }
+  Py_DECREF(seq);
+  eng->started = true;
+  if (!eng->external_loops) {
+    for (Loop* lp : eng->loops) {
+      if (!lp->thr.joinable()) lp->thr = std::thread(loop_run, lp);
+    }
+  }
+  Py_RETURN_NONE;
+}
+
+// set_busy_poll_us(us) — arm/disarm the pre-epoll busy-poll spin.
+// Runtime-settable (relaxed atomic): flag flips take effect on the
+// next loop iteration.
+static PyObject* Engine_set_busy_poll_us(EngineObj* self,
+                                         PyObject* args) {
+  int us;
+  if (!PyArg_ParseTuple(args, "i", &us)) return nullptr;
+  if (us < 0) us = 0;
+  if (us > 1000000) us = 1000000;   // 1s: far past any sane spin
+  self->eng->busy_poll_us.store(us, std::memory_order_relaxed);
   Py_RETURN_NONE;
 }
 
@@ -2928,9 +3099,14 @@ static PyObject* Engine_telemetry(EngineObj* self, PyObject*) {
     if (t.wq_hwm > wq_hwm) wq_hwm = t.wq_hwm;
     if (t.inbuf_hwm > inbuf_hwm) inbuf_hwm = t.inbuf_hwm;
     PyObject* lo = Py_BuildValue(
-        "{s:K,s:K,s:K}", "busy_ns", (unsigned long long)t.busy_ns,
-        "idle_ns", (unsigned long long)t.idle_ns, "polls",
-        (unsigned long long)t.polls);
+        "{s:K,s:K,s:K,s:K,s:K,s:K,s:K}",
+        "busy_ns", (unsigned long long)t.busy_ns,
+        "idle_ns", (unsigned long long)t.idle_ns,
+        "polls", (unsigned long long)t.polls,
+        "spin_polls", (unsigned long long)t.spin_polls,
+        "accepts", (unsigned long long)t.accepts,
+        "frames", (unsigned long long)t.frames,
+        "handoffs", (unsigned long long)t.handoffs);
     if (!lo) {
       Py_DECREF(loops);
       return nullptr;
@@ -3047,6 +3223,40 @@ static PyObject* Engine_telemetry(EngineObj* self, PyObject*) {
     Py_XDECREF(dpc);
     Py_XDECREF(dpB);
   }
+  if (ok) {
+    // loop-pinning map: conn id -> {loop index, frames parsed}.  The
+    // id/loop/frames triples snapshot under cmu into plain C++ storage
+    // FIRST (no Python allocation while the lock is held: an
+    // allocation-triggered GC finalizer calling back into the engine
+    // would self-deadlock on the non-recursive mutex), then
+    // materialize.  Loop ownership is fixed at accept; frame counts
+    // are racy monotonic reads, same discipline as the rest.
+    struct ConnSnap { uint64_t id; int loop; uint64_t frames; };
+    std::vector<ConnSnap> snap;
+    {
+      std::lock_guard<std::mutex> g(eng->cmu);
+      snap.reserve(eng->by_id.size());
+      for (auto& kv : eng->by_id) {
+        Conn* c = kv.second;
+        snap.push_back({kv.first, c->loop ? c->loop->index : -1,
+                        c->frames});
+      }
+    }
+    PyObject* conns = PyDict_New();
+    ok = conns != nullptr;
+    for (size_t i = 0; ok && i < snap.size(); i++) {
+      PyObject* key = PyLong_FromUnsignedLongLong(snap[i].id);
+      PyObject* cd = Py_BuildValue(
+          "{s:i,s:K}", "loop", snap[i].loop, "frames",
+          (unsigned long long)snap[i].frames);
+      ok = key != nullptr && cd != nullptr
+           && PyDict_SetItem(conns, key, cd) == 0;
+      Py_XDECREF(key);
+      Py_XDECREF(cd);
+    }
+    if (ok) ok = PyDict_SetItemString(out, "conns", conns) == 0;
+    Py_XDECREF(conns);
+  }
   if (ok) ok = set_hist(out, "burst", burst) == 0;
   if (ok) ok = set_hist(out, "writev_iov", wiov) == 0;
   if (ok) ok = set_u64(out, "wq_hwm", wq_hwm) == 0;
@@ -3150,19 +3360,14 @@ static PyObject* Engine_send(EngineObj* self, PyObject* args) {
     }
   }
   Py_DECREF(seq);
-  // hand the remaining flush to the owning loop (coalesced: one entry
-  // per conn per loop iteration)
+  // hand the remaining flush to the owning loop — the lock-free
+  // cross-loop completion handoff (coalesced: the flush_queued CAS
+  // admits one node per conn per loop iteration)
   Loop* lp = c->loop;
-  bool need_wake = false;
-  {
-    std::lock_guard<std::mutex> g(lp->mu);
-    if (!c->flush_queued) {
-      c->flush_queued = true;
-      lp->pending_out.push_back(c->id);
-      need_wake = true;
-    }
-  }
-  if (need_wake) loop_wake(lp);
+  bool expect = false;
+  if (c->flush_queued.compare_exchange_strong(
+          expect, true, std::memory_order_acq_rel))
+    loop_post(lp, c->id, HO_FLUSH);
   Py_RETURN_NONE;
 }
 
@@ -3176,12 +3381,7 @@ static PyObject* Engine_close_conn(EngineObj* self, PyObject* args) {
     auto it = eng->by_id.find(id);
     if (it != eng->by_id.end()) c = it->second;
   }
-  if (c) {
-    Loop* lp = c->loop;
-    std::lock_guard<std::mutex> g(lp->mu);
-    lp->pending_close.push_back(id);
-    loop_wake(lp);
-  }
+  if (c) loop_post(c->loop, id, HO_CLOSE);
   Py_RETURN_NONE;
 }
 
@@ -3219,6 +3419,15 @@ static void Engine_dealloc(EngineObj* self) {
       if (lp->thr.joinable()) lp->thr.join();
     Py_END_ALLOW_THREADS;
     for (Loop* lp : self->eng->loops) {
+      // nodes posted after the loop thread drained its last batch
+      // (close_conn during teardown): free, nothing left to run them
+      HandoffNode* head =
+          lp->handoff_head.exchange(nullptr, std::memory_order_acquire);
+      while (head) {
+        HandoffNode* nx = head->next;
+        delete head;
+        head = nx;
+      }
       close(lp->epfd);
       close(lp->wakefd);
       delete lp;
@@ -3241,6 +3450,13 @@ static void Engine_dealloc(EngineObj* self) {
 static PyMethodDef Engine_methods[] = {
     {"listen", (PyCFunction)Engine_listen, METH_VARARGS,
      "adopt a bound+listening fd"},
+    {"listen_sharded", (PyCFunction)Engine_listen_sharded, METH_VARARGS,
+     "listen_sharded(fds) — one SO_REUSEPORT-bound listening fd per "
+     "loop; each loop accepts and pins its own connections"},
+    {"set_busy_poll_us", (PyCFunction)Engine_set_busy_poll_us,
+     METH_VARARGS,
+     "set_busy_poll_us(us) — spin this long on zero-timeout polls "
+     "before each blocking epoll_wait (0 disables; runtime-settable)"},
     {"run_loop", (PyCFunction)Engine_run_loop, METH_VARARGS,
      "run one event loop on the calling (Python) thread until stop()"},
     {"set_http_max_body", (PyCFunction)Engine_set_http_max_body,
@@ -4778,9 +4994,14 @@ struct DemuxImpl {
   std::mutex mu;
   std::unordered_map<uint64_t, CliConn*> conns;
   std::vector<uint64_t> reap;
-  std::atomic<uint64_t> next_token{1};
   CliTelemetry tel;              // loop-thread writes; racy reads OK
 };
+
+// tokens are PROCESS-unique, not per-demux: the client lane runs a
+// POOL of demux loops (one per core-ish, client_lane.py), and the
+// Python routing tables key on the bare token — two loops handing out
+// overlapping counters would cross-wire sockets
+static std::atomic<uint64_t> g_cli_token{1};
 
 typedef struct {
   PyObject_HEAD DemuxImpl* d;
@@ -5191,7 +5412,7 @@ static PyObject* Demux_attach(DemuxObj* self, PyObject* args) {
   }
   CliConn* c = new CliConn();
   c->fd = dupfd;
-  c->token = d->next_token++;
+  c->token = g_cli_token++;
   {
     std::lock_guard<std::mutex> g(d->mu);
     d->conns[c->token] = c;

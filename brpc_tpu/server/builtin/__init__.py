@@ -342,15 +342,28 @@ def _native(server, msg, rest):
             out[name] = row
         return out
 
+    # per-loop view: lifetime busy ratio plus the multi-core engine's
+    # placement counters (accepts = conns pinned by this loop, frames =
+    # messages it parsed, handoffs = cross-loop completion nodes it
+    # consumed, spin_polls = busy-poll harvests).  The WINDOWED per-
+    # loop ratios and their max−min spread come from the shared cache —
+    # the aggregate busy ratio masks exactly the imbalance these show.
+    windowed = bridge.telemetry.per_loop_busy_ratios()
     loops = []
-    for lo in t["loops"]:
+    for i, lo in enumerate(t["loops"]):
         denom = lo["busy_ns"] + lo["idle_ns"]
         loops.append({
             "busy_ratio": round(lo["busy_ns"] / denom, 4) if denom
             else 0.0,
+            "busy_ratio_windowed": round(windowed[i], 4)
+            if i < len(windowed) else 0.0,
             "busy_ms": round(lo["busy_ns"] / 1e6, 1),
             "idle_ms": round(lo["idle_ns"] / 1e6, 1),
             "polls": lo["polls"],
+            "spin_polls": lo.get("spin_polls", 0),
+            "accepts": lo.get("accepts", 0),
+            "frames": lo.get("frames", 0),
+            "handoffs": lo.get("handoffs", 0),
         })
     from ...client.fast_call import scatter_fallback_counters
     from ...deadline import shed_counters
@@ -370,6 +383,8 @@ def _native(server, msg, rest):
             "bursts": cl.get("bursts", 0),
             "attached": cl.get("attached", 0),
             "acks": cl.get("acks", 0),
+            "demux_loops": cl.get("demux_loops", 1),
+            "loops": cl.get("loops", []),
             "completions_per_burst": _hist_view(
                 cl["comp_burst"], cl["comp_burst_count"],
                 cl["comp_burst_sum"]),
@@ -391,6 +406,11 @@ def _native(server, msg, rest):
                                  t["writev_iov_sum"]),
         "wq_hwm": t["wq_hwm"],
         "inbuf_hwm": t["inbuf_hwm"],
+        # flat-scaling smoking gun: max−min of the windowed per-loop
+        # busy ratios (0 on a one-loop engine) — mirrors the
+        # native_engine_loop_busy_imbalance bvar
+        "loop_busy_imbalance": round(
+            bridge.telemetry.loop_busy_imbalance(), 4),
         "loops": loops,
         "methods": _per_target(t["methods"]),
         "routes": _per_target(t["routes"]),

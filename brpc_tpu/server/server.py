@@ -65,7 +65,9 @@ class ServerOptions:
         # protocols only; pair with internal_port for the HTTP portal).
         # Falls back to the Python transport if the engine can't build.
         self.native = False
-        self.native_loops = 2
+        # 0 = placement-aware auto (one loop per core up to 4 — see
+        # native_bridge.default_engine_loops); explicit values pin it
+        self.native_loops = 0
         # run user code directly on the native engine's IO thread instead
         # of a fiber (≈ the reference's usercode_in_pthread,
         # /root/reference/src/brpc/details/usercode_backup_pool.h): saves a
@@ -338,6 +340,23 @@ class Server:
 
         lst = _socket.socket(_socket.AF_INET, _socket.SOCK_STREAM)
         lst.setsockopt(_socket.SOL_SOCKET, _socket.SO_REUSEADDR, 1)
+        if self.options.native and hasattr(_socket, "SO_REUSEPORT"):
+            # the native bridge shards accept across its loops with one
+            # SO_REUSEPORT listener per loop; the PRIMARY socket must
+            # carry the option from before bind or the kernel refuses
+            # the shard binds (mixed-mode).  Gated on the flag AND a
+            # multi-loop resolution: REUSEPORT also waives EADDRINUSE
+            # against other same-UID processes, so a server that will
+            # never shard must not pay that safety loss.
+            from ..butil.flags import get_flag as _get_flag
+            from ..transport.native_bridge import default_engine_loops
+            nloops = self.options.native_loops or default_engine_loops()
+            if nloops > 1 and bool(_get_flag("engine_reuseport", True)):
+                try:
+                    lst.setsockopt(_socket.SOL_SOCKET,
+                                   _socket.SO_REUSEPORT, 1)
+                except OSError:
+                    pass
         try:
             lst.bind(ep.to_sockaddr())
         except OSError as e:

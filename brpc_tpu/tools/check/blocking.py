@@ -46,6 +46,12 @@ ENTRY_POINTS: Tuple[Tuple[str, Tuple[str, ...]], ...] = (
     ("brpc_tpu/server/http_slim.py",
      ("make_http_slim_handler", "slim")),
     ("brpc_tpu/transport/client_lane.py", ("ClientLane", "_on_burst")),
+    # per-demux-loop burst entry — the cross-loop completion handoff
+    # delivery callback (ISSUE 11): completions parsed on one demux
+    # loop are handed to callers on any other thread/loop, so its
+    # whole reachable body runs ON a loop
+    ("brpc_tpu/transport/client_lane.py",
+     ("ClientLane", "_on_loop_burst")),
     ("brpc_tpu/transport/client_lane.py",
      ("ClientLane", "_complete_burst")),
     ("brpc_tpu/transport/client_lane.py",
@@ -56,6 +62,13 @@ ENTRY_POINTS: Tuple[Tuple[str, Tuple[str, ...]], ...] = (
     # reference to a response view — possibly a demux loop
     ("brpc_tpu/transport/shm_ring.py", ("client_complete",)),
     ("brpc_tpu/transport/shm_ring.py", ("wrap_view_iobuf",)),
+    # per-loop shm sweep + response staging (ISSUE 11): EV_CLOSE lands
+    # the dead-conn slot sweep on the owning engine loop, and the slim
+    # shims stage response attachments into the sharded allocator from
+    # their loop thread
+    ("brpc_tpu/transport/shm_ring.py", ("on_socket_closed",)),
+    ("brpc_tpu/transport/shm_ring.py", ("ShmRing", "free_owner")),
+    ("brpc_tpu/transport/shm_ring.py", ("describe_response_att",)),
 )
 
 # names whose call is a handoff, not an execution: arguments/targets

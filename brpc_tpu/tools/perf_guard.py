@@ -46,7 +46,30 @@ WATCHED_RATIOS = (
     "grpc_vs_grpcio_oracle",
     "fanout_cntl_vs_raw_gap",
     "cntl_vs_raw_gap",
+    # multi-core engine (ISSUE 11): qps(N)/(N*qps(1)) medians over
+    # paired interleaved rounds — phase-immune like the other ratios.
+    # NOTE the 1-core caveat (PERF §14): the hardware ceiling is ~1/N
+    # there, so the recorded baseline, not an absolute bar, is the gate
+    "loop_scaling_efficiency",
+    "loop_scaling_efficiency_4loop",
 )
+
+# Recorded baselines for keys that predate any BENCH_r*.json capture —
+# the session-box values recorded when the key landed.  Applied ONLY
+# for keys absent from every --baseline file: the moment a driver
+# capture carries the key, the capture's value replaces the recorded
+# one outright (folding these into the best-of merge would pin slower
+# boxes to this box's numbers forever).  New keys thus gate from day
+# one instead of free-riding as "missing".
+RECORDED_BASELINE = {
+    # ISSUE 11 multi-core engine keys (1-core session box, 2026-08):
+    "sweep_64b_pipelined_qps_1loop": 2049431.0,
+    "sweep_64b_pipelined_qps_2loop": 2077149.0,
+    "sweep_64b_pipelined_qps_4loop": 2039035.0,
+    "loop_scaling_efficiency": 0.486,         # ~0.5 = 1-core ceiling
+    "loop_scaling_efficiency_4loop": 0.244,   # ~0.25 = 1-core ceiling
+    "sweep_64b_pipelined_4loop_p99_us": 460.8,
+}
 
 _HIGHER = ("_qps", "_gbps", "gbps", "_rps", "_tok_s", "tokens_per_s",
            "_tflops", "_speedup", "_frac", "_factor_inverse")
@@ -228,6 +251,10 @@ def main(argv=None) -> int:
                 base[k] = min(base[k], v)
             else:
                 base[k] = max(base[k], v)
+    # recorded day-one values only for keys no --baseline file carries
+    # yet (see RECORDED_BASELINE comment: captures override outright)
+    for k, v in RECORDED_BASELINE.items():
+        base.setdefault(k, v)
     failures, rows = compare(new, base, args.tolerance,
                              args.ratio_tolerance, tuple(args.watch))
     w = max((len(r[0]) for r in rows), default=10)

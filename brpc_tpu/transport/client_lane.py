@@ -52,6 +52,18 @@ define_flag("rpc_native_client_lane", True,
             "native engine's ClientDemux (batched completion delivery); "
             "off = classic Python dispatcher demux for every socket",
             validator=lambda v: isinstance(v, bool))
+define_flag("rpc_client_lane_loops", 0,
+            "ClientDemux loops in the process-wide client lane (each "
+            "owns an epoll loop + thread; sockets spread round-robin "
+            "so completion demux scales with cores instead of "
+            "contending on one loop).  0 = auto: cores//2 capped at 4, "
+            "min 1.  Read once at lane creation",
+            validator=lambda v: isinstance(v, int) and 0 <= v <= 16)
+
+
+def _auto_lane_loops() -> int:
+    import os
+    return max(1, min(4, (os.cpu_count() or 1) // 2))
 
 # closed fallback reason enum — MUST mirror engine.cpp's CliFb order
 REASONS = ("cli_unknown_cid", "cli_meta_unparsed", "cli_meta_tags",
@@ -103,16 +115,45 @@ def lane_cancel(sock, cid: int) -> None:
 
 
 def client_lane_telemetry() -> dict:
-    """Snapshot of the lane's native counters (empty dict when the lane
-    was never created) — the /native portal's client section and the
-    ``native_client_*`` bvars read this."""
+    """Snapshot of the lane's native counters MERGED across the demux
+    pool (empty dict when the lane was never created) — the /native
+    portal's client section and the ``native_client_*`` bvars read
+    this.  Scalars sum; the fallbacks dict sums per reason; the
+    completions-per-burst histogram merges bucket-wise; a ``loops``
+    list carries the per-demux-loop burst counts (the lane's own
+    imbalance view)."""
     lane = _lane
     if lane is None:
         return {}
     try:
-        return lane._demux.telemetry()
+        snaps = [d.telemetry() for d in lane._demuxes]
     except Exception:
         return {}
+    if not snaps:
+        return {}
+    out = dict(snaps[0])
+    for s in snaps[1:]:
+        for k, v in s.items():
+            if isinstance(v, dict):
+                base = dict(out.get(k, {}))
+                for rk, rv in v.items():
+                    base[rk] = base.get(rk, 0) + rv
+                out[k] = base
+            elif isinstance(v, list):
+                prev = out.get(k) or []
+                out[k] = [a + b for a, b in zip(prev, v)]
+            else:
+                out[k] = out.get(k, 0) + v
+    out["demux_loops"] = len(snaps)
+    out["loops"] = [{"bursts": s.get("bursts", 0),
+                     "completions": s.get("completions", 0),
+                     "attached": s.get("attached", 0),
+                     # Python-side delivery count for this loop (the
+                     # engine's `bursts` counts parsed bursts; this one
+                     # counts callbacks that actually entered Python)
+                     "py_bursts": lane._loop_bursts[i]}
+                    for i, s in enumerate(snaps)]
+    return out
 
 
 # eager bvar registration (the families must exist in /vars//metrics
@@ -132,67 +173,116 @@ _bursts_var = PassiveStatus(
 
 
 class ClientLane:
-    """Owns the ClientDemux, its loop thread, and the token → socket
-    routing state."""
+    """Owns a POOL of ClientDemux loops (one per core-ish — see
+    ``rpc_client_lane_loops``), their loop threads, and the token →
+    socket routing state.  Tokens are process-unique (the engine hands
+    them out from one counter), so one routing table serves every
+    demux; each socket's reads belong to exactly ONE demux loop for
+    its whole life — the client-side mirror of the server's
+    connection-pinned-to-loop discipline."""
 
     def __init__(self, mod):
         self._m = mod
-        self._demux = mod.ClientDemux(self._on_burst)
+        nloops = int(get_flag("rpc_client_lane_loops", 0)) \
+            or _auto_lane_loops()
+        self._demuxes = [mod.ClientDemux(self._bind_burst(i))
+                         for i in range(nloops)]
         self._socks: Dict[int, int] = {}     # token -> socket id
+        self._demux_of: Dict[int, int] = {}  # token -> demux index
         self._queues: Dict[int, Any] = {}    # token -> ExecutionQueue
         self._lock = threading.Lock()
-        # the loop runs on a Python thread: resident frames pin the
+        self._rr = 0                         # attach spread counter
+        # per-demux-loop burst delivery counters (each slot written
+        # only by its own demux thread; GIL-snapshotted reads)
+        self._loop_bursts = [0] * nloops
+        # the loops run on Python threads: resident frames pin the
         # datastack chunk, so per-burst callbacks skip cold-eval mmap
         # churn (same rationale as the server bridge's external loops)
-        self._thread = threading.Thread(target=self._demux.run_loop,
-                                        name="client-lane", daemon=True)
-        self._thread.start()
+        self._threads = []
+        for i, d in enumerate(self._demuxes):
+            t = threading.Thread(target=d.run_loop,
+                                 name=f"client-lane-{i}", daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    def _bind_burst(self, idx: int):
+        return lambda token, status, comps, fbs, acks, _i=idx: \
+            self._on_loop_burst(token, status, comps, fbs, acks,
+                                _idx=_i)
 
     # -- attach / detach ---------------------------------------------------
 
     def attach(self, sock) -> bool:
         """Take over the read side of ``sock``.  False = ineligible
         (no fd, TLS, flag off, attach failure) — the caller falls back
-        to the classic dispatcher."""
+        to the classic dispatcher.  The socket is spread round-robin
+        over the demux pool and stays on its loop for life."""
         if sock.fd is None or sock.ssl_context is not None \
                 or sock.failed:
             return False
         if not get_flag("rpc_native_client_lane", True):
             return False
+        with self._lock:
+            idx = self._rr % len(self._demuxes)
+            self._rr += 1
+        demux = self._demuxes[idx]
         try:
-            token = self._demux.attach(sock.fd.fileno())
+            token = demux.attach(sock.fd.fileno())
         except (OSError, ValueError):
             return False
         # routing state BEFORE arming: the very first burst (or an
         # immediate EOF on an already-closed peer) must find the socket
         with self._lock:
             self._socks[token] = sock.id
+            self._demux_of[token] = idx
         sock.lane_token = token
         sock._lane_pref = True
-        if not self._demux.arm(token):
+        if not demux.arm(token):
             self.detach(sock)
             return False
         return True
+
+    def _demux_for(self, token: int):
+        idx = self._demux_of.get(token)
+        return self._demuxes[idx] if idx is not None else None
 
     def detach(self, sock, _stop_queue: bool = True) -> None:
         token = sock.lane_token
         if not token:
             return
         sock.lane_token = 0
+        demux = self._demux_for(token)
         with self._lock:
             self._socks.pop(token, None)
+            self._demux_of.pop(token, None)
             q = self._queues.pop(token, None)
-        self._demux.detach(token)
+        if demux is not None:
+            demux.detach(token)
         if q is not None and _stop_queue:
             q.stop()
 
     def expect(self, sock, cid: int) -> None:
-        self._demux.expect(sock.lane_token, cid)
+        demux = self._demux_for(sock.lane_token)
+        if demux is not None:
+            demux.expect(sock.lane_token, cid)
 
     def cancel(self, sock, cid: int) -> None:
-        self._demux.cancel(sock.lane_token, cid)
+        demux = self._demux_for(sock.lane_token)
+        if demux is not None:
+            demux.cancel(sock.lane_token, cid)
 
-    # -- burst delivery (runs on the demux loop thread, GIL held) ----------
+    # -- burst delivery (runs on the demux loop threads, GIL held) ---------
+
+    def _on_loop_burst(self, token: int, status: int, comps, fbs, acks,
+                       _idx: int = 0) -> None:
+        """Per-demux-loop burst entry — the cross-loop completion
+        handoff delivery callback: completions parsed on demux loop
+        ``_idx`` are handed to callers living on ANY other thread or
+        loop (event sets for sync calls, fiber hops for done-bearing
+        ones).  Runs ON the loop: everything reachable from here is
+        loop-thread code (the blocking-call linter pins this entry)."""
+        self._loop_bursts[_idx] += 1
+        self._on_burst(token, status, comps, fbs, acks)
 
     def _on_burst(self, token: int, status: int, comps, fbs, acks
                   ) -> None:

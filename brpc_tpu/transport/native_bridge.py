@@ -35,6 +35,7 @@ from struct import unpack_from as _struct_unpack_from
 _bytes = bytes
 
 from ..butil.endpoint import EndPoint
+from ..butil.flags import define_flag, get_flag, watch_flag
 from ..butil.iobuf import IOBuf
 from ..butil.logging_util import LOG
 from ..butil.status import Errno
@@ -105,6 +106,30 @@ class NativeSocket(Socket):
 
 _NATIVE_KINDS = {"echo": 0, "const": 1}
 
+# -- multi-core engine knobs (ISSUE 11) -------------------------------------
+
+define_flag("engine_busy_poll_us", 0,
+            "spin this many microseconds on zero-timeout polls before "
+            "each blocking epoll_wait in every engine loop (latency-"
+            "tail knob; 0 = off).  Burns the loop's core while armed — "
+            "only worth it with a core per loop",
+            validator=lambda v: isinstance(v, int) and 0 <= v <= 1000000)
+define_flag("engine_reuseport", True,
+            "shard the native engine's accept across loops with one "
+            "SO_REUSEPORT listener per loop (connections pinned to "
+            "their accepting loop for life); off = single shared "
+            "listener with round-robin adopt handoff",
+            validator=lambda v: isinstance(v, bool))
+
+
+def default_engine_loops() -> int:
+    """Placement-aware loops= default: one loop per core up to 4 (the
+    GIL serializes the shim lanes anyway — loops beyond the low single
+    digits only buy contention on small boxes; big boxes should set
+    ServerOptions.native_loops explicitly)."""
+    import os
+    return max(1, min(4, os.cpu_count() or 1))
+
 # Closed fallback reason-name mirror — MUST match engine.cpp's kFbNames
 # order exactly (the static contract checker, tools/check, pins it).
 # Pre-seeds the native_engine_fallback_total family so every reason row
@@ -174,7 +199,9 @@ class _TelemetryCache:
 
     def busy_ratio(self) -> float:
         """Engine-loop busy fraction (callback time vs epoll_wait) over
-        the last snapshot window — the C++ loops' /hotspots answer."""
+        the last snapshot window — the C++ loops' /hotspots answer.
+        SUMS across loops: a per-loop view (imbalance!) is
+        :meth:`per_loop_busy_ratios`."""
         prev, cur, _dt = self.window()
 
         def _tot(s):
@@ -187,6 +214,28 @@ class _TelemetryCache:
             busy, idle = busy - pb, idle - pi
         denom = busy + idle
         return busy / denom if denom > 0 else 0.0
+
+    def per_loop_busy_ratios(self) -> list:
+        """Windowed busy fraction of EACH loop — the aggregate above
+        masks imbalance (one pegged loop + three idle ones reads as
+        25% busy); the scaling work keys on the spread."""
+        prev, cur, _dt = self.window()
+        out = []
+        for i, lo in enumerate(cur["loops"]):
+            busy, idle = lo["busy_ns"], lo["idle_ns"]
+            if prev is not None and i < len(prev["loops"]):
+                busy -= prev["loops"][i]["busy_ns"]
+                idle -= prev["loops"][i]["idle_ns"]
+            denom = busy + idle
+            out.append(busy / denom if denom > 0 else 0.0)
+        return out
+
+    def loop_busy_imbalance(self) -> float:
+        """max − min of the per-loop windowed busy ratios (0 on a
+        one-loop engine): the flat-scaling smoking gun — high qps
+        plateau + high imbalance = placement problem, not a lock."""
+        ratios = self.per_loop_busy_ratios()
+        return (max(ratios) - min(ratios)) if len(ratios) > 1 else 0.0
 
 
 from ..bvar.multi_dimension import PassiveDimension as _PassiveDim
@@ -225,9 +274,11 @@ def _install_dump_watcher() -> None:
 
 
 class NativeBridge:
-    def __init__(self, server, engine_module, loops: int = 2):
+    def __init__(self, server, engine_module, loops: int = 0):
         self._server = server
         self._m = engine_module
+        if loops <= 0:
+            loops = default_engine_loops()   # placement-aware default
         # external_loops: the event loops run on Python-created threads
         # (run_loop below).  A C-created thread pays an mmap + page
         # fault on EVERY cold eval entry (CPython frees the datastack
@@ -422,6 +473,22 @@ class NativeBridge:
         add(PassiveStatus(
             lambda c=cache: round(c.busy_ratio(), 4),
             name="native_engine_loop_busy_ratio"))
+        # the aggregate above sums busy/idle across loops and masks
+        # imbalance — the per-loop family plus the max−min spread is
+        # what the multi-core scaling work actually watches
+        add(_PassiveDim(
+            ("loop",),
+            lambda c=cache: {str(i): round(r, 4) for i, r
+                             in enumerate(c.per_loop_busy_ratios())},
+            name="native_engine_loop_busy_ratio_by_loop"))
+        add(PassiveStatus(
+            lambda c=cache: round(c.loop_busy_imbalance(), 4),
+            name="native_engine_loop_busy_imbalance"))
+        add(_PassiveDim(
+            ("loop",),
+            lambda c=cache: {str(i): lo["handoffs"] for i, lo
+                             in enumerate(c.get()["loops"])},
+            name="native_engine_loop_handoffs"))
         add(PassiveStatus(lambda c=cache: c.get()["wq_hwm"],
                           name="native_engine_wq_hwm"))
         add(PassiveStatus(lambda c=cache: c.get()["inbuf_hwm"],
@@ -485,10 +552,57 @@ class NativeBridge:
         add(_PassiveDim(("bin",), lambda _s=_size_hist: _s("writev_iov"),
                         name="native_engine_writev_iov"))
 
+    def _shard_listen_sockets(self, listen_socket):
+        """SO_REUSEPORT sharded accept: one extra listener per loop
+        beyond the first, bound to the same (host, port).  Returns the
+        full per-loop socket list (index i = loop i) or None when the
+        platform/config keeps the single-fd rr-handoff fallback.
+        Requires the PRIMARY socket to already carry SO_REUSEPORT
+        (server.py sets it pre-bind when the option exists) — the
+        kernel refuses mixed-mode binds."""
+        import socket as _pysock
+        if self._nloops < 2:
+            return None
+        if not bool(get_flag("engine_reuseport", True)):
+            return None
+        if not hasattr(_pysock, "SO_REUSEPORT"):
+            return None
+        try:
+            if not listen_socket.getsockopt(_pysock.SOL_SOCKET,
+                                            _pysock.SO_REUSEPORT):
+                return None
+        except OSError:
+            return None
+        name = listen_socket.getsockname()
+        shards = [listen_socket]
+        try:
+            for _ in range(self._nloops - 1):
+                s = _pysock.socket(_pysock.AF_INET, _pysock.SOCK_STREAM)
+                try:
+                    s.setsockopt(_pysock.SOL_SOCKET,
+                                 _pysock.SO_REUSEADDR, 1)
+                    s.setsockopt(_pysock.SOL_SOCKET,
+                                 _pysock.SO_REUSEPORT, 1)
+                    s.bind((name[0], name[1]))
+                    s.listen(1024)
+                    s.setblocking(False)
+                except BaseException:
+                    s.close()
+                    raise
+                shards.append(s)
+        except OSError as e:
+            LOG.warning("SO_REUSEPORT shard bind failed (%s); falling "
+                        "back to single-listener rr placement", e)
+            for s in shards[1:]:
+                s.close()
+            return None
+        return shards
+
     def listen(self, listen_socket) -> None:
         listen_socket.setblocking(False)
         # the bridge owns the fd's lifetime alongside the engine
         self._listen_socket = listen_socket
+        self._shard_sockets = []
         name = listen_socket.getsockname()
         self._local_ep = EndPoint(host=name[0], port=name[1])
         self._register_native_methods()
@@ -506,7 +620,20 @@ class NativeBridge:
         # this hook flushes them under one lock per burst
         from ..server.slim_dispatch import flush_burst_accounting
         self.engine.set_burst_end(flush_burst_accounting)
-        self.engine.listen(listen_socket.fileno())
+        # busy-poll spin for the latency tail (live-flippable: the
+        # engine reads a relaxed atomic per loop iteration)
+        self.engine.set_busy_poll_us(int(get_flag("engine_busy_poll_us")))
+        watch_flag("engine_busy_poll_us",
+                   lambda v, _e=self.engine: _e.set_busy_poll_us(int(v)))
+        # SO_REUSEPORT sharded accept: one listener per loop, each loop
+        # accepts and pins its own connections (brpc's per-core
+        # EventDispatcher discipline); single-fd rr handoff otherwise
+        shards = self._shard_listen_sockets(listen_socket)
+        if shards is not None:
+            self._shard_sockets = shards[1:]
+            self.engine.listen_sharded([s.fileno() for s in shards])
+        else:
+            self.engine.listen(listen_socket.fileno())
         import threading
         for i in range(self._nloops):
             t = threading.Thread(target=self.engine.run_loop, args=(i,),
@@ -534,6 +661,12 @@ class NativeBridge:
             except OSError:
                 pass
             self._listen_socket = None
+        for s in getattr(self, "_shard_sockets", []):
+            try:
+                s.close()
+            except OSError:
+                pass
+        self._shard_sockets = []
         for sid in list(self._conns.values()):
             s = Socket.address(sid)
             if s is not None:

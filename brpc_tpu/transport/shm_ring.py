@@ -66,6 +66,16 @@ define_flag("rpc_shm_slot_bytes", 2 * 1024 * 1024,
             validator=lambda v: isinstance(v, int) and v >= 4096)
 define_flag("rpc_shm_slots", 16, "slots per shm ring",
             validator=lambda v: isinstance(v, int) and 0 < v <= 4096)
+define_flag("rpc_shm_shards", 0,
+            "slot-allocator shards of the process tx ring (0 = auto: "
+            "one per core up to 4).  Each engine loop binds to a home "
+            "shard by thread id, so per-loop staging never contends on "
+            "one allocator lock; empty shards steal from neighbours",
+            validator=lambda v: isinstance(v, int) and 0 <= v <= 64)
+
+
+def _auto_shards() -> int:
+    return max(1, min(4, os.cpu_count() or 1))
 
 _SPEC_MAGIC = b"SHMR"
 _SPEC_VER = 1
@@ -235,7 +245,7 @@ class ShmRing:
     path) and is unlinked on close.
     """
 
-    def __init__(self, slot_bytes: int, nslots: int):
+    def __init__(self, slot_bytes: int, nslots: int, shards: int = 1):
         d = _ring_dir()
         if d is None:
             raise OSError("no writable tmpfs/tmp dir for shm ring")
@@ -246,15 +256,34 @@ class ShmRing:
         os.ftruncate(self.fd, self.size)
         self.mm = mmap.mmap(self.fd, self.size)
         self.ring_id = os.urandom(8)
-        self._lock = threading.Lock()
-        self._free: List[int] = list(range(nslots))
-        self._owners: Dict[int, Any] = {}      # slot -> owner key
+        # SHARDED allocator (ISSUE 11): ONE mapping, ONE ring_id, ONE
+        # wire spec — but the slot free-lists split into per-shard
+        # pools, each under its own lock, and every allocating thread
+        # (one engine loop per core in the sharded-accept world) binds
+        # to a home shard by thread id.  Hot-path allocs never meet
+        # another loop's lock; an empty home shard steals from
+        # neighbours (correctness over affinity).  slot -> shard is
+        # slot % nshards, so free()/gen_of() know their lock without
+        # any registry.  Descriptors and the attach protocol are
+        # UNCHANGED: sharding is allocator-internal.
+        self.nshards = max(1, min(int(shards), nslots))
+        self._locks = [threading.Lock() for _ in range(self.nshards)]
+        self._free: List[List[int]] = [[] for _ in range(self.nshards)]
+        for slot in range(nslots):
+            self._free[slot % self.nshards].append(slot)
+        self._owners: List[Dict[int, Any]] = \
+            [{} for _ in range(self.nshards)]  # shard -> {slot: owner}
+        self._steals = 0                       # cross-shard allocs
+        self._tls = threading.local()          # per-thread home shard
+        import itertools
+        self._next_home = itertools.count()    # GIL-atomic rr counter
         # per-slot allocation generation: a free() that raced a
         # free_owner() sweep (dead socket) + re-alloc must not free the
         # NEW tenant's slot — stale settles carry the generation they
         # allocated under and are ignored on mismatch
         self._gen: List[int] = [0] * nslots
         self._closed = False
+        self._closed_lock = threading.Lock()
         # pre-touch every page once: first-touch soft faults otherwise
         # land in the first requests' latency (measured 2.4x slower
         # staging on cold slots on this box)
@@ -264,19 +293,37 @@ class ShmRing:
         for off in range(0, self.size, step):
             mv[off:off + 1] = zero
 
-    # -- slot lifecycle -----------------------------------------------------
+    # -- slot lifecycle (sharded: see __init__) -----------------------------
+
+    def _home_shard(self) -> int:
+        # round-robin per-thread shard binding via a thread-local.
+        # NOT hash(thread id): pthread idents are pointer-aligned
+        # addresses whose low bits (and even their stride — 8MB stack
+        # spacing) are constant, so any modulus collapses every thread
+        # onto one shard
+        idx = getattr(self._tls, "shard", None)
+        if idx is None:
+            idx = next(self._next_home) % self.nshards
+            self._tls.shard = idx
+        return idx
 
     def alloc(self, owner: Any = None) -> Optional[int]:
-        with self._lock:
-            if not self._free:
-                return None
-            slot = self._free.pop()
-            self._owners[slot] = owner
-            self._gen[slot] += 1
-            return slot
+        home = self._home_shard()
+        for i in range(self.nshards):
+            sh = (home + i) % self.nshards
+            with self._locks[sh]:
+                if not self._free[sh]:
+                    continue
+                slot = self._free[sh].pop()
+                self._owners[sh][slot] = owner
+                self._gen[slot] += 1
+                if i:
+                    self._steals += 1   # racy += is fine (diagnostic)
+                return slot
+        return None
 
     def gen_of(self, slot: int) -> int:
-        with self._lock:
+        with self._locks[slot % self.nshards]:
             return self._gen[slot]
 
     def free(self, slot: int, gen: Optional[int] = None) -> None:
@@ -285,27 +332,44 @@ class ShmRing:
         e.g. a timed-out call whose slot was already swept by
         ``free_owner`` and re-allocated to a live call — is a no-op
         instead of freeing the new tenant's slot."""
-        with self._lock:
-            if slot in self._owners and (gen is None
-                                         or self._gen[slot] == gen):
-                del self._owners[slot]
-                self._free.append(slot)
+        sh = slot % self.nshards
+        with self._locks[sh]:
+            if slot in self._owners[sh] and (gen is None
+                                             or self._gen[slot] == gen):
+                del self._owners[sh][slot]
+                self._free[sh].append(slot)
 
     def free_owner(self, owner: Any) -> int:
         """Reclaim every slot tagged with ``owner`` (consumer conn died
-        before sending its release TLV)."""
+        before sending its release TLV).  Walks shard by shard — each
+        under its OWN lock, so a loop sweeping a dead conn never stalls
+        another loop's allocations (the per-loop sweep path)."""
         n = 0
-        with self._lock:
-            for slot, ow in list(self._owners.items()):
-                if ow == owner:
-                    del self._owners[slot]
-                    self._free.append(slot)
-                    n += 1
+        for sh in range(self.nshards):
+            with self._locks[sh]:
+                for slot, ow in list(self._owners[sh].items()):
+                    if ow == owner:
+                        del self._owners[sh][slot]
+                        self._free[sh].append(slot)
+                        n += 1
         return n
 
     def free_count(self) -> int:
-        with self._lock:
-            return len(self._free)
+        n = 0
+        for sh in range(self.nshards):
+            with self._locks[sh]:
+                n += len(self._free[sh])
+        return n
+
+    def shard_stats(self) -> Dict[str, int]:
+        """Allocator-shard diagnostics: shard count, per-shard free
+        slots, cross-shard steals (high steals = imbalanced staging)."""
+        out: Dict[str, int] = {"shards": self.nshards,
+                               "steals": self._steals}
+        for sh in range(self.nshards):
+            with self._locks[sh]:
+                out[f"shard_{sh}_free"] = len(self._free[sh])
+        return out
 
     # -- data ---------------------------------------------------------------
 
@@ -366,7 +430,7 @@ class ShmRing:
         return done
 
     def close(self) -> None:
-        with self._lock:
+        with self._closed_lock:
             if self._closed:
                 return
             self._closed = True
@@ -458,8 +522,10 @@ def process_tx_ring() -> Optional[ShmRing]:
             _tx_failed = True
             return None
         try:
+            shards = int(get_flag("rpc_shm_shards")) or _auto_shards()
             _tx_ring = ShmRing(int(get_flag("rpc_shm_slot_bytes")),
-                               int(get_flag("rpc_shm_slots")))
+                               int(get_flag("rpc_shm_slots")),
+                               shards=shards)
         except (OSError, ValueError) as e:
             LOG.warning("shm tx ring creation failed: %s", e)
             _tx_failed = True

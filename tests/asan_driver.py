@@ -18,7 +18,13 @@ Drives every native memory-discipline surface the sanitizers can see:
    (thread-pinned scatter_call path);
 5. **shm slot lifecycle** — ≥256KB same-host attachments cycling ring
    slots (describe → echo re-describe → finalizer settle → sweep),
-   skipped where the sandbox has no mmap-able shm.
+   skipped where the sandbox has no mmap-able shm;
+6. **multi-core engine** — a 4-loop server (SO_REUSEPORT sharded
+   accept where available) driven CONCURRENTLY by pipelined slim
+   bursts on per-loop connections, ParallelChannel scatter fan-out and
+   shm slot cycles, so the lock-free cross-loop handoff, the sharded
+   slot allocator and the per-loop telemetry all run under ASan/UBSan
+   with real thread interleaving.
 
 Prints ``ASAN_DRIVER_OK`` and exits 0 on success; any sanitizer report
 goes to stderr and (for UBSAN, built no-recover) aborts the process.
@@ -189,6 +195,96 @@ def main():
     else:
         print("shm unsupported in sandbox; lane skipped",
               file=sys.stderr)
+
+    # ---- 6. 4-loop engine: slim bursts + scatter + shm, concurrently ----
+    opts4 = ServerOptions()
+    opts4.native = True
+    opts4.usercode_inline = True
+    opts4.native_loops = 4
+    srv4 = Server(opts4)
+    srv4.add_service(Svc(), name="A")
+    assert srv4.start("127.0.0.1:0") == 0
+    port4 = srv4.listen_endpoint.port
+    errors = []
+
+    def _pipelined_conn(rounds):
+        try:
+            for _ in range(rounds):
+                s = pysock.create_connection(("127.0.0.1", port4),
+                                             timeout=10)
+                blast = b"".join(frame(i + 1, b"q" * (13 * (i % 31)))
+                                 for i in range(120))
+                s.sendall(blast)
+                got = bytearray()
+                seen = 0
+                while seen < 120:
+                    chunk = s.recv(65536)
+                    if not chunk:
+                        raise AssertionError("peer closed mid-burst")
+                    got += chunk
+                    seen = 0
+                    off = 0
+                    while off + 12 <= len(got):
+                        (blen,) = struct.unpack_from("<I", got, off + 4)
+                        if off + 12 + blen > len(got):
+                            break
+                        off += 12 + blen
+                        seen += 1
+                s.close()
+        except Exception as e:          # surfaced after join
+            errors.append(f"pipelined: {type(e).__name__}: {e}")
+
+    def _scatter4(rounds):
+        try:
+            pc4 = ParallelChannel()
+            for sub in servers:
+                c3 = ChannelOptions()
+                c3.timeout_ms = 10_000
+                sch = Channel(c3)
+                sch.init(f"127.0.0.1:{sub.listen_endpoint.port}")
+                pc4.add_channel(sch)
+            for _ in range(rounds):
+                cntl = Controller()
+                cntl.timeout_ms = 10_000
+                r = pc4.call_method("A.Echo", b"mc-scatter", cntl=cntl)
+                assert not r.failed, (r.error_code, r.error_text)
+        except Exception as e:
+            errors.append(f"scatter: {type(e).__name__}: {e}")
+
+    def _shm4(rounds):
+        try:
+            from brpc_tpu.transport import shm_ring as _shm
+            if not _shm.shm_supported():
+                return
+            data = bytes(280 * 1024)
+            c5 = ChannelOptions()
+            c5.connection_type = "pooled"
+            c5.timeout_ms = 10_000
+            ch5 = Channel(c5)
+            ch5.init(f"127.0.0.1:{port4}")
+            for _ in range(rounds):
+                cntl = Controller()
+                cntl.timeout_ms = 10_000
+                cntl.request_attachment = IOBuf(data)
+                r = ch5.call_method("A.Echo", b"shm4", cntl=cntl)
+                assert not r.failed, (r.error_code, r.error_text)
+                assert r.response_attachment.to_bytes() == data
+                del r, cntl
+        except Exception as e:
+            errors.append(f"shm4: {type(e).__name__}: {e}")
+
+    workers = ([threading.Thread(target=_pipelined_conn, args=(3,))
+                for _ in range(4)]
+               + [threading.Thread(target=_scatter4, args=(15,)),
+                  threading.Thread(target=_shm4, args=(12,))])
+    for t in workers:
+        t.start()
+    for t in workers:
+        t.join(timeout=120)
+    assert not errors, errors
+    tel = srv4._native_bridge.engine.telemetry()
+    assert sum(lo["frames"] for lo in tel["loops"]) > 0
+    srv4.stop()
 
     for sub in servers:
         sub.stop()

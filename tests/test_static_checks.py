@@ -188,6 +188,32 @@ def test_drift_untimed_wait_on_loop_thread():
     assert any(".wait()" in f.message for f in findings), findings
 
 
+def test_drift_blocking_call_in_handoff_consumer():
+    """ISSUE-11 surface: the per-demux-loop burst entry (the cross-loop
+    completion handoff delivery callback) is a pinned loop-thread
+    entry — a blocking call seeded into it must be flagged."""
+    ov = _mutate(CLIENT_LANE, "self._loop_bursts[_idx] += 1",
+                 "self._loop_bursts[_idx] += 1; time.sleep(0.001)")
+    ov[CLIENT_LANE] = ov[CLIENT_LANE].replace(
+        "import threading", "import threading\nimport time", 1)
+    findings = check_blocking(Tree(overrides=ov))
+    assert any("sleep" in f.message and "_on_loop_burst" in f.message
+               for f in findings), findings
+
+
+def test_drift_blocking_call_in_shm_sweep():
+    """ISSUE-11 surface: the per-loop shm sweep (EV_CLOSE -> dead-conn
+    slot reclaim) runs on an engine loop — an untimed wait seeded into
+    it must be flagged."""
+    SHM = "brpc_tpu/transport/shm_ring.py"
+    ov = _mutate(SHM, "    if ring is not None:\n        ring.free_owner(owner)",
+                 "    if ring is not None:\n        ring.free_owner(owner)\n"
+                 "        threading.Event().wait()")
+    findings = check_blocking(Tree(overrides=ov))
+    assert any(".wait()" in f.message and "on_socket_closed" in f.message
+               for f in findings), findings
+
+
 def test_allow_marker_suppresses():
     """The reviewed-exception escape hatch works (and is line-scoped)."""
     ov = _mutate(
