@@ -1519,6 +1519,150 @@ def bench_overload_fairness(extra: dict) -> None:
         1.0 if (2 <= steady <= 256 and shrunk < steady) else 0.0
 
 
+def bench_operability(extra: dict) -> None:
+    """§15 fleet operability (ISSUE 12): (a) rolling_restart_failed_rpcs
+    — a 3-replica fleet under sustained Controller load has every
+    replica drained + replaced (lame-duck signal, ELAMEDUCK fail-fast
+    retry, file-NS republish); the acceptance pins the failure count at
+    EXACTLY 0.  (b) drain_p99_victim_ms — the load's per-call p99
+    across the whole roll (victims ride retries while neighbors
+    restart).  (c) conns_10k_rss_mb — idle-connection memory probe:
+    K idle conns' RSS delta scaled to 10k (both endpoints live in this
+    process, so the number covers client+server halves — the honest
+    same-box bound for the many-users story)."""
+    import socket as pysock
+    import threading
+
+    import brpc_tpu.client.naming_service as _ns_mod
+    from brpc_tpu.client import Channel, ChannelOptions, Controller
+    from brpc_tpu.client.naming_service import global_lame_ducks
+    from brpc_tpu.server import Server, ServerOptions, Service
+
+    class Op(Service):
+        def Echo(self, cntl, request):
+            return b"ok:" + bytes(request)
+
+    def mk(publish_to=None):
+        srv = Server(ServerOptions())
+        srv.add_service(Op(), name="OP")
+        assert srv.start("127.0.0.1:0") == 0
+        if publish_to:
+            assert srv.publish(publish_to) == 0
+        return srv
+
+    import tempfile
+    nsdir = tempfile.mkdtemp(prefix="bench_fleet_")
+    nsfile = os.path.join(nsdir, "fleet")
+    open(nsfile, "w").close()
+    old_refresh = _ns_mod.DEFAULT_REFRESH_S
+    _ns_mod.DEFAULT_REFRESH_S = 0.2
+    replicas = [mk(f"file://{nsfile}") for _ in range(3)]
+    try:
+        copts = ChannelOptions()
+        copts.timeout_ms = 3000
+        ch = Channel(copts)
+        assert ch.init(f"file://{nsfile}", "rr") == 0
+
+        stop = threading.Event()
+        lat_ms: list = []
+        counts = [0, 0]                 # sent, failed
+        lock = threading.Lock()
+
+        def load():
+            i = 0
+            while not stop.is_set():
+                i += 1
+                t0 = time.perf_counter()
+                ok = True
+                try:
+                    r = ch.call("OP.Echo", b"x")
+                    ok = (r == b"ok:x")
+                except Exception:
+                    ok = False
+                dt = (time.perf_counter() - t0) * 1e3
+                with lock:
+                    counts[0] += 1
+                    if not ok:
+                        counts[1] += 1
+                    lat_ms.append(dt)
+
+        workers = [threading.Thread(target=load, daemon=True)
+                   for _ in range(3)]
+        for t in workers:
+            t.start()
+        time.sleep(0.4)
+        for idx in range(3):            # the roll: successor-first
+            old = replicas[idx]
+            new = mk(f"file://{nsfile}")
+            time.sleep(0.45)            # one naming refresh period
+            old.drain(grace_ms=3000)
+            old.stop()
+            old.join(timeout=3)
+            replicas[idx] = new
+            time.sleep(0.3)
+        stop.set()
+        for t in workers:
+            t.join(timeout=10)
+        extra["rolling_restart_total_rpcs"] = counts[0]
+        extra["rolling_restart_failed_rpcs"] = counts[1]
+        if lat_ms:
+            lat_ms.sort()
+            extra["drain_p99_victim_ms"] = round(
+                lat_ms[min(len(lat_ms) - 1,
+                           int(len(lat_ms) * 0.99))], 3)
+    finally:
+        _ns_mod.DEFAULT_REFRESH_S = old_refresh
+        for s in replicas:
+            try:
+                s.stop()
+            except Exception:
+                pass
+        global_lame_ducks().reset()
+
+    # ---- idle-connection memory probe, scaled to the box ----
+    def _rss_kb() -> int:
+        with open("/proc/self/status") as f:
+            for ln in f:
+                if ln.startswith("VmRSS:"):
+                    return int(ln.split()[1])
+        return 0
+
+    import resource
+    soft_nofile = resource.getrlimit(resource.RLIMIT_NOFILE)[0]
+    k = max(100, min(1000, (soft_nofile - 256) // 2))
+    srv = Server(ServerOptions())
+    srv.add_service(Op(), name="OP")
+    assert srv.start("127.0.0.1:0") == 0
+    conns = []
+    try:
+        ep = srv.listen_endpoint
+        # settle allocator state before the baseline read
+        for _ in range(3):
+            c = pysock.create_connection((str(ep.host), ep.port),
+                                         timeout=10)
+            conns.append(c)
+        time.sleep(0.3)
+        rss0 = _rss_kb()
+        for _ in range(k):
+            conns.append(pysock.create_connection(
+                (str(ep.host), ep.port), timeout=10))
+        deadline = time.time() + 5
+        while srv.connection_count() < k and time.time() < deadline:
+            time.sleep(0.02)
+        time.sleep(0.3)
+        rss1 = _rss_kb()
+        extra["conns_probe_count"] = k
+        extra["conns_10k_rss_mb"] = round(
+            max(0, rss1 - rss0) / 1024.0 * (10000.0 / k), 1)
+    finally:
+        for c in conns:
+            try:
+                c.close()
+            except OSError:
+                pass
+        srv.stop()
+
+
 def bench_grpc(extra: dict) -> None:
     """gRPC unary 1KB echo: a real grpcio client against our server ON
     THE NATIVE PORT (h2 rides the engine's passthrough lane — native
@@ -2157,6 +2301,7 @@ def main() -> None:
                      ("trace", bench_trace),
                      ("robustness", bench_robustness),
                      ("overload_fairness", bench_overload_fairness),
+                     ("operability", bench_operability),
                      ("grpc", bench_grpc)):
         if not budget_left():
             extra[f"{name}_skipped"] = "bench budget spent"

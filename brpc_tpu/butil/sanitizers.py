@@ -164,10 +164,19 @@ _warn_var_lock = threading.Lock()
 
 def _ensure_warning_var() -> None:
     global _warn_var
-    if _warn_var is not None:
-        return
     with _warn_var_lock:
         if _warn_var is not None:
+            # a test-scoped registry wipe (bvar
+            # clear_registry_for_tests) un-exposes import-time vars
+            # without telling them: re-expose on the next DebugLock
+            # instead of latching the var off for the process's life
+            try:
+                from ..bvar.variable import find_exposed
+                if find_exposed("sanitizer_lock_order_warnings") \
+                        is not _warn_var:
+                    _warn_var.expose("sanitizer_lock_order_warnings")
+            except Exception:
+                pass
             return
         try:
             from ..bvar.passive_status import PassiveStatus

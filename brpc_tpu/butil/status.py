@@ -38,6 +38,9 @@ class Errno(IntEnum):
     ELIMIT = 2004          # concurrent requests over max_concurrency
     ECLOSE = 2005
     EITP = 2007
+    ELAMEDUCK = 2008       # server draining: re-resolve, no breaker
+    #                        penalty (fail-fast retried on LB channels
+    #                        like ELIMIT — the operability plane)
     # Additions for the TPU build
     EDEVICE = 3001         # device/ICI transport failure
     EMESH = 3002           # mesh membership/topology error

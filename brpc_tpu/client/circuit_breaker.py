@@ -31,6 +31,11 @@ _DOUBLE_WINDOW_S = 30.0   # re-trip within this doubles the duration
 # window's 0.2 threshold)
 _ELIMIT_WEIGHT = 0.3
 _ELIMIT = int(Errno.ELIMIT)
+# operability plane: an ELAMEDUCK bounce is a PLANNED restart — zero
+# error weight (the lame-duck registry already removed the node from
+# selection; tripping the breaker on top would penalize the node's
+# post-restart re-entry, exactly what graceful drain exists to avoid)
+_ELAMEDUCK = int(Errno.ELAMEDUCK)
 
 
 class _NodeBreaker:
@@ -94,8 +99,8 @@ class CircuitBreakerMap:
                 latency_us: float) -> None:
         if not self.enabled:
             return
-        if error_code == 0:
-            e = 0.0
+        if error_code == 0 or error_code == _ELAMEDUCK:
+            e = 0.0                 # lame duck: planned, not broken
         elif error_code == _ELIMIT:
             e = _ELIMIT_WEIGHT      # busy, not broken: reduced weight
         else:

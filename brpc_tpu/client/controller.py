@@ -60,16 +60,22 @@ _EV_CREATE_LOCK = threading.Lock()
 _RETRIABLE = {int(Errno.EFAILEDSOCKET), int(Errno.EEOF),
               int(Errno.ELOGOFF), int(Errno.EUNUSED)}
 _ELIMIT = int(Errno.ELIMIT)
+_ELAMEDUCK = int(Errno.ELAMEDUCK)
+# errors the server answered in microseconds PRECISELY so the caller
+# can go elsewhere right now: retried immediately (no backoff) and
+# only when an LB can actually pick a different replica
+_FAIL_FAST = (_ELIMIT, _ELAMEDUCK)
 
 
 def default_retry_policy(cntl: "Controller", error_code: int) -> bool:
-    if error_code == _ELIMIT:
+    if error_code in _FAIL_FAST:
         # brpc-style fail-fast (≈ -server_fail_fast consumer side): an
-        # overloaded server answered ELIMIT in microseconds precisely
-        # so the caller can try a DIFFERENT replica immediately — so
-        # retry only when a load balancer can actually pick another
-        # one (the failed server lands in excluded_servers; the retry
-        # is still token-bucket bounded and skips backoff)
+        # overloaded server's ELIMIT — or a draining server's
+        # ELAMEDUCK — answers in microseconds precisely so the caller
+        # can try a DIFFERENT replica immediately — so retry only when
+        # a load balancer can actually pick another one (the failed
+        # server lands in excluded_servers; the retry is still
+        # token-bucket bounded and skips backoff)
         ch = getattr(cntl, "_channel", None)
         return ch is not None and ch.load_balancer is not None
     return error_code in _RETRIABLE
@@ -708,10 +714,11 @@ class Controller(LazyAttachmentsMixin):
             self.retried_count = self._nretry
             self._live_versions.add(self._nretry)
             delay_ms = 0.0
-            if ch is not None and code != _ELIMIT:
-                # fail-fast: an ELIMIT bounce retries IMMEDIATELY on a
-                # different replica — backing off would waste exactly
-                # the time the server's microsecond rejection saved
+            if ch is not None and code not in _FAIL_FAST:
+                # fail-fast: an ELIMIT/ELAMEDUCK bounce retries
+                # IMMEDIATELY on a different replica — backing off
+                # would waste exactly the time the server's
+                # microsecond rejection saved
                 delay_ms = _backoff_ms(ch.options.retry_backoff_ms,
                                        self._nretry,
                                        ch.options.retry_backoff_max_ms)
@@ -829,6 +836,18 @@ class Controller(LazyAttachmentsMixin):
             return
         shm_view = shm_settle = None
         m = msg.meta
+        from .naming_service import global_lame_ducks as _gld
+        if m.lame_duck:
+            # the answering server is draining: drop it from LB
+            # selection NOW (no breaker penalty — the response itself
+            # is still consumed below, whatever it carries)
+            _gld().mark(self.attempt_remotes.get(version,
+                                                 self.remote_side))
+        elif not m.error_code:
+            # clean response: a restarted successor on the same address
+            # sheds its predecessor's mark (no-op when unmarked)
+            _gld().clear(self.attempt_remotes.get(version,
+                                                  self.remote_side))
         if m.shm_offer or m.shm_accept or m.shm_desc or self._shm_offered \
                 or self._shm_slot is not None:
             # shm data plane: learn accepts/offers, settle the staged
@@ -852,6 +871,12 @@ class Controller(LazyAttachmentsMixin):
                     return
                 self._shm_slot = None
         code = msg.meta.error_code
+        if code == _ELAMEDUCK and not m.lame_duck:
+            # an ELAMEDUCK rejection IS the drain signal even when the
+            # response meta lost the TLV (proxy stripped unknown tags)
+            from .naming_service import global_lame_ducks
+            global_lame_ducks().mark(
+                self.attempt_remotes.get(version, self.remote_side))
         if code != 0:
             if self._retry_locked(version, code):
                 _idp.unlock(self._cid_base)
@@ -1057,6 +1082,12 @@ def process_http_response(msg, sock: Socket) -> None:
         if ok:
             _idp.unlock(cid)
         return
+    if msg.headers.get("x-lame-duck"):
+        # HTTP spelling of the drain signal (rides success AND 503
+        # responses): remove the node from LB selection, keep the
+        # response
+        from .naming_service import global_lame_ducks
+        global_lame_ducks().mark(cntl.remote_side)
     if msg.status_code != 200:
         rpc_code = msg.headers.get("x-rpc-error-code")
         code = int(rpc_code) if rpc_code and rpc_code.isdigit() \

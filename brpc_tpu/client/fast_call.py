@@ -353,10 +353,12 @@ def run(channel, cntl, method_full: str, request: Any,
                 return False
             nretry += 1
             cntl.retried_count = nretry
-            # fail-fast: ELIMIT bounces retry immediately on another
-            # replica (excluded_servers steers the LB away) — no
+            # fail-fast: ELIMIT/ELAMEDUCK bounces retry immediately on
+            # another replica (excluded_servers steers the LB away,
+            # and a lame-duck mark removes the draining node) — no
             # backoff, that's the whole point of the fast rejection
-            delay_ms = 0.0 if code == int(Errno.ELIMIT) else \
+            delay_ms = 0.0 if code in (int(Errno.ELIMIT),
+                                       int(Errno.ELAMEDUCK)) else \
                 _backoff_ms(opts.retry_backoff_ms, nretry,
                             opts.retry_backoff_max_ms)
             if delay_ms > 0:
@@ -797,6 +799,7 @@ def _handle_response(channel, cntl, sock, sid: int, pooled: bool, buf,
             return False, int(Errno.ERESPONSE), str(e)
     if meta.ici_domain:
         sock.ici_peer_domain = meta.ici_domain
+    _mark_lame(meta, cntl.remote_side)
     if meta.error_code:
         # full frame consumed — the connection itself is healthy
         _put_back()
@@ -835,6 +838,31 @@ def _handle_response(channel, cntl, sock, sid: int, pooled: bool, buf,
                     "undecompressable response")
             return True, 0, ""
     return _complete(raw, attachment)
+
+
+_ELAMEDUCK_CODE = int(Errno.ELAMEDUCK)
+_lame_registry = None        # resolved once: the batch lanes decode a
+#                              meta per item, so per-call import/
+#                              accessor machinery would tax them
+
+
+def _mark_lame(meta, remote) -> None:
+    """Operability plane, pinned-lane half: a decoded response meta
+    carrying the lame-duck TLV (or an ELAMEDUCK rejection) removes the
+    draining node from LB selection immediately — the plain-scan fast
+    shape can never carry the TLV, so this only runs on the full-decode
+    sub-paths.  A clean decoded response CLEARS a stale mark (restarted
+    successor on the same address; no-op when unmarked — clear()'s
+    unmarked exit is one dict read)."""
+    global _lame_registry
+    ducks = _lame_registry
+    if ducks is None:
+        from .naming_service import global_lame_ducks
+        ducks = _lame_registry = global_lame_ducks()
+    if meta.lame_duck or meta.error_code == _ELAMEDUCK_CODE:
+        ducks.mark(remote)
+    elif not meta.error_code and remote is not None:
+        ducks.clear(remote)
 
 
 def _breaker_feed(channel, remote, code: int, latency_us: int = 0) -> None:
@@ -1582,6 +1610,7 @@ def _raw_pinned(opts, payload, attachment, timeout_ms, sid, sock, tlv):
                 sock.set_failed(Errno.ERESPONSE, str(e))
                 sock.release()
                 raise RpcError(int(Errno.ERESPONSE), str(e)) from None
+        _mark_lame(meta, sock.remote_side)
         if meta.error_code:
             raise RpcError(meta.error_code, meta.error_text)
         natt = meta.attachment_size
@@ -1685,6 +1714,7 @@ def _raw_pinned(opts, payload, attachment, timeout_ms, sid, sock, tlv):
                 sock.set_failed(Errno.ERESPONSE, str(e))
                 sock.release()
                 raise RpcError(int(Errno.ERESPONSE), str(e)) from None
+        _mark_lame(meta, sock.remote_side)
         if meta.error_code:
             raise RpcError(meta.error_code, meta.error_text)
         rcid, natt = meta.correlation_id, meta.attachment_size
@@ -1838,6 +1868,7 @@ def run_batch(channel, method_full: str, requests, response_type: Any,
                 # peer's window credit instead of silently pinning it
                 from ..ici.endpoint import ack_unused
                 ack_unused(meta, sid)
+            _mark_lame(meta, sock.remote_side)
             if meta.error_code:
                 if first_error is None:
                     first_error = (meta.error_code, meta.error_text)
@@ -1920,6 +1951,7 @@ def run_batch(channel, method_full: str, requests, response_type: Any,
             sock.set_failed(Errno.ERESPONSE, "undecodable batch response")
             sock.release()
             raise RpcError(int(Errno.ERESPONSE), "undecodable batch response")
+        _mark_lame(meta, sock.remote_side)
         if meta.error_code and first_error is None:
             first_error = (meta.error_code, meta.error_text)
         body = mv[meta_size:]

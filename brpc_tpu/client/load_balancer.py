@@ -18,7 +18,7 @@ from ..butil.doubly_buffered import DoublyBufferedData
 from ..butil.endpoint import EndPoint
 from ..butil.extension import extension
 from .circuit_breaker import global_circuit_breaker_map
-from .naming_service import ServerNode
+from .naming_service import ServerNode, global_lame_ducks
 
 
 class LoadBalancer:
@@ -70,9 +70,16 @@ class LoadBalancer:
         nodes = self._servers.read()
         excluded = getattr(cntl, "excluded_servers", None) or ()
         breakers = self._breakers if self.use_circuit_breaker else None
+        # lame-duck filter (operability plane): a draining node said so
+        # itself — drop it from selection immediately, breaker state
+        # untouched (unconditional: the mark only exists because the
+        # node emitted the signal).  In-flight responses still complete
+        # — this filters SELECTION only.
+        ducks = global_lame_ducks()
         usable = [n for n in nodes
-                  if (breakers is None
-                      or not breakers.isolated(n.endpoint))]
+                  if not ducks.is_lame(n.endpoint)
+                  and (breakers is None
+                       or not breakers.isolated(n.endpoint))]
         if breakers is not None and self.min_working_instances > 0:
             if len(usable) < self.min_working_instances:
                 self.recovering = True

@@ -17,12 +17,97 @@ import threading
 from dataclasses import dataclass, field
 from typing import Callable, List, Optional, Sequence
 
+import time as _time
+
 from ..butil.endpoint import EndPoint, parse_endpoint
 from ..butil.extension import extension
+from ..butil.flags import define_flag, get_flag
 from ..butil.logging_util import LOG
 from ..fiber.timer_thread import global_timer_thread
 
 DEFAULT_REFRESH_S = 5.0
+
+define_flag("lame_duck_ttl_s", 10.0,
+            "how long a lame-duck mark keeps a node out of LB "
+            "selection before it may rejoin (a restarted replica "
+            "re-qualifies after this TTL even when the naming source "
+            "still lists it); refreshed by every further lame-duck "
+            "signal from the node",
+            validator=lambda v: isinstance(v, (int, float)) and v > 0)
+
+
+class LameDuckRegistry:
+    """Process-global endpoint → lame-duck-until (monotonic seconds).
+
+    The operability plane's client half: a server entering drain says
+    so on every response (meta TLV 23 / ``x-lame-duck`` / GOAWAY) and
+    with every ``ELAMEDUCK`` rejection; the mark removes the node from
+    LB selection IMMEDIATELY — in-flight responses are still accepted,
+    and the circuit breaker sees no error (a planned restart is not a
+    failure).  Marks expire after ``lame_duck_ttl_s`` so the restarted
+    replica rejoins without any naming-source round trip; a fresh
+    naming push that no longer lists the node removes it the ordinary
+    way."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._until: dict = {}          # EndPoint -> monotonic expiry
+        self.marks = 0                  # lifetime marks (diagnostics)
+
+    def mark(self, ep, ttl_s: Optional[float] = None) -> None:
+        if ep is None:
+            return
+        ttl = float(ttl_s if ttl_s is not None
+                    else get_flag("lame_duck_ttl_s", 10.0))
+        with self._lock:
+            self._until[ep] = _time.monotonic() + ttl
+            self.marks += 1
+
+    def clear(self, ep) -> None:
+        """Drop a mark — fed by any CLEAN response from the endpoint
+        (no lame-duck TLV): the restarted successor on the same
+        address must not inherit its predecessor's mark.  Unmarked
+        endpoints exit on the GIL-atomic dict read, so the completion
+        paths may call this per response."""
+        if ep in self._until:
+            with self._lock:
+                self._until.pop(ep, None)
+
+    def is_lame(self, ep) -> bool:
+        until = self._until.get(ep)
+        if until is None:
+            return False
+        if _time.monotonic() >= until:
+            with self._lock:
+                # re-check under the lock: a racing mark() must win
+                u2 = self._until.get(ep)
+                if u2 is not None and _time.monotonic() >= u2:
+                    del self._until[ep]
+            return False
+        return True
+
+    def snapshot(self) -> dict:
+        now = _time.monotonic()
+        with self._lock:
+            return {ep: round(u - now, 3)
+                    for ep, u in self._until.items() if u > now}
+
+    def reset(self) -> None:
+        with self._lock:
+            self._until.clear()
+
+
+_lame_ducks: Optional[LameDuckRegistry] = None
+_lame_lock = threading.Lock()
+
+
+def global_lame_ducks() -> LameDuckRegistry:
+    global _lame_ducks
+    if _lame_ducks is None:
+        with _lame_lock:
+            if _lame_ducks is None:
+                _lame_ducks = LameDuckRegistry()
+    return _lame_ducks
 
 
 @dataclass(frozen=True)

@@ -242,6 +242,9 @@ enum FbReason : int {
   FB_HTTP_BAD_HEADER,        // LF-only endings / colon-less line
   FB_HTTP_LARGE_BODY,        // over-inbuf Content-Length (direct read)
   FB_HTTP_CHUNK_STREAM,      // over-inbuf chunked body (stream FSM)
+  FB_HTTP_LAME_DUCK,         // server draining: the classic lane owns
+                             // the response so it carries the
+                             // x-lame-duck / Connection: close signal
   FB_REASONS
 };
 static const char* kFbNames[FB_REASONS] = {
@@ -252,7 +255,7 @@ static const char* kFbNames[FB_REASONS] = {
     "http_malformed_line", "http_version",    "http_no_route",
     "http_expect",        "http_upgrade",     "http_connection",
     "http_transfer_encoding", "http_bad_header", "http_large_body",
-    "http_chunk_stream",
+    "http_chunk_stream",  "http_lame_duck",
 };
 
 // per-route fallback reasons the header scan can attribute to a
@@ -569,6 +572,13 @@ struct EngineImpl {
   // HTTP body limit (mirrors protocol/http.py max_body_size; the
   // bridge syncs it at listen time and on live flag flips)
   std::atomic<size_t> http_max_body{64u * 1024u * 1024u};
+  // operability plane: lame-duck drain mode (set_lame_duck).  0 = off;
+  // 1 = accept pause only (listeners disarmed, fds kept for a hot-
+  // restart successor); 2 = pause + SIGNAL: natively-built tpu_std
+  // responses carry the lame-duck TLV (tag 23) and new kind-4 HTTP
+  // matches decline to the classic lane (which owns the x-lame-duck /
+  // Connection: close headers).
+  std::atomic<int> lame_duck{0};
   // optional per-burst epilogue: called ONCE after each flush_py_batch
   // item loop (GIL already held) so the Python shims can flush
   // per-burst aggregated accounting (admitted counts, method samples)
@@ -890,8 +900,13 @@ static NativeMethod* find_native(EngineImpl* eng, const MetaScan& s) {
 // paths.  ``extra`` carries the kind-3 domain-exchange answer (the
 // cached local ici-domain TLV), appended after the att TLV exactly
 // like the classic fast path orders its meta.
-static void native_append_head(std::string& out, uint64_t cid,
-                               uint32_t att, size_t plen,
+// pre-encoded lame-duck TLV (tag 23, u8 1) — MUST mirror meta.py's
+// LAME_DUCK_TLV: the drain signal natively-built responses carry
+// while the engine is in set_lame_duck mode
+static const char kDuckTlv[6] = {0x17, 0x01, 0x00, 0x00, 0x00, 0x01};
+
+static void native_append_head(EngineImpl* eng, std::string& out,
+                               uint64_t cid, uint32_t att, size_t plen,
                                const std::string* extra = nullptr) {
   char meta[22];
   uint32_t l8 = 8, l4 = 4;
@@ -906,24 +921,25 @@ static void native_append_head(std::string& out, uint64_t cid,
     mlen = 22;
   }
   uint32_t xlen = extra ? (uint32_t)extra->size() : 0;
-  uint32_t body = mlen + xlen + (uint32_t)plen;
+  uint32_t dlen =
+      (eng && eng->lame_duck.load(std::memory_order_relaxed) >= 2) ? 6
+                                                                   : 0;
+  uint32_t full = mlen + xlen + dlen;
+  uint32_t body = full + (uint32_t)plen;
   char hdr[12];
   memcpy(hdr, "TRPC", 4);
   memcpy(hdr + 4, &body, 4);
-  memcpy(hdr + 8, &mlen, 4);
-  if (xlen) {
-    uint32_t full = mlen + xlen;
-    memcpy(hdr + 8, &full, 4);
-  }
+  memcpy(hdr + 8, &full, 4);
   out.append(hdr, 12);
   out.append(meta, mlen);
   if (xlen) out.append(*extra);
+  if (dlen) out.append(kDuckTlv, 6);
 }
 
 // append one native response frame (cid + optional att TLV + body bytes)
 static void native_respond(Conn* c, uint64_t cid, const char* payload,
                            size_t plen, uint32_t att) {
-  native_append_head(c->native_out, cid, att, plen);
+  native_append_head(c->loop->eng, c->native_out, cid, att, plen);
   if (plen) {
     dp_copy(c->loop, DP_SERIALIZE, plen);
     c->native_out.append(payload, plen);
@@ -950,6 +966,8 @@ static void native_error(Conn* c, uint64_t cid, int32_t code,
   memcpy(b + 1, &tlen, 4);
   meta.append(b, 5);
   meta.append(text, tlen);
+  if (c->loop->eng->lame_duck.load(std::memory_order_relaxed) >= 2)
+    meta.append(kDuckTlv, 6);   // drain: error frames signal too
   uint32_t body = (uint32_t)meta.size(), mlen = body;
   char hdr[12];
   memcpy(hdr, "TRPC", 4);
@@ -1224,7 +1242,7 @@ static void raw_slim_item(Loop* lp, Conn* c, PyRawItem& it) {
         (it.m->kind == 3 && it.dom_len
          && !lp->eng->domain_tlv.empty())
             ? &lp->eng->domain_tlv : nullptr;
-    native_append_head(c->native_out, it.cid, (uint32_t)ralen,
+    native_append_head(lp->eng, c->native_out, it.cid, (uint32_t)ralen,
                        (size_t)rb.len + ralen, extra);
     dp_copy(lp, DP_SERIALIZE, (size_t)rb.len);
     dp_copy(lp, DP_SERIALIZE, ralen);
@@ -1716,6 +1734,13 @@ static void http_slim_error(Conn* c, const char* text) {
 // resolved — the route lookup precedes the header walk).
 static bool http_slim_match(EngineImpl* eng, Loop* lp, const char* p,
                             size_t total, size_t hlen, PyRawItem* out) {
+  if (eng->lame_duck.load(std::memory_order_relaxed) >= 2) {
+    // drain: the classic EV_HTTP lane owns every response now, so the
+    // x-lame-duck / Connection: close headers (and the keep-alive
+    // teardown they imply) come from ONE serializer
+    lp->tel.fallbacks[FB_HTTP_LAME_DUCK]++;
+    return false;
+  }
   const char* he = p + hlen;                    // body start
   const char* nl = (const char*)memchr(p, '\n', hlen);
   if (!nl) {
@@ -2318,7 +2343,7 @@ static bool conn_readable(EngineImpl* eng, Loop* lp, Conn* c) {
             // echo: append header+meta to native_out, then queue the
             // received buffer itself (offset past the request meta) —
             // the megabyte body is never copied
-            native_append_head(c->native_out, s.cid, s.att, plen);
+            native_append_head(eng, c->native_out, s.cid, s.att, plen);
             WriteItem it;
             bool got = false;
             {
@@ -2751,6 +2776,71 @@ static PyObject* Engine_listen_sharded(EngineObj* self, PyObject* args) {
     }
   }
   Py_RETURN_NONE;
+}
+
+// set_lame_duck(on) — operability plane: enter/leave drain mode.
+// While on: natively-built tpu_std responses carry the lame-duck TLV,
+// new kind-4 slim-HTTP matches decline to the classic lane, and every
+// listener is DISARMED from its loop's epoll — accepting stops but the
+// fds stay open+bound, so a hot-restart successor can inherit them
+// (SCM_RIGHTS) with the kernel listen queue intact.  off re-arms.
+static PyObject* Engine_set_lame_duck(EngineObj* self, PyObject* args) {
+  int mode;   // 0 = off, 1 = accept pause only, 2 = pause + signal
+  if (!PyArg_ParseTuple(args, "i", &mode)) return nullptr;
+  if (mode < 0) mode = 0;
+  if (mode > 2) mode = 2;
+  EngineImpl* eng = self->eng;
+  int on = mode != 0;
+  int prev = eng->lame_duck.exchange(mode, std::memory_order_relaxed);
+  if ((prev != 0) == on) Py_RETURN_NONE;   // arm state unchanged
+  struct epoll_event ev;
+  ev.events = EPOLLIN;
+  ev.data.u64 = UINT64_MAX;
+  if (eng->listen_fd >= 0 && !eng->loops.empty()) {
+    if (on)
+      epoll_ctl(eng->loops[0]->epfd, EPOLL_CTL_DEL, eng->listen_fd,
+                nullptr);
+    else
+      epoll_ctl(eng->loops[0]->epfd, EPOLL_CTL_ADD, eng->listen_fd, &ev);
+  }
+  for (Loop* lp : eng->loops) {
+    if (lp->listen_fd < 0) continue;
+    if (on)
+      epoll_ctl(lp->epfd, EPOLL_CTL_DEL, lp->listen_fd, nullptr);
+    else
+      epoll_ctl(lp->epfd, EPOLL_CTL_ADD, lp->listen_fd, &ev);
+  }
+  Py_RETURN_NONE;
+}
+
+// listener_fds() — the bound+listening fds this engine accepts on
+// (shard listeners included): the hot-restart exporter passes them to
+// the successor binary over a unix socket.
+static PyObject* Engine_listener_fds(EngineObj* self, PyObject* args) {
+  (void)args;
+  EngineImpl* eng = self->eng;
+  PyObject* out = PyList_New(0);
+  if (!out) return nullptr;
+  if (eng->listen_fd >= 0) {
+    PyObject* v = PyLong_FromLong(eng->listen_fd);
+    if (!v || PyList_Append(out, v) != 0) {
+      Py_XDECREF(v);
+      Py_DECREF(out);
+      return nullptr;
+    }
+    Py_DECREF(v);
+  }
+  for (Loop* lp : eng->loops) {
+    if (lp->listen_fd < 0) continue;
+    PyObject* v = PyLong_FromLong(lp->listen_fd);
+    if (!v || PyList_Append(out, v) != 0) {
+      Py_XDECREF(v);
+      Py_DECREF(out);
+      return nullptr;
+    }
+    Py_DECREF(v);
+  }
+  return out;
 }
 
 // set_busy_poll_us(us) — arm/disarm the pre-epoll busy-poll spin.
@@ -3453,6 +3543,13 @@ static PyMethodDef Engine_methods[] = {
     {"listen_sharded", (PyCFunction)Engine_listen_sharded, METH_VARARGS,
      "listen_sharded(fds) — one SO_REUSEPORT-bound listening fd per "
      "loop; each loop accepts and pins its own connections"},
+    {"set_lame_duck", (PyCFunction)Engine_set_lame_duck, METH_VARARGS,
+     "set_lame_duck(mode) — drain: 0 off, 1 = accept pause only, 2 = "
+     "pause + lame-duck TLV on native responses + kind-4 declines; "
+     "listener fds stay open for a hot-restart successor"},
+    {"listener_fds", (PyCFunction)Engine_listener_fds, METH_NOARGS,
+     "listener_fds() -> [fd] — bound listening fds for hot-restart "
+     "fd passing"},
     {"set_busy_poll_us", (PyCFunction)Engine_set_busy_poll_us,
      METH_VARARGS,
      "set_busy_poll_us(us) — spin this long on zero-timeout polls "
@@ -5546,6 +5643,20 @@ static PyObject* Demux_telemetry(DemuxObj* self, PyObject*) {
   return out;
 }
 
+// pending() — total in-flight entries still registered across every
+// attached conn: the drain plane waits for 0 before process exit (a
+// leftover entry is a response the table would deliver into a torn-
+// down Python world).
+static PyObject* Demux_pending(DemuxObj* self, PyObject* args) {
+  (void)args;
+  size_t n = 0;
+  {
+    std::lock_guard<std::mutex> g(self->d->mu);
+    for (auto& kv : self->d->conns) n += kv.second->inflight.size();
+  }
+  return PyLong_FromSize_t(n);
+}
+
 static void Demux_dealloc(DemuxObj* self) {
   if (self->d) {
     self->d->stopping.store(true);
@@ -5586,6 +5697,9 @@ static PyMethodDef Demux_methods[] = {
     {"cancel", (PyCFunction)Demux_cancel, METH_VARARGS,
      "cancel(token, cid) -> bool: drop a registration at call end"},
     {"stop", (PyCFunction)Demux_stop, METH_NOARGS, nullptr},
+    {"pending", (PyCFunction)Demux_pending, METH_NOARGS,
+     "pending() -> int: in-flight entries across attached conns (the "
+     "drain plane waits for 0)"},
     {"telemetry", (PyCFunction)Demux_telemetry, METH_NOARGS,
      "client-lane counters: completions, reason-coded fallbacks, "
      "completions-per-burst histogram, acks, attached conns"},

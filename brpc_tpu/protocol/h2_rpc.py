@@ -29,7 +29,8 @@ from ..deadline import inherit_deadline as _inherit_deadline
 from ..deadline import maybe_shed as _maybe_shed
 from .base import (ParseResult, Protocol, ProtocolType, max_body_size,
                    register_protocol)
-from .h2_session import PREFACE, E_PROTOCOL, H2Error, H2Session
+from .h2_session import (PREFACE, E_NO_ERROR, E_PROTOCOL, H2Error,
+                         H2Session)
 
 GRPC_CT = "application/grpc"
 
@@ -264,6 +265,7 @@ class GrpcServerStream:
                     ("grpc-message", message or "")], end_stream=True)
             self.conn.session.close_stream(self.sid)
             self.conn.live.pop(self.sid, None)
+            self.conn._maybe_goaway_locked()
         self.conn.flush(self.sock)
 
 
@@ -280,6 +282,22 @@ class H2ServerConn:
         self.live: Dict[int, GrpcServerStream] = {}
         self.ready: List[H2Request] = []
         self.lock = threading.Lock()
+        self._goaway_sent = False   # lame-duck GOAWAY: once per conn
+
+    def _maybe_goaway_locked(self) -> None:
+        """Operability plane, h2 spelling: while the server drains,
+        the first response on each connection is followed by a
+        NO_ERROR GOAWAY — the client finishes in-flight streams and
+        re-connects elsewhere (the GOAWAY analogue of tpu_std's
+        lame-duck TLV and HTTP/1.1's Connection: close).  Call with
+        self.lock held, before take_output."""
+        if self._goaway_sent:
+            return
+        srv = self.server
+        if srv is not None and getattr(srv, "lame_duck_signal_on",
+                                       False):
+            self._goaway_sent = True
+            self.session.send_goaway(E_NO_ERROR)
 
     def feed(self, data: bytes) -> None:
         spawn_live: List[Tuple[GrpcServerStream, object]] = []
@@ -381,6 +399,7 @@ class H2ServerConn:
                     ("grpc-status", str(status)),
                     ("grpc-message", message or "")], end_stream=True)
             self.session.close_stream(sid)
+            self._maybe_goaway_locked()
         self.flush(sock)
 
     def send_http_response(self, sock, sid: int, status: int, body: bytes,
@@ -395,6 +414,7 @@ class H2ServerConn:
             if body:
                 self.session.send_data(sid, body, end_stream=True)
             self.session.close_stream(sid)
+            self._maybe_goaway_locked()
         self.flush(sock)
 
 

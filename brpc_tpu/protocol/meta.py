@@ -53,6 +53,14 @@ _T_TENANT = 22          # utf-8: caller's tenant identity (API key /
                         # every native lane (raw kinds ignore it, the
                         # slim shims enforce it — same contract as the
                         # remaining-deadline tag 13)
+_T_LAME_DUCK = 23       # u8: RESPONSE-side drain signal — the server
+                        # is lame-duck (draining toward restart).  The
+                        # client removes the node from LB selection
+                        # immediately (no breaker penalty) while still
+                        # accepting this and every other in-flight
+                        # response.  Appended by the classic send paths
+                        # AND natively by engine.set_lame_duck — never
+                        # scanned on requests
 
 
 class CompressType:
@@ -74,6 +82,10 @@ TLV_ATTACHMENT = b"\x03\x04\x00\x00\x00"    # _T_ATTACHMENT, u32 follows
 TLV_TIMEOUT = b"\x0d\x04\x00\x00\x00"       # _T_TIMEOUT_MS, u32 follows
 TLV_TRACE = b"\x09\x08\x00\x00\x00"         # _T_TRACE_ID, u64 follows
 TLV_SPAN = b"\x0a\x08\x00\x00\x00"          # _T_SPAN_ID, u64 follows
+LAME_DUCK_TLV = b"\x17\x01\x00\x00\x00\x01"  # _T_LAME_DUCK, u8 1 — the
+#   COMPLETE pre-encoded TLV (tag 23 + len 1 + value — deliberately
+#   NOT a TLV_* 5-byte prefix: nothing variable follows), spliced into
+#   response metas while draining; engine.cpp's kDuckTlv mirrors it
 TAG_SERVICE = _T_SERVICE
 TAG_METHOD = _T_METHOD
 TAG_AUTH = _T_AUTH
@@ -85,6 +97,7 @@ TAG_SHM_ACCEPT = _T_SHM_ACCEPT
 TAG_SHM_RELEASE = _T_SHM_RELEASE
 TAG_SHM_DESC = _T_SHM_DESC
 TAG_TENANT = _T_TENANT
+TAG_LAME_DUCK = _T_LAME_DUCK
 
 
 class RpcMeta:
@@ -94,7 +107,7 @@ class RpcMeta:
                  "stream_id", "timeout_ms", "stream_window",
                  "ici_domain", "ici_desc", "ici_conn", "timeout_present",
                  "shm_offer", "shm_accept", "shm_release", "shm_desc",
-                 "tenant")
+                 "tenant", "lame_duck")
 
     def __init__(self):
         self.correlation_id = 0
@@ -123,6 +136,7 @@ class RpcMeta:
         self.shm_release = b""
         self.shm_desc = b""
         self.tenant = b""
+        self.lame_duck = 0
 
     @property
     def is_request(self) -> bool:
@@ -182,6 +196,8 @@ class RpcMeta:
             put(_T_SHM_DESC, self.shm_desc)
         if self.tenant:
             put(_T_TENANT, self.tenant)
+        if self.lame_duck:
+            put(_T_LAME_DUCK, b"\x01")
         return bytes(out)
 
     @staticmethod
@@ -242,6 +258,8 @@ class RpcMeta:
                     m.shm_desc = field
                 elif tag == _T_TENANT:
                     m.tenant = field
+                elif tag == _T_LAME_DUCK:
+                    m.lame_duck = field[0] if field else 1
                 # unknown tags are skipped: forward compatibility
         except (struct.error, IndexError, UnicodeDecodeError):
             return None

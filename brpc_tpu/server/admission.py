@@ -74,6 +74,7 @@ define_flag("enable_fair_admission", True,
             validator=lambda v: isinstance(v, bool))
 
 _ELIMIT = int(Errno.ELIMIT)
+_ELAMEDUCK = int(Errno.ELAMEDUCK)
 
 # the closed verdict enum — every admission decision lands in exactly
 # one of these buckets (acceptance: no "unknown" bucket possible)
@@ -82,7 +83,9 @@ SERVER_CAP = "server_cap"
 METHOD_CAP = "method_cap"
 CODEL = "codel"
 TENANT_QUOTA = "tenant_quota"
-VERDICTS = (ADMITTED, SERVER_CAP, METHOD_CAP, CODEL, TENANT_QUOTA)
+LAME_DUCK = "lame_duck"
+VERDICTS = (ADMITTED, SERVER_CAP, METHOD_CAP, CODEL, TENANT_QUOTA,
+            LAME_DUCK)
 
 
 def normalize_tenant(raw) -> str:
@@ -115,9 +118,10 @@ class Rejection:
 
     __slots__ = ("reason", "code", "text", "retry_after_s")
 
-    def __init__(self, reason: str, text: str, retry_after_s: int = 1):
+    def __init__(self, reason: str, text: str, retry_after_s: int = 1,
+                 code: int = _ELIMIT):
         self.reason = reason
-        self.code = _ELIMIT
+        self.code = code
         self.text = text
         self.retry_after_s = retry_after_s
 
@@ -338,9 +342,28 @@ class AdmissionControl:
         status = entry.status
         with self._lock:
             tenant = self._resolve_tenant(normalize_tenant(tenant_raw))
+        if getattr(server, "draining", False):
+            # operability plane, layer 0: a draining server admits
+            # NOTHING new — the in-flight set must reach zero within
+            # the grace.  ELAMEDUCK (not ELIMIT): the client removes
+            # the node from LB selection with no breaker penalty and
+            # fail-fast-retries elsewhere; every lane serializes this
+            # through its existing rejection path.
+            _count(tenant, LAME_DUCK)
+            return Rejection(LAME_DUCK, "server draining (lame duck)",
+                             code=_ELAMEDUCK)
         if not server.on_request_in():
             _count(tenant, SERVER_CAP)
             return Rejection(SERVER_CAP, "server max_concurrency")
+        if getattr(server, "draining", False):
+            # drain-start raced the unlocked check above: our in-flight
+            # increment is now VISIBLE to drain's settle wait (it reads
+            # under the same lock), so undo and reject — the handler
+            # must not start against a server about to tear down
+            server.on_request_out()
+            _count(tenant, LAME_DUCK)
+            return Rejection(LAME_DUCK, "server draining (lame duck)",
+                             code=_ELAMEDUCK)
         if not status.on_requested():
             server.on_request_out()
             _count(tenant, METHOD_CAP)
@@ -400,6 +423,10 @@ def trivial_shape(server, status) -> bool:
     if status.limiter is not None or status.max_concurrency:
         return False
     if _codel_live[0]:
+        return False
+    if server.draining:
+        # drain: every request must take the full admit() walk so the
+        # lame-duck rejection (and its verdict accounting) fires
         return False
     opts = server.options
     mc = opts.max_concurrency

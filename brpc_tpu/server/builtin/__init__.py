@@ -73,6 +73,13 @@ def _index(server, msg, rest):
 
 
 def _health(server, msg, rest):
+    # drain-state observability: a load balancer polling /health sees
+    # 503 + x-lame-duck the moment drain starts and takes the node out
+    # of rotation — kubernetes-readiness-probe shaped (the header rides
+    # even with enable_lame_duck off; the health poll IS the poll-based
+    # spelling of the signal)
+    if getattr(server, "draining", False):
+        return 503, "text/plain", "draining\n", [("x-lame-duck", "1")]
     return 200, "text/plain", "OK\n"
 
 
@@ -92,6 +99,12 @@ def _status(server, msg, rest):
         "inflight_requests": server.inflight,
         "fiber_workers": rt.worker_count,
         "fiber_pending": rt.pending_count,
+        # operability plane: drain phase + what the drain still waits
+        # for (the rolling-restart operator's watch keys)
+        "drain_phase": getattr(server, "drain_phase", "serving"),
+        "drain_inflight_remaining": server.inflight
+        if getattr(server, "draining", False) else 0,
+        "drain_force_closed": getattr(server, "drain_force_closed", 0),
         "services": {},
     }
     for (svc, mth), entry in sorted(server.methods.items()):

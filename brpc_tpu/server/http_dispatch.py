@@ -31,6 +31,23 @@ from .controller import ServerController
 PUBLIC_BUILTIN_PAGES = ("health", "version")
 
 
+def drain_response_args(server, headers=None, keep_alive=True):
+    """Operability plane, HTTP spelling: while the server drains,
+    every HTTP/1.1 response — success, rejection, builtin page —
+    carries ``x-lame-duck: 1`` and ``Connection: close`` (the
+    keep-alive teardown makes the client re-connect, and its resolver
+    will land elsewhere).  Returns the adjusted ``(headers,
+    keep_alive)`` pair; a no-op outside drain, so the lanes stay
+    byte-identical in steady state."""
+    if server is not None and server.lame_duck_signal_on:
+        h = list(headers or [])
+        if not any(k.lower() == "x-lame-duck" for k, _v in h):
+            h.append(("x-lame-duck", "1"))   # /health already adds its
+            #                                  own — never duplicate
+        return h, False
+    return headers, keep_alive
+
+
 def http_status_for_error(error_code: int) -> int:
     """RPC error -> HTTP status for the bridge (shared with the slim
     HTTP lane, server/http_slim.py — the two must map identically for
@@ -156,8 +173,9 @@ def handle_http_request(msg: HttpMessage, sock, server) -> None:
         LOG.exception("builtin page %s raised", msg.path)
         status, ctype, body, extra = 500, "text/plain", \
             f"internal error: {e}\n".encode(), []
+    extra, ka = drain_response_args(server, extra, msg.keep_alive)
     sock.write(build_response(status, body, ctype, headers=extra,
-                              keep_alive=msg.keep_alive))
+                              keep_alive=ka))
 
 
 def _bridge_rpc(msg: HttpMessage, sock, server, svc: str,
@@ -171,8 +189,9 @@ def _bridge_rpc(msg: HttpMessage, sock, server, svc: str,
                  getattr(msg, "recv_us", 0) or None)
     if rej is not None:
         status_code, body, extra = http_reject(rej)
+        extra, ka = drain_response_args(server, extra, msg.keep_alive)
         sock.write(build_response(status_code, body, headers=extra,
-                                  keep_alive=msg.keep_alive))
+                                  keep_alive=ka))
         return
 
     meta = RpcMeta()
@@ -216,10 +235,11 @@ def _bridge_rpc(msg: HttpMessage, sock, server, svc: str,
             if span is not None:
                 span.response_size = len(body)
                 span.finish(cntl.error_code)
-            s.write(build_response(
-                code, body,
-                headers=[("x-rpc-error-code", str(cntl.error_code))],
-                keep_alive=msg.keep_alive))
+            hdrs, ka = drain_response_args(
+                server, [("x-rpc-error-code", str(cntl.error_code))],
+                msg.keep_alive)
+            s.write(build_response(code, body, headers=hdrs,
+                                   keep_alive=ka))
             return
         if cntl._progressive is not None:
             # chunked transfer: headers now, body chunks whenever the
@@ -248,8 +268,9 @@ def _bridge_rpc(msg: HttpMessage, sock, server, svc: str,
         if span is not None:
             span.response_size = len(body)
             span.finish(0)
+        extra, ka = drain_response_args(server, extra, msg.keep_alive)
         s.write(build_response(200, body, ctype, headers=extra,
-                               keep_alive=msg.keep_alive))
+                               keep_alive=ka))
 
     cntl = ServerController(meta, sock.remote_side, sock.id, send)
     cntl.server = server

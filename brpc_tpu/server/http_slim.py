@@ -180,8 +180,14 @@ def make_http_slim_handler(bridge, server, entry, svc: str, mth: str,
                     return
             s = Socket.address(sock.id)
             if s is not None and not s.failed:
+                # async completions land here AFTER the burst — a
+                # drain may have started meanwhile: the late response
+                # carries the x-lame-duck / Connection: close signal
+                # exactly like the classic bridge's
+                from .http_dispatch import drain_response_args
+                extra, ka = drain_response_args(server, extra, True)
                 s.write(build_response(code, body_, ctype_,
-                                       headers=extra, keep_alive=True))
+                                       headers=extra, keep_alive=ka))
 
         def send(cntl, response):
             latency_us = monotonic_us() - cntl.begin_time_us

@@ -29,7 +29,8 @@ from .controller import ServerController
 
 
 def _send_error(sock: Socket, correlation_id: int, code: int,
-                text: str, request_meta: RpcMeta = None) -> None:
+                text: str, request_meta: RpcMeta = None,
+                server=None) -> None:
     if request_meta is not None and request_meta.ici_desc:
         # rejected before the device attachment was split: return the
         # client's posted window credit
@@ -39,13 +40,17 @@ def _send_error(sock: Socket, correlation_id: int, code: int,
     meta.correlation_id = correlation_id
     meta.error_code = int(code)
     meta.error_text = text
+    if server is not None and server.lame_duck_signal_on:
+        # drain: every error frame (incl. the ELAMEDUCK rejection
+        # itself) tells the peer to re-resolve
+        meta.lame_duck = 1
     sock.write(pack_frame(meta, IOBuf()))
 
 
 import struct as _struct
 
-from ..protocol.meta import (TAG_ICI_DOMAIN, TLV_ATTACHMENT,
-                             TLV_CORRELATION, encode_tlv)
+from ..protocol.meta import (LAME_DUCK_TLV, TAG_ICI_DOMAIN,
+                             TLV_ATTACHMENT, TLV_CORRELATION, encode_tlv)
 
 _CID_TAG = TLV_CORRELATION
 _ATT_TAG = TLV_ATTACHMENT
@@ -149,6 +154,10 @@ def _send_response(server, entry, cntl: ServerController,
             mb += _domain_tlv()
         if shm_extra or shm_desc:
             mb += shm_extra + shm_desc
+        if server.lame_duck_signal_on:
+            # drain: in-flight work still completes, and its response
+            # carries the re-resolve signal (pre-encoded TLV 23)
+            mb += LAME_DUCK_TLV
         head = (b"TRPC"
                 + _struct.pack("<II", len(mb) + len(response) + na, len(mb))
                 + mb)
@@ -168,6 +177,8 @@ def _send_response(server, entry, cntl: ServerController,
         return      # connection died; response dropped like the reference
     meta = RpcMeta()
     meta.correlation_id = cntl.request_meta.correlation_id
+    if server.lame_duck_signal_on:
+        meta.lame_duck = 1          # drain: peers re-resolve away
     if cntl.request_meta.ici_domain:
         # answer the domain exchange so the client can go device-resident
         from ..ici.endpoint import ici_enabled, local_domain_id
@@ -251,7 +262,8 @@ def process_rpc_request(msg: RpcMessage, sock: Socket, server) -> None:
     rej = _admit(server, entry, "tpu_std", meta.tenant,
                  getattr(msg, "recv_us", 0) or None)
     if rej is not None:
-        _send_error(sock, cid, rej.code, rej.text, request_meta=meta)
+        _send_error(sock, cid, rej.code, rej.text, request_meta=meta,
+                    server=server)
         return
 
     cntl = ServerController(

@@ -9,13 +9,18 @@ worker sizing configures the fiber runtime.
 
 from __future__ import annotations
 
+import os as _os
 import socket as _socket
 import threading
+import time as _time
+import weakref as _weakref
 from typing import Any, Callable, Dict, Optional, Tuple
 
 from ..butil.endpoint import EndPoint, parse_endpoint
+from ..butil.flags import define_flag, get_flag
 from ..butil.logging_util import LOG
 from ..butil.status import Errno
+from ..bvar.passive_status import PassiveStatus
 from ..fiber import runtime as fiber_runtime
 from ..protocol.base import list_protocols
 from ..transport.acceptor import Acceptor
@@ -23,6 +28,101 @@ from ..transport.event_dispatcher import global_dispatcher
 from ..transport.input_messenger import InputMessenger
 from .method_status import MethodStatus
 from .service import extract_methods, service_name_of
+
+# -- operability plane (graceful drain / lame duck / hot restart) -----------
+
+define_flag("drain_grace_ms", 5000,
+            "graceful-drain grace: how long Server.drain() (and a "
+            "post-stop join()) waits for in-flight requests, staged "
+            "shm slots and client-demux entries to settle before "
+            "force-closing stragglers with the named reason "
+            "'drain_grace_expired'",
+            validator=lambda v: isinstance(v, int) and v > 0)
+define_flag("enable_lame_duck", True,
+            "emit the lame-duck drain signal to connected peers while "
+            "draining (tpu_std meta TLV 23 — natively on the engine "
+            "lanes too — plus x-lame-duck/Connection: close on HTTP "
+            "and GOAWAY on h2): clients re-resolve immediately with "
+            "no breaker penalty.  Off = drain still rejects new work "
+            "(ELAMEDUCK) but peers only learn per-rejection",
+            validator=lambda v: isinstance(v, bool))
+
+# drain phases (ints so the bvar graphs): the names ride /status
+DRAIN_SERVING, DRAIN_DRAINING, DRAIN_STOPPED = 0, 1, 2
+_DRAIN_PHASE_NAMES = ("serving", "draining", "stopped")
+# the named force-close reason at grace expiry (pinned by the check
+# tooling's reason discipline: a force-closed connection's error text
+# says WHY, not just that it died)
+DRAIN_FORCE_CLOSE_REASON = "drain_grace_expired"
+
+_live_servers: "_weakref.WeakSet[Server]" = _weakref.WeakSet()
+
+
+def _drain_state_now() -> int:
+    """Max drain phase across LIVE (started) servers — any draining
+    server shows; fully-stopped ones drop out so the gauge returns to
+    0 once the process serves nothing mid-restart."""
+    st = DRAIN_SERVING
+    for s in list(_live_servers):
+        if s._started:
+            st = max(st, s._drain_state)
+    return st
+
+
+def _drain_inflight_now() -> int:
+    """In-flight requests still settling on DRAINING servers (0 when
+    nothing drains — the rolling-restart dashboards watch this fall)."""
+    n = 0
+    for s in list(_live_servers):
+        if s._drain_state == DRAIN_DRAINING:
+            n += s._inflight
+    return n
+
+
+_drain_state_var = PassiveStatus(_drain_state_now,
+                                 name="server_drain_state")
+_drain_inflight_var = PassiveStatus(_drain_inflight_now,
+                                    name="drain_inflight_remaining")
+
+
+def _ensure_drain_vars() -> None:
+    """Import-time bvars don't survive a test-scoped registry wipe
+    (bvar ``clear_registry_for_tests``): re-expose at every Server
+    construction — two dict reads when nothing changed."""
+    from ..bvar.variable import find_exposed
+    for name, var in (("server_drain_state", _drain_state_var),
+                      ("drain_inflight_remaining",
+                       _drain_inflight_var)):
+        if find_exposed(name) is not var:
+            var.expose(name)
+
+
+def _publish_file_edit(path: str, line: str, add: bool) -> None:
+    """Atomically add/remove one server line in a file-NS list (the
+    ``file://`` naming source): read-modify-replace under an flock so
+    replicas publishing while a draining neighbor unpublishes cannot
+    lose each other's lines."""
+    import fcntl
+    lockp = path + ".lock"
+    with open(lockp, "a+") as lk:
+        fcntl.flock(lk.fileno(), fcntl.LOCK_EX)
+        try:
+            try:
+                with open(path) as f:
+                    lines = [ln.strip() for ln in f if ln.strip()]
+            except FileNotFoundError:
+                lines = []
+            if add:
+                if line not in lines:
+                    lines.append(line)
+            else:
+                lines = [ln for ln in lines if ln != line]
+            tmp = path + ".tmp"
+            with open(tmp, "w") as f:
+                f.write("".join(ln + "\n" for ln in lines))
+            _os.replace(tmp, path)
+        finally:
+            fcntl.flock(lk.fileno(), fcntl.LOCK_UN)
 
 
 class ServerOptions:
@@ -35,7 +135,7 @@ class ServerOptions:
                  "native", "native_loops", "usercode_inline",
                  "ssl_cert", "ssl_key", "ssl_context",
                  "restful_mappings", "session_local_data_factory",
-                 "tenant_fair_capacity", "tenant_weights")
+                 "tenant_fair_capacity", "tenant_weights", "reuse_port")
 
     def __init__(self):
         self.num_workers = 0            # 0 = leave fiber runtime defaults
@@ -89,6 +189,13 @@ class ServerOptions:
         # SimpleDataPool factory (≈ simple_data_pool.h): per-request
         # reusable user data via cntl.session_local_data()
         self.session_local_data_factory = None
+        # hot restart, overlap-start flavor: bind the listener with
+        # SO_REUSEPORT even outside the native sharded-accept case, so
+        # a successor process can bind the SAME port while this one
+        # drains (the kernel splits accepts; the lame-duck signal
+        # steers clients to the successor).  Costs the EADDRINUSE
+        # safety against unrelated same-UID processes — off by default.
+        self.reuse_port = False
 
 
 class _MethodEntry:
@@ -123,6 +230,19 @@ class Server:
         self._stopped_event = threading.Event()
         self._inflight = 0
         self._inflight_lock = threading.Lock()
+        # operability plane: drain state machine + in-flight settle
+        # rendezvous (the condition SHARES the in-flight lock, so
+        # on_request_out's decrement and the notify are one critical
+        # section)
+        self._drain_state = DRAIN_SERVING
+        self._drain_cv = threading.Condition(self._inflight_lock)
+        self._drain_deadline_mono = 0.0
+        self._drain_force_closed = 0
+        self._published: Optional[Tuple[str, str]] = None
+        self._inherited_listener = False   # hot restart: fd came from
+        #                                    a predecessor, not bind()
+        _live_servers.add(self)
+        _ensure_drain_vars()
         self.version = ""
         self._restful = []           # parsed (segments, has_rest, entry_key)
         self._session_pool = None    # SimpleDataPool when factory set
@@ -300,6 +420,10 @@ class Server:
         with self._inflight_lock:
             if self._inflight > 0:
                 self._inflight -= 1
+            if self._inflight == 0:
+                # drain()/join() block on this rendezvous: the LAST
+                # settling request wakes them
+                self._drain_cv.notify_all()
         if error_code or latency_us:
             lim = self._server_limiter
             if lim is not None:
@@ -324,9 +448,14 @@ class Server:
 
     # -- lifecycle ---------------------------------------------------------
 
-    def start(self, addr: Any = "127.0.0.1:0") -> int:
+    def start(self, addr: Any = "127.0.0.1:0",
+              inherit_from: Optional[str] = None) -> int:
         """≈ Server::Start. ``addr`` is "ip:port" (port 0 = ephemeral),
-        an EndPoint, or a bare port int."""
+        an EndPoint, or a bare port int.  ``inherit_from`` names a
+        predecessor's hot-restart handoff socket (see
+        :meth:`export_listeners`): the listener fds — kernel listen
+        queue included — are taken over instead of bound fresh, so a
+        binary swap never refuses a connect."""
         if self._started:
             return -1
         if isinstance(addr, int):
@@ -338,32 +467,66 @@ class Server:
         if self.options.num_workers > 0:
             fiber_runtime.set_concurrency(self.options.num_workers)
 
-        lst = _socket.socket(_socket.AF_INET, _socket.SOCK_STREAM)
-        lst.setsockopt(_socket.SOL_SOCKET, _socket.SO_REUSEADDR, 1)
-        if self.options.native and hasattr(_socket, "SO_REUSEPORT"):
-            # the native bridge shards accept across its loops with one
-            # SO_REUSEPORT listener per loop; the PRIMARY socket must
-            # carry the option from before bind or the kernel refuses
-            # the shard binds (mixed-mode).  Gated on the flag AND a
-            # multi-loop resolution: REUSEPORT also waives EADDRINUSE
-            # against other same-UID processes, so a server that will
-            # never shard must not pay that safety loss.
-            from ..butil.flags import get_flag as _get_flag
-            from ..transport.native_bridge import default_engine_loops
-            nloops = self.options.native_loops or default_engine_loops()
-            if nloops > 1 and bool(_get_flag("engine_reuseport", True)):
+        inherited_extras = []
+        if inherit_from:
+            from . import hot_restart as _hot_restart
+            try:
+                got = _hot_restart.import_listeners(inherit_from)
+            except (OSError, ValueError) as e:
+                LOG.error("hot-restart import from %s failed: %s",
+                          inherit_from, e)
+                return -1
+            # primary = the inherited listener matching the requested
+            # port (any, when the caller asked for an ephemeral one);
+            # the rest become the engine's shard listeners
+            lst = None
+            for s, _h, p in got:
+                if lst is None and ep.port in (0, p):
+                    lst = s
+                else:
+                    inherited_extras.append(s)
+            if lst is None:
+                for s, _h, _p in got:
+                    s.close()
+                LOG.error("hot-restart handoff carried no listener "
+                          "for port %d", ep.port)
+                return -1
+            self._inherited_listener = True
+        else:
+            lst = _socket.socket(_socket.AF_INET, _socket.SOCK_STREAM)
+            lst.setsockopt(_socket.SOL_SOCKET, _socket.SO_REUSEADDR, 1)
+            want_reuseport = bool(self.options.reuse_port) \
+                and hasattr(_socket, "SO_REUSEPORT")
+            if self.options.native and hasattr(_socket, "SO_REUSEPORT"):
+                # the native bridge shards accept across its loops with
+                # one SO_REUSEPORT listener per loop; the PRIMARY socket
+                # must carry the option from before bind or the kernel
+                # refuses the shard binds (mixed-mode).  Gated on the
+                # flag AND a multi-loop resolution: REUSEPORT also
+                # waives EADDRINUSE against other same-UID processes,
+                # so a server that will never shard must not pay that
+                # safety loss.  ``options.reuse_port`` opts in anyway —
+                # the hot-restart overlap-start story.
+                from ..butil.flags import get_flag as _get_flag
+                from ..transport.native_bridge import default_engine_loops
+                nloops = self.options.native_loops \
+                    or default_engine_loops()
+                if nloops > 1 and bool(_get_flag("engine_reuseport",
+                                                 True)):
+                    want_reuseport = True
+            if want_reuseport:
                 try:
                     lst.setsockopt(_socket.SOL_SOCKET,
                                    _socket.SO_REUSEPORT, 1)
                 except OSError:
                     pass
-        try:
-            lst.bind(ep.to_sockaddr())
-        except OSError as e:
-            LOG.error("bind %s: %s", ep, e)
-            lst.close()
-            return -1
-        lst.listen(1024)
+            try:
+                lst.bind(ep.to_sockaddr())
+            except OSError as e:
+                LOG.error("bind %s: %s", ep, e)
+                lst.close()
+                return -1
+            lst.listen(1024)
         host, port = lst.getsockname()[:2]
         self._listen_endpoint = EndPoint(host=host, port=port)
         self._listener = lst
@@ -394,7 +557,9 @@ class Server:
                 from ..transport.native_bridge import NativeBridge
                 self._native_bridge = NativeBridge(
                     self, native_mod, loops=self.options.native_loops)
-                self._native_bridge.listen(lst)
+                self._native_bridge.listen(
+                    lst, inherited_shards=inherited_extras or None)
+                inherited_extras = []
             else:
                 LOG.warning("native engine unavailable; serving %s through "
                             "the Python transport", ep)
@@ -404,6 +569,14 @@ class Server:
         if self._native_bridge is None:
             self._acceptor = Acceptor(self._messenger, ssl_context=ssl_ctx)
             self._acceptor.start_accept(lst)
+        if inherited_extras:
+            # inherited shard listeners with no native engine to serve
+            # them: close rather than strand their queues silently
+            LOG.warning("closing %d inherited shard listener(s) the "
+                        "Python transport cannot serve",
+                        len(inherited_extras))
+            for s in inherited_extras:
+                s.close()
 
         # Optional second, operator-only port: builtin portal pages (flag
         # mutation, rpcz, profilers …) are served ONLY to connections
@@ -430,6 +603,8 @@ class Server:
                                                tag="internal")
             self._internal_acceptor.start_accept(ilst)
         self._started = True
+        self._drain_state = DRAIN_SERVING
+        self._drain_force_closed = 0
         self._stopped_event.clear()
         from ..bvar.dump import ensure_dumper
         ensure_dumper()     # no-op unless the bvar_dump flag is on
@@ -458,11 +633,178 @@ class Server:
             n += self._internal_acceptor.connection_count()
         return n
 
+    # -- operability plane: drain / lame duck ------------------------------
+
+    @property
+    def draining(self) -> bool:
+        return self._drain_state == DRAIN_DRAINING
+
+    @property
+    def drain_phase(self) -> str:
+        return _DRAIN_PHASE_NAMES[self._drain_state]
+
+    @property
+    def lame_duck_signal_on(self) -> bool:
+        """True while responses should carry the lame-duck signal."""
+        return self._drain_state == DRAIN_DRAINING \
+            and bool(get_flag("enable_lame_duck", True))
+
+    @property
+    def drain_force_closed(self) -> int:
+        return self._drain_force_closed
+
+    def publish(self, target: str) -> int:
+        """Register this server's address with a naming source — the
+        ``file://`` scheme (one ``host:port`` per line, exactly what
+        ``FileNamingService`` reads): the fleet-membership half of the
+        rolling-restart story.  ``drain()`` unpublishes first, so new
+        clients stop resolving here before the lame-duck signal even
+        lands on connected ones."""
+        if self._listen_endpoint is None:
+            return -1
+        path = target[len("file://"):] if target.startswith("file://") \
+            else target
+        line = f"{self._listen_endpoint.host}:{self._listen_endpoint.port}"
+        try:
+            _publish_file_edit(path, line, add=True)
+        except OSError as e:
+            LOG.error("publish to %s failed: %s", path, e)
+            return -1
+        self._published = (path, line)
+        return 0
+
+    def unpublish(self) -> None:
+        pub = self._published
+        if pub is None:
+            return
+        self._published = None
+        path, line = pub
+        try:
+            _publish_file_edit(path, line, add=False)
+        except OSError as e:
+            LOG.warning("unpublish from %s failed: %s", path, e)
+
+    def _wait_inflight_zero(self, deadline_mono: float) -> bool:
+        with self._inflight_lock:
+            while self._inflight > 0:
+                left = deadline_mono - _time.monotonic()
+                if left <= 0:
+                    return False
+                self._drain_cv.wait(min(left, 0.05))
+            return True
+
+    def _force_close_stragglers(self) -> int:
+        """Grace expired: force-close connections still carrying work,
+        each with the NAMED reason — a client sees a precise error, an
+        operator sees a counted event, never a silent hang."""
+        n = 0
+        if self._acceptor is not None:
+            for s in self._acceptor.live_sockets():
+                s.set_failed(Errno.ELOGOFF, DRAIN_FORCE_CLOSE_REASON)
+                s.release()
+                n += 1
+        if self._native_bridge is not None:
+            n += self._native_bridge.force_close_all(
+                DRAIN_FORCE_CLOSE_REASON)
+        self._drain_force_closed += n
+        if n:
+            LOG.warning("drain grace expired: force-closed %d "
+                        "connection(s) (%s)", n, DRAIN_FORCE_CLOSE_REASON)
+        return n
+
+    def export_listeners(self, path: str,
+                         timeout_s: float = 30.0) -> int:
+        """Hot restart, predecessor side: serve ONE fd handoff at
+        unix-socket ``path`` (blocking, bounded by ``timeout_s`` —
+        run it on a thread while still serving), shipping the bound
+        listener fds (primary + SO_REUSEPORT shards) to the successor
+        binary.  Then :meth:`drain` + :meth:`stop`: established
+        connections finish HERE; everything queued or new lands on the
+        successor."""
+        if not self._started:
+            return -1
+        if self._native_bridge is not None:
+            socks = self._native_bridge.listener_sockets()
+        elif self._listener is not None:
+            socks = [self._listener]
+        else:
+            socks = []
+        if not socks:
+            return -1
+        from . import hot_restart as _hot_restart
+        return _hot_restart.handoff_listeners(path, socks, timeout_s)
+
+    def drain(self, grace_ms: Optional[int] = None) -> int:
+        """Enter lame-duck and finish in-flight work (≈ the graceful
+        half of brpc ``Server::Stop`` + ``-graceful_quit_on_sigterm``):
+
+        1. unpublish from the naming source (new clients resolve away);
+        2. stop accepting (Python acceptor paused, engine listeners
+           disarmed — listener FDS stay open for a hot-restart
+           successor) and start stamping the lame-duck signal on every
+           response, on all six lanes;
+        3. reject NEW requests with ELAMEDUCK through the one shared
+           admission stage (fail-fast retried on LB channels);
+        4. wait — bounded by ``grace_ms`` / the ``drain_grace_ms`` flag
+           — for in-flight requests, staged shm-ring slots and client-
+           demux in-flight entries to settle;
+        5. at grace expiry, force-close stragglers with the named
+           reason ``drain_grace_expired``.
+
+        Returns 0 when everything settled inside the grace, -1
+        otherwise.  ``stop()`` afterwards is instant and client-
+        invisible.  Idempotent while draining."""
+        if not self._started:
+            return -1
+        if self._drain_state == DRAIN_DRAINING:
+            return 0
+        grace = int(grace_ms if grace_ms is not None
+                    else get_flag("drain_grace_ms", 5000))
+        deadline = _time.monotonic() + grace / 1e3
+        self._drain_deadline_mono = deadline
+        self._drain_state = DRAIN_DRAINING
+        self.unpublish()
+        if self._acceptor is not None:
+            self._acceptor.pause_accept()
+        if self._native_bridge is not None:
+            # engine: disarm listeners + append the lame-duck TLV to
+            # natively-built responses + decline new kind-4 matches
+            self._native_bridge.enter_lame_duck(
+                bool(get_flag("enable_lame_duck", True)))
+        settled = self._wait_inflight_zero(deadline)
+        if not settled:
+            # in-flight stragglers: THOSE connections earn the named
+            # force-close — data-plane residue below never does (its
+            # gauges are process-global; a co-hosted client's steady
+            # outbound traffic must not cost settled server conns
+            # their sockets)
+            self._force_close_stragglers()
+        # data-plane residue inside the SAME deadline: a process must
+        # not exit while a peer still maps one of its descriptors or a
+        # demux table still expects a response.  NOTE both gauges are
+        # process-wide (they cover this server's responses AND any
+        # co-hosted client's calls): in a proxy process with unrelated
+        # outbound load they may never read 0 — drain then reports -1
+        # after the grace, with the server half itself fully settled.
+        from ..transport import client_lane as _client_lane
+        from ..transport import shm_ring as _shm_ring
+        shm_left = _shm_ring.drain_settle(deadline)
+        lane_left = _client_lane.drain_settle(deadline)
+        if shm_left or lane_left:
+            LOG.warning("drain grace expired with %d shm slot(s) and "
+                        "%d demux entrie(s) unsettled", shm_left,
+                        lane_left)
+        return 0 if settled and not shm_left and not lane_left else -1
+
     def stop(self) -> int:
-        """≈ Server::Stop: stop accepting, fail live connections."""
+        """≈ Server::Stop: stop accepting, fail live connections.
+        After a completed :meth:`drain` there is nothing live to fail —
+        the restart is client-invisible."""
         if not self._started:
             return 0
         self._started = False
+        self._drain_state = DRAIN_STOPPED
+        self.unpublish()
         if self._acceptor is not None:
             self._acceptor.stop_accept()
         if self._native_bridge is not None:
@@ -472,11 +814,31 @@ class Server:
             self._internal_acceptor.stop_accept()
         self._listener = None
         self._stopped_event.set()
+        with self._inflight_lock:
+            # wake joiners even if in-flight never settles: their wait
+            # is grace-bounded, not stop-gated
+            self._drain_cv.notify_all()
         return 0
 
     def join(self, timeout: Optional[float] = None) -> None:
-        """≈ Server::Join (blocks until stop())."""
+        """≈ Server::Join: blocks until stop() AND every in-flight
+        request has settled (bounded by the drain grace — a handler
+        that never returns cannot pin the process forever).  The old
+        behavior returned the instant ``stop()`` fired, with handlers
+        still running in a half-torn-down server."""
         self._stopped_event.wait(timeout)
+        if not self._stopped_event.is_set():
+            return                      # caller's timeout, not ours
+        grace_s = int(get_flag("drain_grace_ms", 5000)) / 1e3
+        deadline = _time.monotonic() + grace_s
+        with self._inflight_lock:
+            while self._inflight > 0:
+                left = deadline - _time.monotonic()
+                if left <= 0:
+                    LOG.warning("join(): %d request(s) still in flight "
+                                "at drain-grace expiry", self._inflight)
+                    return
+                self._drain_cv.wait(min(left, 0.05))
 
     def run_until_asked_to_quit(self) -> None:
         try:

@@ -238,6 +238,17 @@ def make_slim_handler(bridge, server, entry, svc: str, mth: str):
                 cntl.finish(None)
                 return None
             if cntl.is_async:
+                # async escalation OUTLIVES the burst: the "in-flight
+                # counts are net-zero for sync items" elision no longer
+                # holds — take them now (server gauge, method gauge,
+                # '-' tenant slot) so Server.drain()/join() SEE this
+                # request and the classic completion settles each
+                # symmetrically (operability plane: an invisible async
+                # request is one a drain would cut off mid-flight)
+                cntl._slim_fast = False
+                _server.on_request_in()
+                _status.on_requested()
+                _server.admission._tenant_acquire("-")
                 return None
             if (cntl.failed or cntl._accepted_stream_id
                     or cntl.response_compress_type
@@ -286,7 +297,9 @@ def make_slim_handler(bridge, server, entry, svc: str, mth: str):
         # builder, byte-identical with the classic path's
         rej = _admit(_server, _entry, "slim", tenant, recv_ns // 1000)
         if rej is not None:
-            _send_error(sock, cid, rej.code, rej.text)
+            # drain rejections (ELAMEDUCK) carry the lame-duck TLV so
+            # the bounced client re-resolves, not just retries
+            _send_error(sock, cid, rej.code, rej.text, server=_server)
             return None
         if dom is not None:
             # learn the peer's device-fabric domain; the engine answers

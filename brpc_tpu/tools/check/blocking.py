@@ -69,6 +69,18 @@ ENTRY_POINTS: Tuple[Tuple[str, Tuple[str, ...]], ...] = (
     ("brpc_tpu/transport/shm_ring.py", ("on_socket_closed",)),
     ("brpc_tpu/transport/shm_ring.py", ("ShmRing", "free_owner")),
     ("brpc_tpu/transport/shm_ring.py", ("describe_response_att",)),
+    # operability plane (ISSUE 12): the drain/hot-restart paths are
+    # DEADLINE-BOUNDED by contract — every wait they reach must carry
+    # a timeout (a drain that can hang forever defeats the grace), so
+    # they live in the same un-timed-primitive lint as loop code.
+    # Intentional bounded socket ops (settimeout'd handoff accept/
+    # connect) carry reviewed allow-markers.
+    ("brpc_tpu/server/server.py", ("Server", "drain")),
+    ("brpc_tpu/server/server.py", ("Server", "join")),
+    ("brpc_tpu/transport/shm_ring.py", ("drain_settle",)),
+    ("brpc_tpu/transport/client_lane.py", ("drain_settle",)),
+    ("brpc_tpu/server/hot_restart.py", ("handoff_listeners",)),
+    ("brpc_tpu/server/hot_restart.py", ("import_listeners",)),
 )
 
 # names whose call is a handoff, not an execution: arguments/targets

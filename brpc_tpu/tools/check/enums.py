@@ -131,7 +131,7 @@ def check_enums(tree: Tree) -> List[Finding]:
                         and isinstance(node.targets[0], ast.Name) \
                         and node.targets[0].id in (
                             "ADMITTED", "SERVER_CAP", "METHOD_CAP",
-                            "CODEL", "TENANT_QUOTA"):
+                            "CODEL", "TENANT_QUOTA", "LAME_DUCK"):
                     s = _str_const(node.value)
                     if s:
                         reason_names.append((s, f"{rel} (verdict)"))

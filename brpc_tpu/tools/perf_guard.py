@@ -69,11 +69,22 @@ RECORDED_BASELINE = {
     "loop_scaling_efficiency": 0.486,         # ~0.5 = 1-core ceiling
     "loop_scaling_efficiency_4loop": 0.244,   # ~0.25 = 1-core ceiling
     "sweep_64b_pipelined_4loop_p99_us": 460.8,
+    # ISSUE 12 operability keys (session box, 2026-08): the victims'
+    # p99 during a full 3-replica roll, and the 10k-idle-conn RSS
+    # probe (client+server halves in one process — PERF §15)
+    "drain_p99_victim_ms": 1.83,
+    "conns_10k_rss_mb": 31.6,
 }
+
+# keys pinned at EXACTLY zero: any non-zero value fails the gate
+# regardless of tolerance (a failed request during a rolling restart is
+# a correctness bug, not a perf regression) — the zero-base rule that
+# exempts ratio denominators must not exempt these
+PINNED_ZERO = ("rolling_restart_failed_rpcs",)
 
 _HIGHER = ("_qps", "_gbps", "gbps", "_rps", "_tok_s", "tokens_per_s",
            "_tflops", "_speedup", "_frac", "_factor_inverse")
-_LOWER = ("_us", "_ms", "_p50", "_p99")
+_LOWER = ("_us", "_ms", "_p50", "_p99", "_rss_mb")
 # gap keys measure raw/cntl — LOWER is better (a shrinking gap is the
 # win); amplification likewise
 _LOWER_RATIOS = ("cntl_vs_raw_gap", "fanout_cntl_vs_raw_gap",
@@ -167,6 +178,17 @@ def compare(new: Dict[str, float], base: Dict[str, float],
     rows = []
     keys = sorted(set(new) | set(base))
     for k in keys:
+        if k in PINNED_ZERO:
+            nv = new.get(k)
+            if nv is None:
+                rows.append((k, 0, nv, "missing", False))
+            else:
+                bad = nv != 0
+                rows.append((k, 0, nv,
+                             "REGRESSED" if bad else "ok", bad))
+                if bad:
+                    failures.append(k)
+            continue
         d = direction_of(k)
         if d is None and k not in watch:
             continue

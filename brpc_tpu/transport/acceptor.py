@@ -114,6 +114,27 @@ class Acceptor:
                 s.release()      # return the pool slot (no revival for
                                  # server-side connections)
 
+    def pause_accept(self) -> None:
+        """Drain mode (operability plane): stop accepting NEW
+        connections — the listener leaves the dispatcher but its fd
+        stays OPEN and bound (hot restart may pass it to a successor,
+        and the kernel keeps the listen queue for whoever owns it
+        next).  Live connections keep serving; ``stop_accept`` still
+        runs at stop() for the final teardown."""
+        self._stopped = True
+        ls = Socket.address(self._listen_sid)
+        if ls is not None and ls.fd is not None:
+            self._dispatcher.remove_consumer(ls.fd)
+
+    def live_sockets(self):
+        """Snapshot of the live accepted connection Sockets (the drain
+        force-close sweep walks it at grace expiry)."""
+        self._gc()
+        with self._conn_lock:
+            sids = list(self._connections)
+        return [s for s in (Socket.address(sid) for sid in sids)
+                if s is not None]
+
     def stop_accept(self) -> None:
         """≈ Acceptor::StopAccept: close listener, fail connections."""
         self._stopped = True

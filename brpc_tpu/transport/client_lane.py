@@ -114,6 +114,39 @@ def lane_cancel(sock, cid: int) -> None:
             lane.cancel(sock, cid)
 
 
+def pending_inflight() -> int:
+    """ClientDemux in-flight entries still registered across the demux
+    pool (0 when the lane was never created).  The drain plane waits
+    for this to reach zero before process exit — an entry left behind
+    is a response the native table would deliver into a torn-down
+    Python world."""
+    lane = _lane
+    if lane is None:
+        return 0
+    n = 0
+    for d in lane._demuxes:
+        try:
+            n += int(d.pending())
+        except AttributeError:     # stale prebuilt engine: best effort
+            return 0
+    return n
+
+
+def drain_settle(deadline_mono_s: float) -> int:
+    """Wait (bounded by the drain-grace deadline, monotonic seconds)
+    for the demux pool's in-flight tables to empty.  Returns entries
+    still pending at the deadline."""
+    import time as _time
+    ev = threading.Event()
+    while True:
+        n = pending_inflight()
+        if n == 0:
+            return 0
+        if _time.monotonic() >= deadline_mono_s:
+            return n
+        ev.wait(0.005)     # timed: the drain path stays deadline-bound
+
+
 def client_lane_telemetry() -> dict:
     """Snapshot of the lane's native counters MERGED across the demux
     pool (empty dict when the lane was never created) — the /native

@@ -143,7 +143,7 @@ FB_REASON_NAMES = (
     "http_slim_off", "http_malformed_line", "http_version",
     "http_no_route", "http_expect", "http_upgrade", "http_connection",
     "http_transfer_encoding", "http_bad_header", "http_large_body",
-    "http_chunk_stream",
+    "http_chunk_stream", "http_lame_duck",
 )
 
 
@@ -288,6 +288,9 @@ class NativeBridge:
                                            external_loops=True)
         self._nloops = loops
         self._loop_threads: list = []
+        self._listen_socket = None
+        self._shard_sockets: list = []
+        self._inherited_shards: list = []
         self._conns: Dict[int, int] = {}      # engine conn_id -> socket id
         self._socks: Dict[int, Any] = {}      # engine conn_id -> NativeSocket
         self._pt_queues: Dict[int, Any] = {}  # per-conn dispatch serializers
@@ -598,11 +601,12 @@ class NativeBridge:
             return None
         return shards
 
-    def listen(self, listen_socket) -> None:
+    def listen(self, listen_socket, inherited_shards=None) -> None:
         listen_socket.setblocking(False)
         # the bridge owns the fd's lifetime alongside the engine
         self._listen_socket = listen_socket
         self._shard_sockets = []
+        self._inherited_shards = list(inherited_shards or [])
         name = listen_socket.getsockname()
         self._local_ep = EndPoint(host=name[0], port=name[1])
         self._register_native_methods()
@@ -627,8 +631,31 @@ class NativeBridge:
                    lambda v, _e=self.engine: _e.set_busy_poll_us(int(v)))
         # SO_REUSEPORT sharded accept: one listener per loop, each loop
         # accepts and pins its own connections (brpc's per-core
-        # EventDispatcher discipline); single-fd rr handoff otherwise
-        shards = self._shard_listen_sockets(listen_socket)
+        # EventDispatcher discipline); single-fd rr handoff otherwise.
+        # Hot restart: a predecessor's shard listeners (fd-passed, with
+        # their kernel queues) are reused when the count fits — one per
+        # loop beyond the primary; a mismatched handoff (different loop
+        # count across versions) closes the extras and re-shards fresh.
+        shards = None
+        if self._inherited_shards:
+            if len(self._inherited_shards) >= self._nloops - 1 \
+                    and self._nloops > 1:
+                shards = [listen_socket] \
+                    + self._inherited_shards[:self._nloops - 1]
+                for s in shards:
+                    s.setblocking(False)
+                leftovers = self._inherited_shards[self._nloops - 1:]
+            else:
+                leftovers = self._inherited_shards
+            for s in leftovers:
+                s.close()
+            if leftovers:
+                LOG.warning("hot restart: closed %d inherited shard "
+                            "listener(s) beyond this server's %d "
+                            "loop(s)", len(leftovers), self._nloops)
+            self._inherited_shards = []
+        if shards is None:
+            shards = self._shard_listen_sockets(listen_socket)
         if shards is not None:
             self._shard_sockets = shards[1:]
             self.engine.listen_sharded([s.fileno() for s in shards])
@@ -640,6 +667,48 @@ class NativeBridge:
                                  name=f"native-loop-{i}", daemon=True)
             t.start()
             self._loop_threads.append(t)
+
+    # -- operability plane: drain / lame duck / hot restart -------------
+
+    def enter_lame_duck(self, signal: bool = True) -> None:
+        """Drain mode: disarm the engine's listeners (fds stay open for
+        a hot-restart successor) and — when ``signal`` — start stamping
+        the lame-duck TLV on natively-built responses; new kind-4 HTTP
+        matches decline to the classic lane, whose serializer owns the
+        x-lame-duck / Connection: close headers.  A prebuilt engine
+        without the hook degrades to accept-pause via the admission
+        rejection alone."""
+        try:
+            self.engine.set_lame_duck(2 if signal else 1)
+        except AttributeError:
+            LOG.warning("native engine lacks set_lame_duck; drain "
+                        "relies on admission rejections only")
+
+    def listener_sockets(self):
+        """The bound listening sockets this bridge serves (primary +
+        SO_REUSEPORT shards): the hot-restart exporter passes their fds
+        to the successor binary."""
+        out = []
+        if self._listen_socket is not None:
+            out.append(self._listen_socket)
+        out.extend(self._shard_sockets)
+        return out
+
+    def force_close_all(self, reason: str) -> int:
+        """Drain-grace expiry: force-close every live native connection
+        with the named reason.  Returns the count."""
+        n = 0
+        for conn_id, sock in list(self._socks.items()):
+            try:
+                sock.set_failed(Errno.ELOGOFF, reason)
+            except Exception:
+                pass
+            try:
+                self.engine.close_conn(conn_id)
+            except (ConnectionError, OSError):
+                pass
+            n += 1
+        return n
 
     def stop(self) -> None:
         for v in self._native_vars:

@@ -623,6 +623,36 @@ def on_socket_closed(owner: Any) -> None:
         ring.free_owner(owner)
 
 
+def outstanding_tx_slots() -> int:
+    """Slots of this process's tx ring currently staged or leased —
+    the drain plane's "every descriptor on the wire has settled"
+    gauge (0 when the lane never engaged)."""
+    with _reg_lock:
+        ring = _tx_ring
+    if ring is None or ring._closed:
+        return 0
+    return ring.nslots - ring.free_count()
+
+
+def drain_settle(deadline_mono_s: float) -> int:
+    """Operability plane: wait — bounded by the caller's drain-grace
+    deadline (``time.monotonic()`` seconds) — for every outstanding
+    tx-ring slot to settle (peers return credits when they drop their
+    response views; dead-conn sweeps run from the transport close
+    path).  Returns the slots still outstanding at the deadline (0 =
+    fully settled; the process may exit without stranding a peer's
+    mapped descriptor)."""
+    import time as _time
+    ev = threading.Event()
+    while True:
+        n = outstanding_tx_slots()
+        if n == 0:
+            return 0
+        if _time.monotonic() >= deadline_mono_s:
+            return n
+        ev.wait(0.005)     # timed: the drain path stays deadline-bound
+
+
 def _reset_for_tests() -> None:
     """Drop process-wide state (tests re-negotiate from scratch)."""
     global _tx_ring, _tx_failed

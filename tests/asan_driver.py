@@ -24,7 +24,12 @@ Drives every native memory-discipline surface the sanitizers can see:
    bursts on per-loop connections, ParallelChannel scatter fan-out and
    shm slot cycles, so the lock-free cross-loop handoff, the sharded
    slot allocator and the per-loop telemetry all run under ASan/UBSan
-   with real thread interleaving.
+   with real thread interleaving;
+7. **drain under load** (ISSUE 12) — a fresh native server drained
+   MID-BURST: set_lame_duck flips while pipelined slim frames are in
+   flight (listener epoll disarm, lame-duck TLV append on natively
+   built responses, kind-4 declines), then stop() tears the engine
+   down with the late rejections still settling.
 
 Prints ``ASAN_DRIVER_OK`` and exits 0 on success; any sanitizer report
 goes to stderr and (for UBSAN, built no-recover) aborts the process.
@@ -285,6 +290,56 @@ def main():
     tel = srv4._native_bridge.engine.telemetry()
     assert sum(lo["frames"] for lo in tel["loops"]) > 0
     srv4.stop()
+
+    # ---- 7. drain under load (graceful lame-duck mid-burst) ----
+    optsd = ServerOptions()
+    optsd.native = True
+    optsd.usercode_inline = True
+    optsd.native_loops = 2
+    srvd = Server(optsd)
+    srvd.add_service(Svc(), name="A")
+    assert srvd.start("127.0.0.1:0") == 0
+    portd = srvd.listen_endpoint.port
+    conns = [pysock.create_connection(("127.0.0.1", portd), timeout=10)
+             for _ in range(3)]
+    stop_blast = threading.Event()
+    derrors = []
+
+    def _blaster(s):
+        # keep pipelined frames flowing while the drain flips the
+        # engine into lame-duck: pre-drain frames answer 0, post-drain
+        # ones answer ELAMEDUCK with the native duck TLV appended —
+        # both shapes must be sanitizer-clean
+        i = 0
+        try:
+            s.settimeout(5)
+            while not stop_blast.is_set():
+                i += 1
+                s.sendall(frame(i, b"d" * (11 * (i % 23))))
+                try:
+                    s.recv(65536)
+                except OSError:
+                    return
+        except OSError:
+            pass
+        except Exception as e:
+            derrors.append(f"drain blaster: {type(e).__name__}: {e}")
+
+    blasters = [threading.Thread(target=_blaster, args=(c,))
+                for c in conns]
+    for t in blasters:
+        t.start()
+    time.sleep(0.3)
+    rc = srvd.drain(grace_ms=2000)
+    assert rc == 0, f"drain under load rc={rc}"
+    stop_blast.set()
+    for t in blasters:
+        t.join(timeout=10)
+    for c in conns:
+        c.close()
+    assert not derrors, derrors
+    srvd.stop()
+    srvd.join(timeout=5)
 
     for sub in servers:
         sub.stop()

@@ -1,0 +1,785 @@
+"""Operability plane — graceful drain, lame-duck, hot restart
+(ISSUE 12 acceptance).
+
+The rolling-restart story end to end:
+
+- ``Server.join()`` waits for in-flight settle, not just the stop
+  event (the headline semantics fix, pinned first);
+- ``Server.drain()`` finishes in-flight work on every lane while NEW
+  requests bounce ELAMEDUCK / 503 + x-lame-duck / grpc-status 8
+  through the ONE shared admission stage — matrix-tested over classic
+  tpu_std, the slim kind-3 native lane, classic HTTP/1.1, the kind-4
+  slim HTTP lane, gRPC unary over h2 and the gRPC streaming fiber
+  body;
+- the lame-duck signal (meta TLV 23 / x-lame-duck / GOAWAY) removes
+  the node from LB selection immediately with NO breaker penalty, and
+  ELAMEDUCK fail-fast-retries on LB channels like ELIMIT;
+- a 3-replica rolling restart under sustained Controller load
+  completes with ``rolling_restart_failed_rpcs == 0``;
+- drain-grace expiry force-closes stragglers with the named reason
+  ``drain_grace_expired``; staged shm-ring slots settle before exit;
+- hot restart hands listener fds (kernel listen queue included) to a
+  successor over a unix socket — established connections finish on
+  the predecessor, everything else lands on the successor.
+"""
+
+import os
+import socket as pysock
+import struct
+import threading
+import time
+
+import pytest
+
+from brpc_tpu.butil.flags import get_flag, set_flag
+from brpc_tpu.butil.status import Errno
+from brpc_tpu.client import Channel, ChannelOptions
+from brpc_tpu.client.naming_service import global_lame_ducks
+from brpc_tpu.client.circuit_breaker import global_circuit_breaker_map
+from brpc_tpu.protocol.meta import RpcMeta, TLV_CORRELATION, encode_tlv
+from brpc_tpu.server import Server, ServerOptions, Service
+from brpc_tpu.server.admission import LAME_DUCK, admission_counters
+from brpc_tpu.server.service import grpc_streaming
+from brpc_tpu.butil.endpoint import EndPoint
+
+from conftest import require_native  # noqa: E402
+
+ELAMEDUCK = int(Errno.ELAMEDUCK)
+
+# the closed-enum literals this plane exports (the static enums pass
+# requires every exportable reason name pinned by a test):
+assert LAME_DUCK == "lame_duck"
+HTTP_LAME_DUCK_REASON = "http_lame_duck"
+FORCE_CLOSE_REASON = "drain_grace_expired"
+
+
+class OpSvc(Service):
+    def __init__(self):
+        self.calls = []
+        self.parked = []
+        self._plock = threading.Lock()
+        self.stream_release = threading.Event()
+
+    def Echo(self, cntl, request):
+        self.calls.append(bytes(request))
+        return b"ok:" + bytes(request)
+
+    def Park(self, cntl, request):
+        """Async in-flight occupancy (works on inline native servers
+        where a blocking handler would stall the loop serving the
+        probe itself)."""
+        cntl.begin_async()
+        with self._plock:
+            self.parked.append(cntl)
+        return None
+
+    @grpc_streaming
+    def Stream(self, cntl, msgs):
+        for m in msgs:
+            pass
+        self.stream_release.wait(10)
+        return b"stream-done"
+
+    def release_parked(self):
+        with self._plock:
+            parked, self.parked = self.parked, []
+        for c in parked:
+            c.finish(b"released")
+
+
+def _server(native: bool, **opt_kv):
+    opts = ServerOptions()
+    if native:
+        opts.native = True
+        opts.usercode_inline = True
+        opts.native_loops = 1
+    for k, v in opt_kv.items():
+        setattr(opts, k, v)
+    svc = OpSvc()
+    srv = Server(opts)
+    srv.add_service(svc, name="OP")
+    assert srv.start("127.0.0.1:0") == 0
+    return srv, svc
+
+
+def _frame(cid: int, mth: bytes, payload: bytes = b"") -> bytes:
+    mb = TLV_CORRELATION + struct.pack("<Q", cid)
+    mb += encode_tlv(4, b"OP") + encode_tlv(5, mth)
+    body = mb + payload
+    return b"TRPC" + struct.pack("<II", len(body), len(mb)) + body
+
+
+def _read_frames(c: pysock.socket, n: int, timeout=10.0):
+    c.settimeout(timeout)
+    buf = b""
+    out = {}
+    while len(out) < n:
+        while True:
+            if len(buf) >= 12:
+                (blen,) = struct.unpack_from("<I", buf, 4)
+                if len(buf) >= 12 + blen:
+                    break
+            chunk = c.recv(65536)
+            if not chunk:
+                raise ConnectionError("peer closed")
+            buf += chunk
+        (blen,) = struct.unpack_from("<I", buf, 4)
+        (mlen,) = struct.unpack_from("<I", buf, 8)
+        meta = RpcMeta.decode(buf[12:12 + mlen])
+        assert meta is not None
+        out[meta.correlation_id] = meta
+        buf = buf[12 + blen:]
+    return out
+
+
+def _connect(ep) -> pysock.socket:
+    return pysock.create_connection((str(ep.host), ep.port), timeout=10)
+
+
+def _park(srv, conn, cid=900, svc=None):
+    base = srv.inflight
+    nparked = len(svc.parked) if svc is not None else 0
+    conn.sendall(_frame(cid, b"Park"))
+    deadline = time.time() + 5
+    while srv.inflight < base + 1 and time.time() < deadline:
+        time.sleep(0.005)
+    assert srv.inflight >= base + 1, "Park not admitted in time"
+    if svc is not None:
+        # wait for the HANDLER too (admission precedes it by a fiber
+        # hop): releasing before the cntl is parked would release
+        # nothing
+        while len(svc.parked) <= nparked and time.time() < deadline:
+            time.sleep(0.005)
+        assert len(svc.parked) > nparked, "Park handler not reached"
+
+
+def _drain_on_thread(srv, grace_ms=5000):
+    out = {}
+
+    def run():
+        out["rc"] = srv.drain(grace_ms=grace_ms)
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    deadline = time.time() + 5
+    while not srv.draining and time.time() < deadline:
+        time.sleep(0.005)
+    assert srv.draining
+    return t, out
+
+
+def _http_exchange_on(c: pysock.socket, request: bytes):
+    c.sendall(request)
+    c.settimeout(10)
+    buf = b""
+    while b"\r\n\r\n" not in buf:
+        chunk = c.recv(65536)
+        if not chunk:
+            raise ConnectionError("peer closed before headers")
+        buf += chunk
+    head, _, rest = buf.partition(b"\r\n\r\n")
+    lines = head.decode("latin1").split("\r\n")
+    status = int(lines[0].split()[1])
+    headers = {}
+    for ln in lines[1:]:
+        k, _, v = ln.partition(":")
+        headers[k.strip().lower()] = v.strip()
+    clen = int(headers.get("content-length", "0"))
+    while len(rest) < clen:
+        rest += c.recv(65536)
+    return status, headers, rest[:clen]
+
+
+def _http_req(path: bytes, body: bytes = b"") -> bytes:
+    return (b"POST " + path + b" HTTP/1.1\r\nHost: x\r\n"
+            b"Content-Length: " + str(len(body)).encode()
+            + b"\r\n\r\n" + body)
+
+
+def _teardown(*servers):
+    for s in servers:
+        try:
+            s.stop()
+        except Exception:
+            pass
+    global_lame_ducks().reset()
+    global_circuit_breaker_map().reset()
+
+
+# ---------------------------------------------------------------------------
+# join() semantics (satellite 1)
+# ---------------------------------------------------------------------------
+
+def test_join_waits_for_inflight_settle():
+    """join() must block until in-flight work settles — the old
+    behavior returned the instant stop() fired, handlers still
+    running."""
+    srv, svc = _server(native=False)
+    conn = _connect(srv.listen_endpoint)
+    try:
+        _park(srv, conn, svc=svc)
+        release_at = [0.0]
+
+        def releaser():
+            time.sleep(0.4)
+            release_at[0] = time.monotonic()
+            svc.release_parked()
+
+        threading.Thread(target=releaser, daemon=True).start()
+        srv.stop()
+        t0 = time.monotonic()
+        srv.join(timeout=5)
+        t1 = time.monotonic()
+        # join returned only AFTER the handler finished (not at stop)
+        assert release_at[0] > 0 and t1 >= release_at[0] - 0.01, \
+            (t0, release_at[0], t1)
+        assert srv.inflight == 0
+    finally:
+        conn.close()
+        _teardown(srv)
+
+
+def test_join_bounded_by_drain_grace():
+    """A handler that never finishes cannot pin join() forever: the
+    wait is bounded by drain_grace_ms."""
+    srv, svc = _server(native=False)
+    conn = _connect(srv.listen_endpoint)
+    old = get_flag("drain_grace_ms")
+    try:
+        set_flag("drain_grace_ms", 300)
+        _park(srv, conn, svc=svc)     # never released
+        srv.stop()
+        t0 = time.monotonic()
+        srv.join(timeout=5)
+        assert time.monotonic() - t0 < 2.0
+    finally:
+        set_flag("drain_grace_ms", old)
+        svc.release_parked()
+        conn.close()
+        _teardown(srv)
+
+
+# ---------------------------------------------------------------------------
+# drain matrix: in-flight finishes + new work bounces, on every lane
+# ---------------------------------------------------------------------------
+
+def _probe_tpu_std_lame(srv, ep, conn, cid=51):
+    before = admission_counters()
+    conn.sendall(_frame(cid, b"Echo", b"probe"))
+    metas = _read_frames(conn, 1)
+    assert metas[cid].error_code == ELAMEDUCK, metas[cid].error_code
+    assert metas[cid].lame_duck == 1      # rejection carries the TLV
+    after = admission_counters()
+    assert after.get(("-", "lame_duck"), 0) \
+        - before.get(("-", "lame_duck"), 0) == 1
+
+
+@pytest.mark.parametrize("native", [False, True],
+                         ids=["classic", "slim_native"])
+def test_drain_finishes_inflight_tpu_std(native):
+    """tpu_std lanes (classic + kind-3 slim): an in-flight request
+    admitted before drain COMPLETES during it (response stamped with
+    the lame-duck TLV), a new request bounces ELAMEDUCK, drain
+    returns 0 once released."""
+    if native:
+        require_native()
+    srv, svc = _server(native=native)
+    ep = srv.listen_endpoint
+    inflight_conn = _connect(ep)
+    probe_conn = _connect(ep)
+    try:
+        _park(srv, inflight_conn, svc=svc)
+        t, out = _drain_on_thread(srv)
+        _probe_tpu_std_lame(srv, ep, probe_conn)
+        assert t.is_alive()               # still waiting on the park
+        svc.release_parked()
+        t.join(timeout=5)
+        assert out.get("rc") == 0, out
+        metas = _read_frames(inflight_conn, 1)
+        assert metas[900].error_code == 0
+        assert metas[900].lame_duck == 1  # in-flight response signals
+    finally:
+        svc.release_parked()
+        inflight_conn.close()
+        probe_conn.close()
+        _teardown(srv)
+
+
+@pytest.mark.parametrize("native", [False, True],
+                         ids=["classic", "slim_http"])
+def test_drain_finishes_inflight_http(native):
+    """HTTP lanes (classic + kind-4 slim): in-flight async request
+    completes during drain; a new request gets 503 + x-lame-duck +
+    Connection: close; on the native server the kind-4 lane declines
+    under the NAMED reason http_lame_duck."""
+    if native:
+        require_native()
+    srv, svc = _server(native=native)
+    ep = srv.listen_endpoint
+    inflight_conn = _connect(ep)
+    probe_conn = _connect(ep)
+    try:
+        # async park over HTTP (held by the handler until release)
+        inflight_conn.sendall(_http_req(b"/OP/Park"))
+        deadline = time.time() + 5
+        while srv.inflight < 1 and time.time() < deadline:
+            time.sleep(0.005)
+        assert srv.inflight >= 1
+        while not svc.parked and time.time() < deadline:
+            time.sleep(0.005)
+        assert svc.parked, "Park handler not reached"
+        fb_before = 0
+        if native and srv._native_bridge is not None:
+            fb_before = srv._native_bridge.engine.telemetry()["fallbacks"].get(
+                HTTP_LAME_DUCK_REASON, 0)
+        t, out = _drain_on_thread(srv)
+        status, headers, body = _http_exchange_on(
+            probe_conn, _http_req(b"/OP/Echo", b"probe"))
+        assert status == 503
+        assert headers.get("x-lame-duck") == "1"
+        assert headers.get("x-rpc-error-code") == str(ELAMEDUCK)
+        assert headers.get("connection") == "close"
+        if native and srv._native_bridge is not None:
+            fb_after = srv._native_bridge.engine.telemetry()["fallbacks"].get(
+                HTTP_LAME_DUCK_REASON, 0)
+            assert fb_after > fb_before   # kind-4 declined, by name
+        svc.release_parked()
+        t.join(timeout=5)
+        assert out.get("rc") == 0, out
+        status, headers, body = _http_exchange_on(inflight_conn, b"")
+        assert status == 200 and body == b"released"
+        assert headers.get("x-lame-duck") == "1"
+    finally:
+        svc.release_parked()
+        inflight_conn.close()
+        probe_conn.close()
+        _teardown(srv)
+
+
+def test_drain_finishes_inflight_grpc_unary_and_goaway():
+    """gRPC over h2: in-flight unary completes during drain, the
+    connection receives a NO_ERROR GOAWAY with the response, and a
+    new request on the same connection bounces grpc-status 8."""
+    from brpc_tpu.protocol.h2_rpc import pack_grpc_message
+    from brpc_tpu.protocol.h2_session import H2Session
+
+    srv, svc = _server(native=False)
+    ep = srv.listen_endpoint
+    sess = H2Session(is_server=False)
+    sess.start()
+    c = _connect(ep)
+    try:
+        sid = sess.next_stream_id()
+        sess.send_headers(sid, [
+            (":method", "POST"), (":path", "/OP/Park"),
+            (":scheme", "http"), (":authority", "t"),
+            ("content-type", "application/grpc"), ("te", "trailers")])
+        sess.send_data(sid, pack_grpc_message(b"x"), end_stream=True)
+        c.sendall(sess.take_output())
+        deadline = time.time() + 5
+        while srv.inflight < 1 and time.time() < deadline:
+            time.sleep(0.005)
+        assert srv.inflight >= 1
+        t, out = _drain_on_thread(srv)
+        svc.release_parked()
+        t.join(timeout=5)
+        assert out.get("rc") == 0, out
+        # collect the in-flight response + the GOAWAY
+        statuses = {}
+        saw_goaway = False
+        c.settimeout(10)
+        end = time.time() + 10
+        while sid not in statuses and time.time() < end:
+            data = c.recv(65536)
+            if not data:
+                break
+            for ev in sess.feed(data):
+                if ev[0] == "headers":
+                    for k, v in ev[2]:
+                        if k == "grpc-status":
+                            statuses[ev[1]] = v
+                elif ev[0] == "goaway":
+                    saw_goaway = True
+            pend = sess.take_output()
+            if pend:
+                c.sendall(pend)
+        assert statuses.get(sid) == "0", statuses
+        assert saw_goaway
+        # new request while still lame-duck (pre-stop): grpc-status 8
+        sid2 = sess.next_stream_id()
+        sess.send_headers(sid2, [
+            (":method", "POST"), (":path", "/OP/Echo"),
+            (":scheme", "http"), (":authority", "t"),
+            ("content-type", "application/grpc"), ("te", "trailers")])
+        sess.send_data(sid2, pack_grpc_message(b"y"), end_stream=True)
+        c.sendall(sess.take_output())
+        end = time.time() + 10
+        while sid2 not in statuses and time.time() < end:
+            data = c.recv(65536)
+            if not data:
+                break
+            for ev in sess.feed(data):
+                if ev[0] == "headers":
+                    for k, v in ev[2]:
+                        if k == "grpc-status":
+                            statuses[ev[1]] = v
+            pend = sess.take_output()
+            if pend:
+                c.sendall(pend)
+        assert statuses.get(sid2) == "8", statuses
+    finally:
+        svc.release_parked()
+        c.close()
+        _teardown(srv)
+
+
+def test_drain_finishes_inflight_grpc_streaming():
+    """The gRPC streaming fiber body (sixth lane): a live stream
+    admitted before drain runs to completion during it."""
+    from brpc_tpu.protocol.h2_rpc import pack_grpc_message
+    from brpc_tpu.protocol.h2_session import H2Session
+
+    srv, svc = _server(native=False)
+    ep = srv.listen_endpoint
+    sess = H2Session(is_server=False)
+    sess.start()
+    c = _connect(ep)
+    try:
+        sid = sess.next_stream_id()
+        sess.send_headers(sid, [
+            (":method", "POST"), (":path", "/OP/Stream"),
+            (":scheme", "http"), (":authority", "t"),
+            ("content-type", "application/grpc"), ("te", "trailers")])
+        sess.send_data(sid, pack_grpc_message(b"m1"), end_stream=True)
+        c.sendall(sess.take_output())
+        deadline = time.time() + 5
+        while srv.inflight < 1 and time.time() < deadline:
+            time.sleep(0.005)
+        assert srv.inflight >= 1
+        t, out = _drain_on_thread(srv)
+        svc.stream_release.set()
+        t.join(timeout=5)
+        assert out.get("rc") == 0, out
+        status = None
+        c.settimeout(10)
+        end = time.time() + 10
+        while status is None and time.time() < end:
+            data = c.recv(65536)
+            if not data:
+                break
+            for ev in sess.feed(data):
+                if ev[0] == "headers":
+                    for k, v in ev[2]:
+                        if k == "grpc-status":
+                            status = v
+            pend = sess.take_output()
+            if pend:
+                c.sendall(pend)
+        assert status == "0"
+    finally:
+        svc.stream_release.set()
+        c.close()
+        _teardown(srv)
+
+
+# ---------------------------------------------------------------------------
+# client half: lame-duck removes the node from LB, breaker untouched
+# ---------------------------------------------------------------------------
+
+def test_lame_duck_removes_node_from_lb_without_breaker_trip():
+    srv_a, svc_a = _server(native=False)
+    srv_b, svc_b = _server(native=False)
+    ep_a, ep_b = srv_a.listen_endpoint, srv_b.listen_endpoint
+    park_conn = _connect(ep_a)
+    try:
+        opts = ChannelOptions()
+        opts.enable_circuit_breaker = True
+        opts.retry_backoff_ms = 2000      # fail-fast must SKIP this
+        ch = Channel(opts)
+        assert ch.init(f"list://{ep_a.host}:{ep_a.port},"
+                       f"{ep_b.host}:{ep_b.port}", "rr") == 0
+        # warm both replicas
+        for i in range(4):
+            assert ch.call("OP.Echo", b"warm%d" % i) == b"ok:warm%d" % i
+        # hold one in-flight on A so drain stays in the draining phase
+        _park(srv_a, park_conn, svc=svc_a)
+        t, out = _drain_on_thread(srv_a)
+        svc_a.calls.clear()
+        svc_b.calls.clear()
+        t0 = time.monotonic()
+        for i in range(12):
+            assert ch.call("OP.Echo", b"d%d" % i) == b"ok:d%d" % i
+        elapsed = time.monotonic() - t0
+        # ELAMEDUCK bounces fail-fast-retried on the LB channel: with a
+        # 2s backoff configured, sub-second completion proves the
+        # backoff was skipped
+        assert elapsed < 1.5, elapsed
+        # every call landed on B (the bounced first one retried there);
+        # once marked, A was never selected again
+        assert svc_a.calls == []
+        assert len(svc_b.calls) == 12
+        assert global_lame_ducks().is_lame(ep_a)
+        # planned restart ≠ failure: the breaker did NOT isolate A
+        assert not global_circuit_breaker_map().isolated(ep_a)
+        svc_a.release_parked()
+        t.join(timeout=5)
+        assert out.get("rc") == 0
+    finally:
+        svc_a.release_parked()
+        park_conn.close()
+        _teardown(srv_a, srv_b)
+
+
+# ---------------------------------------------------------------------------
+# the acceptance centerpiece: 3-replica rolling restart, zero failures
+# ---------------------------------------------------------------------------
+
+def test_rolling_restart_zero_failed_rpcs(tmp_path, monkeypatch):
+    import brpc_tpu.client.naming_service as ns_mod
+    monkeypatch.setattr(ns_mod, "DEFAULT_REFRESH_S", 0.2)
+
+    nsfile = str(tmp_path / "fleet")
+    open(nsfile, "w").close()
+    replicas = []
+    for _ in range(3):
+        srv, _svc = _server(native=False)
+        assert srv.publish(f"file://{nsfile}") == 0
+        replicas.append(srv)
+
+    opts = ChannelOptions()
+    opts.timeout_ms = 3000
+    ch = Channel(opts)
+    assert ch.init(f"file://{nsfile}", "rr") == 0
+
+    stop_load = threading.Event()
+    failed = [0]
+    sent = [0]
+
+    def load():
+        i = 0
+        while not stop_load.is_set():
+            i += 1
+            sent[0] += 1
+            try:
+                r = ch.call("OP.Echo", b"r%d" % i)
+                if r != b"ok:r%d" % i:
+                    failed[0] += 1
+            except Exception:
+                failed[0] += 1
+
+    threads = [threading.Thread(target=load, daemon=True)
+               for _ in range(3)]
+    for t in threads:
+        t.start()
+    try:
+        time.sleep(0.3)
+        for idx in range(3):
+            old = replicas[idx]
+            # successor first (a fresh address), then drain the old —
+            # the kubernetes-rolling-update order
+            new, _svc = _server(native=False)
+            assert new.publish(f"file://{nsfile}") == 0
+            time.sleep(0.45)          # one naming refresh period
+            assert old.drain(grace_ms=3000) == 0
+            old.stop()
+            old.join(timeout=3)
+            replicas[idx] = new
+            time.sleep(0.25)
+    finally:
+        stop_load.set()
+        for t in threads:
+            t.join(timeout=5)
+        _teardown(*replicas)
+    assert sent[0] > 50, sent[0]
+    # THE acceptance key: a full fleet roll under sustained load
+    # completed without one client-visible failure
+    assert failed[0] == 0, f"{failed[0]}/{sent[0]} rpcs failed"
+
+
+# ---------------------------------------------------------------------------
+# grace expiry + shm settle + observability + hot restart
+# ---------------------------------------------------------------------------
+
+def test_drain_grace_expiry_force_closes_with_named_reason():
+    srv, svc = _server(native=False)
+    conn = _connect(srv.listen_endpoint)
+    try:
+        _park(srv, conn, svc=svc)     # never released within the grace
+        t0 = time.monotonic()
+        rc = srv.drain(grace_ms=250)
+        assert rc == -1
+        assert 0.2 <= time.monotonic() - t0 < 2.0
+        assert srv.drain_force_closed >= 1
+        # the straggler's socket was force-closed: reads see EOF/RST
+        conn.settimeout(2)
+        try:
+            got = conn.recv(4096)
+        except OSError:
+            got = b""
+        assert got == b""
+        assert FORCE_CLOSE_REASON == "drain_grace_expired"
+    finally:
+        svc.release_parked()
+        conn.close()
+        _teardown(srv)
+
+
+def test_drain_settles_shm_slots():
+    """Staged tx-ring slots settle before drain returns 0 (the slot
+    frees when the consumer drops the response view)."""
+    from brpc_tpu.transport import shm_ring
+
+    if not shm_ring.shm_supported():
+        pytest.skip("no shm support here")
+    srv, svc = _server(native=False)
+    try:
+        ch = Channel()
+        assert ch.init(f"127.0.0.1:{srv.listen_endpoint.port}") == 0
+        from brpc_tpu.butil.iobuf import IOBuf
+        from brpc_tpu.client.controller import Controller
+        big = os.urandom(int(get_flag("rpc_shm_threshold")) + 1024)
+        for _ in range(3):            # later calls ride the shm lane
+            cntl = Controller()
+            cntl.timeout_ms = 10_000
+            cntl.request_attachment = IOBuf(big)
+            r = ch.call_method("OP.Echo", b"shm", cntl=cntl)
+            assert not r.failed, (r.error_code, r.error_text)
+            del cntl, r               # drop response views -> settle
+        deadline = time.monotonic() + 2
+        rc = srv.drain(grace_ms=2000)
+        assert rc == 0
+        assert shm_ring.outstanding_tx_slots() == 0
+        assert deadline > time.monotonic()  # settled, did not expire
+    finally:
+        _teardown(srv)
+
+
+def test_health_status_and_bvars_during_drain():
+    from brpc_tpu.bvar.variable import find_exposed
+    import json as _json
+
+    srv, svc = _server(native=False)
+    ep = srv.listen_endpoint
+    park_conn = _connect(ep)
+    page_conn = _connect(ep)
+    try:
+        status, headers, body = _http_exchange_on(
+            page_conn, _http_req(b"/health"))
+        assert status == 200 and body == b"OK\n"
+        _park(srv, park_conn, svc=svc)
+        t, out = _drain_on_thread(srv)
+        # /status shows the drain phase + remaining in-flight
+        status, headers, body = _http_exchange_on(
+            page_conn, _http_req(b"/status"))
+        st = _json.loads(body)
+        assert st["drain_phase"] == "draining"
+        assert st["drain_inflight_remaining"] >= 1
+        # bvars on /vars + /metrics families
+        assert find_exposed("server_drain_state").get_value() == 1
+        assert find_exposed("drain_inflight_remaining").get_value() >= 1
+        # /health flips 503 + x-lame-duck (LB-pollable) — last request
+        # on this conn: the drain response closes it
+        status, headers, body = _http_exchange_on(
+            page_conn, _http_req(b"/health"))
+        assert status == 503 and body == b"draining\n"
+        assert headers.get("x-lame-duck") == "1"
+        svc.release_parked()
+        t.join(timeout=5)
+        assert out.get("rc") == 0
+        srv.stop()
+        assert find_exposed("server_drain_state").get_value() == 0
+    finally:
+        svc.release_parked()
+        park_conn.close()
+        page_conn.close()
+        _teardown(srv)
+
+
+def test_hot_restart_fd_passing_preserves_service(tmp_path):
+    """The binary-swap story: the successor inherits the listener fd
+    (kernel listen queue included) while the predecessor finishes its
+    established connections — no refused connects, no dropped
+    in-flight work."""
+    handoff = str(tmp_path / "handoff.sock")
+    old_srv, old_svc = _server(native=False)
+    ep = old_srv.listen_endpoint
+    inflight_conn = _connect(ep)
+    try:
+        _park(old_srv, inflight_conn, svc=old_svc)
+        t = threading.Thread(target=old_srv.export_listeners,
+                             args=(handoff, 10.0), daemon=True)
+        t.start()
+        time.sleep(0.05)
+        # build the successor explicitly (same port, inherited fd)
+        new_srv = None
+        opts = ServerOptions()
+        new_svc = OpSvc()
+        new_srv = Server(opts)
+        new_srv.add_service(new_svc, name="OP")
+        assert new_srv.start(f"127.0.0.1:{ep.port}",
+                             inherit_from=handoff) == 0
+        t.join(timeout=5)
+        assert new_srv.listen_endpoint.port == ep.port
+        # predecessor drains: its established conn finishes HERE
+        t2, out = _drain_on_thread(old_srv)
+        old_svc.release_parked()
+        t2.join(timeout=5)
+        assert out.get("rc") == 0
+        metas = _read_frames(inflight_conn, 1)
+        assert metas[900].error_code == 0
+        old_srv.stop()
+        old_srv.join(timeout=3)
+        # a brand-new connection lands on the successor via the SAME fd
+        with _connect(ep) as c:
+            c.sendall(_frame(7, b"Echo", b"post-swap"))
+            metas = _read_frames(c, 1)
+            assert metas[7].error_code == 0
+        assert new_svc.calls == [b"post-swap"]
+        assert old_svc.calls == []
+    finally:
+        old_svc.release_parked()
+        inflight_conn.close()
+        _teardown(old_srv)
+        if new_srv is not None:
+            _teardown(new_srv)
+
+
+def test_hot_restart_native_sharded_listeners(tmp_path):
+    """Native engine flavor: the predecessor exports its primary +
+    SO_REUSEPORT shard listeners; the successor's engine adopts them
+    (listener_fds non-empty, same port served)."""
+    require_native()
+    handoff = str(tmp_path / "handoff-native.sock")
+    old_srv, old_svc = _server(native=True)
+    ep = old_srv.listen_endpoint
+    try:
+        assert old_srv._native_bridge is not None
+        fds = old_srv._native_bridge.engine.listener_fds()
+        assert fds, "engine exports no listener fds"
+        t = threading.Thread(target=old_srv.export_listeners,
+                             args=(handoff, 10.0), daemon=True)
+        t.start()
+        time.sleep(0.05)
+        opts = ServerOptions()
+        opts.native = True
+        opts.usercode_inline = True
+        opts.native_loops = 1
+        new_svc = OpSvc()
+        new_srv = Server(opts)
+        new_srv.add_service(new_svc, name="OP")
+        assert new_srv.start(f"127.0.0.1:{ep.port}",
+                             inherit_from=handoff) == 0
+        t.join(timeout=5)
+        assert old_srv.drain(grace_ms=2000) == 0
+        old_srv.stop()
+        with _connect(ep) as c:
+            c.sendall(_frame(9, b"Echo", b"native-swap"))
+            metas = _read_frames(c, 1)
+            assert metas[9].error_code == 0
+        assert new_svc.calls == [b"native-swap"]
+    finally:
+        _teardown(old_srv)
+        try:
+            _teardown(new_srv)
+        except NameError:
+            pass

@@ -22,7 +22,7 @@ ENGINE_FB_REASONS = (
     "http_slim_off", "http_malformed_line", "http_version",
     "http_no_route", "http_expect", "http_upgrade", "http_connection",
     "http_transfer_encoding", "http_bad_header", "http_large_body",
-    "http_chunk_stream",
+    "http_chunk_stream", "http_lame_duck",
 )
 
 # client demux lane reasons — must equal engine.cpp kCliFbNames

@@ -214,6 +214,50 @@ def test_drift_blocking_call_in_shm_sweep():
                for f in findings), findings
 
 
+def test_drift_sleep_in_drain_path():
+    """ISSUE-12 surface: Server.drain is deadline-bounded by contract
+    and entry-listed in the blocking pass — a time.sleep seeded into
+    it must be flagged."""
+    SERVER = "brpc_tpu/server/server.py"
+    ov = _mutate(SERVER, "        self.unpublish()\n"
+                 "        if self._acceptor is not None:\n"
+                 "            self._acceptor.pause_accept()",
+                 "        self.unpublish()\n"
+                 "        _time.sleep(0.5)\n"
+                 "        if self._acceptor is not None:\n"
+                 "            self._acceptor.pause_accept()")
+    ov[SERVER] = ov[SERVER].replace("import time as _time",
+                                    "import time\nimport time as _time",
+                                    1)
+    ov[SERVER] = ov[SERVER].replace("_time.sleep", "time.sleep")
+    findings = check_blocking(Tree(overrides=ov))
+    assert any("sleep" in f.message and "drain" in f.message
+               for f in findings), findings
+
+
+def test_drift_untimed_wait_in_shm_drain_settle():
+    """ISSUE-12 surface: the shm settle wait must stay bounded by the
+    drain grace — dropping the timeout must be flagged."""
+    SHM = "brpc_tpu/transport/shm_ring.py"
+    ov = _mutate(SHM, "        ev.wait(0.005)     # timed: the drain "
+                 "path stays deadline-bound",
+                 "        ev.wait()")
+    findings = check_blocking(Tree(overrides=ov))
+    assert any(".wait()" in f.message and "drain_settle" in f.message
+               for f in findings), findings
+
+
+def test_drift_lame_duck_reason_renamed():
+    """ISSUE-12 surface: the http_lame_duck fallback reason is part of
+    the closed engine↔bridge name-table contract — renaming one side
+    must be flagged."""
+    ov = _mutate(ENGINE, '"http_chunk_stream",  "http_lame_duck",',
+                 '"http_chunk_stream",  "http_lameduck2",')
+    findings = check_contracts(Tree(overrides=ov))
+    assert any("http_lame" in f.message or "kFbNames" in f.message
+               for f in findings), findings
+
+
 def test_allow_marker_suppresses():
     """The reviewed-exception escape hatch works (and is line-scoped)."""
     ov = _mutate(
