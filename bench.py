@@ -699,6 +699,293 @@ def bench_streaming(extra: dict) -> None:
         srv.stop()
 
 
+def _stream_count_child(addr: str, n: int, q) -> None:
+    """Subprocess client for the stream A/B: opens ``n`` sessions,
+    counts every received token chunk, and reports (tokens, seconds)
+    measured first-chunk → all-streams-closed.  A separate PROCESS so
+    the client's Python chunk parsing does not share the server
+    pusher's GIL (in-process the two arms compress into each other)."""
+    import os
+    import time as _t
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from brpc_tpu.client import Channel, Controller
+    from brpc_tpu.streaming import StreamOptions, stream_create
+
+    import threading as _th
+
+    got = [0]
+    first = []
+    lock = _th.Lock()       # deliver callbacks run on several runtime
+                            # threads; a bare += would lose increments
+
+    def on_recv(s, msgs):
+        with lock:
+            if not first:
+                first.append(_t.perf_counter())
+            got[0] += len(msgs)
+
+    chans = []
+    for _ in range(4):
+        ch = Channel()
+        ch.init(addr)
+        chans.append(ch)
+    streams = []
+    try:
+        for i in range(n):
+            cntl = Controller()
+            cntl.timeout_ms = 30_000
+            st = stream_create(cntl,
+                               StreamOptions(on_received=on_recv))
+            c = chans[i % len(chans)].call_method("PS.Open", b"",
+                                                  cntl=cntl)
+            if c.failed:
+                q.put(("error", c.error_text))
+                return
+            if not st.wait_established(15):
+                q.put(("error", "establish timeout"))
+                return
+            streams.append(st)
+    except Exception as e:
+        q.put(("error", f"{type(e).__name__}: {e}"))
+        return
+    q.put(("ready", None))
+    deadline = _t.time() + 90
+    while any(not s.closed for s in streams) and _t.time() < deadline:
+        _t.sleep(0.02)
+    end = _t.perf_counter()
+    dt = (end - first[0]) if first else 0.0
+    q.put(("done", (got[0], dt)))
+
+
+def bench_decode_stream(extra: dict) -> None:
+    """Kind-5 streaming lane + continuous-batching LLM decode.
+
+    Two halves:
+
+    - ``stream_native_vs_py``: PAIRED interleaved A/B of the stream
+      TRANSPORT at c=64 sessions — a server-side pusher emits one
+      token-sized chunk per session per step (the decode service's
+      write shape: native arm batch-writes the step through
+      ``stream_write_many`` → one coalesced writev per conn; Python
+      arm pays per-chunk ``Stream.write``).  Arms alternate per round
+      on the SAME server via the live lane flag, so the ratio is
+      phase-immune.
+    - ``stream_tokens_per_s`` / ``stream_ttft_p99_ms`` /
+      ``decode_stream_sessions``: the real LMService ``Decode`` path —
+      64 concurrent sessions riding the continuous batcher, aggregate
+      tokens/s and time-to-first-token p99 measured end-to-end.
+    """
+    import struct as _struct
+    import threading
+
+    from brpc_tpu.butil.flags import set_flag
+    from brpc_tpu.client import Channel, Controller
+    from brpc_tpu.server import Server, ServerOptions, Service
+    from brpc_tpu.streaming import (StreamOptions, stream_accept,
+                                    stream_create)
+
+    C = 64                              # concurrent decode sessions
+
+    # ---- transport A/B: synthetic token pusher ------------------------
+    class Push(Service):
+        def __init__(self):
+            self.streams = []
+
+        def Open(self, cntl, request):
+            s = stream_accept(cntl, StreamOptions(write_timeout_s=5.0))
+            assert s is not None
+            self.streams.append(s)
+            return b"ok"
+
+    opts = ServerOptions()
+    opts.native = True
+    opts.usercode_inline = True
+    srv = Server(opts)
+    svc = Push()
+    srv.add_service(svc, name="PS")
+    assert srv.start("127.0.0.1:0") == 0
+    engine = srv._native_bridge.engine
+    tok = _struct.pack("<i", 7)
+
+    def push_window(server_streams, seconds):
+        """Emit one token per session per step until the window ends;
+        returns steps emitted.  Native streams batch through the
+        engine (ONE coalesced call per step); Python ones pay
+        per-chunk writes — exactly the two transports under
+        measurement."""
+        t_end = time.perf_counter() + seconds
+        steps = 0
+        native = [s for s in server_streams if s._native_tx is not None]
+        pys = [s for s in server_streams if s._native_tx is None]
+        items = [(s.id, tok) for s in native]
+        while time.perf_counter() < t_end:
+            if items:
+                # batch-bounded credit wait: stalled/dead sessions fail
+                # fast instead of eating the window
+                engine.stream_write_many(items, 1000)
+            if pys:
+                # drop a failed session from the loop (its write just
+                # burned its timeout) — re-writing it every step would
+                # stall the whole py arm and corrupt the gated ratio;
+                # dropping ONLY it keeps the rest of the step honest
+                pys = [s for s in pys if s.write(tok) == 0]
+            steps += 1
+        return steps
+
+    def run_arm(native_on, nprocs=4):
+        """One arm: C sessions split over ``nprocs`` CLIENT PROCESSES
+        (a single client process's chunk parsing caps near the py
+        arm's rate and would mask the native lane's headroom), server
+        pushes one window, aggregate rate = Σtokens / max(dt)."""
+        set_flag("rpc_native_stream_lane", bool(native_on))
+        ctx = mp.get_context("spawn")
+        per = C // nprocs
+        procs = []
+        try:
+            for _ in range(nprocs):
+                q = ctx.Queue()
+                p = ctx.Process(target=_stream_count_child,
+                                args=(str(srv.listen_endpoint), per, q))
+                p.start()
+                procs.append((p, q))
+            for _p, q in procs:
+                tag, info = q.get(timeout=120)
+                assert tag == "ready", (tag, info)
+            mine = svc.streams[-C:]
+            want_native = bool(native_on)
+            assert all((s._native_tx is not None) == want_native
+                       for s in mine)
+            push_window(mine, 0.15)               # warm the pipe
+            push_window(mine, 1.0)                # the measured window
+            for s in mine:
+                s.close()
+            toks = 0
+            dt = 0.0
+            for _p, q in procs:
+                tag, (t, d) = q.get(timeout=120)
+                assert tag == "done", tag
+                toks += t
+                dt = max(dt, d)
+            return toks / dt if dt > 0 else 0.0
+        finally:
+            for p, _q in procs:
+                p.join(15)
+                if p.is_alive():
+                    p.kill()
+                    p.join(10)
+
+    try:
+        ratios = []
+        a_best = b_best = 0.0
+        for r in range(4):               # interleaved, alternating order
+            if r % 2 == 0:
+                a = run_arm(True)
+                b = run_arm(False)
+            else:
+                b = run_arm(False)
+                a = run_arm(True)
+            a_best = max(a_best, a)
+            b_best = max(b_best, b)
+            ratios.append(a / b if b > 0 else 0.0)
+        ratios.sort()
+        extra["stream_native_tokens_per_s"] = round(a_best, 1)
+        extra["stream_py_tokens_per_s"] = round(b_best, 1)
+        extra["stream_native_vs_py"] = round(ratios[len(ratios) // 2], 2)
+    finally:
+        set_flag("rpc_native_stream_lane", True)
+        srv.stop()
+
+    # ---- end-to-end: continuous-batching LM decode at c=64 ------------
+    import numpy as np
+
+    from brpc_tpu.models.lm_service import (LMService,
+                                            pack_generate_request)
+    from brpc_tpu.models.transformer_lm import LMConfig
+
+    cfg = LMConfig(vocab=256, dim=64, heads=4, depth=2, max_seq=96,
+                   remat=False)
+    opts2 = ServerOptions()
+    opts2.native = True
+    opts2.usercode_inline = True
+    srv2 = Server(opts2)
+    lm = LMService(cfg=cfg, decode_slots=C)
+    srv2.add_service(lm, name="LM")
+    assert srv2.start("127.0.0.1:0") == 0
+    MAX_NEW = 24
+    prompt = np.arange(8, dtype=np.int32)[None, :] % cfg.vocab
+    try:
+        chans = []
+        for _ in range(4):
+            ch = Channel()
+            ch.init(str(srv2.listen_endpoint))
+            chans.append(ch)
+
+        def warm():
+            done = threading.Event()
+            cntl = Controller()
+            cntl.timeout_ms = 120_000
+            st = stream_create(cntl, StreamOptions(
+                on_closed=lambda s: done.set()))
+            c = chans[0].call_method(
+                "LM.Decode", pack_generate_request(prompt, MAX_NEW),
+                cntl=cntl)
+            assert not c.failed, c.error_text
+            assert done.wait(120)
+
+        warm()                           # compile prefill + step once
+
+        ttfts = []
+        counts = [0] * C
+        closed = [threading.Event() for _ in range(C)]
+        lock = threading.Lock()
+
+        def one(i):
+            first = []
+            t_start = time.perf_counter()
+
+            def on_recv(s, msgs, _i=i, _first=first, _t=t_start):
+                if not _first:
+                    _first.append(time.perf_counter() - _t)
+                counts[_i] += len(msgs)
+
+            cntl = Controller()
+            cntl.timeout_ms = 120_000
+            st = stream_create(cntl, StreamOptions(
+                on_received=on_recv,
+                on_closed=lambda s, _i=i: closed[_i].set()))
+            c = chans[i % len(chans)].call_method(
+                "LM.Decode", pack_generate_request(prompt, MAX_NEW),
+                cntl=cntl)
+            if c.failed:
+                closed[i].set()
+                return
+            if closed[i].wait(180) and first:
+                with lock:
+                    ttfts.append(first[0])
+
+        t0 = time.perf_counter()
+        threads = [threading.Thread(target=one, args=(i,))
+                   for i in range(C)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(240)
+        dt = time.perf_counter() - t0
+        total = sum(counts)
+        extra["decode_stream_sessions"] = int(
+            sum(1 for e in closed if e.is_set()))
+        if dt > 0 and total:
+            extra["stream_tokens_per_s"] = round(total / dt, 1)
+        if ttfts:
+            ttfts.sort()
+            extra["stream_ttft_p99_ms"] = round(
+                ttfts[min(len(ttfts) - 1,
+                          int(len(ttfts) * 0.99))] * 1e3, 2)
+    finally:
+        srv2.stop()
+
+
 def bench_fanout(extra: dict) -> None:
     """ParallelChannel over 3 sub-servers.  Primary keys use the
     framework's intended partition-serving shape — raw echo parts on
@@ -2296,6 +2583,7 @@ def main() -> None:
     for name, fn in (("loop_scaling", bench_loop_scaling),
                      ("data_plane", bench_data_plane),
                      ("streaming", bench_streaming),
+                     ("decode_stream", bench_decode_stream),
                      ("fanout", bench_fanout),
                      ("http", bench_http),
                      ("trace", bench_trace),

@@ -15,10 +15,13 @@ shape (batch, max_new).
 from __future__ import annotations
 
 import struct
+import threading
+from collections import deque
 from typing import Optional
 
 import numpy as np
 
+from ..butil.logging_util import LOG
 from ..butil.status import Errno
 from ..server.service import Service
 from .transformer_lm import LMConfig, init_params
@@ -35,11 +38,311 @@ def unpack_generated(data: bytes) -> np.ndarray:
     return np.frombuffer(data, dtype=np.int32, offset=8).reshape(b, n)
 
 
+def unpack_token(chunk) -> int:
+    """One streamed decode token (the ``Decode`` chunk wire format:
+    int32 little-endian per token per step)."""
+    (tok,) = struct.unpack("<i", bytes(chunk))
+    return tok
+
+
+class _Session:
+    __slots__ = ("stream", "prompt", "max_new", "sent", "slot")
+
+    def __init__(self, stream, prompt: np.ndarray, max_new: int):
+        self.stream = stream
+        self.prompt = prompt
+        self.max_new = max_new
+        self.sent = 0
+        self.slot = -1
+
+
+class ContinuousBatcher:
+    """Continuous-batching decode engine: ONE decode-step loop over a
+    fixed pool of session slots.  Per step, every live session advances
+    one token and the tokens stream back per session (int32 chunks on
+    each session's server stream); NEW sessions are admitted into free
+    slots BETWEEN steps (bucketed prefill at batch 1, caches copied
+    into the slot, first token emitted by the very next step — that
+    write is the time-to-first-token); finished or broken sessions
+    evict and free their slot, the stream closing with a NAMED reason.
+
+    This is the fabric-lib serving shape (PAPERS.md): the transport —
+    the engine's kind-5 stream lane — batch-writes one step's worth of
+    tokens across ALL sessions as one coalesced call, so per-token
+    transport cost amortizes exactly like per-token compute does.
+
+    The loop runs on one daemon thread, started lazily at the first
+    join and exiting after ``idle_linger_s`` with nothing to serve.
+    """
+
+    def __init__(self, cfg: LMConfig, params, slots: int = 8,
+                 idle_linger_s: float = 5.0):
+        self.cfg = cfg
+        self.params = params
+        self.slots = int(slots)
+        self.idle_linger_s = idle_linger_s
+        # the HEAVY half (jit wrappers + the device KV-pool allocation)
+        # is deferred to the batcher thread's first iteration: the
+        # first Decode call runs on an engine loop thread inside the
+        # batched GIL entry, and allocating a serving-sized pool there
+        # would stall every connection the loop owns
+        self._prefill = None
+        self._step = None
+        self._insert = None
+        self._cache = None
+        self._tokens = np.zeros((self.slots,), np.int32)
+        self._active = np.zeros((self.slots,), bool)
+        self._sessions = {}                       # slot -> _Session
+        self._pending: deque = deque()
+        self._lock = threading.Lock()
+        self._wake = threading.Event()
+        self._thread = None
+        self._steps = 0                           # decode steps run
+
+    # -- public -----------------------------------------------------------
+
+    def join(self, stream, prompt: np.ndarray, max_new: int) -> None:
+        """Queue a session; it enters the live batch between steps."""
+        sess = _Session(stream, np.ascontiguousarray(prompt, np.int32),
+                        int(max_new))
+        with self._lock:
+            self._pending.append(sess)
+            if self._thread is None:
+                self._thread = threading.Thread(
+                    target=self._run, name="lm-decode-batcher",
+                    daemon=True)
+                self._thread.start()
+        self._wake.set()
+
+    def live_slots(self) -> int:
+        with self._lock:
+            return len(self._sessions)
+
+    def steps_run(self) -> int:
+        return self._steps
+
+    # -- internals (batcher thread only past the pending handoff) ---------
+
+    def _ensure_engine(self) -> None:
+        """Build the compiled programs + device KV pool, ON the batcher
+        thread (see __init__: the constructor must stay cheap enough to
+        run inside an engine loop's batched GIL entry)."""
+        if self._prefill is not None and self._cache is not None:
+            return
+        import functools
+
+        import jax
+
+        from .transformer_lm import empty_batch_cache, make_batch_decode
+
+        if self._prefill is None:
+            prefill, step = make_batch_decode(self.cfg)
+            self._prefill = jax.jit(functools.partial(prefill,
+                                                      self.params))
+            self._step = jax.jit(functools.partial(step, self.params),
+                                 donate_argnums=(0,))
+
+            # jitted slot insert with the pool cache DONATED: an eager
+            # .at[].set chain would copy the whole (slots, max_seq, ...)
+            # pool 2*depth+1 times per join, stalling every live
+            # session between steps in proportion to pool size
+            cfg = self.cfg
+
+            def _insert(cache, cache1, slot, ctx_len):
+                import jax.lax as lax
+                cache = dict(cache)
+                for i in range(cfg.depth):
+                    cache[f"k{i}"] = lax.dynamic_update_slice(
+                        cache[f"k{i}"], cache1[f"k{i}"],
+                        (slot, 0, 0, 0))
+                    cache[f"v{i}"] = lax.dynamic_update_slice(
+                        cache[f"v{i}"], cache1[f"v{i}"],
+                        (slot, 0, 0, 0))
+                cache["len"] = lax.dynamic_update_slice(
+                    cache["len"], ctx_len[None], (slot,))
+                return cache
+
+            self._insert = jax.jit(_insert, donate_argnums=(0,))
+        if self._cache is None:
+            self._cache = empty_batch_cache(self.cfg, self.slots)
+
+    # credit wait bound for one step's token writes: a healthy client
+    # holds megabytes of window credit per 4-byte token, so a stream
+    # that cannot take one token within this is STALLED — and the
+    # batcher must never let one stalled client head-of-line-block the
+    # whole live batch behind a long write timeout
+    EMIT_TIMEOUT_MS = 200
+
+    def _emit(self, pairs) -> list:
+        """Write one step's tokens — native-lane streams in ONE
+        coalesced engine call per engine (one writev per connection),
+        Python-lane ones individually.  Credit waits are bounded by
+        EMIT_TIMEOUT_MS so a stalled session costs the batch one short
+        stall ONCE and is then evicted — continuous batching must not
+        head-of-line-block every live session on one dead client.
+        Returns sessions to evict (stream gone or out of credit)."""
+        dead = []
+        by_engine = {}                 # id(engine) -> (engine, items)
+        for sess, tok in pairs:
+            s = sess.stream
+            if s.closed:
+                dead.append((sess, None))
+                continue
+            data = struct.pack("<i", tok)
+            eng = s._native_tx
+            if eng is not None:
+                # sessions may span servers (multiple engines): group
+                # per engine — a sid is only resolvable by its own
+                by_engine.setdefault(id(eng), (eng, []))[1].append(
+                    (sess, s.id, data))
+            else:
+                prev = s.options.write_timeout_s
+                s.options.write_timeout_s = self.EMIT_TIMEOUT_MS / 1e3
+                try:
+                    rc = s.write(data)
+                finally:
+                    s.options.write_timeout_s = prev
+                if rc != 0:
+                    dead.append((sess, "backpressure" if rc == int(
+                        Errno.EOVERCROWDED) else None))
+        for eng, items in by_engine.values():
+            sts = eng.stream_write_many(
+                [(sid, data) for _sess, sid, data in items],
+                self.EMIT_TIMEOUT_MS)
+            for (sess, _sid, _data), st in zip(items, sts):
+                if st == -1:
+                    dead.append((sess, "backpressure"))
+                elif st == -2:
+                    dead.append((sess, None))
+        return dead
+
+    def _admit(self, sess: _Session) -> None:
+        # Prefill the prompt CONTEXT (all but the last token), padded
+        # to a power-of-two bucket so distinct prompt lengths share
+        # compiled programs — an unbucketed per-length jit would stall
+        # EVERY live session for a fresh XLA compile at each new
+        # length.  The prompt's LAST token then rides the next batch
+        # step (teacher-forced equivalence: step logits at pos s-1 ==
+        # full-prefill last-position logits), which both yields the
+        # first generated token and overwrites the padded garbage rows
+        # before the mask ever admits them.
+        free = next(i for i in range(self.slots) if not self._active[i])
+        ctx = sess.prompt[:-1]
+        bucket = 1
+        while bucket < max(len(ctx), 1):
+            bucket <<= 1
+        bucket = min(bucket, self.cfg.max_seq)
+        padded = np.zeros((bucket,), np.int32)
+        padded[:len(ctx)] = ctx
+        cache1, _logits = self._prefill(padded[None, :])
+        import jax.numpy as jnp
+        self._cache = self._insert(self._cache, cache1,
+                                   jnp.int32(free),
+                                   jnp.int32(len(ctx)))
+        self._tokens[free] = int(sess.prompt[-1])
+        self._active[free] = True
+        sess.slot = free
+        sess.sent = 0            # first token leaves on the next step
+        self._sessions[free] = sess
+
+    def _evict(self, sess: _Session, reason: Optional[str]) -> None:
+        self._sessions.pop(sess.slot, None)
+        self._active[sess.slot] = False
+        if not sess.stream.closed:
+            sess.stream.close(reason=reason or "finished")
+
+    def _run(self) -> None:
+        import jax.numpy as jnp
+        try:
+            self._ensure_engine()
+            while True:
+                with self._lock:
+                    pending = []
+                    while self._pending and \
+                            len(self._sessions) + len(pending) \
+                            < self.slots:
+                        pending.append(self._pending.popleft())
+                    idle = not self._sessions and not pending \
+                        and not self._pending
+                if idle:
+                    self._wake.clear()
+                    # re-check AFTER the clear: a join landing between
+                    # the idle check and the clear set the event we
+                    # just cleared — its session must not wait out the
+                    # whole linger for its first token
+                    with self._lock:
+                        if self._pending:
+                            continue
+                    if not self._wake.wait(self.idle_linger_s):
+                        with self._lock:
+                            if not self._pending \
+                                    and not self._sessions:
+                                self._thread = None
+                                return
+                    continue
+                for sess in pending:
+                    # join-mid-batch: bucketed prefill + slot insert,
+                    # BETWEEN steps (bucketing keeps a fresh prompt
+                    # length from stalling live sessions on an XLA
+                    # compile; the next step emits the first token)
+                    self._admit(sess)
+                if not self._sessions:
+                    continue
+                cache, logits = self._step(
+                    self._cache, jnp.asarray(self._tokens),
+                    jnp.asarray(self._active))
+                self._cache = cache
+                self._steps += 1
+                toks = np.asarray(jnp.argmax(logits, axis=-1))
+                pairs = []
+                finished = []
+                for slot, sess in list(self._sessions.items()):
+                    tok = int(toks[slot])
+                    self._tokens[slot] = tok
+                    sess.sent += 1
+                    pairs.append((sess, tok))
+                    if sess.sent >= sess.max_new:
+                        finished.append(sess)
+                for sess, reason in self._emit(pairs):
+                    self._evict(sess, reason)
+                for sess in finished:
+                    if sess.slot in self._sessions:
+                        self._evict(sess, "finished")
+        except Exception:
+            LOG.exception("continuous batcher crashed; closing "
+                          "sessions")
+            with self._lock:
+                sessions = list(self._sessions.values()) \
+                    + list(self._pending)
+                self._sessions.clear()
+                self._pending.clear()
+                # free every slot: a leaked _active bit would make the
+                # next incarnation's _admit run out of slots forever
+                self._active[:] = False
+                self._tokens[:] = 0
+                # the crashed _step DONATED self._cache — on donating
+                # backends those buffers are gone; drop the pool so
+                # the next incarnation's _ensure_engine rebuilds it.
+                # State reset (incl. _thread) happens BEFORE any
+                # fallible allocation: a rebuild failure under the
+                # same pressure must not wedge join() forever.
+                self._cache = None
+                self._thread = None
+            for sess in sessions:
+                try:
+                    sess.stream.close(reason="decode_error")
+                except Exception:
+                    pass
+
+
 class LMService(Service):
-    """``Generate`` — greedy completion; ``Info`` — model config JSON."""
+    """``Generate`` — greedy completion; ``Decode`` — server-streaming
+    completion with continuous batching (one token chunk per step per
+    session); ``Info`` — model config JSON."""
 
     def __init__(self, cfg: Optional[LMConfig] = None, params=None,
-                 max_new_cap: int = 128, quantize: bool = False):
+                 max_new_cap: int = 128, quantize: bool = False,
+                 decode_slots: int = 8):
         import jax
 
         self.cfg = cfg or LMConfig(vocab=256, dim=64, heads=4, depth=2,
@@ -62,6 +365,19 @@ class LMService(Service):
         # (batch, prompt_len, bucketed max_new) and are reused.
         from .transformer_lm import make_scan_generator
         self._gen = make_scan_generator(self.cfg, self.params)
+        # continuous-batching decode engine, built lazily at the first
+        # Decode call (Generate-only deployments never pay the batch
+        # step compile).  scan_layers configs serve Generate only.
+        self.decode_slots = int(decode_slots)
+        self._batcher: Optional[ContinuousBatcher] = None
+        self._batcher_lock = threading.Lock()
+
+    def batcher(self) -> ContinuousBatcher:
+        with self._batcher_lock:
+            if self._batcher is None:
+                self._batcher = ContinuousBatcher(
+                    self.cfg, self.params, slots=self.decode_slots)
+            return self._batcher
 
     def Generate(self, cntl, request):
         try:
@@ -97,6 +413,54 @@ class LMService(Service):
         out = np.asarray(self._gen(prompt, int(bucket)),
                          dtype=np.int32)[:, :max_new]
         return struct.pack("<II", *out.shape) + out.tobytes()
+
+    def Decode(self, cntl, request):
+        """Server-streaming decode: same request wire format as
+        ``Generate`` at batch 1, but the caller attaches a stream
+        (``stream_create`` before the call) and tokens arrive as int32
+        chunks — one per decode step — while the session rides the
+        continuous batch (new sessions join between steps, finished
+        ones evict; the stream closes with reason ``finished``).  The
+        unary response is ``<u32 max_new>`` (the token count the
+        stream will carry)."""
+        from ..streaming import StreamOptions, stream_accept
+
+        try:
+            b, s, max_new = struct.unpack_from("<III", request)
+            prompt = np.frombuffer(request, dtype=np.int32,
+                                   offset=12).reshape(b, s)
+        except (struct.error, ValueError) as e:
+            cntl.set_failed(Errno.EREQUEST, f"bad decode request: {e}")
+            return None
+        if b != 1 or s == 0:
+            cntl.set_failed(Errno.EREQUEST,
+                            "Decode streams one session per call")
+            return None
+        if max_new <= 0 or max_new > self.max_new_cap:
+            cntl.set_failed(Errno.EREQUEST,
+                            f"max_new must be in [1, {self.max_new_cap}]")
+            return None
+        if s + max_new > self.cfg.max_seq:
+            cntl.set_failed(
+                Errno.EREQUEST,
+                f"prompt {s} + max_new {max_new} exceeds max_seq "
+                f"{self.cfg.max_seq}")
+            return None
+        if (prompt < 0).any() or (prompt >= self.cfg.vocab).any():
+            cntl.set_failed(Errno.EREQUEST, "prompt ids out of vocab")
+            return None
+        if self.cfg.scan_layers:
+            cntl.set_failed(Errno.EREQUEST,
+                            "Decode serves unrolled configs only")
+            return None
+        stream = stream_accept(cntl, StreamOptions())
+        if stream is None:
+            cntl.set_failed(Errno.EREQUEST,
+                            "Decode requires a client stream "
+                            "(stream_create before the call)")
+            return None
+        self.batcher().join(stream, prompt[0].copy(), int(max_new))
+        return struct.pack("<I", max_new)
 
     def Info(self, cntl, request):
         import json

@@ -389,6 +389,119 @@ def empty_cache(cfg: LMConfig, batch: int, start_len: int = 1):
     return cache
 
 
+def _rope_at_vec(x, pos, head_dim: int):
+    """Rotary embedding at PER-ELEMENT positions — the continuous-
+    batching variant of :func:`_rope_at`: ``x`` is (b, 1, heads, hd)
+    and ``pos`` is a (b,) vector, so every batch slot rotates at its
+    own sequence position (sessions in one batch sit at different
+    depths).  Same math, same single home for the rotation."""
+    import jax.numpy as jnp
+    half = head_dim // 2
+    freq = jnp.exp(-math.log(10000.0)
+                   * jnp.arange(half, dtype=jnp.float32) / half)
+    ang = pos.astype(jnp.float32)[:, None, None, None] \
+        * freq[None, None, None, :]
+    return _rope(x, jnp.sin(ang), jnp.cos(ang))
+
+
+def make_batch_decode(cfg: LMConfig):
+    """Continuous-batching decode: one compiled step over a FIXED pool
+    of session slots, each at its OWN position — the serving shape
+    where new sessions join the live batch between steps and finished
+    ones evict (the streaming LM service's engine).
+
+    Returns ``(prefill, step)``:
+      - ``prefill`` is :func:`make_decode`'s prompt pass, run per
+        joining session at batch 1 — the batcher copies the resulting
+        per-layer caches into the session's slot;
+      - ``step(params, cache, token[b], active[b]) -> (cache, logits)``
+        advances every ACTIVE slot one token.  ``cache["len"]`` is a
+        per-slot (b,) int32 position vector (vs the scalar in
+        :func:`make_decode`); inactive slots are position-clamped and
+        never advance, and their logits are garbage by contract.
+
+    Per-element math is independent (attention never crosses the batch
+    axis), so an active slot's tokens are identical with a solo
+    :func:`make_decode` run of the same session.  Unrolled dense/MoE
+    blocks only — ``scan_layers`` serving should batch per-depth
+    shards instead."""
+    import jax
+    import jax.numpy as jnp
+
+    hd = cfg.dim // cfg.heads
+    if cfg.scan_layers:
+        raise NotImplementedError(
+            "batch decode supports unrolled layers only — scan_layers "
+            "serving uses make_decode per shard")
+    if cfg.moe_experts > 0:
+        from .moe import forward_grouped as moe_forward
+        moe_cfg = cfg.moe_cfg()
+
+    from ..ops.quant import qmatmul
+
+    def mlp(bp, h):
+        if cfg.moe_experts > 0:
+            out, _ = moe_forward(bp["moe"], h, moe_cfg)
+            return out
+        up = qmatmul(h, bp["w1"])
+        return qmatmul(jax.nn.gelu(up), bp["w2"])
+
+    def decode_layer(bp, x, kc, vc, pos):
+        """One block, one token per slot, per-slot positions."""
+        b = x.shape[0]
+        h = _rmsnorm(x, bp["ln1"])
+        qkv = qmatmul(h, bp["wqkv"])
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        shp = (b, 1, cfg.heads, hd)
+        q = _rope_at_vec(q.reshape(shp), pos, hd)
+        k = _rope_at_vec(k.reshape(shp), pos, hd)
+        v = v.reshape(shp)
+
+        def upd(cache_b, new_b, pos_b):
+            return jax.lax.dynamic_update_slice(cache_b, new_b,
+                                                (pos_b, 0, 0))
+
+        kc = jax.vmap(upd)(kc, k, pos)
+        vc = jax.vmap(upd)(vc, v, pos)
+        s_mat = jnp.einsum("bqhd,bkhd->bhqk", q, kc,
+                           preferred_element_type=jnp.float32
+                           ) / (hd ** 0.5)
+        live = jnp.arange(cfg.max_seq)[None, :] <= pos[:, None]
+        s_mat = jnp.where(live[:, None, None, :], s_mat, -1e30)
+        p = jax.nn.softmax(s_mat, axis=-1)
+        att = jnp.einsum("bhqk,bkhd->bqhd", p, vc,
+                         preferred_element_type=jnp.float32)
+        x = x + qmatmul(att.reshape(b, 1, cfg.dim), bp["wo"])
+        x = x + mlp(bp, _rmsnorm(x, bp["ln2"]))
+        return x, kc, vc
+
+    def step(params, cache, token, active):
+        cache = dict(cache)
+        pos = jnp.minimum(cache["len"], cfg.max_seq - 1)
+        x = params["embed"][token][:, None, :]
+        for i in range(cfg.depth):
+            x, kc, vc = decode_layer(params[f"blk{i}"], x,
+                                     cache[f"k{i}"], cache[f"v{i}"],
+                                     pos)
+            cache[f"k{i}"], cache[f"v{i}"] = kc, vc
+        cache["len"] = jnp.where(active, cache["len"] + 1,
+                                 cache["len"])
+        return cache, qmatmul(x[:, 0], params["unembed"])
+
+    prefill, _ = make_decode(cfg)
+    return prefill, step
+
+
+def empty_batch_cache(cfg: LMConfig, slots: int):
+    """A fresh slot-pool KV cache for :func:`make_batch_decode` —
+    ``len`` is the per-slot position vector (all zero = every slot
+    free); layer layouts match :func:`empty_cache`'s unrolled form."""
+    import jax.numpy as jnp
+    cache = empty_cache(cfg, slots, start_len=1)
+    cache["len"] = jnp.zeros((slots,), jnp.int32)
+    return cache
+
+
 def make_decode_loop(cfg: LMConfig, steps: int):
     """Greedy generation as ONE compiled program: ``lax.scan`` feeds the
     argmax token back through ``decode_step`` for ``steps`` tokens, so a

@@ -25,9 +25,12 @@
 #include <arpa/inet.h>
 #include <atomic>
 #include <cerrno>
+#include <chrono>
+#include <condition_variable>
 #include <cstdint>
 #include <cstring>
 #include <deque>
+#include <memory>
 #include <mutex>
 #include <netinet/in.h>
 #include <netinet/tcp.h>
@@ -204,9 +207,12 @@ struct Hist {
   }
 };
 
-// server-lane index for the per-stage histograms
-enum Lane : int { LANE_RAW = 0, LANE_SLIM = 1, LANE_HTTP = 2, kLanes = 3 };
-static const char* kLaneNames[kLanes] = {"raw", "slim", "http"};
+// server-lane index for the per-stage histograms (LANE_STREAM is the
+// kind-5 stream-OPEN path: the unary call that negotiates a stream,
+// batched through flush_py_batch exactly like the kind-3 items)
+enum Lane : int { LANE_RAW = 0, LANE_SLIM = 1, LANE_HTTP = 2,
+                  LANE_STREAM = 3, kLanes = 4 };
+static const char* kLaneNames[kLanes] = {"raw", "slim", "http", "stream"};
 
 // Reason-coded fallbacks: every branch that routes a request OFF a
 // native lane (kind 2/3 tpu_std, kind 4 HTTP) and onto the classic
@@ -269,6 +275,39 @@ static const char* kRouteFbNames[kRouteFb] = {
     "http_transfer_encoding", "http_bad_header",
 };
 
+// Kind-5 streaming-lane fallbacks: every TSTR frame or stream-open
+// request that declines the native lane and rides the Python streaming
+// path instead lands in exactly one of these (closed enum — no
+// "unknown" bucket, same discipline as FbReason).  CONTRACT
+// (machine-checked): kStreamFbNames and the Python mirror
+// (server/stream_slim.STREAM_FB_NAMES) must track this enum
+// member-for-member — tools/check gates all three in tier-1.
+enum StreamFb : int {
+  SFB_NO_SHIM = 0,     // no kind-5 capability: stream shim never
+                       // registered (lane flag off, or the server has
+                       // no eligible unary methods)
+  SFB_NON_INLINE,      // server runs user code off the loop
+                       // (usercode_inline false): the open must ride
+                       // the fiber path, so the whole stream stays on
+                       // the Python lane
+  SFB_COMPRESSED,      // stream-open request carries the compress TLV:
+                       // only the classic path can decompress
+  SFB_CHUNK_OVERSIZE,  // TSTR frame (or open) too large for the burst
+                       // batch: the direct-read path delivers it to
+                       // the Python streaming lane whole
+  SFB_DRAIN,           // server draining: the classic path owns the
+                       // ELAMEDUCK rejection + lame-duck TLV
+  SFB_UNREGISTERED,    // TSTR frame for a stream the engine does not
+                       // own (pure-Python streams, closed streams,
+                       // forged ids) — the Python dispatch's
+                       // socket-binding guard arbitrates
+  SFB_REASONS
+};
+static const char* kStreamFbNames[SFB_REASONS] = {
+    "stream_no_shim",   "stream_non_inline",  "stream_compressed",
+    "stream_chunk_oversize", "stream_drain",  "stream_unregistered",
+};
+
 // Data-plane copy accounting: every place the engine COPIES payload
 // bytes between buffers (the wire recv/writev themselves are not
 // copies in this ledger — they are the transfer) increments a stage
@@ -293,12 +332,17 @@ constexpr size_t kDpFloor = 4096;
 
 struct LoopTelemetry {
   uint64_t fallbacks[FB_REASONS] = {};
+  uint64_t sfallbacks[SFB_REASONS] = {};  // kind-5 streaming lane
   uint64_t dp_copies[kDpStages] = {};
   uint64_t dp_copy_bytes[kDpStages] = {};
   Hist queue[kLanes];   // frame parse -> batched shim entry (us)
   Hist shim[kLanes];    // shim entry -> item complete (us)
   Hist resid[kLanes];   // frame parse -> response build done (us)
   Hist burst;           // batched items per flush_py_batch
+  Hist stream_burst;    // stream chunks per batched delivery entry
+  uint64_t stream_chunks_in = 0;   // DATA/CLOSE frames consumed natively
+  uint64_t stream_feedbacks = 0;   // credit feedback frames consumed
+                                   // natively (zero GIL entries)
   Hist wiov;            // iovs coalesced per writev in conn_flush
   uint64_t busy_ns = 0; // loop body time (callbacks, parsing, writes)
   uint64_t idle_ns = 0; // time blocked in epoll_wait (busy-poll spin
@@ -457,13 +501,41 @@ struct NativeMethod {
                                  // 3 = slim full-method dispatch
   std::string const_data;             // kind=1 response payload
   PyObject* handler = nullptr;        // kind=2/3 Python callable
+  // kind-5 STREAM-OPEN shim (server/stream_slim.py): a kind-3 method's
+  // stream-negotiating variant — requests carrying the stream TLVs
+  // dispatch here instead of `handler`, batched in the same burst
+  PyObject* stream_handler = nullptr;
   std::atomic<uint64_t> count{0};     // answered natively
   std::atomic<uint64_t> errors{0};    // EREQUEST answers (malformed att)
+  // kind-5 lane accounting (stream opens ride LANE_STREAM hists; the
+  // hist-count == handled+errors invariant holds per lane)
+  std::atomic<uint64_t> stream_opens{0};
+  std::atomic<uint64_t> stream_errors{0};
   // per-method fallback attribution (reasons where the method is
   // already resolved); atomics: several loops may hit one method
   std::atomic<uint64_t> fb_att_over_cap{0};
   std::atomic<uint64_t> fb_large_frame{0};
   std::atomic<uint64_t> fb_trace_raw{0};
+  std::atomic<uint64_t> fb_stream_open{0};  // opens declined to Python
+};
+
+// One kind-5 native stream: the engine owns the WRITE-side credit
+// window (produced vs the peer's consumption feedback, both accounted
+// here in C++ — the Python producer only ever blocks on `cv`) and
+// consumes inbound TSTR frames for `sid` natively.  Registered by the
+// stream-open shim after stream_accept; looked up per frame by the
+// owning loop; shared_ptr so an unregister/conn-close cannot free it
+// under a writer mid-wait.
+struct NativeStream {
+  uint64_t sid = 0;        // OUR stream id (inbound frames' dest)
+  uint64_t peer_sid = 0;   // peer's id (outbound frames' dest)
+  uint64_t conn_id = 0;    // pinned connection (forged-frame guard)
+  uint64_t window = 0;     // peer's advertised receive window (bytes)
+  std::mutex mu;
+  std::condition_variable cv;
+  uint64_t produced = 0;          // bytes written by our side
+  uint64_t remote_consumed = 0;   // peer feedback (absolute)
+  bool closed = false;
 };
 
 // An HTTP route the engine dispatches through the SLIM HTTP LANE
@@ -512,6 +584,12 @@ struct PyRawItem {
   // stage keys per-tenant fair admission off it (overload plane)
   const char* ten = nullptr;
   uint32_t ten_len = 0;
+  // kind-5 stream-open fields (stream_id != 0 selects the lane): the
+  // client's stream id (TLV 12) and its advertised receive window
+  // (TLV 14) — the shim accepts the stream, answers the grant in the
+  // response meta, and registers the stream with the engine
+  uint64_t stream_id = 0;
+  uint32_t stream_window = 0;
   // kind-4 slim-HTTP fields (hroute != nullptr selects the lane)
   HttpRoute* hroute = nullptr;
   const char* query = nullptr;  // bytes after '?' in the request target
@@ -529,6 +607,17 @@ struct PyRawItem {
   // telemetry: CLOCK_MONOTONIC ns at frame parse (comparable with
   // Python's time.monotonic_ns — the shims backdate rpcz spans with it)
   int64_t t_parse = 0;
+};
+
+// One inbound stream chunk (DATA/CLOSE/RST) bound for the batched
+// Python delivery: payload aims into the connection's inbuf and is
+// valid only until parse_frames returns — every exit path flushes the
+// stream batch alongside the PyRawItem batch.
+struct StreamItem {
+  uint64_t sid;          // OUR stream id (the frame's dest)
+  int flags;
+  const char* payload;
+  size_t len;
 };
 
 struct EngineImpl {
@@ -584,6 +673,28 @@ struct EngineImpl {
   // per-burst aggregated accounting (admitted counts, method samples)
   // instead of paying locked counters per item
   PyObject* burst_end = nullptr;
+  // ---- kind-5 streaming lane ----
+  // native stream table: OUR stream id -> stream state.  Mutated by
+  // GIL-holding Python threads (register/unregister) and conn_destroy;
+  // loops look frames up under the same short lock.  nstreams is the
+  // lock-free existence check on the per-frame hot path.
+  std::mutex smu;
+  std::unordered_map<uint64_t, std::shared_ptr<NativeStream>> streams;
+  std::atomic<size_t> nstreams{0};
+  // 0 = lane off (no capability), 1 = on, 2 = declined because the
+  // server runs user code off the loop (usercode_inline false) — the
+  // bridge sets it so the fallback reason names WHY, not just that
+  std::atomic<int> stream_mode{0};
+  // batched chunk delivery: ONE call per read burst with every
+  // DATA/CLOSE chunk of every stream on the loop —
+  // callable(list[(sid, flags, payload_bytes)])
+  PyObject* stream_chunks = nullptr;
+  // write-side counters (producers run on arbitrary Python threads,
+  // so these are engine-level atomics, unlike the per-loop counters)
+  std::atomic<uint64_t> s_chunks_out{0};
+  std::atomic<uint64_t> s_chunk_bytes_out{0};
+  std::atomic<uint64_t> s_credit_stalls{0};   // writes that had to wait
+  std::atomic<uint64_t> s_write_batches{0};   // stream_write_many calls
 };
 
 static int64_t now_ms() {
@@ -687,6 +798,25 @@ static void conn_destroy(EngineImpl* eng, Loop* lp, Conn* c, bool notify) {
   {
     std::lock_guard<std::mutex> g(eng->cmu);
     eng->by_id.erase(c->id);
+  }
+  if (eng->nstreams.load(std::memory_order_acquire) != 0) {
+    // kind-5 streams pinned to this conn: close (producers blocked on
+    // credit wake with -2) and drop from the table — the Python-side
+    // Stream teardown rides the EV_CLOSE socket release as before
+    std::lock_guard<std::mutex> g(eng->smu);
+    for (auto it = eng->streams.begin(); it != eng->streams.end();) {
+      if (it->second->conn_id == c->id) {
+        {
+          std::lock_guard<std::mutex> g2(it->second->mu);
+          it->second->closed = true;
+          it->second->cv.notify_all();
+        }
+        it = eng->streams.erase(it);
+      } else {
+        ++it;
+      }
+    }
+    eng->nstreams.store(eng->streams.size(), std::memory_order_release);
   }
   // free pending writes + in-flight message under the GIL
   PyGILState_STATE gs = PyGILState_Ensure();
@@ -805,6 +935,16 @@ struct MetaScan {
   // kinds ignore it — same lane contract as the deadline tag 13
   const char* ten = nullptr;
   uint32_t ten_len = 0;
+  // tags 12/14 (stream id / stream receive window): a stream-OPEN
+  // request — the kind-5 STREAM lane dispatches it to the method's
+  // stream shim; every other kind declines under a named StreamFb
+  // reason (the Python lane owns the open there)
+  uint64_t stream_id = 0;
+  uint32_t stream_window = 0;
+  // tag 2 (compress): scanned only so a compressed stream open gets
+  // its NAMED kind-5 reason — every lane still declines compressed
+  // requests to the classic path (only it can decompress)
+  bool compressed = false;
 };
 
 // Mirror of native_bridge._scan_request_meta: collect cid/att/svc/mth
@@ -828,6 +968,10 @@ static bool scan_request_meta(const char* p, size_t len, MetaScan* out) {
         if (ln != 8) return false;
         memcpy(&out->cid, p + off, 8);
         break;
+      case 2:
+        if (ln != 1) return false;
+        out->compressed = true;  // named screening only — every native
+        break;                   // kind still declines compressed frames
       case 3:
         if (ln != 4) return false;
         memcpy(&out->att, p + off, 4);
@@ -852,11 +996,19 @@ static bool scan_request_meta(const char* p, size_t len, MetaScan* out) {
         if (ln != 8) return false;
         memcpy(&out->parent_id, p + off, 8);
         break;
+      case 12:
+        if (ln != 8) return false;
+        memcpy(&out->stream_id, p + off, 8);   // stream open: kind-5
+        break;                                 // lane (or named decline)
       case 13:
         if (ln != 4) return false;
         memcpy(&out->timeout_ms, p + off, 4);  // remaining-deadline ms:
         out->timeout_present = true;
         break;              // safe for every lane; enforced by kind 3
+      case 14:
+        if (ln != 4) return false;
+        memcpy(&out->stream_window, p + off, 4);  // open handshake:
+        break;                                    // peer's recv window
       case 15:
         out->dom = p + off;
         out->dom_len = ln;
@@ -1254,6 +1406,131 @@ static void raw_slim_item(Loop* lp, Conn* c, PyRawItem& it) {
     it.m->count++;
 }
 
+// Run one kind-5 STREAM-OPEN item: call the method's stream shim
+// (server/stream_slim.py — the interceptor-chain binding) and build
+// the grant response natively.  Runs under the GIL, inside
+// flush_py_batch's single per-burst acquisition.
+//
+// Return contract with the shim:
+//   (payload, grant_meta_bytes)  success: grant TLVs (stream id +
+//                                window) appended to the response meta,
+//                                frame built natively
+//   bytes / memoryview           success without a stream grant (the
+//                                method declined to accept)
+//   None                         escalated to the classic completion
+static void stream_open_item(Loop* lp, Conn* c, PyRawItem& it) {
+  size_t plen = it.plen - it.att;
+  dp_copy(lp, DP_SHIM, plen);
+  dp_copy(lp, DP_SHIM, (size_t)it.att);
+  PyObject* r = nullptr;
+  PyObject* pb = PyBytes_FromStringAndSize(it.payload, plen);
+  PyObject* ab = nullptr;
+  if (pb && it.att)
+    ab = PyBytes_FromStringAndSize(it.payload + plen, it.att);
+  PyObject* cid = pb ? PyLong_FromUnsignedLongLong(it.cid) : nullptr;
+  PyObject* conn = cid ? PyLong_FromUnsignedLongLong(c->id) : nullptr;
+  PyObject* dom = it.dom_len
+      ? PyBytes_FromStringAndSize(it.dom, it.dom_len) : nullptr;
+  PyObject* nonce = it.conn_len
+      ? PyBytes_FromStringAndSize(it.conn, it.conn_len) : nullptr;
+  PyObject* rcv = conn
+      ? PyLong_FromLongLong((long long)it.t_parse) : nullptr;
+  PyObject* tr = nullptr;
+  if (it.trace_id)
+    tr = Py_BuildValue("(KKK)", (unsigned long long)it.trace_id,
+                       (unsigned long long)it.span_id,
+                       (unsigned long long)it.parent_id);
+  PyObject* tmo = it.timeout_present
+      ? PyLong_FromUnsignedLong(it.timeout_ms) : nullptr;
+  PyObject* ten = it.ten_len
+      ? PyBytes_FromStringAndSize(it.ten, it.ten_len) : nullptr;
+  PyObject* sid = rcv
+      ? PyLong_FromUnsignedLongLong(it.stream_id) : nullptr;
+  PyObject* swin = sid
+      ? PyLong_FromUnsignedLong(it.stream_window) : nullptr;
+  if (pb && (it.att == 0 || ab) && cid && conn && rcv && sid && swin
+      && (!it.timeout_present || tmo)
+      && (it.dom_len == 0 || dom) && (it.conn_len == 0 || nonce)
+      && (it.trace_id == 0 || tr) && (it.ten_len == 0 || ten))
+    r = PyObject_CallFunctionObjArgs(it.m->stream_handler, pb,
+                                     ab ? ab : Py_None, cid, conn,
+                                     dom ? dom : Py_None,
+                                     nonce ? nonce : Py_None,
+                                     rcv, tr ? tr : Py_None,
+                                     tmo ? tmo : Py_None,
+                                     ten ? ten : Py_None,
+                                     sid, swin, nullptr);
+  Py_XDECREF(pb);
+  Py_XDECREF(ab);
+  Py_XDECREF(cid);
+  Py_XDECREF(conn);
+  Py_XDECREF(dom);
+  Py_XDECREF(nonce);
+  Py_XDECREF(rcv);
+  Py_XDECREF(tr);
+  Py_XDECREF(tmo);
+  Py_XDECREF(ten);
+  Py_XDECREF(sid);
+  Py_XDECREF(swin);
+  if (!r) {
+    char msg[160] = "stream shim failed";
+    PyObject *t, *v, *tb;
+    PyErr_Fetch(&t, &v, &tb);
+    if (v) {
+      PyObject* s = PyObject_Str(v);
+      if (s) {
+        const char* u = PyUnicode_AsUTF8(s);
+        if (u) snprintf(msg, sizeof msg, "%.*s", 150, u);
+        Py_DECREF(s);
+      }
+    }
+    PyErr_Clear();
+    Py_XDECREF(t); Py_XDECREF(v); Py_XDECREF(tb);
+    it.m->stream_errors++;
+    native_error(c, it.cid, 2001 /* EINTERNAL */, msg);
+    return;
+  }
+  if (r == Py_None) {
+    // escalated: the shim completed (or will complete) the RPC through
+    // the classic Python send path (async methods, error shapes,
+    // compressed/device responses)
+    Py_DECREF(r);
+    it.m->stream_opens++;
+    return;
+  }
+  PyObject* resp = r;
+  PyObject* grant = nullptr;
+  if (PyTuple_Check(r) && PyTuple_GET_SIZE(r) == 2) {
+    resp = PyTuple_GET_ITEM(r, 0);
+    grant = PyTuple_GET_ITEM(r, 1);
+    if (grant == Py_None) grant = nullptr;
+  }
+  Py_buffer rb = {}, gb = {};
+  if (PyObject_GetBuffer(resp, &rb, PyBUF_SIMPLE) != 0
+      || (grant && PyObject_GetBuffer(grant, &gb, PyBUF_SIMPLE) != 0)) {
+    PyErr_Clear();
+    if (rb.obj) PyBuffer_Release(&rb);
+    Py_DECREF(r);
+    it.m->stream_errors++;
+    native_error(c, it.cid, 2001, "stream shim returned non-bytes");
+    return;
+  }
+  // response meta: cid + (domain-exchange answer) + grant TLVs — the
+  // classic path orders its meta the same way for escalations
+  std::string extra;
+  if (it.dom_len && !lp->eng->domain_tlv.empty())
+    extra.append(lp->eng->domain_tlv);
+  if (gb.obj) extra.append((const char*)gb.buf, (size_t)gb.len);
+  native_append_head(lp->eng, c->native_out, it.cid, 0, (size_t)rb.len,
+                     extra.empty() ? nullptr : &extra);
+  dp_copy(lp, DP_SERIALIZE, (size_t)rb.len);
+  if (rb.len) c->native_out.append((const char*)rb.buf, rb.len);
+  PyBuffer_Release(&rb);
+  if (gb.obj) PyBuffer_Release(&gb);
+  Py_DECREF(r);
+  it.m->stream_opens++;
+}
+
 // Run a burst's worth of batched items (kind-2 raw, kind-3 slim,
 // kind-4 slim-HTTP) under ONE GIL acquisition and append their
 // responses to c->native_out (shipped by the burst-end native_flush as
@@ -1264,25 +1541,63 @@ static void raw_slim_item(Loop* lp, Conn* c, PyRawItem& it) {
 // queue (frame parse -> this batch entry), shim (item dispatch time),
 // resid (parse -> response build done).
 static void flush_py_batch(Loop* lp, Conn* c,
-                           std::vector<PyRawItem>& batch) {
-  if (batch.empty()) return;
+                           std::vector<PyRawItem>& batch,
+                           std::vector<StreamItem>& sbatch) {
+  if (batch.empty() && sbatch.empty()) return;
   int64_t t_entry = now_ns();
-  lp->tel.burst.add((uint64_t)batch.size());
+  if (!batch.empty()) lp->tel.burst.add((uint64_t)batch.size());
   PyGILState_STATE gs = PyGILState_Ensure();
   flush_decrefs_locked_gil(lp);
   for (PyRawItem& it : batch) {
     int lane = it.hroute ? LANE_HTTP
-                         : (it.m->kind == 3 ? LANE_SLIM : LANE_RAW);
+                         : (it.stream_id ? LANE_STREAM
+                            : (it.m->kind == 3 ? LANE_SLIM : LANE_RAW));
     lp->tel.queue[lane].add(
         (uint64_t)((t_entry - it.t_parse) / 1000));
     int64_t t0 = now_ns();
     if (it.hroute)
       http_slim_item(lp, c, it);   // kind-4 slim-HTTP item
+    else if (it.stream_id)
+      stream_open_item(lp, c, it); // kind-5 stream-open item
     else
       raw_slim_item(lp, c, it);    // kind-2/3 tpu_std item
     int64_t t1 = now_ns();
     lp->tel.shim[lane].add((uint64_t)((t1 - t0) / 1000));
     lp->tel.resid[lane].add((uint64_t)((t1 - it.t_parse) / 1000));
+  }
+  if (!sbatch.empty()) {
+    // kind-5 chunk delivery: EVERY stream chunk of this read burst —
+    // across all streams on the connection — enters Python in this
+    // ONE call (the kind-3/4 batching discipline applied to streams)
+    lp->tel.stream_burst.add((uint64_t)sbatch.size());
+    if (lp->eng->stream_chunks != nullptr) {
+      PyObject* list = PyList_New((Py_ssize_t)sbatch.size());
+      if (list) {
+        bool ok = true;
+        for (size_t i = 0; ok && i < sbatch.size(); i++) {
+          StreamItem& si = sbatch[i];
+          PyObject* t = Py_BuildValue(
+              "(Kiy#)", (unsigned long long)si.sid, si.flags,
+              si.payload, (Py_ssize_t)si.len);
+          if (!t) { ok = false; break; }
+          PyList_SET_ITEM(list, (Py_ssize_t)i, t);
+        }
+        if (ok) {
+          PyObject* r = PyObject_CallFunctionObjArgs(
+              lp->eng->stream_chunks, list, nullptr);
+          if (!r)
+            PyErr_WriteUnraisable(lp->eng->stream_chunks);
+          else
+            Py_DECREF(r);
+        } else {
+          PyErr_Clear();
+        }
+        Py_DECREF(list);
+      } else {
+        PyErr_Clear();
+      }
+    }
+    sbatch.clear();
   }
   if (lp->eng->burst_end != nullptr) {
     // per-burst accounting epilogue (one call per batched GIL entry)
@@ -1318,7 +1633,80 @@ static bool native_try_handle(EngineImpl* eng, Loop* lp, Conn* c,
     lp->tel.fallbacks[FB_RPC_SHM_LANE]++;
     return false;
   }
+  if (s.compressed) {
+    // compressed frames always decline (only the classic path can
+    // decompress); a compressed stream OPEN earns its kind-5 name
+    if (s.stream_id) {
+      lp->tel.sfallbacks[SFB_COMPRESSED]++;
+      NativeMethod* m0 = find_native(eng, s);
+      if (m0) m0->fb_stream_open++;
+    } else {
+      lp->tel.fallbacks[FB_RPC_META_TAG]++;
+    }
+    return false;
+  }
   NativeMethod* m = find_native(eng, s);
+  if (s.stream_id) {
+    // kind-5 STREAM OPEN: the unary call negotiating a stream rides
+    // the stream shim (interceptor-chain binding).  Every decline is
+    // NAMED (closed StreamFb enum); the classic Python lane serves
+    // declined opens byte-identically.
+    int mode = eng->stream_mode.load(std::memory_order_relaxed);
+    int fb = -1;
+    if (eng->lame_duck.load(std::memory_order_relaxed) >= 1)
+      fb = SFB_DRAIN;         // classic path owns the ELAMEDUCK shape
+    else if (mode != 1 || m == nullptr
+             || m->stream_handler == nullptr)
+      fb = mode == 2 ? SFB_NON_INLINE : SFB_NO_SHIM;
+    else if (!batch)
+      fb = SFB_CHUNK_OVERSIZE;  // direct-read path: too big to batch
+    else if (s.att > kSlimAttCap) {
+      lp->tel.fallbacks[FB_RPC_ATT_OVER_CAP]++;
+      m->fb_att_over_cap++;
+      return false;
+    }
+    if (fb >= 0) {
+      lp->tel.sfallbacks[fb]++;
+      if (m) m->fb_stream_open++;
+      return false;
+    }
+    const char* spayload = body + meta_size;
+    size_t splen = body_len - meta_size;
+    if (s.att > splen) {
+      m->stream_errors++;
+      native_error(c, s.cid, 1003 /* EREQUEST */,
+                   "attachment size exceeds body");
+      return true;
+    }
+    PyRawItem si{};
+    si.m = m;
+    si.cid = s.cid;
+    si.payload = spayload;
+    si.plen = splen;
+    si.att = s.att;
+    si.dom = s.dom;
+    si.dom_len = s.dom_len;
+    si.conn = s.conn;
+    si.conn_len = s.conn_len;
+    si.trace_id = s.trace_id;
+    si.span_id = s.span_id;
+    si.parent_id = s.parent_id;
+    si.timeout_ms = s.timeout_ms;
+    si.timeout_present = s.timeout_present;
+    si.ten = s.ten;
+    si.ten_len = s.ten_len;
+    si.stream_id = s.stream_id;       // selects the kind-5 lane
+    si.stream_window = s.stream_window;
+    si.t_parse = now_ns();
+    batch->push_back(si);
+    return true;
+  }
+  if (s.stream_window) {
+    // window TLV without a stream id: malformed handshake — classic
+    // path arbitrates (the pre-stream-lane behavior for tag 14)
+    lp->tel.fallbacks[FB_RPC_META_TAG]++;
+    return false;
+  }
   if (!m) {
     lp->tel.fallbacks[FB_RPC_NO_METHOD]++;
     return false;
@@ -1886,7 +2274,8 @@ static bool http_slim_match(EngineImpl* eng, Loop* lp, const char* p,
 
 // parse as many complete frames as possible from c->inbuf / direct reads
 static bool parse_frames_inner(EngineImpl* eng, Loop* lp, Conn* c,
-                               std::vector<PyRawItem>& batch) {
+                               std::vector<PyRawItem>& batch,
+                               std::vector<StreamItem>& sbatch) {
   if (c->passthrough) {
     // deliver the whole gulp; Python's registry owns this connection
     size_t avail = c->in_end - c->in_start;
@@ -1989,12 +2378,78 @@ static bool parse_frames_inner(EngineImpl* eng, Loop* lp, Conn* c,
       kind = EV_ACK;
       hdr = kAckHeader;
     } else if (memcmp(p, "TSTR", 4) == 0) {
-      // stream frame: [magic][u8 flags][u64 dest][u32 len][payload];
-      // hand flags+dest+len+payload to Python in one buffer
+      // stream frame: [magic][u8 flags][u64 dest][u32 len][payload].
+      // Frames for a kind-5 NATIVE stream are consumed here: credit
+      // feedback settles entirely in C++ (zero GIL entries), DATA and
+      // CLOSE chunks batch with the burst and enter Python ONCE in
+      // flush_py_batch.  Everything else (pure-Python streams, closed
+      // streams, forged ids, oversize chunks) rides the classic
+      // EV_STREAM path under a NAMED StreamFb reason.
       if (avail < 17) return true;
       uint32_t len = 0;
       memcpy(&len, p + 13, 4);
       if (len > kMaxBody) return false;
+      size_t stotal = 17 + (size_t)len;
+      if (eng->nstreams.load(std::memory_order_acquire) != 0) {
+        uint64_t dest = 0;
+        memcpy(&dest, p + 5, 8);
+        std::shared_ptr<NativeStream> ns;
+        {
+          std::lock_guard<std::mutex> g(eng->smu);
+          auto sit = eng->streams.find(dest);
+          if (sit != eng->streams.end()) ns = sit->second;
+        }
+        if (ns && ns->conn_id == c->id) {
+          if (avail >= stotal) {
+            uint8_t flags = (uint8_t)p[4];
+            if (flags == 1 /* F_FEEDBACK */) {
+              if (len >= 8) {
+                uint64_t consumed = 0;
+                memcpy(&consumed, p + 17, 8);
+                std::lock_guard<std::mutex> g(ns->mu);
+                // clamp to produced: an over-acking peer must not
+                // push remote_consumed past produced, or the unsigned
+                // produced - remote_consumed window check underflows
+                // and stalls the stream forever (the Python lane's
+                // signed arithmetic tolerates over-ack; so do we)
+                if (consumed > ns->produced) consumed = ns->produced;
+                if (consumed > ns->remote_consumed) {
+                  ns->remote_consumed = consumed;
+                  ns->cv.notify_all();   // wake blocked producers
+                }
+              }
+              lp->tel.stream_feedbacks++;
+            } else {
+              if (flags == 2 || flags == 3) {  // F_CLOSE / F_RST
+                std::lock_guard<std::mutex> g(ns->mu);
+                ns->closed = true;       // writers fail fast, not at
+                ns->cv.notify_all();     // their credit timeout
+              }
+              sbatch.push_back(StreamItem{
+                  dest, (int)flags, p + 17, (size_t)len});
+              lp->tel.stream_chunks_in++;
+            }
+            c->in_start += stotal;
+            count_msg(eng, lp, c);
+            continue;
+          }
+          if (stotal > kInbufCap / 2) {
+            // about to switch to the direct-read path: too large to
+            // batch — the Python streaming lane delivers it whole
+            // (counted ONCE: the switch below consumes the frame)
+            lp->tel.sfallbacks[SFB_CHUNK_OVERSIZE]++;
+          }
+          // incomplete small frame: generic tail waits for more bytes
+        } else if (avail >= stotal) {
+          // not ours (pure-Python stream, closed, or forged onto the
+          // wrong conn): the classic dispatch path arbitrates
+          lp->tel.sfallbacks[SFB_UNREGISTERED]++;
+        }
+      } else if (avail >= stotal) {
+        lp->tel.sfallbacks[
+            eng->stream_mode.load(std::memory_order_relaxed) == 0
+                ? SFB_NO_SHIM : SFB_UNREGISTERED]++;
+      }
       body = 13 + len;
       meta = 0;
       kind = EV_STREAM;
@@ -2008,11 +2463,11 @@ static bool parse_frames_inner(EngineImpl* eng, Loop* lp, Conn* c,
       // protocol the Python transport does.  Malformed HTTP (sniffed
       // as HTTP but uncuttable) stays a close.
       if (!http_sniff(p)) {
-        flush_py_batch(lp, c, batch);
+        flush_py_batch(lp, c, batch, sbatch);
         if (!c->native_out.empty() && !native_flush(lp, c)) return false;
         c->passthrough = true;
         // re-enter: the passthrough head delivers the buffered bytes
-        return parse_frames_inner(eng, lp, c, batch);
+        return parse_frames_inner(eng, lp, c, batch, sbatch);
       }
       if (c->http_state == 0) {
         // SNIFF COMMITMENT (ADVICE r5 #5): a 4-byte method-token match
@@ -2032,12 +2487,12 @@ static bool parse_frames_inner(EngineImpl* eng, Loop* lp, Conn* c,
           arbitrate = true;
         }
         if (arbitrate) {
-          flush_py_batch(lp, c, batch);
+          flush_py_batch(lp, c, batch, sbatch);
           if (!c->native_out.empty() && !native_flush(lp, c))
             return false;
           c->sniff_deadline = 0;
           c->passthrough = true;
-          return parse_frames_inner(eng, lp, c, batch);
+          return parse_frames_inner(eng, lp, c, batch, sbatch);
         }
         if (!commit) {
           // incomplete request line: wait, but only within the sniff
@@ -2049,7 +2504,7 @@ static bool parse_frames_inner(EngineImpl* eng, Loop* lp, Conn* c,
             lp->sniffing.push_back(c->id);
           }
           if (c->in_start > 0) {
-            flush_py_batch(lp, c, batch);
+            flush_py_batch(lp, c, batch, sbatch);
             memmove(c->inbuf, c->inbuf + c->in_start, avail);
             c->in_end = avail;
             c->in_start = 0;
@@ -2065,7 +2520,7 @@ static bool parse_frames_inner(EngineImpl* eng, Loop* lp, Conn* c,
           &cl_total, &http_hlen);
       if (hr == -3) {
         // body over the limit: answer 413 cleanly, then close
-        flush_py_batch(lp, c, batch);
+        flush_py_batch(lp, c, batch, sbatch);
         c->native_out.append(k413, sizeof(k413) - 1);
         native_flush(lp, c);
         return false;
@@ -2074,7 +2529,7 @@ static bool parse_frames_inner(EngineImpl* eng, Loop* lp, Conn* c,
         // chunked body outgrowing the inbuf: stream raw bytes through
         // the incremental chunk FSM, bounded by http_max_body
         lp->tel.fallbacks[FB_HTTP_CHUNK_STREAM]++;
-        flush_py_batch(lp, c, batch);
+        flush_py_batch(lp, c, batch, sbatch);
         c->chunk = new (std::nothrow) ChunkState();
         if (!c->chunk) return false;
         c->chunk->cap =
@@ -2108,7 +2563,7 @@ static bool parse_frames_inner(EngineImpl* eng, Loop* lp, Conn* c,
           lp->tel.fallbacks[FB_HTTP_SLIM_OFF]++;
         }
         // one complete HTTP message: classic EV_HTTP dispatch
-        flush_py_batch(lp, c, batch);   // wire order vs earlier frames
+        flush_py_batch(lp, c, batch, sbatch);   // wire order vs earlier frames
         if (!c->native_out.empty() && !native_flush(lp, c)) return false;
         c->in_start += (size_t)hr;
         count_msg(eng, lp, c);
@@ -2135,7 +2590,7 @@ static bool parse_frames_inner(EngineImpl* eng, Loop* lp, Conn* c,
       if (hr == 0) {
         // incomplete HTTP message: wait for more bytes
         if (c->in_start > 0) {
-          flush_py_batch(lp, c, batch);
+          flush_py_batch(lp, c, batch, sbatch);
           memmove(c->inbuf, c->inbuf + c->in_start, avail);
           c->in_end = avail;
           c->in_start = 0;
@@ -2146,7 +2601,7 @@ static bool parse_frames_inner(EngineImpl* eng, Loop* lp, Conn* c,
         // large Content-Length body: direct-into-buffer reads, same
         // machinery as large tpu_std frames (msg_kind = EV_HTTP)
         lp->tel.fallbacks[FB_HTTP_LARGE_BODY]++;
-        flush_py_batch(lp, c, batch);
+        flush_py_batch(lp, c, batch, sbatch);
         NativeBuf* b;
         {
           PyGILState_STATE gs = PyGILState_Ensure();
@@ -2242,7 +2697,7 @@ static bool parse_frames_inner(EngineImpl* eng, Loop* lp, Conn* c,
     if (c->in_start > 0) {
       // batched kind=2 items point into the consumed prefix this
       // memmove is about to overwrite — run them first
-      flush_py_batch(lp, c, batch);
+      flush_py_batch(lp, c, batch, sbatch);
       memmove(c->inbuf, c->inbuf + c->in_start, avail);
       c->in_end = avail;
       c->in_start = 0;
@@ -2253,10 +2708,11 @@ static bool parse_frames_inner(EngineImpl* eng, Loop* lp, Conn* c,
 
 static bool parse_frames(EngineImpl* eng, Loop* lp, Conn* c) {
   std::vector<PyRawItem> batch;
-  bool ok = parse_frames_inner(eng, lp, c, batch);
+  std::vector<StreamItem> sbatch;
+  bool ok = parse_frames_inner(eng, lp, c, batch, sbatch);
   // requests already complete on the wire get processed even when a
   // later frame kills the connection (same order the Python path gives)
-  flush_py_batch(lp, c, batch);
+  flush_py_batch(lp, c, batch, sbatch);
   if (!ok && !c->native_out.empty()) {
     // the conn is about to be destroyed, but the batch above ran side
     // effects (user code, MethodStatus) for requests that were fully
@@ -2306,6 +2762,28 @@ static bool conn_readable(EngineImpl* eng, Loop* lp, Conn* c) {
             lp->tel.fallbacks[FB_RPC_META_TAG]++;
           else if (s.shm)
             lp->tel.fallbacks[FB_RPC_SHM_LANE]++;
+          else if (s.stream_id) {
+            // large-frame stream open: reason-coded mirror of
+            // native_try_handle's kind-5 screening — same request,
+            // same NAME regardless of frame size (only the
+            // genuinely-eligible-but-oversize shape earns
+            // stream_chunk_oversize)
+            NativeMethod* m0 = find_native(eng, s);
+            int mode = eng->stream_mode.load(std::memory_order_relaxed);
+            int sfb;
+            if (s.compressed)            // same rank order as the
+              sfb = SFB_COMPRESSED;      // buffered-path screening
+            else if (eng->lame_duck.load(std::memory_order_relaxed) >= 1)
+              sfb = SFB_DRAIN;
+            else if (mode != 1 || m0 == nullptr
+                     || m0->stream_handler == nullptr)
+              sfb = mode == 2 ? SFB_NON_INLINE : SFB_NO_SHIM;
+            else
+              sfb = SFB_CHUNK_OVERSIZE;
+            lp->tel.sfallbacks[sfb]++;
+            if (m0) m0->fb_stream_open++;
+          } else if (s.compressed || s.stream_window)
+            lp->tel.fallbacks[FB_RPC_META_TAG]++;
           else if ((m = find_native(eng, s)) == nullptr)
             lp->tel.fallbacks[FB_RPC_NO_METHOD]++;
         }
@@ -2965,6 +3443,366 @@ static PyObject* Engine_set_native_dispatch(EngineObj* self,
   Py_RETURN_NONE;
 }
 
+// ---------------------------------------------------------------------------
+// Kind-5 streaming lane: per-method stream-open shims, batched chunk
+// delivery, and the WRITE side — C++-accounted credit windows with
+// chunk coalescing (many streams' chunks -> one owned buffer -> one
+// writev per connection).
+// ---------------------------------------------------------------------------
+
+// set_stream_shim(svc, mth, handler) — kind-5 stream-OPEN shim for an
+// already-registered kind-3 method; pre-listen only.
+static PyObject* Engine_set_stream_shim(EngineObj* self, PyObject* args) {
+  const char* svc;
+  const char* mth;
+  PyObject* handler;
+  if (!PyArg_ParseTuple(args, "ssO", &svc, &mth, &handler))
+    return nullptr;
+  EngineImpl* eng = self->eng;
+  if (eng->started) {
+    PyErr_SetString(PyExc_RuntimeError,
+                    "stream shims must be set before listen()");
+    return nullptr;
+  }
+  if (!PyCallable_Check(handler)) {
+    PyErr_SetString(PyExc_TypeError, "stream shim must be callable");
+    return nullptr;
+  }
+  std::string key(svc);
+  key.push_back('\0');
+  key.append(mth);
+  auto it = eng->native_methods.find(key);
+  if (it == eng->native_methods.end() || it->second->kind != 3) {
+    PyErr_SetString(PyExc_ValueError,
+                    "stream shim requires a registered kind-3 method");
+    return nullptr;
+  }
+  Py_INCREF(handler);
+  Py_XDECREF(it->second->stream_handler);
+  it->second->stream_handler = handler;
+  Py_RETURN_NONE;
+}
+
+// set_stream_chunks(callable_or_None) — the ONE batched chunk-delivery
+// entry: callable(list[(sid, flags, payload_bytes)]); pre-listen only.
+static PyObject* Engine_set_stream_chunks(EngineObj* self,
+                                          PyObject* args) {
+  PyObject* cb;
+  if (!PyArg_ParseTuple(args, "O", &cb)) return nullptr;
+  if (self->eng->started) {
+    PyErr_SetString(PyExc_RuntimeError,
+                    "stream_chunks must be set before listen()");
+    return nullptr;
+  }
+  if (cb != Py_None && !PyCallable_Check(cb)) {
+    PyErr_SetString(PyExc_TypeError, "stream_chunks must be callable");
+    return nullptr;
+  }
+  Py_XDECREF(self->eng->stream_chunks);
+  self->eng->stream_chunks = nullptr;
+  if (cb != Py_None) {
+    Py_INCREF(cb);
+    self->eng->stream_chunks = cb;
+  }
+  Py_RETURN_NONE;
+}
+
+// set_stream_mode(mode) — 0 = lane off, 1 = on, 2 = declined because
+// the server runs user code off the loop; names the fallback reason.
+static PyObject* Engine_set_stream_mode(EngineObj* self, PyObject* args) {
+  int mode;
+  if (!PyArg_ParseTuple(args, "i", &mode)) return nullptr;
+  if (mode < 0 || mode > 2) {
+    PyErr_SetString(PyExc_ValueError, "stream mode must be 0, 1 or 2");
+    return nullptr;
+  }
+  self->eng->stream_mode.store(mode, std::memory_order_relaxed);
+  Py_RETURN_NONE;
+}
+
+// stream_register(conn_id, sid, peer_sid, window) — adopt one accepted
+// stream onto the kind-5 lane.  Called by the stream-open shim (GIL
+// held, ON the owning loop inside the batched entry) BEFORE the grant
+// response leaves, so no peer frame can race the registration.
+static PyObject* Engine_stream_register(EngineObj* self, PyObject* args) {
+  unsigned long long conn_id, sid, peer_sid, window;
+  if (!PyArg_ParseTuple(args, "KKKK", &conn_id, &sid, &peer_sid,
+                        &window))
+    return nullptr;
+  EngineImpl* eng = self->eng;
+  auto ns = std::make_shared<NativeStream>();
+  ns->sid = sid;
+  ns->peer_sid = peer_sid;
+  ns->conn_id = conn_id;
+  ns->window = window ? window : (2ull << 20);
+  {
+    std::lock_guard<std::mutex> g(eng->smu);
+    eng->streams[sid] = ns;
+    eng->nstreams.store(eng->streams.size(), std::memory_order_release);
+  }
+  Py_RETURN_NONE;
+}
+
+// stream_unregister(sid) — drop a stream from the lane (close path).
+// Blocked producers wake with "closed".  Returns whether it was ours.
+static PyObject* Engine_stream_unregister(EngineObj* self,
+                                          PyObject* args) {
+  unsigned long long sid;
+  if (!PyArg_ParseTuple(args, "K", &sid)) return nullptr;
+  EngineImpl* eng = self->eng;
+  std::shared_ptr<NativeStream> ns;
+  {
+    std::lock_guard<std::mutex> g(eng->smu);
+    auto it = eng->streams.find(sid);
+    if (it != eng->streams.end()) {
+      ns = it->second;
+      eng->streams.erase(it);
+      eng->nstreams.store(eng->streams.size(),
+                          std::memory_order_release);
+    }
+  }
+  if (!ns) Py_RETURN_FALSE;
+  {
+    std::lock_guard<std::mutex> g(ns->mu);
+    ns->closed = true;
+    ns->cv.notify_all();
+  }
+  Py_RETURN_TRUE;
+}
+
+// build one TSTR frame header (17 bytes) into out
+static void stream_frame_head(std::string& out, uint8_t flags,
+                              uint64_t dest, uint32_t len) {
+  char h[17];
+  memcpy(h, "TSTR", 4);
+  h[4] = (char)flags;
+  memcpy(h + 5, &dest, 8);
+  memcpy(h + 13, &len, 4);
+  out.append(h, 17);
+}
+
+// Reserve `len` bytes of write credit on ns, blocking (caller must NOT
+// hold the GIL) until the peer's feedback frees window or timeout.
+// Python-lane parity: a write is admitted while ANY credit remains —
+// requiring room for the whole chunk would deadlock chunks larger
+// than the window.  0 = ok, -1 = credit timeout, -2 = closed.
+static int stream_reserve(EngineImpl* eng, NativeStream* ns, size_t len,
+                          int timeout_ms) {
+  std::unique_lock<std::mutex> g(ns->mu);
+  if (ns->closed) return -2;
+  if (ns->produced - ns->remote_consumed >= ns->window) {
+    eng->s_credit_stalls++;
+    bool ok = ns->cv.wait_for(
+        g, std::chrono::milliseconds(timeout_ms > 0 ? timeout_ms : 1),
+        [&] {
+          return ns->closed
+                 || ns->produced - ns->remote_consumed < ns->window;
+        });
+    if (!ok) return -1;
+  }
+  if (ns->closed) return -2;
+  ns->produced += (uint64_t)len;
+  return 0;
+}
+
+// queue one owned buffer on conn_id and hand the flush to the owning
+// loop (GIL must be held: it serializes this against conn_destroy's
+// delete, exactly like Engine_send).  Consumes `s` either way.
+static bool send_owned(EngineImpl* eng, uint64_t conn_id,
+                       std::string* s) {
+  Conn* c = nullptr;
+  {
+    std::lock_guard<std::mutex> g(eng->cmu);
+    auto it = eng->by_id.find(conn_id);
+    if (it != eng->by_id.end()) c = it->second;
+  }
+  if (!c || c->dead || c->closing) {
+    delete s;
+    return false;
+  }
+  {
+    std::lock_guard<std::mutex> g(c->wmu);
+    WriteItem it;
+    memset(&it.view, 0, sizeof(it.view));
+    it.view.buf = (void*)s->data();
+    it.view.len = (Py_ssize_t)s->size();
+    it.owned_str = s;
+    c->wq.push_back(it);
+  }
+  bool expect = false;
+  if (c->flush_queued.compare_exchange_strong(
+          expect, true, std::memory_order_acq_rel))
+    loop_post(c->loop, c->id, HO_FLUSH);
+  return true;
+}
+
+// stream_write_many(items, timeout_ms=10000) -> list[int] — the burst
+// write path: items is [(sid, payload), ...]; chunks are credit-
+// reserved in order (GIL RELEASED across the waits — a stalled stream
+// blocks only its producer thread, never a loop), framed into ONE
+// owned buffer per connection and shipped as one writev.  Per-item
+// status: 0 = queued, -1 = credit exhaustion (backpressure — the
+// producer should yield and retry), -2 = stream closed/unknown.
+static PyObject* Engine_stream_write_many(EngineObj* self,
+                                          PyObject* args) {
+  PyObject* items;
+  int timeout_ms = 10000;
+  if (!PyArg_ParseTuple(args, "O|i", &items, &timeout_ms))
+    return nullptr;
+  EngineImpl* eng = self->eng;
+  PyObject* seq = PySequence_Fast(items, "items must be a sequence");
+  if (!seq) return nullptr;
+  Py_ssize_t n = PySequence_Fast_GET_SIZE(seq);
+  struct Pend {
+    uint64_t sid = 0;
+    Py_buffer buf = {};
+    int status = -2;
+    std::shared_ptr<NativeStream> ns;
+  };
+  std::vector<Pend> pend((size_t)n);
+  bool argerr = false;
+  for (Py_ssize_t i = 0; i < n && !argerr; i++) {
+    PyObject* item = PySequence_Fast_GET_ITEM(seq, i);
+    if (!PyTuple_Check(item) || PyTuple_GET_SIZE(item) != 2) {
+      argerr = true;
+      break;
+    }
+    unsigned long long sid =
+        PyLong_AsUnsignedLongLong(PyTuple_GET_ITEM(item, 0));
+    if (sid == (unsigned long long)-1 && PyErr_Occurred()) {
+      argerr = true;
+      break;
+    }
+    if (PyObject_GetBuffer(PyTuple_GET_ITEM(item, 1), &pend[i].buf,
+                           PyBUF_SIMPLE) != 0) {
+      argerr = true;
+      break;
+    }
+    pend[i].sid = sid;
+    {
+      std::lock_guard<std::mutex> g(eng->smu);
+      auto it = eng->streams.find(sid);
+      if (it != eng->streams.end()) pend[i].ns = it->second;
+    }
+  }
+  if (argerr) {
+    for (auto& p : pend)
+      if (p.buf.obj) PyBuffer_Release(&p.buf);
+    Py_DECREF(seq);
+    if (!PyErr_Occurred())
+      PyErr_SetString(PyExc_TypeError,
+                      "items must be (sid, payload) tuples");
+    return nullptr;
+  }
+  eng->s_write_batches++;
+  // credit + framing with the GIL released: the Py_buffer views stay
+  // pinned by the references taken above.  timeout_ms bounds the
+  // WHOLE batch, not each item: N simultaneously stalled streams must
+  // cost the caller one bounded stall, not N of them (the continuous
+  // batcher's one-short-stall-then-evict contract)
+  std::unordered_map<uint64_t, std::string*> per_conn;
+  Py_BEGIN_ALLOW_THREADS;
+  auto t_end = std::chrono::steady_clock::now()
+               + std::chrono::milliseconds(timeout_ms > 0 ? timeout_ms
+                                                          : 1);
+  for (auto& p : pend) {
+    if (!p.ns) continue;            // status stays -2
+    int left_ms = (int)std::chrono::duration_cast<
+        std::chrono::milliseconds>(
+        t_end - std::chrono::steady_clock::now()).count();
+    if (left_ms < 1) left_ms = 1;   // budget spent: fail fast, 1ms cap
+    int st = stream_reserve(eng, p.ns.get(), (size_t)p.buf.len,
+                            left_ms);
+    p.status = st;
+    if (st != 0) continue;
+    std::string*& out = per_conn[p.ns->conn_id];
+    if (out == nullptr) out = new std::string();
+    stream_frame_head(*out, 0 /* F_DATA */, p.ns->peer_sid,
+                      (uint32_t)p.buf.len);
+    out->append((const char*)p.buf.buf, (size_t)p.buf.len);
+    eng->s_chunks_out++;
+    eng->s_chunk_bytes_out += (uint64_t)p.buf.len;
+  }
+  Py_END_ALLOW_THREADS;
+  // a dead/closing connection drops its whole buffer: report those
+  // items closed (-2), not success — the Python lane answers
+  // EFAILEDSOCKET for the same state, and the decode batcher keys
+  // eviction off the status
+  std::unordered_set<uint64_t> dead_conns;
+  for (auto& kv : per_conn)
+    if (!send_owned(eng, kv.first, kv.second))
+      dead_conns.insert(kv.first);
+  if (!dead_conns.empty()) {
+    for (auto& p : pend)
+      if (p.status == 0 && p.ns
+          && dead_conns.count(p.ns->conn_id) != 0)
+        p.status = -2;
+  }
+  PyObject* out = PyList_New(n);
+  bool ok = out != nullptr;
+  for (Py_ssize_t i = 0; ok && i < n; i++) {
+    PyObject* v = PyLong_FromLong(pend[i].status);
+    if (!v) ok = false;
+    else PyList_SET_ITEM(out, i, v);
+  }
+  for (auto& p : pend)
+    if (p.buf.obj) PyBuffer_Release(&p.buf);
+  Py_DECREF(seq);
+  if (!ok) {
+    Py_XDECREF(out);
+    return nullptr;
+  }
+  return out;
+}
+
+// stream_write(sid, payload, timeout_ms=10000) -> int — single-chunk
+// convenience over the same reserve/frame/ship path.
+static PyObject* Engine_stream_write(EngineObj* self, PyObject* args) {
+  unsigned long long sid;
+  Py_buffer buf = {};
+  int timeout_ms = 10000;
+  if (!PyArg_ParseTuple(args, "Ky*|i", &sid, &buf, &timeout_ms))
+    return nullptr;
+  EngineImpl* eng = self->eng;
+  std::shared_ptr<NativeStream> ns;
+  {
+    std::lock_guard<std::mutex> g(eng->smu);
+    auto it = eng->streams.find(sid);
+    if (it != eng->streams.end()) ns = it->second;
+  }
+  int st = -2;
+  std::string* s = nullptr;
+  if (ns) {
+    Py_BEGIN_ALLOW_THREADS;
+    st = stream_reserve(eng, ns.get(), (size_t)buf.len, timeout_ms);
+    if (st == 0) {
+      s = new (std::nothrow) std::string();
+      if (s) {
+        stream_frame_head(*s, 0 /* F_DATA */, ns->peer_sid,
+                          (uint32_t)buf.len);
+        s->append((const char*)buf.buf, (size_t)buf.len);
+      } else {
+        // frame alloc failed AFTER the credit reservation: roll the
+        // reservation back, or the window shrinks by bytes the peer
+        // can never ack (permanent spurious backpressure)
+        std::lock_guard<std::mutex> g(ns->mu);
+        ns->produced -= (uint64_t)buf.len;
+      }
+    }
+    Py_END_ALLOW_THREADS;
+  }
+  if (s != nullptr) {
+    if (send_owned(eng, ns->conn_id, s)) {
+      eng->s_chunks_out++;
+      eng->s_chunk_bytes_out += (uint64_t)buf.len;
+    } else {
+      st = -2;       // conn dead/closing: the chunk was dropped — the
+    }                // Python lane's EFAILEDSOCKET shape, not success
+  }
+  PyBuffer_Release(&buf);
+  return PyLong_FromLong(st == 0 && s == nullptr ? -2 : st);
+}
+
 // register_http_route(method, path, handler) — pre-listen only.  The
 // SLIM HTTP LANE (kind 4): eligible HTTP/1.1 requests matching
 // METHOD+path are parsed in C++, burst-batched, and dispatched to the
@@ -3167,14 +4005,17 @@ static PyObject* Engine_telemetry(EngineObj* self, PyObject*) {
   // owns its LoopTelemetry; a snapshot may trail a few increments,
   // which monotonic counters tolerate)
   uint64_t fb[FB_REASONS] = {};
-  Hist queue[kLanes], shim[kLanes], resid[kLanes], burst, wiov;
+  uint64_t sfb[SFB_REASONS] = {};
+  Hist queue[kLanes], shim[kLanes], resid[kLanes], burst, wiov, sburst;
   uint64_t wq_hwm = 0, inbuf_hwm = 0;
+  uint64_t s_chunks_in = 0, s_feedbacks = 0;
   uint64_t dp[kDpStages] = {}, dpb[kDpStages] = {};
   PyObject* loops = PyList_New((Py_ssize_t)eng->loops.size());
   if (!loops) return nullptr;
   for (size_t i = 0; i < eng->loops.size(); i++) {
     const LoopTelemetry& t = eng->loops[i]->tel;
     for (int r = 0; r < FB_REASONS; r++) fb[r] += t.fallbacks[r];
+    for (int r = 0; r < SFB_REASONS; r++) sfb[r] += t.sfallbacks[r];
     for (int s = 0; s < kDpStages; s++) {
       dp[s] += t.dp_copies[s];
       dpb[s] += t.dp_copy_bytes[s];
@@ -3185,6 +4026,9 @@ static PyObject* Engine_telemetry(EngineObj* self, PyObject*) {
       hist_merge(resid[ln], t.resid[ln]);
     }
     hist_merge(burst, t.burst);
+    hist_merge(sburst, t.stream_burst);
+    s_chunks_in += t.stream_chunks_in;
+    s_feedbacks += t.stream_feedbacks;
     hist_merge(wiov, t.wiov);
     if (t.wq_hwm > wq_hwm) wq_hwm = t.wq_hwm;
     if (t.inbuf_hwm > inbuf_hwm) inbuf_hwm = t.inbuf_hwm;
@@ -3214,6 +4058,8 @@ static PyObject* Engine_telemetry(EngineObj* self, PyObject*) {
     NativeMethod* m = kv.second;
     uint64_t cnt = m->count.load(std::memory_order_relaxed);
     uint64_t err = m->errors.load(std::memory_order_relaxed);
+    uint64_t sop = m->stream_opens.load(std::memory_order_relaxed);
+    uint64_t serr = m->stream_errors.load(std::memory_order_relaxed);
     if (m->kind == 2) {
       handled[LANE_RAW] += cnt;
       errors[LANE_RAW] += err;
@@ -3221,12 +4067,17 @@ static PyObject* Engine_telemetry(EngineObj* self, PyObject*) {
       handled[LANE_SLIM] += cnt;
       errors[LANE_SLIM] += err;
     }
+    handled[LANE_STREAM] += sop;
+    errors[LANE_STREAM] += serr;
     std::string name = kv.first;
     size_t z = name.find('\0');
     if (z != std::string::npos) name[z] = '.';
     PyObject* md = Py_BuildValue(
-        "{s:i,s:K,s:K,s:K,s:K,s:K}", "kind", m->kind, "handled",
+        "{s:i,s:K,s:K,s:K,s:K,s:K,s:K,s:K,s:K}", "kind", m->kind,
+        "handled",
         (unsigned long long)cnt, "errors", (unsigned long long)err,
+        "stream_opens", (unsigned long long)sop,
+        "stream_errors", (unsigned long long)serr,
         "fb_rpc_att_over_cap",
         (unsigned long long)m->fb_att_over_cap.load(
             std::memory_order_relaxed),
@@ -3235,6 +4086,9 @@ static PyObject* Engine_telemetry(EngineObj* self, PyObject*) {
             std::memory_order_relaxed),
         "fb_rpc_trace_raw_lane",
         (unsigned long long)m->fb_trace_raw.load(
+            std::memory_order_relaxed),
+        "fb_stream_open",
+        (unsigned long long)m->fb_stream_open.load(
             std::memory_order_relaxed));
     if (!md || PyDict_SetItemString(methods, name.c_str(), md) != 0) {
       Py_XDECREF(md);
@@ -3284,6 +4138,11 @@ static PyObject* Engine_telemetry(EngineObj* self, PyObject*) {
   bool ok = out && fbd && lanes;
   for (int r = 0; ok && r < FB_REASONS; r++)
     ok = set_u64(fbd, kFbNames[r], fb[r]) == 0;
+  // kind-5 stream reasons ride the same fallback family (closed enum,
+  // one flat dict for /native + the fallback_total bvar) AND the
+  // dedicated streams section below
+  for (int r = 0; ok && r < SFB_REASONS; r++)
+    ok = set_u64(fbd, kStreamFbNames[r], sfb[r]) == 0;
   for (int ln = 0; ok && ln < kLanes; ln++) {
     PyObject* ld = PyDict_New();
     ok = ld != nullptr;
@@ -3346,6 +4205,46 @@ static PyObject* Engine_telemetry(EngineObj* self, PyObject*) {
     }
     if (ok) ok = PyDict_SetItemString(out, "conns", conns) == 0;
     Py_XDECREF(conns);
+  }
+  if (ok) {
+    // kind-5 streaming section: streams open, chunk/burst/credit
+    // accounting — the /native "streaming" block and the
+    // native_stream_* bvars read this
+    PyObject* sd = PyDict_New();
+    ok = sd != nullptr;
+    if (ok)
+      ok = set_u64(sd, "open",
+                   (uint64_t)eng->nstreams.load(
+                       std::memory_order_relaxed)) == 0;
+    if (ok) ok = set_u64(sd, "chunks_in", s_chunks_in) == 0;
+    if (ok) ok = set_u64(sd, "feedbacks_in", s_feedbacks) == 0;
+    if (ok)
+      ok = set_u64(sd, "chunks_out",
+                   eng->s_chunks_out.load(
+                       std::memory_order_relaxed)) == 0;
+    if (ok)
+      ok = set_u64(sd, "chunk_bytes_out",
+                   eng->s_chunk_bytes_out.load(
+                       std::memory_order_relaxed)) == 0;
+    if (ok)
+      ok = set_u64(sd, "credit_stalls",
+                   eng->s_credit_stalls.load(
+                       std::memory_order_relaxed)) == 0;
+    if (ok)
+      ok = set_u64(sd, "write_batches",
+                   eng->s_write_batches.load(
+                       std::memory_order_relaxed)) == 0;
+    if (ok) ok = set_hist(sd, "chunk_burst", sburst) == 0;
+    if (ok) {
+      PyObject* sfd = PyDict_New();
+      ok = sfd != nullptr;
+      for (int r = 0; ok && r < SFB_REASONS; r++)
+        ok = set_u64(sfd, kStreamFbNames[r], sfb[r]) == 0;
+      if (ok) ok = PyDict_SetItemString(sd, "fallbacks", sfd) == 0;
+      Py_XDECREF(sfd);
+    }
+    if (ok) ok = PyDict_SetItemString(out, "streams", sd) == 0;
+    Py_XDECREF(sd);
   }
   if (ok) ok = set_hist(out, "burst", burst) == 0;
   if (ok) ok = set_hist(out, "writev_iov", wiov) == 0;
@@ -3524,6 +4423,7 @@ static void Engine_dealloc(EngineObj* self) {
     }
     for (auto& kv : self->eng->native_methods) {
       Py_XDECREF(kv.second->handler);
+      Py_XDECREF(kv.second->stream_handler);
       delete kv.second;
     }
     for (auto& kv : self->eng->http_routes) {
@@ -3532,6 +4432,7 @@ static void Engine_dealloc(EngineObj* self) {
     }
     Py_XDECREF(self->eng->dispatch);
     Py_XDECREF(self->eng->burst_end);
+    Py_XDECREF(self->eng->stream_chunks);
     delete self->eng;
   }
   Py_TYPE(self)->tp_free((PyObject*)self);
@@ -3575,6 +4476,36 @@ static PyMethodDef Engine_methods[] = {
     {"set_burst_end", (PyCFunction)Engine_set_burst_end, METH_VARARGS,
      "set_burst_end(callable|None) — per-burst accounting epilogue "
      "called once after each batched shim entry; pre-listen only"},
+    {"set_stream_shim", (PyCFunction)Engine_set_stream_shim,
+     METH_VARARGS,
+     "set_stream_shim(svc, mth, handler) — kind-5 stream-OPEN shim "
+     "for a registered kind-3 method; pre-listen only"},
+    {"set_stream_chunks", (PyCFunction)Engine_set_stream_chunks,
+     METH_VARARGS,
+     "set_stream_chunks(callable|None) — batched chunk delivery: one "
+     "call per read burst with [(sid, flags, payload)]; pre-listen "
+     "only"},
+    {"set_stream_mode", (PyCFunction)Engine_set_stream_mode,
+     METH_VARARGS,
+     "set_stream_mode(mode) — 0 lane off, 1 on, 2 declined "
+     "(non-inline server); names the kind-5 fallback reason"},
+    {"stream_register", (PyCFunction)Engine_stream_register,
+     METH_VARARGS,
+     "stream_register(conn_id, sid, peer_sid, window) — adopt an "
+     "accepted stream onto the kind-5 lane (write credit accounted "
+     "in C++)"},
+    {"stream_unregister", (PyCFunction)Engine_stream_unregister,
+     METH_VARARGS,
+     "stream_unregister(sid) -> bool — drop a stream from the lane; "
+     "blocked producers wake closed"},
+    {"stream_write", (PyCFunction)Engine_stream_write, METH_VARARGS,
+     "stream_write(sid, payload, timeout_ms=10000) -> 0 ok | -1 "
+     "credit exhaustion | -2 closed/unknown"},
+    {"stream_write_many", (PyCFunction)Engine_stream_write_many,
+     METH_VARARGS,
+     "stream_write_many([(sid, payload)], timeout_ms=10000) -> "
+     "[status] — chunk-coalesced burst write: one owned buffer and "
+     "one writev per connection"},
     {"register_http_route", (PyCFunction)Engine_register_http_route,
      METH_VARARGS,
      "register_http_route(method, path, handler) — slim HTTP lane "

@@ -89,6 +89,8 @@ LAME_DUCK_TLV = b"\x17\x01\x00\x00\x00\x01"  # _T_LAME_DUCK, u8 1 — the
 TAG_SERVICE = _T_SERVICE
 TAG_METHOD = _T_METHOD
 TAG_AUTH = _T_AUTH
+TAG_STREAM_ID = _T_STREAM_ID
+TAG_STREAM_WINDOW = _T_STREAM_WINDOW
 TAG_ICI_DOMAIN = _T_ICI_DOMAIN
 TAG_ICI_DESC = _T_ICI_DESC
 TAG_ICI_CONN = _T_ICI_CONN

@@ -402,9 +402,31 @@ def _native(server, msg, rest):
                 cl["comp_burst"], cl["comp_burst_count"],
                 cl["comp_burst_sum"]),
         }
+    # STREAMING section (kind-5 lane): streams open, chunk flow both
+    # directions, the chunks-per-burst distribution and credit stalls
+    # (write-side backpressure events), plus the closed per-reason
+    # fallback table
+    st = t.get("streams", {})
+    streaming = {}
+    if st:
+        streaming = {
+            "open": st.get("open", 0),
+            "chunks_in": st.get("chunks_in", 0),
+            "chunks_out": st.get("chunks_out", 0),
+            "chunk_bytes_out": st.get("chunk_bytes_out", 0),
+            "feedbacks_in": st.get("feedbacks_in", 0),
+            "credit_stalls": st.get("credit_stalls", 0),
+            "write_batches": st.get("write_batches", 0),
+            "chunks_per_burst": _hist_view(
+                st["chunk_burst"], st["chunk_burst_count"],
+                st["chunk_burst_sum"]),
+            "fallbacks": {k: v for k, v in st.get("fallbacks",
+                                                  {}).items() if v},
+        }
     out = {
         "lanes": lanes,
         "fallbacks": dict(top_fallbacks),
+        "streaming": streaming,
         "client_lane": client_lane,
         "scatter_fallbacks": scatter_fallback_counters(),
         # deadline plane: per-(lane, method) doomed-work sheds — a

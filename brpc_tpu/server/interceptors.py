@@ -1,0 +1,157 @@
+"""One pipeline, N lane bindings — the per-lane-compiled interceptor
+chain (ROADMAP item 1's extraction, first bound by the kind-5 streaming
+lane).
+
+Every server lane runs the same cross-cutting stages around user code:
+
+    admission      the SHARED overload-plane stage (server/admission) —
+                   server cap, adaptive method cap, CoDel, tenant fair
+                   admission, drain rejection
+    deadline shed  queue-expired requests answered ERPCTIMEDOUT before
+                   user code runs, anchored at the engine parse stamp
+    trace extract  rpcz span sampling / forced spans for traced
+                   requests, backdated to the parse stamp
+    MethodStatus   per-method accounting + rpcz span completion
+    telemetry      latency fed to the adaptive limiters through
+                   on_responded / on_request_out
+
+Until this module, those stages were hand-replicated across six lane
+bodies (round 12's shared admission stage was the first slice; round
+14's lane linter pins the invariants mechanically).  :func:`compile_chain`
+composes them ONCE per (server, method, lane) into a flat
+``(enter, settle)`` closure pair — all per-entry state bound into
+default args, zero per-call abstraction cost (≈ brpc's per-protocol
+``process_request`` policy callbacks, protocol.h:92-146).  A lane that
+binds the chain cannot drop a stage or reorder admission after user
+code: the stages live HERE, the lane body only calls ``enter`` before
+user code and ``settle`` (or ``cntl.finish`` escalation) after.
+
+The chain is tpu_std-flavored (rejections serialize through the classic
+``_send_error`` builder, byte-identical with the hand-rolled lanes); a
+future HTTP binding compiles with its own rejection serializer.  The
+lane linter (tools/check/lanes.py) analyzes this module's ``enter``
+body for the admission-before-shed ordering and the lane body for the
+enter-before-user-code ordering — the binding is machine-checked, not
+a convention.
+"""
+
+from __future__ import annotations
+
+from time import monotonic_ns as _mono_ns
+
+from ..butil.iobuf import IOBuf
+from ..butil.status import Errno
+from ..deadline import arm as _arm_deadline
+from ..deadline import maybe_shed as _maybe_shed
+from ..protocol.meta import RpcMeta
+from ..rpcz import backdate_span, start_server_span
+from .admission import admit as _admit
+from .controller import ServerController
+from .rpc_dispatch import _send_error, _send_response
+
+_ELOGOFF = int(Errno.ELOGOFF)
+
+
+def compile_chain(server, entry, lane: str):
+    """Compile the cross-cutting stages for one (server, method, lane)
+    into a flat ``(enter, settle)`` pair.
+
+    ``enter(sock, cid, payload_len, att, dom, nonce, recv_ns, trace,
+    tmo, tenant)`` runs admission → deadline shed → trace extract and
+    returns a ready :class:`ServerController`, or ``None`` when the
+    request was rejected/shed (the client is already answered and every
+    taken count undone — the lane must not touch the request again).
+
+    ``settle(cntl, response_len)`` is the fast-completion epilogue:
+    MethodStatus + limiter latency feed + tenant slot release + span
+    finish.  Escalations (``cntl.finish``) settle through the classic
+    completion instead and must NOT also call ``settle``.
+    """
+    status = entry.status
+    full_name = status.full_name
+    svc, _, mth = full_name.partition(".")
+
+    def _send(cntl, response, _server=server, _entry=entry):
+        _send_response(_server, _entry, cntl, response)
+
+    def enter(sock, cid, payload_len, att, dom, nonce, recv_ns, trace,
+              tmo, tenant,
+              _server=server, _entry=entry, _status=status, _svc=svc,
+              _mth=mth, _send=_send, _admit_stage=_admit,
+              _shed=_maybe_shed, _arm=_arm_deadline,
+              _sample=start_server_span, _backdate=backdate_span,
+              _lane=lane):
+        if not _server.running:
+            _send_error(sock, cid, _ELOGOFF, "server is stopping")
+            return None
+        # ---- admission: the ONE shared overload-plane stage, FIRST —
+        # CoDel sojourn and the adaptive limiters measure from the
+        # engine's CLOCK_MONOTONIC parse stamp, so native batch
+        # queueing counts against the limit
+        rej = _admit_stage(_server, _entry, _lane, tenant,
+                           recv_ns // 1000)
+        if rej is not None:
+            # rejection serialization through the SHARED classic error
+            # builder (drain rejections carry the lame-duck TLV)
+            _send_error(sock, cid, rej.code, rej.text, server=_server)
+            return None
+        meta = RpcMeta()
+        meta.correlation_id = cid
+        meta.service_name = _svc
+        meta.method_name = _mth
+        if dom is not None:
+            sock.ici_peer_domain = dom
+            meta.ici_domain = dom
+        if nonce is not None and sock.ici_conn_token is None:
+            sock.ici_conn_token = nonce     # first write wins
+        if trace is not None:
+            meta.trace_id, meta.span_id, meta.parent_span_id = trace
+        if tenant is not None:
+            meta.tenant = tenant            # slot-release key
+        na = len(att) if att is not None else 0
+        if na:
+            meta.attachment_size = na
+        cntl = ServerController(meta, sock.remote_side, sock.id, _send)
+        cntl.server = _server
+        # latency measured from the ENGINE's frame-parse stamp (native
+        # queueing is where an overloaded server's latency lives)
+        cntl.begin_time_us = recv_ns // 1000
+        if tmo is not None:
+            meta.timeout_ms = tmo
+            _arm(cntl, tmo, recv_ns // 1000)
+        if na:
+            ab = IOBuf()
+            ab.append_user_data(att)
+            cntl._req_att = ab
+        # ---- trace extract: sampled spans + FORCED spans for traced
+        # requests, backdated so they cover native queueing
+        span = _sample(_status.full_name, meta, sock.remote_side)
+        if span is not None:
+            span.request_size = payload_len + na
+            _backdate(span, recv_ns)
+            cntl.span = span
+        # ---- deadline shed, AFTER admission (rejections are cheaper
+        # than armed deadlines), BEFORE user code
+        if tmo is not None and _shed(cntl, _lane, _status.full_name):
+            cntl.finish(None)
+            return None
+        return cntl
+
+    def settle(cntl, response_len,
+               _status=status, _server=server, _ns=_mono_ns):
+        """Fast-completion epilogue: MethodStatus settle (feeds the
+        adaptive limiters), tenant slot release, span completion."""
+        latency_us = _ns() // 1000 - cntl.begin_time_us
+        _status.on_responded(0, latency_us)
+        _server.on_request_out(tenant=cntl.request_meta.tenant,
+                               latency_us=latency_us)
+        if cntl._session_data is not None \
+                and _server._session_pool is not None:
+            _server._session_pool.give_back(cntl._session_data)
+            cntl._session_data = None
+        span = cntl.span
+        if span is not None:
+            span.response_size = response_len
+            span.finish(0)
+
+    return enter, settle

@@ -47,6 +47,14 @@ define_flag("enable_lame_duck", True,
             "(ELAMEDUCK) but peers only learn per-rejection",
             validator=lambda v: isinstance(v, bool))
 
+define_flag("graceful_quit_on_sigterm", False,
+            "install a SIGTERM handler that drains every live server "
+            "(unpublish, lame-duck, bounded in-flight + stream settle) "
+            "and then stops it — the brpc -graceful_quit_on_sigterm "
+            "shape.  Read at Server.start(); the handler can only "
+            "install from the main thread",
+            validator=lambda v: isinstance(v, bool))
+
 # drain phases (ints so the bvar graphs): the names ride /status
 DRAIN_SERVING, DRAIN_DRAINING, DRAIN_STOPPED = 0, 1, 2
 _DRAIN_PHASE_NAMES = ("serving", "draining", "stopped")
@@ -56,6 +64,68 @@ _DRAIN_PHASE_NAMES = ("serving", "draining", "stopped")
 DRAIN_FORCE_CLOSE_REASON = "drain_grace_expired"
 
 _live_servers: "_weakref.WeakSet[Server]" = _weakref.WeakSet()
+
+_sigterm_installed = False
+
+
+def _install_sigterm_drain() -> None:
+    """Signal-driven drain (``-graceful_quit_on_sigterm``): SIGTERM →
+    ``drain()`` then ``stop()`` on EVERY live server.  The handler only
+    spawns a worker thread (signal context must stay tiny); the worker
+    runs the normal grace-bounded drain, so in-flight requests finish
+    and streams close with the named lame-duck reason.  A serving
+    process parked in ``run_until_asked_to_quit()``/``join()`` then
+    returns from main and exits client-invisibly; an embedder doing
+    other work keeps running (we drain ITS servers, not its process).
+    A SECOND SIGTERM while/after draining restores the default
+    disposition and re-delivers — terminate now, gracefully-degraded —
+    so supervisors escalating before SIGKILL still get a clean death.
+    Installable from the main thread only (CPython restriction) —
+    elsewhere it degrades to a warning."""
+    global _sigterm_installed
+    if _sigterm_installed:
+        return
+    import signal as _signal
+
+    _drain_started = [False]
+
+    def _on_sigterm(_signum, _frame):
+        if _drain_started[0]:
+            # second TERM: the operator wants OUT — default disposition
+            # (handlers run on the main thread, so re-arming is legal)
+            _signal.signal(_signal.SIGTERM, _signal.SIG_DFL)
+            _os.kill(_os.getpid(), _signal.SIGTERM)
+            return
+        _drain_started[0] = True
+
+        def _drain_all():
+            for s in list(_live_servers):
+                if not s._started:
+                    continue
+                # per-server isolation: one replica's drain failure
+                # must not leave the REST of the process serving after
+                # SIGTERM (the supervisor would escalate to SIGKILL)
+                try:
+                    s.drain()
+                except Exception:
+                    LOG.exception("sigterm drain failed for %s",
+                                  s._listen_endpoint)
+                finally:
+                    try:
+                        s.stop()
+                    except Exception:
+                        LOG.exception("sigterm stop failed for %s",
+                                      s._listen_endpoint)
+
+        threading.Thread(target=_drain_all, name="sigterm-drain",
+                         daemon=True).start()
+
+    try:
+        _signal.signal(_signal.SIGTERM, _on_sigterm)
+        _sigterm_installed = True
+    except ValueError:
+        LOG.warning("graceful_quit_on_sigterm: not on the main "
+                    "thread; SIGTERM handler not installed")
 
 
 def _drain_state_now() -> int:
@@ -466,6 +536,9 @@ class Server:
             ep = parse_endpoint(str(addr))
         if self.options.num_workers > 0:
             fiber_runtime.set_concurrency(self.options.num_workers)
+        if bool(get_flag("graceful_quit_on_sigterm", False)):
+            # signal-driven drain: SIGTERM → grace-bounded drain + stop
+            _install_sigterm_drain()
 
         inherited_extras = []
         if inherit_from:
@@ -769,8 +842,14 @@ class Server:
         if self._native_bridge is not None:
             # engine: disarm listeners + append the lame-duck TLV to
             # natively-built responses + decline new kind-4 matches
+            # (new kind-5 stream opens decline under `stream_drain`)
             self._native_bridge.enter_lame_duck(
                 bool(get_flag("enable_lame_duck", True)))
+        # in-flight STREAMS settle too: each gets its current chunk
+        # window flushed (bounded by the same grace) then a FIN
+        # carrying the NAMED lame-duck reason — never cut mid-frame
+        from ..streaming import drain_server_streams
+        drain_server_streams(self, deadline)
         settled = self._wait_inflight_zero(deadline)
         if not settled:
             # in-flight stragglers: THOSE connections earn the named

@@ -22,6 +22,7 @@ import itertools
 import os
 import struct
 import threading
+import time
 from typing import Callable, Dict, List, Optional
 
 from .butil.iobuf import IOBuf
@@ -82,6 +83,18 @@ class Stream:
         self.id = _register(self)
         self.socket_id = 0
         self.peer_stream_id = 0
+        # named close reason: set locally by close(reason=...) or from
+        # the peer's F_CLOSE payload (wire-compatible — pre-reason
+        # receivers ignored the payload); surfaced through on_closed
+        self.close_reason: Optional[str] = None
+        # kind-5 native write lane: the engine that owns this stream's
+        # write-side credit window (server/stream_slim binds it after
+        # stream_register) — None means the Python credit path below
+        self._native_tx = None
+        # the Server that accepted this stream (stream_accept tags it):
+        # drain_server_streams closes a draining server's streams with
+        # a named reason instead of cutting them at force-close
+        self._server = None
         self._established = threading.Event()
         self._closed = False
         self._close_lock = threading.Lock()
@@ -137,12 +150,28 @@ class Stream:
         """Ordered write; blocks while the peer's window is full
         (≈ StreamWrite returning EAGAIN→wait, stream.cpp:277).
         IOBuf payloads ride zero-copy (block refs shared into the
-        frame, never flattened)."""
+        frame, never flattened).  Streams adopted onto the engine's
+        kind-5 lane route through the C++ credit window instead
+        (chunk framed natively, backpressure = credit exhaustion)."""
         if isinstance(data, str):
             data = data.encode()
         if not self._established.wait(self.options.write_timeout_s):
             return int(Errno.EINTERNAL)
         if self._closed:
+            return int(Errno.EEOF)
+        engine = self._native_tx
+        if engine is not None:
+            if isinstance(data, IOBuf):
+                data = data.to_bytes()
+            st = engine.stream_write(
+                self.id, data,
+                int(self.options.write_timeout_s * 1000))
+            if st == 0:
+                return 0
+            if st == -1:
+                return int(Errno.EOVERCROWDED)   # credit exhaustion
+            # closed / connection gone
+            self._on_conn_broken()
             return int(Errno.EEOF)
         with self._cond:
             # admit while ANY credit remains (stream.cpp:277) — requiring
@@ -192,7 +221,15 @@ class Stream:
             self._close_local(notify_peer=False)
         elif flags == F_CLOSE:
             # ordered close: runs through the deliver queue so data cut
-            # before the FIN is handed to on_received first
+            # before the FIN is handed to on_received first.  A non-
+            # empty payload is the peer's NAMED close reason (drain
+            # lame-duck, decode "finished", ...)
+            if payload and self.close_reason is None:
+                try:
+                    self.close_reason = bytes(payload).decode(
+                        "utf-8", "replace")
+                except Exception:
+                    self.close_reason = "peer_close"
             self._deliver.execute(_CLOSE_SENTINEL)
 
     def _deliver_batch(self, it) -> None:
@@ -222,17 +259,56 @@ class Stream:
 
     # -- teardown ----------------------------------------------------------
 
-    def close(self) -> None:
-        """Graceful: FIN to peer, then local close."""
-        self._close_local(notify_peer=True)
+    def close(self, reason: Optional[str] = None) -> None:
+        """Graceful: FIN to peer (carrying the NAMED reason when
+        given), then local close."""
+        self._close_local(notify_peer=True, reason=reason)
 
-    def _close_local(self, notify_peer: bool) -> None:
+    def drain_close(self, reason: str, settle_timeout_s: float) -> None:
+        """Operability-plane close: give the CURRENT chunk window a
+        short bounded settle before the FIN, so a draining server ends
+        streams after the in-flight chunks instead of cutting a
+        producer mid-window.  The FIN itself is ordered AFTER every
+        already-queued data frame on the connection, so delivery never
+        truncates regardless; this wait only lets an in-progress
+        producer finish.  It is deliberately capped well below the
+        drain grace — receivers ack at half-window granularity, so
+        ``produced == consumed`` may never hold and an uncapped wait
+        would burn the whole grace on the first stream (starving the
+        in-flight RPC settle that follows).  Native-lane streams
+        settle through the engine's write queue (their ledger lives in
+        C++)."""
+        if self._closed:
+            return
+        if self._native_tx is None:
+            cap = min(max(settle_timeout_s, 0.0), 0.25)
+            with self._cond:
+                self._cond.wait_for(
+                    lambda: self._closed
+                    or self._produced <= self._remote_consumed,
+                    timeout=cap)
+        self.close(reason=reason)
+
+    def _close_local(self, notify_peer: bool,
+                     reason: Optional[str] = None) -> None:
         with self._close_lock:
             if self._closed:
                 return
             self._closed = True
+        if reason is not None and self.close_reason is None:
+            self.close_reason = reason
+        engine = self._native_tx
+        if engine is not None:
+            # drop off the kind-5 lane FIRST: a racing producer fails
+            # fast instead of writing after the FIN
+            self._native_tx = None
+            try:
+                engine.stream_unregister(self.id)
+            except Exception:
+                pass
         if notify_peer and self.peer_stream_id:
-            self._send_frame(F_CLOSE)
+            self._send_frame(F_CLOSE,
+                            reason.encode() if reason else b"")
         with self._cond:
             self._cond.notify_all()
         sock = Socket.address(self.socket_id)
@@ -270,8 +346,30 @@ def stream_accept(cntl, options: Optional[StreamOptions] = None) \
     if not peer_id:
         return None
     s = Stream(options)
+    s._server = getattr(cntl, "server", None)   # drain enumeration
     s._bind(cntl.socket_id, peer_id,
             peer_window=cntl.request_meta.stream_window)
     cntl._accepted_stream_id = s.id
     cntl._accepted_stream_window = s.options.max_buf_size
     return s
+
+
+def server_streams(server) -> List[Stream]:
+    """Live streams accepted by ``server`` (tagged at stream_accept)."""
+    with _streams_lock:
+        return [s for s in _streams.values() if s._server is server]
+
+
+def drain_server_streams(server, deadline_mono: float,
+                         reason: str = "lame_duck") -> int:
+    """Operability plane: gracefully end every in-flight stream a
+    draining server accepted — each gets the bounded current-window
+    settle then a FIN carrying the NAMED reason, instead of dying at
+    the drain's force-close.  Bounded by ``deadline_mono`` (the drain
+    grace); returns how many streams were closed."""
+    n = 0
+    for s in server_streams(server):
+        left = deadline_mono - time.monotonic()
+        s.drain_close(reason, settle_timeout_s=max(left, 0.0))
+        n += 1
+    return n

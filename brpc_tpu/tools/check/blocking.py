@@ -81,6 +81,19 @@ ENTRY_POINTS: Tuple[Tuple[str, Tuple[str, ...]], ...] = (
     ("brpc_tpu/transport/client_lane.py", ("drain_settle",)),
     ("brpc_tpu/server/hot_restart.py", ("handoff_listeners",)),
     ("brpc_tpu/server/hot_restart.py", ("import_listeners",)),
+    # kind-5 streaming lane (ISSUE 13): the stream-open shim and the
+    # batched chunk delivery run inside the engine's per-burst GIL
+    # entry, ON a loop thread; the compiled interceptor chain they
+    # bind is loop-thread code by the same contract
+    ("brpc_tpu/server/stream_slim.py",
+     ("make_stream_handler", "slim")),
+    ("brpc_tpu/server/stream_slim.py", ("slim_chunks",)),
+    ("brpc_tpu/server/interceptors.py", ("compile_chain", "enter")),
+    ("brpc_tpu/server/interceptors.py", ("compile_chain", "settle")),
+    # drain-path stream settle: deadline-bounded by contract, same
+    # un-timed-primitive lint as Server.drain
+    ("brpc_tpu/streaming.py", ("drain_server_streams",)),
+    ("brpc_tpu/streaming.py", ("Stream", "drain_close")),
 )
 
 # names whose call is a handoff, not an execution: arguments/targets

@@ -24,6 +24,7 @@ BRIDGE = "brpc_tpu/transport/native_bridge.py"
 CLIENT_LANE = "brpc_tpu/transport/client_lane.py"
 SLIM = "brpc_tpu/server/slim_dispatch.py"
 HTTP_SLIM = "brpc_tpu/server/http_slim.py"
+STREAM_SLIM = "brpc_tpu/server/stream_slim.py"
 
 # struct format char -> byte width (the meta codec's fixed-size fields)
 _WIDTHS = {"Q": 8, "q": 8, "I": 4, "i": 4, "H": 2, "h": 2, "B": 1}
@@ -165,6 +166,32 @@ def _check_reason_tables(tree, eng, findings) -> None:
                       "reason — per-route attribution would invent a "
                       "name the global family never exports")
 
+    # kind-5 streaming lane: StreamFb vs kStreamFbNames vs the
+    # stream_slim mirror
+    sfb = cppscan.parse_enum(eng, "StreamFb")
+    sfb_names = cppscan.parse_string_array(eng, "kStreamFbNames")
+    if sfb is None or sfb_names is None:
+        _fail(findings, ENGINE, 1,
+              "StreamFb enum or kStreamFbNames table not found")
+    else:
+        sfb_members = [m for m in sfb if m != "SFB_REASONS"]
+        if len(sfb_members) != len(sfb_names):
+            _fail(findings, ENGINE, 1,
+                  f"StreamFb has {len(sfb_members)} members but "
+                  f"kStreamFbNames has {len(sfb_names)} strings — the "
+                  "kind-5 reason-name table drifted from the enum")
+        smirror = _module_tuple(tree, STREAM_SLIM, "STREAM_FB_NAMES")
+        if smirror is None:
+            _fail(findings, STREAM_SLIM, 1,
+                  "STREAM_FB_NAMES mirror missing from stream_slim "
+                  "(the kind-5 fallback pre-seed must cover every "
+                  "engine reason)")
+        elif list(smirror) != list(sfb_names):
+            _fail(findings, STREAM_SLIM, 1,
+                  f"stream_slim STREAM_FB_NAMES != engine "
+                  f"kStreamFbNames: "
+                  f"{sorted(set(smirror) ^ set(sfb_names)) or 'order differs'}")
+
     # client lane: CliFb vs kCliFbNames vs the Python REASONS tuple
     cli = cppscan.parse_enum(eng, "CliFb")
     cli_names = cppscan.parse_string_array(eng, "kCliFbNames")
@@ -290,6 +317,39 @@ def _check_shim_arities(tree, eng, findings) -> None:
                   f"engine calls the kind-2 raw handler with "
                   f"{len(kind2)} args; @raw_method's contract is "
                   "(payload, attachment)")
+
+    # kind-5 (stream open) shim
+    s5_sites = cppscan.call_sites(eng, "PyObject_CallFunctionObjArgs",
+                                  "it.m->stream_handler")
+    if not s5_sites:
+        _fail(findings, ENGINE, 1,
+              "kind-5 stream shim call site not found")
+    else:
+        want = _public_def_arity(tree, STREAM_SLIM,
+                                 ["make_stream_handler", "slim"])
+        if want is None:
+            _fail(findings, STREAM_SLIM, 1,
+                  "make_stream_handler's inner slim() def not found")
+        elif len(s5_sites[0][1]) != want:
+            _fail(findings, ENGINE, 1,
+                  f"engine calls the kind-5 stream shim with "
+                  f"{len(s5_sites[0][1])} args but stream_slim's shim "
+                  f"takes {want} — the contract grew/shrank on one "
+                  "side only")
+
+    # batched stream-chunk delivery: one-list contract
+    chunk_sites = cppscan.call_sites(eng, "PyObject_CallFunctionObjArgs",
+                                     "lp->eng->stream_chunks")
+    if not chunk_sites:
+        _fail(findings, ENGINE, 1,
+              "stream chunk delivery call site not found")
+    else:
+        want = _public_def_arity(tree, STREAM_SLIM, ["slim_chunks"])
+        if want is not None and len(chunk_sites[0][1]) != want:
+            _fail(findings, ENGINE, 1,
+                  f"engine calls stream_chunks with "
+                  f"{len(chunk_sites[0][1])} args but slim_chunks "
+                  f"takes {want}")
 
     # kind-4 (slim HTTP) shim
     http_sites = cppscan.call_sites(eng, "PyObject_CallFunctionObjArgs",

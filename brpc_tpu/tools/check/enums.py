@@ -35,6 +35,7 @@ _ENUM_PREFIX = {
     "CFB_": "CliFb",
     "RFB_": "RouteFb",
     "DP_": "DpStage",
+    "SFB_": "StreamFb",
 }
 # python-side identifiers sharing an enum prefix that are NOT engine
 # constants (the bridge's name-table mirror)
@@ -114,7 +115,7 @@ def check_enums(tree: Tree) -> List[Finding]:
 
     # 2. every exportable reason name has a test pin
     reason_names: List[Tuple[str, str]] = []      # (name, origin)
-    for arr in ("kFbNames", "kCliFbNames"):
+    for arr in ("kFbNames", "kCliFbNames", "kStreamFbNames"):
         for n in cppscan.parse_string_array(eng, arr) or []:
             reason_names.append((n, f"{ENGINE} ({arr})"))
     for rel, _text, mod in pkg:

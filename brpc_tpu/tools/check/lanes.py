@@ -71,10 +71,31 @@ LANES = (
         "reject": {"kind": "grpc8"},
         "optional": {"trace", "shed"},
     },
+    {
+        # kind-5 streaming lane — the FIRST interceptor-chain BINDING
+        # (ROADMAP item 1): the cross-cutting stages live in the
+        # compiled chain (server/interceptors.py), not the lane body.
+        # The linter checks the CHAIN for admission→shed ordering,
+        # trace extraction and the shared rejection serializer, and
+        # the LANE BODY for chain-enter-before-user-code plus the
+        # settle call — a binding lane cannot drop or reorder a stage
+        # without one of the two halves failing here.
+        "lane": "stream_slim",
+        "path": "brpc_tpu/server/stream_slim.py",
+        "func": ["make_stream_handler", "slim"],
+        "reject": {"kind": "call", "names": {"_send_error"}},
+        "chain": {
+            "path": "brpc_tpu/server/interceptors.py",
+            "func": ["compile_chain", "enter"],
+            "settle_func": ["compile_chain", "settle"],
+            "entry_names": {"_enter", "enter"},
+            "settle_names": {"_settle", "settle"},
+        },
+    },
 )
 
-ADMIT_NAMES = {"admit", "_admit", "_admit_rpc", "_trivial",
-               "trivial_shape"}
+ADMIT_NAMES = {"admit", "_admit", "_admit_rpc", "_admit_stage",
+               "_trivial", "trivial_shape"}
 SHED_NAMES = {"maybe_shed", "_maybe_shed", "_shed"}
 TRACE_NAMES = {"start_server_span", "passive_server_span",
                "parse_traceparent", "_sample", "_pspan"}
@@ -147,11 +168,113 @@ def _block_has_grpc8(block: ast.If) -> bool:
     return False
 
 
+def _check_chain_lane(tree: Tree, spec, findings: List[Finding]) -> None:
+    """An interceptor-chain BINDING lane: the mandatory stages live in
+    the compiled chain, the lane body only calls enter/settle.  Two
+    halves, both machine-checked:
+
+    - CHAIN (interceptors.enter): admission present and BEFORE the
+      deadline shed; trace extraction present; every ``if rej:`` block
+      serializes through the shared helper;
+    - LANE BODY: the chain-enter call runs BEFORE user code, and the
+      settle half (chain ``settle`` with the MethodStatus
+      ``on_responded``) is actually invoked.
+    """
+    lane, path = spec["lane"], spec["path"]
+    chain = spec["chain"]
+    cpath = chain["path"]
+    try:
+        cmod = ast.parse(tree.text(cpath))
+    except SyntaxError as e:
+        _fail(findings, cpath, e.lineno or 1, lane,
+              f"chain syntax error: {e.msg}")
+        return
+    enter = _find_func(cmod, chain["func"])
+    if enter is None:
+        _fail(findings, cpath, 1, lane,
+              f"chain function {'.'.join(chain['func'])} not found")
+        return
+    ccalls = _calls(enter)
+    admit_at = _first_line(ccalls, ADMIT_NAMES)
+    shed_at = _first_line(ccalls, SHED_NAMES)
+    trace_at = _first_line(ccalls, TRACE_NAMES)
+    if admit_at is None:
+        _fail(findings, cpath, enter.lineno, lane,
+              "chain enter is missing the mandatory admission stage "
+              "(server/admission.admit)")
+    if shed_at is None:
+        _fail(findings, cpath, enter.lineno, lane,
+              "chain enter is missing the deadline shed "
+              "(deadline.maybe_shed)")
+    if admit_at is not None and shed_at is not None \
+            and admit_at > shed_at:
+        _fail(findings, cpath, admit_at, lane,
+              "chain admission must precede the deadline shed "
+              "(rejections are cheaper than armed deadlines)")
+    if trace_at is None:
+        _fail(findings, cpath, enter.lineno, lane,
+              "chain enter is missing trace extraction "
+              "(start_server_span family)")
+    blocks = _rejection_blocks(enter)
+    if admit_at is not None and not blocks:
+        _fail(findings, cpath, enter.lineno, lane,
+              "no `if rej is not None` rejection guard found in the "
+              "chain — admission verdicts are not being honored")
+    for block in blocks:
+        if not _block_has_call(block, spec["reject"]["names"]):
+            _fail(findings, cpath, block.lineno, lane,
+                  "chain rejection block does not serialize through "
+                  "the shared helper "
+                  f"({' / '.join(sorted(spec['reject']['names']))})")
+    settle_fn = _find_func(cmod, chain["settle_func"])
+    if settle_fn is None or _first_line(_calls(settle_fn),
+                                        SETTLE_NAMES) is None:
+        _fail(findings, cpath, enter.lineno, lane,
+              "chain settle half is missing the MethodStatus settle "
+              "(on_responded) — admission in-flight counts would leak")
+    # -- the lane body: enter-before-user-code + settle invoked --------
+    try:
+        mod = ast.parse(tree.text(path))
+    except SyntaxError as e:
+        _fail(findings, path, e.lineno or 1, lane,
+              f"syntax error: {e.msg}")
+        return
+    func = _find_func(mod, spec["func"])
+    if func is None:
+        _fail(findings, path, 1, lane,
+              f"lane function {'.'.join(spec['func'])} not found")
+        return
+    calls = _calls(func)
+    user_at = _first_line(calls, USER_FN_NAMES)
+    enter_at = _first_line(calls, set(chain["entry_names"]))
+    settle_at = _first_line(calls, set(chain["settle_names"]))
+    if user_at is None:
+        _fail(findings, path, func.lineno, lane,
+              "no user-code invocation (entry.fn/raw_fn) found — "
+              "lane shape changed, update the linter spec")
+        return
+    if enter_at is None:
+        _fail(findings, path, func.lineno, lane,
+              "lane body never calls the compiled interceptor chain "
+              "(enter) — the binding is gone")
+    elif enter_at > user_at:
+        _fail(findings, path, enter_at, lane,
+              f"chain enter runs at line {enter_at}, AFTER user code "
+              f"at line {user_at} — the stages must run first")
+    if settle_at is None:
+        _fail(findings, path, func.lineno, lane,
+              "lane body never calls the chain settle half — "
+              "fast completions would skip MethodStatus/rpcz")
+
+
 def check_lanes(tree: Tree) -> List[Finding]:
     findings: List[Finding] = []
     for spec in LANES:
         lane, path = spec["lane"], spec["path"]
         optional = spec.get("optional", set())
+        if "chain" in spec:
+            _check_chain_lane(tree, spec, findings)
+            continue
         try:
             mod = ast.parse(tree.text(path))
         except SyntaxError as e:

@@ -52,6 +52,9 @@ WATCHED_RATIOS = (
     # there, so the recorded baseline, not an absolute bar, is the gate
     "loop_scaling_efficiency",
     "loop_scaling_efficiency_4loop",
+    # kind-5 streaming lane (ISSUE 13): paired interleaved A/B of the
+    # native stream transport vs the forced-Python lane at c=64
+    "stream_native_vs_py",
 )
 
 # Recorded baselines for keys that predate any BENCH_r*.json capture —
@@ -74,6 +77,13 @@ RECORDED_BASELINE = {
     # probe (client+server halves in one process — PERF §15)
     "drain_p99_victim_ms": 1.83,
     "conns_10k_rss_mb": 31.6,
+    # ISSUE 13 streaming-lane keys (session box, 2026-08): c=64
+    # sessions, 4 client processes; the A/B ratio is the native stream
+    # transport vs the forced-Python lane, paired interleaved
+    "stream_native_vs_py": 4.68,
+    "stream_tokens_per_s": 3391.3,
+    "stream_ttft_p99_ms": 319.66,
+    "decode_stream_sessions": 64.0,
 }
 
 # keys pinned at EXACTLY zero: any non-zero value fails the gate
@@ -83,7 +93,8 @@ RECORDED_BASELINE = {
 PINNED_ZERO = ("rolling_restart_failed_rpcs",)
 
 _HIGHER = ("_qps", "_gbps", "gbps", "_rps", "_tok_s", "tokens_per_s",
-           "_tflops", "_speedup", "_frac", "_factor_inverse")
+           "_tflops", "_speedup", "_frac", "_factor_inverse",
+           "_sessions")
 _LOWER = ("_us", "_ms", "_p50", "_p99", "_rss_mb")
 # gap keys measure raw/cntl — LOWER is better (a shrinking gap is the
 # win); amplification likewise

@@ -120,6 +120,13 @@ define_flag("engine_reuseport", True,
             "their accepting loop for life); off = single shared "
             "listener with round-robin adopt handoff",
             validator=lambda v: isinstance(v, bool))
+define_flag("rpc_native_stream_lane", True,
+            "kind-5 native streaming lane: stream opens dispatch "
+            "through the stream shim, chunk bursts enter Python once, "
+            "write credit is accounted in C++.  Off = every stream "
+            "rides the Python lane (the A/B switch; live-flippable — "
+            "already-adopted streams keep their lane)",
+            validator=lambda v: isinstance(v, bool))
 
 
 def default_engine_loops() -> int:
@@ -145,6 +152,12 @@ FB_REASON_NAMES = (
     "http_transfer_encoding", "http_bad_header", "http_large_body",
     "http_chunk_stream", "http_lame_duck",
 )
+
+# kind-5 streaming-lane reasons ride the same engine fallback family;
+# the authoritative mirror of kStreamFbNames lives next to the lane
+# (server/stream_slim.STREAM_FB_NAMES, machine-checked by
+# tools/check/contracts) — the fallback_total pre-seed below pulls it
+# lazily so every stream reason row exists from the first scrape
 
 
 # ---------------------------------------------------------------------------
@@ -295,6 +308,7 @@ class NativeBridge:
         self._socks: Dict[int, Any] = {}      # engine conn_id -> NativeSocket
         self._pt_queues: Dict[int, Any] = {}  # per-conn dispatch serializers
         self._native_ok = False
+        self._stream_capable = False          # kind-5 shims registered
         self._native_vars = []                # PassiveStatus keep-alives
         # one engine.telemetry() snapshot per sampling interval feeds
         # every native_engine_* var, the /native portal and /hotspots
@@ -386,6 +400,18 @@ class NativeBridge:
                                          svc, mth)
                 self.engine.register_native_method(svc, mth, 3, b"",
                                                    shim)
+                # kind-5 STREAMING lane: the same method's stream-open
+                # variant — requests carrying the stream TLVs dispatch
+                # to the stream shim (interceptor-chain binding) and
+                # accepted streams are adopted onto the engine's
+                # credit-accounted transport
+                if bool(get_flag("rpc_native_stream_lane", True)):
+                    from ..server.stream_slim import make_stream_handler
+                    self.engine.set_stream_shim(
+                        svc, mth,
+                        make_stream_handler(self, self._server, entry,
+                                            svc, mth))
+                    self._stream_capable = True
             safe = f"{svc}_{mth}".lower()
             cache = self.telemetry
 
@@ -496,11 +522,39 @@ class NativeBridge:
                           name="native_engine_wq_hwm"))
         add(PassiveStatus(lambda c=cache: c.get()["inbuf_hwm"],
                           name="native_engine_inbuf_hwm"))
+        from ..server.stream_slim import STREAM_FB_NAMES
         add(_PassiveDim(("reason",),
-                        lambda c=cache: {
+                        lambda c=cache, _sfb=STREAM_FB_NAMES: {
                             **{r: 0 for r in FB_REASON_NAMES},
+                            **{r: 0 for r in _sfb},
                             **c.get()["fallbacks"]},
                         name="native_engine_fallback_total"))
+        # kind-5 streaming lane: streams open, chunk flow, credit
+        # stalls (the /native "streaming" section reads the same
+        # snapshot's streams dict)
+        add(PassiveStatus(
+            lambda c=cache: c.get().get("streams", {}).get("open", 0),
+            name="native_stream_open"))
+        add(PassiveStatus(
+            lambda c=cache: c.get().get("streams", {}).get(
+                "chunks_in", 0),
+            name="native_stream_chunks_in"))
+        add(PassiveStatus(
+            lambda c=cache: c.get().get("streams", {}).get(
+                "chunks_out", 0),
+            name="native_stream_chunks_out"))
+        add(PassiveStatus(
+            lambda c=cache: c.get().get("streams", {}).get(
+                "credit_stalls", 0),
+            name="native_stream_credit_stalls"))
+
+        def _chunk_burst(_c=cache):
+            bks = _c.get().get("streams", {}).get("chunk_burst", [])
+            return {bucket_label(i, len(bks)): n
+                    for i, n in enumerate(bks)}
+
+        add(_PassiveDim(("bin",), _chunk_burst,
+                        name="native_stream_chunk_burst"))
         add(_PassiveDim(("stage",),
                         lambda c=cache: c.get().get("data_plane_copies",
                                                     {}),
@@ -612,6 +666,24 @@ class NativeBridge:
         self._register_native_methods()
         self._register_http_routes()
         self._register_engine_vars()
+        # kind-5 streaming lane: batched chunk delivery (pre-listen)
+        # and the lane mode — mode 2 NAMES the non-inline decline,
+        # mode 0 the no-capability one (closed StreamFb enum); the
+        # lane flag is live-flippable for the native-vs-Python A/B
+        # (already-adopted streams keep their lane)
+        from ..server.stream_slim import slim_chunks
+        self.engine.set_stream_chunks(slim_chunks)
+
+        def _stream_mode(enabled, _self=self) -> int:
+            if not _self._server.options.usercode_inline:
+                return 2
+            return 1 if (_self._stream_capable and bool(enabled)) else 0
+
+        self.engine.set_stream_mode(
+            _stream_mode(get_flag("rpc_native_stream_lane", True)))
+        watch_flag("rpc_native_stream_lane",
+                   lambda v, _e=self.engine, _m=_stream_mode:
+                   _e.set_stream_mode(_m(v)))
         from ..protocol.base import max_body_size
         self.engine.set_http_max_body(int(max_body_size()))
         # kind-3 domain-exchange answers: the local ici-domain TLV is a

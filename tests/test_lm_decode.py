@@ -2,6 +2,8 @@
 greedy generate shapes/determinism, MoE decode, and the LMService
 serving generation over a real RPC server."""
 
+import time
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -106,6 +108,260 @@ def test_lm_service_generates_over_rpc():
         c = ch.call_method("LM.Generate",
                            pack_generate_request(np.asarray(prompt), 999),
                            cntl=bad)
+        assert c.failed and "max_new" in c.error_text
+    finally:
+        srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# continuous batching (ISSUE 13): per-slot batch decode + the streaming
+# Decode service — join-mid-batch, evict, TTFT under load
+# ---------------------------------------------------------------------------
+
+def test_batch_decode_matches_solo_decode():
+    """A slot inside the continuous batch produces the same tokens as
+    a solo make_decode run (per-element math is independent)."""
+    import functools as ft
+
+    from brpc_tpu.models.transformer_lm import (empty_batch_cache,
+                                                make_batch_decode)
+
+    cfg, params, prompt = _setup()
+    prefill, step = make_batch_decode(cfg)
+    cache = empty_batch_cache(cfg, 4)
+    # insert session 0 (prompt row 0) into slot 2, nothing else active
+    c1, logits = jax.jit(ft.partial(prefill, params))(prompt[:1])
+    for i in range(cfg.depth):
+        cache[f"k{i}"] = cache[f"k{i}"].at[2].set(c1[f"k{i}"][0])
+        cache[f"v{i}"] = cache[f"v{i}"].at[2].set(c1[f"v{i}"][0])
+    cache["len"] = cache["len"].at[2].set(prompt.shape[1])
+    active = jnp.zeros((4,), bool).at[2].set(True)
+    toks = [int(jnp.argmax(logits[0]))]
+    tokens = jnp.zeros((4,), jnp.int32).at[2].set(toks[0])
+    step_j = jax.jit(ft.partial(step, params))
+    for _ in range(5):
+        cache, lg = step_j(cache, tokens, active)
+        t = int(jnp.argmax(lg[2]))
+        toks.append(t)
+        tokens = tokens.at[2].set(t)
+    want = np.asarray(generate(params, cfg, prompt[:1], 6))[0].tolist()
+    assert toks == want
+
+
+def test_batch_decode_scan_layers_rejected():
+    from brpc_tpu.models.transformer_lm import make_batch_decode
+    cfg = LMConfig(vocab=64, dim=32, heads=2, depth=2, max_seq=16,
+                   scan_layers=True)
+    with pytest.raises(NotImplementedError, match="unrolled"):
+        make_batch_decode(cfg)
+
+
+def _decode_server(cfg, params, slots=4):
+    from brpc_tpu.models.lm_service import LMService
+    from brpc_tpu.server import Server
+
+    srv = Server()
+    svc = LMService(cfg=cfg, params=params, decode_slots=slots)
+    srv.add_service(svc, name="LM")
+    assert srv.start("127.0.0.1:0") == 0
+    return srv, svc
+
+
+def _stream_decode(srv, prompt, max_new, timeout=120.0):
+    """One streamed decode session: returns (tokens, close_reason,
+    ttft_seconds)."""
+    import time
+
+    from brpc_tpu.client import Channel, Controller
+    from brpc_tpu.models.lm_service import (pack_generate_request,
+                                            unpack_token)
+    from brpc_tpu.streaming import StreamOptions, stream_create
+
+    toks, closed, first = [], [], []
+
+    def on_received(st, msgs):
+        if not first:
+            first.append(time.monotonic())
+        toks.extend(unpack_token(m) for m in msgs)
+
+    ch = Channel()
+    ch.init(str(srv.listen_endpoint))
+    cntl = Controller()
+    cntl.timeout_ms = int(timeout * 1000)
+    stream = stream_create(cntl, StreamOptions(
+        on_received=on_received,
+        on_closed=lambda st: closed.append(st.close_reason)))
+    t0 = time.monotonic()
+    c = ch.call_method("LM.Decode",
+                       pack_generate_request(prompt, max_new),
+                       cntl=cntl)
+    assert not c.failed, (c.error_code, c.error_text)
+    deadline = time.monotonic() + timeout
+    while not closed and time.monotonic() < deadline:
+        time.sleep(0.005)
+    assert closed, "decode stream never closed"
+    return toks, closed[0], (first[0] - t0 if first else None)
+
+
+def test_decode_streams_tokens_and_finishes():
+    """Server-streaming decode: one token chunk per step, greedy-
+    identical with Generate, stream closed with reason 'finished'."""
+    cfg, params, prompt = _setup()
+    srv, svc = _decode_server(cfg, params)
+    try:
+        toks, reason, ttft = _stream_decode(srv, np.asarray(prompt[:1]),
+                                            6)
+        want = np.asarray(generate(params, cfg, prompt[:1], 6))[0]
+        assert toks == want.tolist()
+        assert reason == "finished"
+        assert ttft is not None
+    finally:
+        srv.stop()
+
+
+def test_decode_join_mid_batch_and_evict():
+    """Continuous batching: a second session joins while the first is
+    mid-generation; both produce their solo-greedy tokens; finished
+    sessions evict and free their slot for reuse."""
+    import threading
+
+    cfg, params, prompt = _setup()
+    p2 = np.asarray(jax.random.randint(jax.random.PRNGKey(7), (1, 5),
+                                       0, cfg.vocab, jnp.int32))
+    srv, svc = _decode_server(cfg, params, slots=2)
+    try:
+        res = {}
+        t1 = threading.Thread(target=lambda: res.__setitem__(
+            "a", _stream_decode(srv, np.asarray(prompt[:1]), 10)))
+        t1.start()
+        time.sleep(0.3)          # a is mid-generation; b joins the batch
+        res["b"] = _stream_decode(srv, p2, 4)
+        t1.join(120)
+        wa = np.asarray(generate(params, cfg, prompt[:1], 10))[0]
+        wb = np.asarray(generate(params, cfg, p2, 4))[0]
+        assert res["a"][0] == wa.tolist()
+        assert res["b"][0] == wb.tolist()
+        assert res["a"][1] == res["b"][1] == "finished"
+        # both evicted: slots free again, and a THIRD session reuses one
+        deadline = time.time() + 10
+        while svc.batcher().live_slots() and time.time() < deadline:
+            time.sleep(0.01)
+        assert svc.batcher().live_slots() == 0
+        toks, reason, _ = _stream_decode(srv, p2, 3)
+        assert toks == wb.tolist()[:3]
+        assert reason == "finished"
+    finally:
+        srv.stop()
+
+
+def test_decode_ttft_under_load():
+    """TTFT: with more sessions than slots, queued sessions still get
+    their first token as soon as a slot frees (prefill-on-join emits
+    immediately), and every session completes correctly."""
+    import threading
+
+    cfg, params, prompt = _setup()
+    srv, svc = _decode_server(cfg, params, slots=2)
+    try:
+        results = {}
+
+        def one(i):
+            results[i] = _stream_decode(srv, np.asarray(prompt[:1]), 5)
+
+        threads = [threading.Thread(target=one, args=(i,))
+                   for i in range(5)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(180)
+        want = np.asarray(generate(params, cfg, prompt[:1], 5))[0]
+        for i, (toks, reason, ttft) in results.items():
+            assert toks == want.tolist(), i
+            assert reason == "finished"
+            assert ttft is not None and ttft < 120
+    finally:
+        srv.stop()
+
+
+def test_decode_stalled_client_evicted_not_hol_blocking():
+    """A client that stops consuming (tiny window, handler wedged) is
+    evicted with reason 'backpressure' after ONE bounded stall — it
+    must not head-of-line-block the other live sessions' tokens."""
+    import threading
+
+    from brpc_tpu.client import Channel, Controller
+    from brpc_tpu.models.lm_service import pack_generate_request
+    from brpc_tpu.streaming import StreamOptions, stream_create
+
+    cfg, params, prompt = _setup()
+    srv, svc = _decode_server(cfg, params, slots=4)
+    try:
+        ch = Channel()
+        ch.init(str(srv.listen_endpoint))
+        stall_closed = []
+        wedge = threading.Event()
+        cntl = Controller()
+        cntl.timeout_ms = 120_000
+        stalled = stream_create(cntl, StreamOptions(
+            on_received=lambda s, m: wedge.wait(60),
+            on_closed=lambda s: stall_closed.append(s.close_reason),
+            max_buf_size=16))           # 4 tokens of credit, no acks
+        c = ch.call_method("LM.Decode",
+                           pack_generate_request(
+                               np.asarray(prompt[:1]), 20), cntl=cntl)
+        assert not c.failed, c.error_text
+        # a healthy session joins the same batch and must complete
+        toks, reason, _ = _stream_decode(srv, np.asarray(prompt[:1]), 8)
+        want = np.asarray(generate(params, cfg, prompt[:1], 8))[0]
+        assert toks == want.tolist()
+        assert reason == "finished"
+        # server side evicts the stalled session (slot freed)...
+        deadline = time.time() + 60
+        while svc.batcher().live_slots() and time.time() < deadline:
+            time.sleep(0.02)
+        assert svc.batcher().live_slots() == 0
+        # ...and once the wedged client handler releases, the queued
+        # FIN delivers the NAMED reason
+        wedge.set()
+        deadline = time.time() + 10
+        while not stall_closed and time.time() < deadline:
+            time.sleep(0.02)
+        assert stall_closed == ["backpressure"], stall_closed
+    finally:
+        wedge.set()
+        srv.stop()
+
+
+def test_decode_rejects_bad_shapes():
+    cfg, params, prompt = _setup()
+    srv, _ = _decode_server(cfg, params)
+    try:
+        from brpc_tpu.client import Channel, Controller
+        from brpc_tpu.models.lm_service import pack_generate_request
+        from brpc_tpu.streaming import StreamOptions, stream_create
+
+        ch = Channel()
+        ch.init(str(srv.listen_endpoint))
+        # no stream attached
+        c = ch.call_method("LM.Decode",
+                           pack_generate_request(
+                               np.asarray(prompt[:1]), 4),
+                           cntl=Controller())
+        assert c.failed and "stream" in c.error_text
+        # batch != 1
+        cntl = Controller()
+        stream_create(cntl, StreamOptions())
+        c = ch.call_method("LM.Decode",
+                           pack_generate_request(np.asarray(prompt), 4),
+                           cntl=cntl)
+        assert c.failed and "one session" in c.error_text
+        # over max_new cap
+        cntl = Controller()
+        stream_create(cntl, StreamOptions())
+        c = ch.call_method("LM.Decode",
+                           pack_generate_request(
+                               np.asarray(prompt[:1]), 999),
+                           cntl=cntl)
         assert c.failed and "max_new" in c.error_text
     finally:
         srv.stop()

@@ -29,7 +29,7 @@ from brpc_tpu.server import Server, ServerOptions, Service
 from conftest import require_native  # noqa: E402
 from test_http_slim import FALLBACK_REQUESTS, _exchange, _post  # noqa: E402
 
-LANES = ("raw", "slim", "http")
+LANES = ("raw", "slim", "http", "stream")
 STAGES = ("queue", "shim", "resid")
 
 

@@ -31,6 +31,13 @@ CLIENT_LANE_REASONS = (
     "cli_stream_frame", "cli_unknown_magic",
 )
 
+# kind-5 streaming-lane fallback reasons — must equal engine.cpp
+# kStreamFbNames and stream_slim's STREAM_FB_NAMES mirror, in order
+STREAM_FB_REASONS = (
+    "stream_no_shim", "stream_non_inline", "stream_compressed",
+    "stream_chunk_oversize", "stream_drain", "stream_unregistered",
+)
+
 # scatter_call screening reasons — the closed set of
 # _scatter_fallback("...") literals in client/fast_call.py
 SCATTER_REASONS = {
@@ -51,6 +58,11 @@ def test_client_lane_reasons_match_pins():
     assert REASONS == CLIENT_LANE_REASONS
 
 
+def test_stream_lane_reasons_match_pins():
+    from brpc_tpu.server.stream_slim import STREAM_FB_NAMES
+    assert STREAM_FB_NAMES == STREAM_FB_REASONS
+
+
 def test_engine_tables_match_pins():
     """The C++ source's name tables equal the pinned literals (source
     scan — no toolchain needed, so the pin holds even where the engine
@@ -64,6 +76,8 @@ def test_engine_tables_match_pins():
         == ENGINE_FB_REASONS
     assert tuple(cppscan.parse_string_array(text, "kCliFbNames")) \
         == CLIENT_LANE_REASONS
+    assert tuple(cppscan.parse_string_array(text, "kStreamFbNames")) \
+        == STREAM_FB_REASONS
 
 
 def test_scatter_screening_set_matches_pins():

@@ -258,6 +258,73 @@ def test_drift_lame_duck_reason_renamed():
                for f in findings), findings
 
 
+# -- ISSUE-13 kind-5 streaming-lane drift classes ----------------------------
+
+def test_drift_stream_shim_arity_changed():
+    """Dropping one arg from the engine's kind-5 stream-shim call (the
+    same 'grew one arg on one side' class as the kind-3 negative)."""
+    ov = _mutate(ENGINE, "sid, swin, nullptr);", "sid, nullptr);")
+    findings = check_contracts(Tree(overrides=ov))
+    assert any("kind-5" in f.message and "11 args" in f.message
+               for f in findings), findings
+
+
+def test_drift_stream_reason_table_renamed():
+    """Renaming a kStreamFbNames string with the enum untouched: the
+    stream_slim mirror no longer matches."""
+    ov = _mutate(ENGINE, '"stream_chunk_oversize", "stream_drain",',
+                 '"stream_chunk_oversize", "stream_drained2",')
+    findings = check_contracts(Tree(overrides=ov))
+    assert any("STREAM_FB_NAMES" in f.message for f in findings), findings
+
+
+def test_drift_admission_deleted_from_chain():
+    """Deleting the admission stage from the compiled interceptor
+    chain breaks EVERY binding lane at once — the linter must see it
+    through the chain half of the kind-5 spec."""
+    ov = _mutate("brpc_tpu/server/interceptors.py",
+                 "rej = _admit_stage(_server, _entry, _lane, tenant,",
+                 "rej = _noadmit_stage(_server, _entry, _lane, tenant,")
+    findings = check_lanes(Tree(overrides=ov))
+    assert any("[stream_slim]" in f.message and "admission" in f.message
+               for f in findings), findings
+
+
+def test_drift_chain_binding_removed_from_lane():
+    """The kind-5 lane body no longer calling the compiled chain —
+    the binding is gone even though the chain itself is intact."""
+    ov = _mutate("brpc_tpu/server/stream_slim.py",
+                 "cntl = _enter(sock, cid, len(payload), att, dom, nonce,",
+                 "cntl = _no_chain(sock, cid, len(payload), att, dom, nonce,")
+    findings = check_lanes(Tree(overrides=ov))
+    assert any("[stream_slim]" in f.message
+               and ("chain" in f.message or "enter" in f.message)
+               for f in findings), findings
+
+
+def test_drift_blocking_call_in_chunk_delivery():
+    """slim_chunks runs inside the engine's batched GIL entry ON a
+    loop thread — a sleep seeded into it must be flagged."""
+    ov = _mutate("brpc_tpu/server/stream_slim.py",
+                 "            s.on_frame(flags, payload)",
+                 "            time.sleep(0.001)\n"
+                 "            s.on_frame(flags, payload)")
+    findings = check_blocking(Tree(overrides=ov))
+    assert any("slim_chunks" in f.message and "sleep" in f.message
+               for f in findings), findings
+
+
+def test_drift_untimed_wait_in_stream_drain():
+    """Stream drain settle is deadline-bounded by contract — an
+    untimed wait_for seeded into drain_close must be flagged."""
+    ov = _mutate("brpc_tpu/streaming.py",
+                 "                    timeout=cap)",
+                 "                    )")
+    findings = check_blocking(Tree(overrides=ov))
+    assert any("drain_close" in f.message and "wait_for" in f.message
+               for f in findings), findings
+
+
 def test_allow_marker_suppresses():
     """The reviewed-exception escape hatch works (and is line-scoped)."""
     ov = _mutate(

@@ -1,0 +1,571 @@
+"""Kind-5 native streaming lane — native-vs-forced-Python observable
+identity, the cross-cutting plane on stream open (trace / deadline /
+tenant / admission, via the compiled interceptor chain), every NAMED
+fallback reason, credit backpressure, and drain-mid-stream (the
+test_deadline_plane lane-matrix shape applied to streams)."""
+
+import os
+import signal
+import struct
+import socket as pysock
+import threading
+import time
+
+import pytest
+
+from brpc_tpu.butil.flags import get_flag, set_flag
+from brpc_tpu.butil.status import Errno
+from brpc_tpu.client import Channel, Controller
+from brpc_tpu.protocol.meta import RpcMeta
+from brpc_tpu.server import Server, ServerOptions, Service
+from brpc_tpu.streaming import (StreamOptions, stream_accept,
+                                stream_create)
+
+from conftest import require_native, wire_tlv  # noqa: E402
+
+
+class StreamSvc(Service):
+    """Echo-upper streaming service + a plain unary method."""
+
+    def __init__(self):
+        self.server_streams = []
+
+    def Start(self, cntl, request):
+        def on_received(stream, msgs):
+            for m in msgs:
+                stream.write(bytes(m).upper())
+
+        s = stream_accept(cntl, StreamOptions(on_received=on_received))
+        assert s is not None
+        self.server_streams.append(s)
+        return b"accepted:" + bytes(request)
+
+    def StartShortFuse(self, cntl, request):
+        """Accepts with a short write timeout: backpressure surfaces
+        to the producer as EOVERCROWDED instead of a long block."""
+        s = stream_accept(cntl, StreamOptions(write_timeout_s=0.25))
+        assert s is not None
+        self.server_streams.append(s)
+        return b"ok"
+
+    def Plain(self, cntl, request):
+        return b"plain:" + bytes(request)
+
+
+def _server(**opt_kw):
+    require_native()
+    opts = ServerOptions()
+    opts.native = True
+    opts.usercode_inline = True
+    opts.native_loops = 1
+    for k, v in opt_kw.items():
+        setattr(opts, k, v)
+    svc = StreamSvc()
+    srv = Server(opts)
+    srv.add_service(svc, name="SL")
+    assert srv.start("127.0.0.1:0") == 0
+    return srv, svc
+
+
+def _tele(srv) -> dict:
+    return srv._native_bridge.engine.telemetry()
+
+
+@pytest.fixture()
+def pair():
+    srv, svc = _server()
+    yield srv, svc
+    srv.stop()
+
+
+def _open_session(srv, received=None, closed=None, method="SL.Start",
+                  payload=b"hi", window=None, cntl=None):
+    ch = Channel()
+    ch.init(str(srv.listen_endpoint))
+    received = received if received is not None else []
+    cntl = cntl or Controller()
+    opts = StreamOptions(
+        on_received=lambda st, msgs: received.extend(msgs),
+        on_closed=(lambda st: closed.append(st.close_reason))
+        if closed is not None else None)
+    if window:
+        opts.max_buf_size = window
+    stream = stream_create(cntl, opts)
+    c = ch.call_method(method, payload, cntl=cntl)
+    return c, stream, received
+
+
+def _echo_roundtrip(srv, n=12):
+    received = []
+    c, stream, _ = _open_session(srv, received)
+    assert not c.failed, (c.error_code, c.error_text)
+    assert bytes(c.response) == b"accepted:hi"
+    assert stream.wait_established(5.0)
+    for i in range(n):
+        assert stream.write(f"msg{i}".encode()) == 0
+    deadline = time.time() + 10
+    while len(received) < n and time.time() < deadline:
+        time.sleep(0.01)
+    assert received == [f"MSG{i}".encode() for i in range(n)]
+    stream.close()
+    return stream
+
+
+# ---------------------------------------------------------------------------
+# native-vs-forced-Python observable identity
+# ---------------------------------------------------------------------------
+
+def test_native_vs_python_identity_matrix(pair):
+    """The SAME workload over both lanes (live flag flip): responses,
+    grant negotiation, echo payloads and close behavior identical; the
+    native arm rides the stream lane (handled grows, zero fallbacks),
+    the Python arm falls back under the NAMED no-capability reason."""
+    srv, svc = pair
+    t0 = _tele(srv)
+    s_native = _echo_roundtrip(srv)
+    t1 = _tele(srv)
+    assert t1["lanes"]["stream"]["handled"] \
+        == t0["lanes"]["stream"]["handled"] + 1
+    assert t1["streams"]["chunks_in"] > t0["streams"]["chunks_in"]
+    assert t1["streams"]["chunks_out"] >= t0["streams"]["chunks_out"] + 12
+    for r, v in t1["streams"]["fallbacks"].items():
+        assert v == t0["streams"]["fallbacks"][r], r
+
+    set_flag("rpc_native_stream_lane", False)
+    try:
+        s_py = _echo_roundtrip(srv)
+        t2 = _tele(srv)
+        # python arm: open fell back NAMED; no new native opens
+        assert t2["lanes"]["stream"]["handled"] \
+            == t1["lanes"]["stream"]["handled"]
+        assert t2["streams"]["fallbacks"]["stream_no_shim"] \
+            > t1["streams"]["fallbacks"]["stream_no_shim"]
+    finally:
+        set_flag("rpc_native_stream_lane", True)
+    # both arms negotiated the same window shape
+    assert s_native._write_window == s_py._write_window
+
+
+def test_plain_unary_still_rides_slim_lane(pair):
+    """Kind-3 regression pin: a streamless call on the same service
+    keeps its lane (the stream shim only takes stream-TLV requests)."""
+    srv, _ = pair
+    ch = Channel()
+    ch.init(str(srv.listen_endpoint))
+    t0 = _tele(srv)
+    assert ch.call("SL.Plain", b"x") == b"plain:x"
+    t1 = _tele(srv)
+    assert t1["lanes"]["slim"]["handled"] \
+        == t0["lanes"]["slim"]["handled"] + 1
+    assert t1["lanes"]["stream"]["handled"] \
+        == t0["lanes"]["stream"]["handled"]
+
+
+def test_stream_lane_hist_identity(pair):
+    """Per the telemetry invariant: every stream-open item lands in
+    all three stage hists exactly once (resid count == opens+errors)."""
+    srv, _ = pair
+    for _ in range(3):
+        _echo_roundtrip(srv, n=2)
+    t = _tele(srv)
+    d = t["lanes"]["stream"]
+    total = d["handled"] + d["errors"]
+    assert total >= 3
+    for st in ("queue", "shim", "resid"):
+        assert d[f"{st}_us_count"] == total, (st, d)
+
+
+# ---------------------------------------------------------------------------
+# the cross-cutting plane on stream open (interceptor-chain binding)
+# ---------------------------------------------------------------------------
+
+def test_traced_open_stays_on_lane(pair):
+    """An explicitly traced stream open RIDES the kind-5 lane (the
+    chain's trace extract records the forced span) instead of falling
+    back — tracing must not change the path being observed."""
+    from brpc_tpu.rpcz import global_span_store
+    srv, _ = pair
+    t0 = _tele(srv)
+    received = []
+    cntl = Controller()
+    cntl.trace_id = 53535
+    c, stream, _ = _open_session(srv, received, cntl=cntl)
+    assert not c.failed, c.error_text
+    assert stream.wait_established(5.0)
+    t1 = _tele(srv)
+    assert t1["lanes"]["stream"]["handled"] \
+        == t0["lanes"]["stream"]["handled"] + 1
+    for r, v in t1["streams"]["fallbacks"].items():
+        assert v == t0["streams"]["fallbacks"][r], r
+    spans = global_span_store().by_trace(53535)
+    assert any(s.is_server for s in spans), spans
+    stream.close()
+
+
+def test_expired_deadline_sheds_open_before_user_code(pair):
+    """A stream open carrying an expired on-wire budget (TLV 13 = 0)
+    is shed ERPCTIMEDOUT by the chain BEFORE the service method runs —
+    no stream is accepted, no grant leaves."""
+    srv, svc = pair
+    before = len(svc.server_streams)
+    meta = (wire_tlv(1, struct.pack("<Q", 77))
+            + wire_tlv(4, b"SL") + wire_tlv(5, b"Start")
+            + wire_tlv(12, struct.pack("<Q", 999999))
+            + wire_tlv(14, struct.pack("<I", 65536))
+            + wire_tlv(13, struct.pack("<I", 0)))
+    frame = b"TRPC" + struct.pack("<II", len(meta), len(meta)) + meta
+    ep = srv.listen_endpoint
+    with pysock.create_connection((str(ep.host), ep.port),
+                                  timeout=10) as c:
+        c.sendall(frame)
+        c.settimeout(10)
+        buf = b""
+        while len(buf) < 12:
+            buf += c.recv(65536)
+        (blen,) = struct.unpack_from("<I", buf, 4)
+        while len(buf) < 12 + blen:
+            buf += c.recv(65536)
+        (mlen,) = struct.unpack_from("<I", buf, 8)
+        resp = RpcMeta.decode(buf[12:12 + mlen])
+    assert resp is not None
+    assert resp.error_code == int(Errno.ERPCTIMEDOUT), resp.error_code
+    assert resp.stream_id == 0          # no grant
+    assert len(svc.server_streams) == before
+
+
+def test_tenant_stamped_open_feeds_admission(pair):
+    """A tenant-stamped open runs the shared admission stage with the
+    tenant key (per-tenant fair-admission accounting grows)."""
+    from brpc_tpu.client import ChannelOptions
+    from brpc_tpu.server.admission import admission_counters
+    srv, _ = pair
+    before = admission_counters().get(("tt-stream", "admitted"), 0)
+    co = ChannelOptions()
+    co.tenant = "tt-stream"
+    ch = Channel(co)
+    ch.init(str(srv.listen_endpoint))
+    received = []
+    cntl = Controller()
+    stream = stream_create(
+        cntl, StreamOptions(
+            on_received=lambda st, msgs: received.extend(msgs)))
+    c = ch.call_method("SL.Start", b"t", cntl=cntl)
+    assert not c.failed, c.error_text
+    assert stream.wait_established(5.0)
+    after = admission_counters().get(("tt-stream", "admitted"), 0)
+    assert after == before + 1
+    stream.close()
+
+
+def test_draining_server_rejects_open_elameduck(pair):
+    """Admission on a draining server: new stream opens bounce with
+    ELAMEDUCK (engine declines them under the NAMED stream_drain
+    reason; the classic lane serializes the rejection)."""
+    srv, _ = pair
+    ch = Channel()
+    ch.init(str(srv.listen_endpoint))
+    assert ch.call("SL.Plain", b"warm") == b"plain:warm"  # conn up
+    t0 = _tele(srv)
+    assert srv.drain(grace_ms=300) == 0
+    cntl = Controller()
+    cntl.timeout_ms = 3000
+    stream = stream_create(cntl, StreamOptions())
+    c = ch.call_method("SL.Start", b"", cntl=cntl)
+    assert c.failed
+    assert c.error_code == int(Errno.ELAMEDUCK), \
+        (c.error_code, c.error_text)
+    assert stream.closed                  # never bound
+    t1 = _tele(srv)
+    assert t1["streams"]["fallbacks"]["stream_drain"] \
+        > t0["streams"]["fallbacks"]["stream_drain"]
+
+
+# ---------------------------------------------------------------------------
+# named fallback pins — every kind-5 ineligible shape, byte-identical
+# over the Python lane
+# ---------------------------------------------------------------------------
+
+def test_fallback_no_shim_lane_off():
+    """Lane flag off at listen: no capability — opens fall back under
+    stream_no_shim and the whole workload runs on the Python lane
+    unchanged."""
+    require_native()
+    prev = get_flag("rpc_native_stream_lane", True)
+    set_flag("rpc_native_stream_lane", False)
+    try:
+        srv, svc = _server()
+        try:
+            _echo_roundtrip(srv, n=4)
+            t = _tele(srv)
+            assert t["streams"]["fallbacks"]["stream_no_shim"] >= 1
+            assert t["lanes"]["stream"]["handled"] == 0
+            assert svc.server_streams[-1]._native_tx is None
+        finally:
+            srv.stop()
+    finally:
+        set_flag("rpc_native_stream_lane", prev)
+
+
+def test_fallback_non_inline_named():
+    """usercode_inline off: the server cannot run the open on the
+    loop, and the decline is NAMED stream_non_inline (not a generic
+    bucket).  A kind-0 echo method keeps native dispatch on so the
+    screening actually runs."""
+    require_native()
+    from brpc_tpu.server.service import raw_method
+
+    class Mixed(Service):
+        @raw_method(native="echo")
+        def Echo(self, payload, att):
+            return bytes(payload)
+
+        def Start(self, cntl, request):
+            s = stream_accept(cntl, StreamOptions())
+            assert s is not None
+            return b"ok"
+
+    opts = ServerOptions()
+    opts.native = True
+    opts.usercode_inline = False
+    opts.native_loops = 1
+    srv = Server(opts)
+    srv.add_service(Mixed(), name="M")
+    assert srv.start("127.0.0.1:0") == 0
+    try:
+        received = []
+        ch = Channel()
+        ch.init(str(srv.listen_endpoint))
+        cntl = Controller()
+        stream = stream_create(cntl, StreamOptions(
+            on_received=lambda st, msgs: received.extend(msgs)))
+        c = ch.call_method("M.Start", b"", cntl=cntl)
+        assert not c.failed, c.error_text
+        assert stream.wait_established(5.0)   # python lane still works
+        t = _tele(srv)
+        assert t["streams"]["fallbacks"]["stream_non_inline"] >= 1
+        assert t["lanes"]["stream"]["handled"] == 0
+        stream.close()
+    finally:
+        srv.stop()
+
+
+def test_fallback_compressed_open_named(pair):
+    """A gzip-compressed open declines under stream_compressed and the
+    Python lane serves it byte-identically (stream still binds)."""
+    from brpc_tpu.protocol.meta import CompressType
+    srv, _ = pair
+    t0 = _tele(srv)
+    received = []
+    cntl = Controller()
+    cntl.request_compress_type = CompressType.GZIP
+    c, stream, _ = _open_session(srv, received, cntl=cntl)
+    assert not c.failed, c.error_text
+    assert bytes(c.response) == b"accepted:hi"
+    assert stream.wait_established(5.0)
+    assert stream.write(b"zz") == 0
+    deadline = time.time() + 10
+    while not received and time.time() < deadline:
+        time.sleep(0.01)
+    assert received == [b"ZZ"]
+    t1 = _tele(srv)
+    assert t1["streams"]["fallbacks"]["stream_compressed"] \
+        > t0["streams"]["fallbacks"]["stream_compressed"]
+    assert t1["lanes"]["stream"]["handled"] \
+        == t0["lanes"]["stream"]["handled"]
+    stream.close()
+
+
+def test_fallback_oversize_chunk_named(pair):
+    """A chunk too large for the burst batch rides the direct-read
+    Python path under stream_chunk_oversize — and still arrives
+    intact (byte-identical delivery through the same Stream)."""
+    srv, svc = pair
+    received = []
+    c, stream, _ = _open_session(srv, received)
+    assert not c.failed
+    assert stream.wait_established(5.0)
+    t0 = _tele(srv)
+    big = bytes(bytearray(range(256)) * 400)      # 100KB > inbuf/2
+    assert stream.write(big) == 0
+    deadline = time.time() + 15
+    while not received and time.time() < deadline:
+        time.sleep(0.01)
+    assert len(received) == 1
+    assert bytes(received[0]) == big.upper()
+    t1 = _tele(srv)
+    assert t1["streams"]["fallbacks"]["stream_chunk_oversize"] \
+        > t0["streams"]["fallbacks"]["stream_chunk_oversize"]
+    stream.close()
+
+
+def test_fallback_unregistered_named(pair):
+    """Frames for a stream the engine no longer owns (closed server
+    side) fall back NAMED and are dropped by the Python guard — never
+    crash, never an unknown bucket."""
+    srv, svc = pair
+    c, stream, _ = _open_session(srv)
+    assert not c.failed
+    assert stream.wait_established(5.0)
+    peer = svc.server_streams[-1]
+    peer.close()                       # server side unregisters
+    deadline = time.time() + 5
+    while not stream.closed and time.time() < deadline:
+        time.sleep(0.01)
+    t0 = _tele(srv)
+    # forge one more DATA frame at the dead sid over a fresh conn
+    from brpc_tpu.protocol.streaming import MAGIC
+    frame = MAGIC + struct.pack("<BQI", 0, peer.id, 3) + b"xyz"
+    ep = srv.listen_endpoint
+    with pysock.create_connection((str(ep.host), ep.port),
+                                  timeout=5) as s:
+        s.sendall(frame)
+        time.sleep(0.3)
+    t1 = _tele(srv)
+    assert t1["streams"]["fallbacks"]["stream_unregistered"] \
+        > t0["streams"]["fallbacks"]["stream_unregistered"]
+
+
+# ---------------------------------------------------------------------------
+# credit backpressure + drain-mid-stream
+# ---------------------------------------------------------------------------
+
+def test_credit_backpressure_surfaces_and_resumes(pair):
+    """Server-side writes against a tiny client window: the producer
+    sees EOVERCROWDED at credit exhaustion (counted as a stall), then
+    resumes once the consumer's feedback frees credit — and every
+    chunk arrives exactly once, in order."""
+    srv, svc = pair
+    received = []
+    hold = threading.Event()
+
+    def slow_consumer(st, msgs):
+        hold.wait(2.0)                 # stall the first delivery
+        received.extend(msgs)
+
+    ch = Channel()
+    ch.init(str(srv.listen_endpoint))
+    cntl = Controller()
+    stream = stream_create(cntl, StreamOptions(
+        on_received=slow_consumer, max_buf_size=4096))
+    c = ch.call_method("SL.StartShortFuse", b"", cntl=cntl)
+    assert not c.failed, c.error_text
+    assert stream.wait_established(5.0)
+    peer = svc.server_streams[-1]
+    assert peer._native_tx is not None
+    assert peer._write_window == 4096   # negotiated client window
+    t0 = _tele(srv)
+    payload = b"x" * 1024
+    sent = 0
+    saw_backpressure = False
+    deadline = time.time() + 20
+    while sent < 12 and time.time() < deadline:
+        rc = peer.write(payload)
+        if rc == 0:
+            sent += 1
+            continue
+        assert rc == int(Errno.EOVERCROWDED), rc
+        saw_backpressure = True
+        hold.set()                      # release the consumer
+    assert sent == 12
+    assert saw_backpressure
+    t1 = _tele(srv)
+    assert t1["streams"]["credit_stalls"] \
+        > t0["streams"]["credit_stalls"]
+    deadline = time.time() + 10
+    while len(received) < 12 and time.time() < deadline:
+        time.sleep(0.01)
+    assert [bytes(m) for m in received] == [payload] * 12
+    stream.close()
+
+
+def test_drain_closes_streams_with_named_reason(pair):
+    """Drain-mid-stream: lame duck ends in-flight streams AFTER the
+    current chunk window with the NAMED close reason — the client's
+    on_closed sees 'lame_duck', and drain still settles clean."""
+    srv, svc = pair
+    received, closed = [], []
+    ch = Channel()
+    ch.init(str(srv.listen_endpoint))
+    cntl = Controller()
+    stream = stream_create(cntl, StreamOptions(
+        on_received=lambda st, msgs: received.extend(msgs),
+        on_closed=lambda st: closed.append(st.close_reason)))
+    c = ch.call_method("SL.Start", b"", cntl=cntl)
+    assert not c.failed
+    assert stream.wait_established(5.0)
+    assert stream.write(b"pre-drain") == 0
+    deadline = time.time() + 10
+    while not received and time.time() < deadline:
+        time.sleep(0.01)
+    assert received == [b"PRE-DRAIN"]   # window flushed before close
+    assert srv.drain(grace_ms=2000) == 0
+    deadline = time.time() + 5
+    while not closed and time.time() < deadline:
+        time.sleep(0.01)
+    assert closed == ["lame_duck"], closed
+    assert stream.closed
+
+
+def test_sigterm_drives_drain():
+    """graceful_quit_on_sigterm: SIGTERM → drain (streams closed with
+    the named reason, in-flight settled) → stop, without killing the
+    process."""
+    require_native()
+    prev_flag = get_flag("graceful_quit_on_sigterm", False)
+    prev_handler = signal.getsignal(signal.SIGTERM)
+    set_flag("graceful_quit_on_sigterm", True)
+    try:
+        srv, svc = _server()
+        closed = []
+        ch = Channel()
+        ch.init(str(srv.listen_endpoint))
+        cntl = Controller()
+        stream = stream_create(cntl, StreamOptions(
+            on_closed=lambda st: closed.append(st.close_reason)))
+        c = ch.call_method("SL.Start", b"", cntl=cntl)
+        assert not c.failed
+        assert stream.wait_established(5.0)
+        os.kill(os.getpid(), signal.SIGTERM)
+        deadline = time.time() + 10
+        while (srv._started or not closed) and time.time() < deadline:
+            time.sleep(0.02)
+        assert not srv._started
+        assert closed == ["lame_duck"], closed
+    finally:
+        set_flag("graceful_quit_on_sigterm", prev_flag)
+        signal.signal(signal.SIGTERM, prev_handler)
+        import brpc_tpu.server.server as _srv_mod
+        _srv_mod._sigterm_installed = False
+
+
+def test_native_portal_streaming_section(pair):
+    """/native carries the streaming block: streams open, chunk flow,
+    chunks-per-burst histogram, credit stalls, per-reason fallbacks."""
+    import json
+    srv, _ = pair
+    _echo_roundtrip(srv, n=6)
+    ep = srv.listen_endpoint
+    req = (b"GET /native HTTP/1.1\r\nHost: x\r\n"
+           b"Accept: application/json\r\nConnection: close\r\n\r\n")
+    with pysock.create_connection((str(ep.host), ep.port),
+                                  timeout=10) as s:
+        s.sendall(req)
+        buf = b""
+        s.settimeout(10)
+        while True:
+            try:
+                chunk = s.recv(65536)
+            except OSError:
+                break
+            if not chunk:
+                break
+            buf += chunk
+    body = buf.split(b"\r\n\r\n", 1)[1]
+    page = json.loads(body)
+    st = page["streaming"]
+    assert st["chunks_in"] >= 6
+    assert st["chunks_out"] >= 6
+    assert st["chunks_per_burst"]["count"] >= 1
+    assert "stream_no_shim" not in st["fallbacks"] \
+        or st["fallbacks"]["stream_no_shim"] >= 0
+    assert "stream" in page["lanes"]
