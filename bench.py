@@ -986,6 +986,205 @@ def bench_decode_stream(extra: dict) -> None:
         srv2.stop()
 
 
+def bench_kv_disagg(extra: dict) -> None:
+    """§17 disaggregated prefill/decode + the KV transfer plane
+    (ISSUE 15):
+
+    - ``kv_transfer_gbps``: the page plane's same-host byte lane —
+      2MB pages staged into the shm ring (the lane's ONE memcpy),
+      resolved and landed on the import side; GB/s over the full
+      stage→resolve→land cycle.
+    - ``disagg_handoff_copies``: payload copies (engine ledgers of
+      BOTH tiers + Python copy_audit) across one full ici-lane
+      handoff session — PINNED at exactly 0 (the "zero payload bytes
+      through the message path" acceptance, perf_guard PINNED_ZERO).
+    - ``disagg_ttft_p99_ms`` / ``mono_ttft_p99_ms`` /
+      ``disagg_vs_mono_ttft``: PAIRED interleaved A/B — the same
+      C-session decode workload against the two-tier stack (prefill
+      tier hands every session to the decode tier mid-request) and
+      against one monolithic server; TTFT p99 per arm, order
+      alternated per round, ratio from per-round pairs (phase-immune).
+    - ``disagg_sessions_per_box``: sessions completed by the two-tier
+      stack in the A/B (the "sessions-per-box at fixed p99" lever the
+      ROADMAP names).
+    """
+    import threading
+
+    import numpy as np
+
+    from brpc_tpu.butil import copy_audit
+    from brpc_tpu.client import Channel, Controller
+    from brpc_tpu.kv import DecodeTierService, KvTransport, \
+        PrefillService
+    from brpc_tpu.kv import pages as kv_pages
+    from brpc_tpu.kv import transport as kv_transport
+    from brpc_tpu.models.lm_service import (LMService,
+                                            pack_generate_request)
+    from brpc_tpu.models.transformer_lm import LMConfig
+    from brpc_tpu.server import Server, ServerOptions
+    from brpc_tpu.streaming import StreamOptions, stream_create
+    from brpc_tpu.transport import shm_ring
+
+    # ---- page-plane transfer throughput (shm byte lane) ---------------
+    if shm_ring.shm_supported():
+        import jax.numpy as jnp
+        PAGE = 2 * 1024 * 1024 - 4096     # fits the default ring slot
+        page_host = np.zeros((PAGE,), np.uint8)
+        moved = 0
+        t0 = time.perf_counter()
+        while time.perf_counter() - t0 < 1.0:
+            staged = shm_ring.stage_page(page_host, owner=("kv", -1))
+            if staged is None:
+                break
+            desc, lease = staged
+            parsed = shm_ring.decode_desc(desc)
+            view = shm_ring.resolve(parsed[0], parsed[2], parsed[3])
+            landed = jnp.asarray(np.frombuffer(view, np.uint8))
+            landed.block_until_ready()
+            del view, landed
+            shm_ring.client_complete(lease)
+            moved += PAGE
+        dt = time.perf_counter() - t0
+        if moved:
+            extra["kv_transfer_gbps"] = round(moved / dt / 1e9, 3)
+
+    # ---- the two-tier stack (shared by the copy pin and the A/B) ------
+    C = 16                               # concurrent decode sessions
+    MAX_NEW = 16
+    cfg = LMConfig(vocab=256, dim=64, heads=4, depth=2, max_seq=96,
+                   remat=False)
+    prompt = np.arange(8, dtype=np.int32)[None, :] % cfg.vocab
+
+    def native_opts():
+        o = ServerOptions()
+        o.native = True
+        o.usercode_inline = False        # prefill runs nested RPCs
+        return o
+
+    kv_pages._reset_for_tests()
+    kv_transport._reset_for_tests()
+    dec_lm = LMService(cfg=cfg, decode_slots=C)
+    dec_srv = Server(native_opts())
+    dec_srv.add_service(dec_lm, name="LM")
+    dec_srv.add_service(DecodeTierService(dec_lm), name="KV")
+    assert dec_srv.start("127.0.0.1:0") == 0
+    dch = Channel()
+    dch.init(str(dec_srv.listen_endpoint))
+    pre_svc = PrefillService(cfg=cfg, params=dec_lm.params,
+                             decode_channel=dch,
+                             transport=KvTransport(), decode_slots=C)
+    pre_srv = Server(native_opts())
+    pre_srv.add_service(pre_svc, name="LM")
+    assert pre_srv.start("127.0.0.1:0") == 0
+
+    mono_lm = LMService(cfg=cfg, params=dec_lm.params, decode_slots=C)
+    mono_srv = Server(native_opts())
+    mono_srv.add_service(mono_lm, name="LM")
+    assert mono_srv.start("127.0.0.1:0") == 0
+
+    def one_session(srv, chans, i, ttfts, done_counter, lock):
+        first = []
+        t_start = time.perf_counter()
+
+        def on_recv(s, msgs, _first=first, _t=t_start):
+            if not _first:
+                _first.append(time.perf_counter() - _t)
+
+        ok = threading.Event()
+        cntl = Controller()
+        cntl.timeout_ms = 120_000
+        stream_create(cntl, StreamOptions(
+            on_received=on_recv, on_closed=lambda s: ok.set()))
+        c = chans[i % len(chans)].call_method(
+            "LM.Decode", pack_generate_request(prompt, MAX_NEW),
+            cntl=cntl)
+        if c.failed:
+            return
+        if ok.wait(120) and first:
+            with lock:
+                ttfts.append(first[0])
+                done_counter[0] += 1
+
+    def run_arm(srv):
+        chans = []
+        for _ in range(4):
+            ch = Channel()
+            ch.init(str(srv.listen_endpoint))
+            chans.append(ch)
+        ttfts = []
+        done = [0]
+        lock = threading.Lock()
+        threads = [threading.Thread(target=one_session,
+                                    args=(srv, chans, i, ttfts, done,
+                                          lock))
+                   for i in range(C)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(180)
+        ttfts.sort()
+        p99 = ttfts[min(len(ttfts) - 1, int(len(ttfts) * 0.99))] * 1e3 \
+            if ttfts else None
+        return p99, done[0]
+
+    try:
+        run_arm(pre_srv)                 # compile both tiers once
+        run_arm(mono_srv)
+
+        # ---- the copy pin: one full ici handoff, both ledgers ---------
+        engines = [s._native_bridge.engine for s in (pre_srv, dec_srv)]
+
+        def ledgers():
+            return sum(sum(e.telemetry()["data_plane_copies"].values())
+                       for e in engines)
+
+        base = ledgers()
+        with copy_audit.audit() as snap:
+            p99_once, done_once = run_arm(pre_srv)
+            counts, _nb = snap()
+        if done_once:
+            extra["disagg_handoff_copies"] = \
+                sum(counts.values()) + (ledgers() - base)
+
+        # ---- paired interleaved A/B -----------------------------------
+        dis_p, mono_p, ratios = [], [], []
+        dis_done = 0
+        for r in range(3):
+            arms = [("disagg", pre_srv), ("mono", mono_srv)]
+            if r % 2:
+                arms.reverse()
+            vals = {}
+            for name, srv in arms:
+                p99, done = run_arm(srv)
+                vals[name] = p99
+                if name == "disagg":
+                    dis_done = max(dis_done, done)
+            if vals.get("disagg") is not None:
+                dis_p.append(vals["disagg"])
+            if vals.get("mono") is not None:
+                mono_p.append(vals["mono"])
+            if vals.get("disagg") and vals.get("mono"):
+                ratios.append(vals["disagg"] / vals["mono"])
+        if dis_p:
+            extra["disagg_ttft_p99_ms"] = round(
+                statistics.median(dis_p), 2)
+        if mono_p:
+            extra["mono_ttft_p99_ms"] = round(
+                statistics.median(mono_p), 2)
+        if ratios:
+            ratios.sort()
+            extra["disagg_vs_mono_ttft"] = round(
+                ratios[len(ratios) // 2], 2)
+        extra["disagg_sessions_per_box"] = dis_done
+        st = kv_transport.kv_stats()
+        extra["disagg_handoff_sessions"] = st["sessions"]
+        extra["disagg_local_fallbacks"] = st["local_fallbacks"]
+    finally:
+        pre_srv.stop()
+        mono_srv.stop()
+        dec_srv.stop()
+
+
 def bench_fanout(extra: dict) -> None:
     """ParallelChannel over 3 sub-servers.  Primary keys use the
     framework's intended partition-serving shape — raw echo parts on
@@ -2584,6 +2783,7 @@ def main() -> None:
                      ("data_plane", bench_data_plane),
                      ("streaming", bench_streaming),
                      ("decode_stream", bench_decode_stream),
+                     ("kv_disagg", bench_kv_disagg),
                      ("fanout", bench_fanout),
                      ("http", bench_http),
                      ("trace", bench_trace),

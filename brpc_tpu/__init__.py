@@ -25,6 +25,10 @@ A from-scratch re-design of the capabilities of Apache brpc (reference:
                 channels and streaming map onto when peers form an ICI mesh.
 - ``ops``     : pallas TPU kernels (checksum, chunked copy, ring transfer).
 - ``models``  : flagship workloads (sharded embedding parameter-server).
+- ``kv``      : KV-cache transfer subsystem — cache pages as first-class
+                transferable objects (export/describe/import/release),
+                lane-picking KvTransport, disaggregated prefill/decode
+                serving tiers.
 
 Nothing here is a port: architecture follows SURVEY.md, not the reference's
 source. Reference citations in docstrings are for capability parity only.

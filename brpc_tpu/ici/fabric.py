@@ -155,6 +155,31 @@ class InProcessFabric:
             arr = jax.device_put(arr, device)
         return arr
 
+    def take(self, desc_id: int, conn_key=None) -> Optional[Any]:
+        """Redeem AND consume in one step — the one-shot import the KV
+        transfer plane rides: the caller owns the array from here on
+        and the registration is gone, so a second take of the same
+        descriptor (double import, or an import racing the exporter's
+        release) returns None instead of silently aliasing memory two
+        owners now believe they hold exclusively.  Same-device, so the
+        hand-over is an alias: zero data motion."""
+        with self._lock:
+            entry = self._posted.get(desc_id)
+            if entry is None:
+                return None
+            if entry.conn_key is not None and conn_key != entry.conn_key:
+                LOG.warning("ICI take rejected: descriptor %d bound to "
+                            "a different connection", desc_id)
+                return None
+            del self._posted[desc_id]
+            self.posted_bytes -= entry.nbytes
+        if entry.on_release is not None:
+            try:
+                entry.on_release(entry.nbytes)
+            except Exception:
+                LOG.exception("ici on_release callback raised")
+        return entry.array
+
     def release(self, desc_id: int,
                 only_socket: Optional[int] = None) -> bool:
         """Drop the posted ref (descriptor acked or expired).

@@ -1,0 +1,52 @@
+"""KV-cache transfer subsystem — disaggregated prefill/decode serving.
+
+LLM serving at scale separates prompt processing (prefill) from token
+generation (decode) and moves each session's KV-cache between the
+tiers as *registered memory*, never as bytes squeezed through the
+serialized message path (fabric-lib, PAPERS.md; the same attachment/
+RDMA discipline the reference applies to tensor traffic — PARITY row
+68b).  This package makes KV-cache pages first-class transferable
+objects:
+
+- :mod:`pages` — the export registry: a session's cache is a **page
+  list** with an explicit RDMA-style lifecycle (export → describe →
+  import → release), generation-checked like ``transport/shm_ring``'s
+  slots, owner-swept on socket death, settled by the drain plane;
+- :mod:`transport` — :class:`KvTransport` picks the cheapest lane per
+  peer (in-process/ICI fabric descriptors, same-host shm ring slots,
+  copy-lane attachment fallback) under the closed
+  ``KV_FALLBACK_REASONS`` enum — per-reason telemetry, no "unknown"
+  bucket;
+- :mod:`disagg` — the two-tier service: :class:`PrefillService` runs
+  the prompt, exports the pages and hands the LIVE session to a
+  :class:`DecodeTierService` mid-request; tokens stream to the
+  original client over the stream lane it already holds.
+"""
+
+from .pages import (KvPageError, KvPageHandle, KvPageStore,
+                    drain_settle, on_socket_closed, outstanding_pages,
+                    process_kv_store)
+from .transport import (KV_CLOSE_REASONS, KV_FALLBACK_REASONS,
+                        KvTransport, count_fallback,
+                        kv_fallback_counters, kv_stats)
+
+# the service layer pulls in the model stack (jax/numpy); keep it lazy
+# so transport-plane importers (socket teardown sweeps) stay cheap
+_LAZY = {"DecodeTierService": "disagg", "PrefillService": "disagg"}
+
+__all__ = [
+    "DecodeTierService", "PrefillService",
+    "KvPageError", "KvPageHandle", "KvPageStore",
+    "drain_settle", "on_socket_closed", "outstanding_pages",
+    "process_kv_store",
+    "KV_CLOSE_REASONS", "KV_FALLBACK_REASONS", "KvTransport",
+    "count_fallback", "kv_fallback_counters", "kv_stats",
+]
+
+
+def __getattr__(name):
+    if name in _LAZY:
+        import importlib
+        mod = importlib.import_module("." + _LAZY[name], __name__)
+        return getattr(mod, name)
+    raise AttributeError(name)

@@ -1,0 +1,283 @@
+"""KV-cache pages — first-class transferable objects with an explicit
+RDMA-style lifecycle.
+
+A serving session's KV-cache is not a blob to serialize: it is a set of
+**pages** (one per layer cache array) that a prefill tier *exports*,
+*describes* over the control plane, and a decode tier *imports* — the
+payload itself moving as registered memory (the in-process/ICI fabric,
+or a shm ring slot), never through the serialized message path.  This
+module is the export registry: the sender-side bookkeeping that makes a
+page a capability with a bounded lifetime instead of a leaked alias.
+
+Lifecycle (mirrors ``transport/shm_ring``'s slot discipline):
+
+    export    the page's device array is posted on the ICI fabric
+              (``InProcessFabric.post`` — the "memory registration")
+              and pinned in a FIXED page table under a fresh
+              generation; the table is bounded, so a leak is visible
+              as exhaustion, not as silent growth
+    describe  ``(page_id, generation, nbytes)`` — 12 bytes on the wire
+              per page; the generation makes every descriptor
+              single-lifetime (a recycled page id cannot resolve an
+              old descriptor)
+    import    one-shot: resolves the descriptor through the registry
+              and CONSUMES the fabric entry (``InProcessFabric.take``),
+              so a second import of the same descriptor — or an import
+              after the exporter released — fails LOUDLY with
+              :class:`KvPageError` (surfaced as ERESPONSE by the
+              handoff service, never "success with an empty cache")
+    release   generation-checked: releasing a page twice, or with a
+              stale generation, raises instead of freeing the table
+              slot's NEXT tenant
+
+Pages are tagged with an **owner** key at export (the client
+connection whose session they belong to): a dying socket sweeps its
+pages (``on_socket_closed``, wired into ``Socket.release`` next to the
+shm sweep), and the drain plane waits for every outstanding exported
+page to settle before the process exits (``drain_settle``, bounded by
+the drain grace like the shm ring's).
+"""
+
+from __future__ import annotations
+
+import struct
+import threading
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..butil.flags import define_flag, get_flag
+from ..butil.logging_util import LOG
+
+define_flag("kv_pages", 256,
+            "size of the KV page export table (exported-but-unsettled "
+            "pages; bounded so leaks surface as exhaustion)",
+            validator=lambda v: isinstance(v, int) and 0 < v <= 65535)
+
+_DESC_FMT = "<IIQ"          # page_id, generation, nbytes
+DESC_BYTES = struct.calcsize(_DESC_FMT)
+
+
+class KvPageError(Exception):
+    """A KV page descriptor this process cannot honor — stale
+    generation, double import, double free, or an unknown page.  A
+    protocol violation, not a fallback shape: the handoff service
+    answers ERESPONSE (the import side must fail loudly, never hand
+    the decoder an empty cache)."""
+
+
+class KvPageHandle:
+    """Sender-side lease of one exported page (settle exactly once)."""
+
+    __slots__ = ("page_id", "gen", "nbytes")
+
+    def __init__(self, page_id: int, gen: int, nbytes: int):
+        self.page_id = page_id
+        self.gen = gen
+        self.nbytes = nbytes
+
+    def describe(self) -> bytes:
+        return struct.pack(_DESC_FMT, self.page_id, self.gen,
+                           self.nbytes)
+
+
+def decode_desc(data: bytes) -> Tuple[int, int, int]:
+    if len(data) != DESC_BYTES:
+        raise KvPageError(f"malformed kv page descriptor "
+                          f"({len(data)} bytes)")
+    return struct.unpack(_DESC_FMT, data)
+
+
+class _Rec:
+    __slots__ = ("desc_id", "nbytes", "owner", "imported")
+
+    def __init__(self, desc_id: int, nbytes: int, owner: Any):
+        self.desc_id = desc_id
+        self.nbytes = nbytes
+        self.owner = owner
+        self.imported = False
+
+
+class KvPageStore:
+    """The process's page export table (fixed size, generation-checked
+    — the shm ring's slot model applied to device arrays)."""
+
+    def __init__(self, npages: int):
+        self.npages = int(npages)
+        self._lock = threading.Lock()
+        self._recs: List[Optional[_Rec]] = [None] * self.npages
+        self._gen = [0] * self.npages
+        self._free = list(range(self.npages))
+        self.exported = 0            # lifetime counters (stats)
+        self.imported = 0
+        self.swept = 0
+
+    # -- export ------------------------------------------------------------
+
+    def export_array(self, array: Any, nbytes: int,
+                     owner: Any = None) -> Optional[KvPageHandle]:
+        """Register one page (a live device array) for transfer.  The
+        array is posted on the in-process fabric — kept alive and
+        addressable until imported, released, or swept.  Returns None
+        when the table is full (the caller falls back under a NAMED
+        reason — exhaustion is backpressure, not an error)."""
+        from ..ici.fabric import in_process_fabric
+        with self._lock:
+            if not self._free:
+                return None
+            page_id = self._free.pop()
+            self._gen[page_id] += 1
+            gen = self._gen[page_id]
+        desc_id = in_process_fabric().post(array, nbytes)
+        with self._lock:
+            self._recs[page_id] = _Rec(desc_id, nbytes, owner)
+            self.exported += 1
+        return KvPageHandle(page_id, gen, nbytes)
+
+    # -- import (one-shot, loud) -------------------------------------------
+
+    def import_page(self, page_id: int, gen: int, nbytes: int) -> Any:
+        """Resolve a descriptor into its array, CONSUMING the fabric
+        entry: the importer owns the array from here on.  Stale
+        generation, unknown page, size mismatch, or a second import all
+        raise :class:`KvPageError` — the loud-failure contract."""
+        from ..ici.fabric import in_process_fabric
+        with self._lock:
+            rec = self._recs[page_id] \
+                if 0 <= page_id < self.npages else None
+            if rec is None or self._gen[page_id] != gen:
+                raise KvPageError(
+                    f"stale kv page import (page {page_id} gen {gen})")
+            if rec.imported:
+                raise KvPageError(
+                    f"kv page {page_id} already imported")
+            if rec.nbytes != nbytes:
+                raise KvPageError(
+                    f"kv page {page_id} size mismatch "
+                    f"({nbytes} != {rec.nbytes})")
+            desc_id = rec.desc_id
+            rec.imported = True
+        arr = in_process_fabric().take(desc_id)
+        if arr is None:
+            # released/swept between the rec check and the take — the
+            # registry says live but the registration is gone: loud
+            raise KvPageError(
+                f"kv page {page_id} no longer registered")
+        with self._lock:
+            self.imported += 1
+        return arr
+
+    # -- release (generation-checked, loud on misuse) ----------------------
+
+    def release(self, page_id: int, gen: int) -> None:
+        """Settle one exported page (the sender's end-of-handoff).
+        Double-free and stale-generation frees raise — a silent no-op
+        here would free the table slot's NEXT tenant one day."""
+        from ..ici.fabric import in_process_fabric
+        with self._lock:
+            rec = self._recs[page_id] \
+                if 0 <= page_id < self.npages else None
+            if rec is None or self._gen[page_id] != gen:
+                raise KvPageError(
+                    f"double/stale kv page free (page {page_id} "
+                    f"gen {gen})")
+            self._recs[page_id] = None
+            self._free.append(page_id)
+            desc_id, imported = rec.desc_id, rec.imported
+        if not imported:
+            # never imported: drop the fabric registration ourselves
+            in_process_fabric().release(desc_id)
+
+    def settle_handles(self, handles) -> None:
+        """Release a handoff's whole page set (each exactly once)."""
+        for h in handles:
+            self.release(h.page_id, h.gen)
+
+    # -- sweeps / drain ----------------------------------------------------
+
+    def release_owner(self, owner: Any) -> int:
+        """Reclaim every page tagged with ``owner`` (its connection
+        died before the handoff settled).  Soft by design — the sweep
+        races legitimate settles and must not throw at either."""
+        from ..ici.fabric import in_process_fabric
+        stale = []
+        with self._lock:
+            for page_id, rec in enumerate(self._recs):
+                if rec is not None and rec.owner == owner:
+                    self._recs[page_id] = None
+                    self._free.append(page_id)
+                    if not rec.imported:
+                        stale.append(rec.desc_id)
+                    self.swept += 1
+        for desc_id in stale:
+            in_process_fabric().release(desc_id)
+        return len(stale)
+
+    def outstanding(self) -> int:
+        with self._lock:
+            return self.npages - len(self._free)
+
+    def stats(self) -> Dict[str, int]:
+        with self._lock:
+            return {"pages": self.npages,
+                    "outstanding": self.npages - len(self._free),
+                    "exported": self.exported,
+                    "imported": self.imported,
+                    "swept": self.swept}
+
+
+# ---------------------------------------------------------------------------
+# Process-wide registry (mirrors shm_ring's process_tx_ring shape)
+# ---------------------------------------------------------------------------
+
+_reg_lock = threading.Lock()
+_store: Optional[KvPageStore] = None
+
+
+def process_kv_store() -> KvPageStore:
+    global _store
+    with _reg_lock:
+        if _store is None:
+            _store = KvPageStore(int(get_flag("kv_pages")))
+        return _store
+
+
+def on_socket_closed(owner: Any) -> None:
+    """Sweep pages exported for a dead connection (its handoff will
+    never settle) — wired into ``Socket.release`` next to the shm
+    sweep, so it runs on the owning loop and must stay non-blocking."""
+    with _reg_lock:
+        store = _store
+    if store is not None:
+        n = store.release_owner(owner)
+        if n:
+            LOG.info("kv page sweep: %d page(s) of dead owner %r", n,
+                     owner)
+
+
+def outstanding_pages() -> int:
+    """Exported-but-unsettled pages — the drain plane's gauge (0 when
+    the kv plane never engaged)."""
+    with _reg_lock:
+        store = _store
+    return store.outstanding() if store is not None else 0
+
+
+def drain_settle(deadline_mono_s: float) -> int:
+    """Operability plane: wait — bounded by the drain-grace deadline —
+    for every outstanding exported page to settle (handoff responses
+    release them; dead-conn sweeps run from socket close).  Returns
+    pages still outstanding at the deadline (0 = fully settled)."""
+    import time as _time
+    ev = threading.Event()
+    while True:
+        n = outstanding_pages()
+        if n == 0:
+            return 0
+        if _time.monotonic() >= deadline_mono_s:
+            return n
+        ev.wait(0.005)     # timed: the drain path stays deadline-bound
+
+
+def _reset_for_tests() -> None:
+    global _store
+    with _reg_lock:
+        _store = None

@@ -1,0 +1,544 @@
+"""KvTransport — move a session's KV pages to a peer over the cheapest
+lane it can actually reach.
+
+Three lanes, probed per peer and chosen per handoff:
+
+    ici    the peers share one JAX runtime (domain-token match — two
+           tiers in one process, or a single-controller slice): pages
+           are already registered on the in-process fabric at export,
+           so the wire carries 12-byte descriptors and the import is an
+           alias.  Zero payload bytes through the message path, zero
+           copies on either ledger.
+    shm    same host, different process: each page's bytes are staged
+           into the process tx ring (ONE memcpy — the round-11 shm
+           discipline) and the wire carries 24-byte ring descriptors;
+           the importer maps the ring and lands the pages device-side.
+    copy   the fallback — page bytes ride the handoff RPC's attachment
+           (the serialized message path).  Correct everywhere, and
+           every arrival here is counted under a NAMED reason from the
+           closed enum below: there is no "unknown" bucket, so a lane
+           regression shows up as a counter, not a mystery slowdown.
+
+The handoff RPC itself (``KV.ImportSession``) is an ordinary unary
+call: it rides whatever server lane the decode tier runs — on a native
+tier that is the kind-3 slim lane, which binds the compiled interceptor
+chain, so handoffs pass admission/deadline/trace like any other
+request.  Only the page PAYLOAD is special-cased off the message path.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import struct
+import threading
+import time
+import weakref
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..butil.flags import define_flag, get_flag
+from ..butil.logging_util import LOG
+from .pages import KvPageError, process_kv_store
+
+define_flag("kv_transfer_enabled", True,
+            "move KV-cache pages by fabric/shm descriptor instead of "
+            "serialized bytes (off = every handoff rides the copy "
+            "lane under kv_disabled)",
+            validator=lambda v: isinstance(v, bool))
+
+# ---------------------------------------------------------------------------
+# Closed reason enums (no "unknown" bucket — every handoff that does not
+# ride the cheapest lane, and every session that falls back to local
+# decode, increments exactly one of these; the static enum checker
+# requires a test pin for each, tools/check/enums.py).
+# ---------------------------------------------------------------------------
+
+KV_FALLBACK_REASONS = (
+    "kv_disabled",          # kv_transfer_enabled flag off -> copy lane
+    "kv_probe_failed",      # peer never answered the capability probe
+    "kv_model_mismatch",    # peer serves a different model fingerprint
+    "kv_shm_unavailable",   # same host, but no shm ring in this sandbox
+    "kv_page_over_slot",    # a page exceeds the ring slot size
+    "kv_ring_exhausted",    # no free ring slots (sender backpressure)
+    "kv_pages_exhausted",   # page export table full (backpressure)
+    "kv_peer_remote",       # different host, no transfer fabric
+    "kv_stream_not_local",  # client stream not adoptable by the peer
+    "kv_import_rejected",   # peer refused/failed the import RPC
+    "kv_no_decode_tier",    # no decode channel configured / reachable
+)
+
+# stream close reasons the kv plane can emit (strict tiers close the
+# client stream with a NAMED reason instead of decoding locally)
+KV_CLOSE_REASONS = (
+    "kv_handoff_failed",
+)
+
+_fb_lock = threading.Lock()
+_fallbacks: Dict[str, int] = {r: 0 for r in KV_FALLBACK_REASONS}
+
+
+def count_fallback(reason: str) -> None:
+    assert reason in _fallbacks, f"unnamed kv fallback {reason!r}"
+    with _fb_lock:
+        _fallbacks[reason] += 1
+
+
+def kv_fallback_counters() -> Dict[str, int]:
+    with _fb_lock:
+        return dict(_fallbacks)
+
+
+_stats_lock = threading.Lock()
+_stats = {"sessions": 0, "ici_sessions": 0, "shm_sessions": 0,
+          "copy_sessions": 0, "local_fallbacks": 0, "pages_moved": 0,
+          "bytes_moved": 0}
+
+
+def _stat(key: str, n: int = 1) -> None:
+    with _stats_lock:
+        _stats[key] += n
+
+
+def kv_stats() -> Dict[str, int]:
+    with _stats_lock:
+        return dict(_stats)
+
+
+def _reset_for_tests() -> None:
+    with _fb_lock:
+        for k in _fallbacks:
+            _fallbacks[k] = 0
+    with _stats_lock:
+        for k in _stats:
+            _stats[k] = 0
+
+
+# ---------------------------------------------------------------------------
+# Wire codecs — manifest + per-lane page descriptor lists.  The payload
+# carries session METADATA and descriptors only; page bytes ride the
+# lane (ici/shm) or, on the copy lane, the RPC attachment.
+# ---------------------------------------------------------------------------
+
+_MAGIC = b"KVH1"
+LANE_ICI, LANE_SHM, LANE_COPY = 0, 1, 2
+_LANE_NAMES = {LANE_ICI: "ici", LANE_SHM: "shm", LANE_COPY: "copy"}
+
+_PROBE_MAGIC = b"KVP1"
+
+# Stream-adoption authenticator: stream ids are enumerable enough (a
+# random offset, then sequential) that "name a live stream id" must
+# not suffice to seat a session on another client's stream.  The tag
+# is keyed on a PROCESS secret — exactly the reach of direct stream
+# takeover (the decode tier must share the prefill tier's stream
+# registry, i.e. the process), so a co-resident tier can always mint
+# and verify it while a remote forger never can.  Same trust posture
+# as the ici domain exchange: guards misconfiguration and cross-tenant
+# reach, not a compromised process.
+_STREAM_SECRET = os.urandom(16)
+_AUTH_BYTES = 8
+
+
+def stream_auth(stream_id: int) -> bytes:
+    return hashlib.blake2b(struct.pack("<Q", stream_id),
+                           key=_STREAM_SECRET,
+                           digest_size=_AUTH_BYTES).digest()
+
+
+class SessionManifest:
+    __slots__ = ("lane", "stream_id", "auth", "ctx_len", "last_token",
+                 "max_new", "model_fp", "descs")
+
+    def __init__(self, lane: int, stream_id: int, auth: bytes,
+                 ctx_len: int, last_token: int, max_new: int,
+                 model_fp: bytes, descs: List[bytes]):
+        self.lane = lane
+        self.stream_id = stream_id
+        self.auth = auth
+        self.ctx_len = ctx_len
+        self.last_token = last_token
+        self.max_new = max_new
+        self.model_fp = model_fp
+        self.descs = descs
+
+
+def encode_manifest(m: SessionManifest) -> bytes:
+    out = [_MAGIC, struct.pack("<BQ", m.lane, m.stream_id),
+           m.auth,
+           struct.pack("<IiIH", m.ctx_len, m.last_token, m.max_new,
+                       len(m.model_fp)), m.model_fp,
+           struct.pack("<H", len(m.descs))]
+    for d in m.descs:
+        out.append(struct.pack("<H", len(d)))
+        out.append(d)
+    return b"".join(out)
+
+
+def decode_manifest(data: bytes) -> SessionManifest:
+    if data[:4] != _MAGIC:
+        raise KvPageError("bad kv manifest magic")
+    lane, sid = struct.unpack_from("<BQ", data, 4)
+    off = 4 + struct.calcsize("<BQ")
+    auth = bytes(data[off:off + _AUTH_BYTES])
+    off += _AUTH_BYTES
+    ctx_len, last_tok, max_new, fplen = \
+        struct.unpack_from("<IiIH", data, off)
+    off += struct.calcsize("<IiIH")
+    fp = bytes(data[off:off + fplen])
+    off += fplen
+    (nd,) = struct.unpack_from("<H", data, off)
+    off += 2
+    descs = []
+    for _ in range(nd):
+        (dl,) = struct.unpack_from("<H", data, off)
+        off += 2
+        descs.append(bytes(data[off:off + dl]))
+        off += dl
+    if off != len(data):
+        raise KvPageError("trailing bytes in kv manifest")
+    return SessionManifest(lane, sid, auth, ctx_len, last_tok, max_new,
+                           fp, descs)
+
+
+def encode_probe_response() -> bytes:
+    """The decode tier's capability answer: fabric domain token, host
+    token, shm availability — everything the sender needs to pick the
+    cheapest lane BEFORE moving a byte."""
+    from ..ici.fabric import local_domain_id
+    from ..transport import shm_ring
+    dom = local_domain_id()
+    host = shm_ring._host_token()
+    return (_PROBE_MAGIC
+            + struct.pack("<H", len(dom)) + dom
+            + struct.pack("<H", len(host)) + host
+            + struct.pack("<B", 1 if shm_ring.lane_enabled() else 0))
+
+
+def decode_probe_response(data: bytes):
+    """-> (domain, host, shm_ok) or None (not a kv-capable peer)."""
+    try:
+        if data[:4] != _PROBE_MAGIC:
+            return None
+        (dl,) = struct.unpack_from("<H", data, 4)
+        off = 6
+        dom = bytes(data[off:off + dl])
+        off += dl
+        (hl,) = struct.unpack_from("<H", data, off)
+        off += 2
+        host = bytes(data[off:off + hl])
+        off += hl
+        (shm_ok,) = struct.unpack_from("<B", data, off)
+        return dom, host, bool(shm_ok)
+    except struct.error:
+        return None
+
+
+def _host_view(array):
+    """A device page's bytes as a read-only host view (the shm/copy
+    lanes' D2H staging; the ici lane never calls this)."""
+    import numpy as np
+    a = np.ascontiguousarray(np.asarray(array))
+    return memoryview(a).cast("B")
+
+
+# ---------------------------------------------------------------------------
+# The transport
+# ---------------------------------------------------------------------------
+
+class HandoffResult:
+    __slots__ = ("ok", "lane", "reason", "ambiguous")
+
+    def __init__(self, ok: bool, lane: Optional[str],
+                 reason: Optional[str], ambiguous: bool = False):
+        self.ok = ok            # the peer imported the session
+        self.lane = lane        # "ici" / "shm" / "copy" when ok
+        self.reason = reason    # named fallback reason (lane demotion
+        #                         or handoff failure), None on a clean
+        #                         cheapest-lane handoff
+        # the failure does NOT prove the peer never seated the session
+        # (timeout / transport death after the import may have landed):
+        # the caller must NOT decode locally — two batchers writing one
+        # client stream is the at-most-once violation.  False only for
+        # failures that provably precede the join (no RPC attempted, or
+        # a clean application-level refusal from the import handler).
+        self.ambiguous = ambiguous
+
+
+class KvTransport:
+    """Per-process handoff client: probes peers once per channel,
+    exports/stages pages on the cheapest reachable lane, settles every
+    lease whatever the outcome."""
+
+    # probe-cache lifetimes: capabilities are near-static (re-probed
+    # occasionally in case a peer restarted with different ones), but a
+    # FAILED probe must retry fast — a decode tier that was briefly
+    # unreachable at first contact must not be written off for the
+    # process lifetime with only a counter as evidence
+    PROBE_OK_TTL_S = 60.0
+    PROBE_FAIL_TTL_S = 2.0
+
+    def __init__(self, probe_timeout_ms: int = 5_000,
+                 import_timeout_ms: int = 30_000,
+                 force_lane: Optional[str] = None):
+        self.probe_timeout_ms = probe_timeout_ms
+        self.import_timeout_ms = import_timeout_ms
+        # tests/benches pin a lane ("ici"/"shm"/"copy") to measure it
+        # in isolation; production leaves None (cheapest reachable)
+        self.force_lane = force_lane
+        self._peer_lock = threading.Lock()
+        # weak-keyed: a GC'd channel must not alias its cache entry to
+        # whatever new channel lands on the recycled id(), and dead
+        # channels must not accumulate entries
+        self._peers: "weakref.WeakKeyDictionary[Any, Tuple[Any, float]]" \
+            = weakref.WeakKeyDictionary()
+
+    # -- peer capability ---------------------------------------------------
+
+    def peer_info(self, channel):
+        """TTL-cached KV.Probe of ``channel``'s peer (None = not
+        kv-capable / unreachable right now)."""
+        now = time.monotonic()
+        with self._peer_lock:
+            hit = self._peers.get(channel)
+            if hit is not None and now < hit[1]:
+                return hit[0]
+        from ..client import Controller
+        info = None
+        try:
+            cntl = Controller()
+            cntl.timeout_ms = self.probe_timeout_ms
+            c = channel.call_method("KV.Probe", b"", cntl=cntl)
+            if not c.failed:
+                info = decode_probe_response(bytes(c.response))
+        except Exception as e:
+            LOG.info("kv probe failed: %s", e)
+        ttl = self.PROBE_OK_TTL_S if info is not None \
+            else self.PROBE_FAIL_TTL_S
+        with self._peer_lock:
+            self._peers[channel] = (info, now + ttl)
+        return info
+
+    # -- lane choice + page preparation ------------------------------------
+
+    def _pick_lane(self, info) -> Tuple[int, Optional[str]]:
+        """(lane, demotion_reason) — reason is None on the cheapest
+        lane, else names WHY the cheaper lanes were ineligible."""
+        from ..ici.fabric import in_process_fabric
+        from ..transport import shm_ring
+        dom, host, peer_shm = info
+        if not bool(get_flag("kv_transfer_enabled")):
+            return LANE_COPY, "kv_disabled"
+        if self.force_lane is not None:
+            return {"ici": LANE_ICI, "shm": LANE_SHM,
+                    "copy": LANE_COPY}[self.force_lane], None
+        if in_process_fabric().can_reach(dom):
+            return LANE_ICI, None
+        if host == shm_ring._host_token():
+            if peer_shm and shm_ring.lane_enabled():
+                return LANE_SHM, None
+            return LANE_COPY, "kv_shm_unavailable"
+        return LANE_COPY, "kv_peer_remote"
+
+    def _prepare_pages(self, lane: int, pages, owner):
+        """Stage/export each ``(array, nbytes)`` page for ``lane``.
+        Returns (lane, descs, att, leases, reason) — the lane may
+        DEMOTE to copy (named reason) when a page does not fit the
+        chosen lane; leases must be settled by the caller.  Host bytes
+        are materialized lazily: the ici lane never leaves the
+        device."""
+        from ..transport import shm_ring
+        store = process_kv_store()
+        descs: List[bytes] = []
+        leases: List[Tuple[str, Any]] = []
+        if lane == LANE_ICI:
+            for array, nbytes in pages:
+                h = store.export_array(array, nbytes, owner=owner)
+                if h is None:
+                    self._settle(leases)
+                    return self._prepare_pages(
+                        LANE_COPY, pages, owner)[:4] \
+                        + ("kv_pages_exhausted",)
+                descs.append(h.describe())
+                leases.append(("page", h))
+            return lane, descs, None, leases, None
+        if lane == LANE_SHM:
+            ring = shm_ring.process_tx_ring()
+            if ring is None:
+                return self._prepare_pages(LANE_COPY, pages, owner)[:4] \
+                    + ("kv_shm_unavailable",)
+            for array, nbytes in pages:
+                if nbytes > ring.slot_bytes:
+                    self._settle(leases)
+                    return self._prepare_pages(
+                        LANE_COPY, pages, owner)[:4] \
+                        + ("kv_page_over_slot",)
+                staged = shm_ring.stage_page(_host_view(array),
+                                             owner=owner)
+                if staged is None:
+                    self._settle(leases)
+                    return self._prepare_pages(
+                        LANE_COPY, pages, owner)[:4] \
+                        + ("kv_ring_exhausted",)
+                desc, lease = staged
+                descs.append(desc)
+                leases.append(("slot", lease))
+            return lane, descs, None, leases, None
+        # copy lane: page bytes ride the attachment, concatenated; the
+        # descriptor is just each page's length (order carries layout).
+        # join() takes the views directly — one gather into the blob,
+        # no per-page bytes() intermediate
+        att_parts = []
+        for array, nbytes in pages:
+            descs.append(struct.pack("<I", nbytes))
+            att_parts.append(_host_view(array))
+        return LANE_COPY, descs, b"".join(att_parts), leases, None
+
+    @staticmethod
+    def _settle(leases) -> None:
+        """Release every lease of a handoff attempt (sync response —
+        success OR failure — proves the peer is done reading)."""
+        from ..transport import shm_ring
+        store = process_kv_store()
+        for kind, lease in leases:
+            try:
+                if kind == "page":
+                    store.release(lease.page_id, lease.gen)
+                else:
+                    shm_ring.client_complete(lease)
+            except KvPageError:
+                pass      # swept by a dead-owner sweep mid-handoff
+
+    # -- the handoff -------------------------------------------------------
+
+    def handoff(self, channel, stream_id: int, ctx_len: int,
+                last_token: int, max_new: int, model_fp: bytes,
+                pages, owner: Any = None) -> HandoffResult:
+        """Hand one live session to ``channel``'s peer.  ``pages`` is
+        the ordered ``(device_array, nbytes)`` list from the model's
+        cache export.  Never raises: a False result means the caller
+        still owns the session (decode locally or close with a named
+        reason) and every lease is settled."""
+        if channel is None:
+            count_fallback("kv_no_decode_tier")
+            _stat("local_fallbacks")
+            return HandoffResult(False, None, "kv_no_decode_tier")
+        info = self.peer_info(channel)
+        if info is None:
+            count_fallback("kv_probe_failed")
+            _stat("local_fallbacks")
+            return HandoffResult(False, None, "kv_probe_failed")
+        lane, reason = self._pick_lane(info)
+        if reason is not None:
+            count_fallback(reason)
+        lane, descs, att, leases, demote = self._prepare_pages(
+            lane, pages, owner)
+        if demote is not None:
+            count_fallback(demote)
+            reason = demote
+        m = SessionManifest(lane, stream_id, stream_auth(stream_id),
+                            ctx_len, last_token, max_new, model_fp,
+                            descs)
+        from ..butil.status import Errno
+        from ..client import Controller
+        cntl = Controller()
+        cntl.timeout_ms = self.import_timeout_ms
+        try:
+            c = channel.call_method("KV.ImportSession",
+                                    encode_manifest(m), cntl=cntl,
+                                    attachment=att if att else None)
+            failed, err, code = c.failed, (c.error_text or ""), \
+                c.error_code
+        except Exception as e:
+            failed, err, code = True, f"{type(e).__name__}: {e}", -1
+        finally:
+            self._settle(leases)
+        if failed:
+            why = err.split(":", 1)[0].strip()
+            if why not in KV_FALLBACK_REASONS:
+                why = "kv_import_rejected"
+            count_fallback(why)
+            _stat("local_fallbacks")
+            # only a clean APPLICATION refusal (the import handler's
+            # EREQUEST/ERESPONSE answer) proves the session was never
+            # seated; a timeout or transport death may have landed
+            # AFTER the join — the caller must not decode the session
+            # a second time onto the same stream
+            ambiguous = code not in (int(Errno.EREQUEST),
+                                     int(Errno.ERESPONSE))
+            return HandoffResult(False, None, why,
+                                 ambiguous=ambiguous)
+        nbytes = sum(p[1] for p in pages)
+        _stat("sessions")
+        _stat(f"{_LANE_NAMES[lane]}_sessions")
+        _stat("pages_moved", len(pages))
+        _stat("bytes_moved", nbytes)
+        return HandoffResult(True, _LANE_NAMES[lane], reason)
+
+
+# ---------------------------------------------------------------------------
+# Import side (the decode tier's half, called by kv/disagg)
+# ---------------------------------------------------------------------------
+
+def import_pages(manifest: SessionManifest, attachment,
+                 page_specs) -> List[Any]:
+    """Resolve the manifest's descriptors into device arrays, one per
+    page, per the manifest's lane.  ``page_specs`` is the model's
+    ordered ``(shape, dtype, nbytes)`` list — layout comes from the
+    model config, never from the wire.  Raises :class:`KvPageError`
+    loudly on anything stale/malformed (the service answers ERESPONSE:
+    a silent empty cache is the one forbidden outcome)."""
+    import numpy as np
+
+    from .pages import decode_desc
+    if len(manifest.descs) != len(page_specs):
+        raise KvPageError(
+            f"page count mismatch ({len(manifest.descs)} descriptors "
+            f"for {len(page_specs)} pages)")
+    arrays: List[Any] = []
+    if manifest.lane == LANE_ICI:
+        store = process_kv_store()
+        for d, (shape, dtype, nbytes) in zip(manifest.descs, page_specs):
+            page_id, gen, n = decode_desc(d)
+            if n != nbytes:
+                raise KvPageError(
+                    f"kv page size mismatch ({n} != {nbytes})")
+            arrays.append(store.import_page(page_id, gen, n))
+        return arrays
+    if manifest.lane == LANE_SHM:
+        import jax.numpy as jnp
+
+        from ..transport import shm_ring
+        for d, (shape, dtype, nbytes) in zip(manifest.descs, page_specs):
+            parsed = shm_ring.decode_desc(d)
+            if parsed is None:
+                raise KvPageError("malformed shm kv page descriptor")
+            rid, _slot, off, ln = parsed
+            if ln != nbytes:
+                raise KvPageError(
+                    f"kv page size mismatch ({ln} != {nbytes})")
+            view = shm_ring.resolve(rid, off, ln)
+            if view is None:
+                raise KvPageError("unresolvable shm kv page descriptor")
+            host = np.frombuffer(view, dtype=dtype).reshape(shape)
+            # land before returning: the ring slot recycles once the
+            # handoff response settles, so the page must not remain a
+            # borrowed view of it
+            arrays.append(jnp.asarray(host))
+        return arrays
+    if manifest.lane == LANE_COPY:
+        import jax.numpy as jnp
+        blob = bytes(attachment) if attachment is not None else b""
+        off = 0
+        for d, (shape, dtype, nbytes) in zip(manifest.descs, page_specs):
+            (n,) = struct.unpack("<I", d)
+            if n != nbytes or off + n > len(blob):
+                raise KvPageError("kv copy-lane page bounds mismatch")
+            host = np.frombuffer(blob, dtype=dtype,
+                                 offset=off, count=nbytes
+                                 // np.dtype(dtype).itemsize
+                                 ).reshape(shape)
+            arrays.append(jnp.asarray(host))
+            off += n
+        if off != len(blob):
+            raise KvPageError("trailing bytes in kv copy-lane blob")
+        return arrays
+    raise KvPageError(f"unknown kv lane {manifest.lane}")

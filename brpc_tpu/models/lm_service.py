@@ -46,14 +46,42 @@ def unpack_token(chunk) -> int:
 
 
 class _Session:
-    __slots__ = ("stream", "prompt", "max_new", "sent", "slot")
+    __slots__ = ("stream", "prompt", "max_new", "sent", "slot",
+                 "cache1", "ctx_len", "last_token")
 
-    def __init__(self, stream, prompt: np.ndarray, max_new: int):
+    def __init__(self, stream, prompt: Optional[np.ndarray],
+                 max_new: int):
         self.stream = stream
         self.prompt = prompt
         self.max_new = max_new
         self.sent = 0
         self.slot = -1
+        # disaggregated serving (kv/): a session whose prefill ran on
+        # ANOTHER tier joins with its imported per-layer caches instead
+        # of a prompt — the batcher inserts them into a slot between
+        # steps exactly like a local prefill's
+        self.cache1 = None
+        self.ctx_len = 0
+        self.last_token = 0
+
+
+def bucketed_prefill(prefill_j, cfg: LMConfig, prompt: np.ndarray):
+    """Prompt-CONTEXT prefill (all but the last token), padded to a
+    power-of-two bucket — returns ``(cache1, ctx_len)``.  ONE home for
+    the bucketing: the continuous batcher's join and the kv prefill
+    tier both run exactly this, which is the token-identity contract
+    between monolithic and disaggregated serving (the prompt's last
+    token then rides the first batch step on whichever tier decodes —
+    teacher-forced equivalence, see :meth:`ContinuousBatcher._admit`)."""
+    ctx = prompt[:-1]
+    bucket = 1
+    while bucket < max(len(ctx), 1):
+        bucket <<= 1
+    bucket = min(bucket, cfg.max_seq)
+    padded = np.zeros((bucket,), np.int32)
+    padded[:len(ctx)] = ctx
+    cache1, _logits = prefill_j(padded[None, :])
+    return cache1, len(ctx)
 
 
 class ContinuousBatcher:
@@ -105,6 +133,24 @@ class ContinuousBatcher:
         """Queue a session; it enters the live batch between steps."""
         sess = _Session(stream, np.ascontiguousarray(prompt, np.int32),
                         int(max_new))
+        self._enqueue(sess)
+
+    def join_imported(self, stream, last_token: int, ctx_len: int,
+                      max_new: int, cache1) -> None:
+        """Disaggregated serving (kv/): admit a session whose prefill
+        ran on ANOTHER tier.  ``cache1`` is the imported per-layer
+        cache dict (``decode_cache_from_pages`` layout, batch 1); it
+        drops into a free slot between steps exactly like a local
+        prefill's, and the imported last prompt token rides the next
+        step — so the token stream is identical with the monolithic
+        path by the same teacher-forcing argument as `_admit`'s."""
+        sess = _Session(stream, None, int(max_new))
+        sess.cache1 = cache1
+        sess.ctx_len = int(ctx_len)
+        sess.last_token = int(last_token)
+        self._enqueue(sess)
+
+    def _enqueue(self, sess: _Session) -> None:
         with self._lock:
             self._pending.append(sess)
             if self._thread is None:
@@ -225,21 +271,23 @@ class ContinuousBatcher:
         # step (teacher-forced equivalence: step logits at pos s-1 ==
         # full-prefill last-position logits), which both yields the
         # first generated token and overwrites the padded garbage rows
-        # before the mask ever admits them.
+        # before the mask ever admits them.  A session imported from a
+        # prefill tier (kv/ handoff) skips the prefill: its caches
+        # arrived as pages and insert the same way.
         free = next(i for i in range(self.slots) if not self._active[i])
-        ctx = sess.prompt[:-1]
-        bucket = 1
-        while bucket < max(len(ctx), 1):
-            bucket <<= 1
-        bucket = min(bucket, self.cfg.max_seq)
-        padded = np.zeros((bucket,), np.int32)
-        padded[:len(ctx)] = ctx
-        cache1, _logits = self._prefill(padded[None, :])
+        if sess.cache1 is not None:
+            cache1, ctx_len = sess.cache1, sess.ctx_len
+            last = int(sess.last_token)
+            sess.cache1 = None   # the pool owns the rows after insert
+        else:
+            cache1, ctx_len = bucketed_prefill(self._prefill, self.cfg,
+                                               sess.prompt)
+            last = int(sess.prompt[-1])
         import jax.numpy as jnp
         self._cache = self._insert(self._cache, cache1,
                                    jnp.int32(free),
-                                   jnp.int32(len(ctx)))
-        self._tokens[free] = int(sess.prompt[-1])
+                                   jnp.int32(ctx_len))
+        self._tokens[free] = last
         self._active[free] = True
         sess.slot = free
         sess.sent = 0            # first token leaves on the next step
@@ -414,15 +462,11 @@ class LMService(Service):
                          dtype=np.int32)[:, :max_new]
         return struct.pack("<II", *out.shape) + out.tobytes()
 
-    def Decode(self, cntl, request):
-        """Server-streaming decode: same request wire format as
-        ``Generate`` at batch 1, but the caller attaches a stream
-        (``stream_create`` before the call) and tokens arrive as int32
-        chunks — one per decode step — while the session rides the
-        continuous batch (new sessions join between steps, finished
-        ones evict; the stream closes with reason ``finished``).  The
-        unary response is ``<u32 max_new>`` (the token count the
-        stream will carry)."""
+    def _check_decode_request(self, cntl, request):
+        """Shared ``Decode`` validation + stream accept (the monolithic
+        service and the kv/ prefill tier serve the SAME wire contract).
+        Returns ``(prompt[1, s], max_new, stream)`` or None with the
+        controller already failed."""
         from ..streaming import StreamOptions, stream_accept
 
         try:
@@ -459,7 +503,33 @@ class LMService(Service):
                             "Decode requires a client stream "
                             "(stream_create before the call)")
             return None
-        self.batcher().join(stream, prompt[0].copy(), int(max_new))
+        return prompt, int(max_new), stream
+
+    def model_fingerprint(self) -> bytes:
+        """Identity the kv/ handoff handshake compares: two tiers may
+        exchange KV pages only when they serve the same architecture
+        and weight image (a page layout is meaningless under any other
+        model).  ``param_bytes`` stands in for a weight hash — cheap,
+        and wrong only for same-shape different-weight deployments,
+        which a fleet rollout should version explicitly anyway."""
+        c = self.cfg
+        return (f"{c.vocab}:{c.dim}:{c.heads}:{c.depth}:{c.max_seq}:"
+                f"{self._param_bytes}:{int(self.quantized)}").encode()
+
+    def Decode(self, cntl, request):
+        """Server-streaming decode: same request wire format as
+        ``Generate`` at batch 1, but the caller attaches a stream
+        (``stream_create`` before the call) and tokens arrive as int32
+        chunks — one per decode step — while the session rides the
+        continuous batch (new sessions join between steps, finished
+        ones evict; the stream closes with reason ``finished``).  The
+        unary response is ``<u32 max_new>`` (the token count the
+        stream will carry)."""
+        parsed = self._check_decode_request(cntl, request)
+        if parsed is None:
+            return None
+        prompt, max_new, stream = parsed
+        self.batcher().join(stream, prompt[0].copy(), max_new)
         return struct.pack("<I", max_new)
 
     def Info(self, cntl, request):

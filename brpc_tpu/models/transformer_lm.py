@@ -389,6 +389,53 @@ def empty_cache(cfg: LMConfig, batch: int, start_len: int = 1):
     return cache
 
 
+def kv_page_specs(cfg: LMConfig, batch: int = 1):
+    """Ordered ``(shape, dtype, nbytes)`` of a decode cache's
+    transferable KV pages — k then v per layer, the page order
+    :func:`export_decode_cache` emits and the import side rebuilds
+    from.  Layout is owned by the MODEL (like :func:`empty_cache`):
+    the wire carries sizes for validation only, never shape."""
+    if cfg.scan_layers:
+        raise NotImplementedError(
+            "paged KV export supports unrolled layers only (the "
+            "continuous batcher's serving shape)")
+    hd = cfg.dim // cfg.heads
+    shape = (batch, cfg.max_seq, cfg.heads, hd)
+    nbytes = batch * cfg.max_seq * cfg.heads * hd * 4      # float32
+    return [(shape, "float32", nbytes) for _ in range(2 * cfg.depth)]
+
+
+def export_decode_cache(cfg: LMConfig, cache):
+    """A prefilled :func:`make_decode` cache (batch-1, unrolled) as its
+    transferable page list ``[(device_array, nbytes), ...]`` in
+    :func:`kv_page_specs` order.  No data motion here: the pages ARE
+    the live cache arrays — the transfer plane decides whether they
+    move as registered memory (descriptor) or bytes."""
+    if cfg.scan_layers:
+        raise NotImplementedError(
+            "paged KV export supports unrolled layers only")
+    pages = []
+    for i in range(cfg.depth):
+        for key in (f"k{i}", f"v{i}"):
+            arr = cache[key]
+            pages.append((arr, int(arr.size) * arr.dtype.itemsize))
+    return pages
+
+
+def decode_cache_from_pages(cfg: LMConfig, arrays):
+    """Imported page arrays (in :func:`kv_page_specs` order) back into
+    the per-layer cache dict the batcher's slot insert consumes."""
+    if len(arrays) != 2 * cfg.depth:
+        raise ValueError(
+            f"expected {2 * cfg.depth} pages, got {len(arrays)}")
+    cache = {}
+    it = iter(arrays)
+    for i in range(cfg.depth):
+        cache[f"k{i}"] = next(it)
+        cache[f"v{i}"] = next(it)
+    return cache
+
+
 def _rope_at_vec(x, pos, head_dim: int):
     """Rotary embedding at PER-ELEMENT positions — the continuous-
     batching variant of :func:`_rope_at`: ``x`` is (b, 1, heads, hd)

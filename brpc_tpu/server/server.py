@@ -865,15 +865,18 @@ class Server:
         # co-hosted client's calls): in a proxy process with unrelated
         # outbound load they may never read 0 — drain then reports -1
         # after the grace, with the server half itself fully settled.
+        from ..kv import pages as _kv_pages
         from ..transport import client_lane as _client_lane
         from ..transport import shm_ring as _shm_ring
         shm_left = _shm_ring.drain_settle(deadline)
         lane_left = _client_lane.drain_settle(deadline)
-        if shm_left or lane_left:
-            LOG.warning("drain grace expired with %d shm slot(s) and "
-                        "%d demux entrie(s) unsettled", shm_left,
-                        lane_left)
-        return 0 if settled and not shm_left and not lane_left else -1
+        kv_left = _kv_pages.drain_settle(deadline)
+        if shm_left or lane_left or kv_left:
+            LOG.warning("drain grace expired with %d shm slot(s), "
+                        "%d demux entrie(s) and %d kv page(s) "
+                        "unsettled", shm_left, lane_left, kv_left)
+        return 0 if settled and not shm_left and not lane_left \
+            and not kv_left else -1
 
     def stop(self) -> int:
         """≈ Server::Stop: stop accepting, fail live connections.

@@ -68,10 +68,10 @@ from ..deadline import arm as arm_deadline
 from ..deadline import inherit_deadline, maybe_shed
 from ..protocol.meta import RpcMeta
 from ..protocol.tpu_std import parse_payload
-from ..rpcz import backdate_span, passive_server_span, start_server_span
-from .admission import admit as _admit_rpc
+from ..rpcz import backdate_span, passive_server_span
 from .admission import count_admitted_burst, trivial_shape
 from .controller import ServerController
+from .interceptors import compile_chain
 from .rpc_dispatch import _send_error, _send_response
 
 # per-entry pooled-controller cap: enough to cover a whole engine read
@@ -113,12 +113,24 @@ _ELOGOFF = int(Errno.ELOGOFF)
 def make_slim_handler(bridge, server, entry, svc: str, mth: str):
     """Build the kind-3 shim for one (service, method) entry.  All
     per-entry state is bound into default args — the steady-state call
-    touches no module globals."""
+    touches no module globals.
+
+    Since the interceptor-chain port (ROADMAP item 1, second binding
+    after the kind-5 stream lane): the non-trivial request path runs
+    through the compiled chain (server/interceptors.py) — ``enter``
+    before user code, ``settle`` after — so admission ordering, trace
+    extraction, deadline shed and the MethodStatus epilogue live in ONE
+    place and the lane linter checks the binding, not a copy.  The
+    precompiled fast template below it is the documented exception: it
+    serves only trivial shapes (no trace/tenant TLVs, no admission
+    layer configured), where the chain's stages are each provably
+    no-ops and the per-call cost is the whole point."""
     status = entry.status
     fn = entry.fn
     req_type = entry.request_type
     full_name = status.full_name
     socks = bridge._socks          # conn_id -> NativeSocket (live dict)
+    enter, settle = compile_chain(server, entry, "slim")
 
     # one shared completion closure (not one lambda per call): it only
     # reads its (cntl, response) arguments
@@ -140,12 +152,13 @@ def make_slim_handler(bridge, server, entry, svc: str, mth: str):
              _server=server, _entry=entry, _status=status, _fn=fn,
              _rt=req_type,
              _svc=svc, _mth=mth, _send=_send, _socks=socks,
-             _ns=_mono_ns, _sample=start_server_span,
+             _ns=_mono_ns,
              _backdate=backdate_span, _shed=maybe_shed,
              _inherit=inherit_deadline, _arm=arm_deadline,
-             _admit=_admit_rpc, _pool=sc_pool,
+             _pool=sc_pool,
              _trivial=trivial_shape, _refs=sys.getrefcount,
-             _cell=_burst_cell, _pspan=passive_server_span):
+             _cell=_burst_cell, _pspan=passive_server_span,
+             _enter=enter, _settle=settle):
         sock = _socks.get(conn_id)
         if sock is None:
             return None          # connection died mid-burst: drop, like
@@ -153,73 +166,21 @@ def make_slim_handler(bridge, server, entry, svc: str, mth: str):
         if not _server.running:
             _send_error(sock, cid, _ELOGOFF, "server is stopping")
             return None
-        # ---- precompiled fast template (the per-call cost collapse the
-        # client lane's acceptance keys measure): for the hot request
-        # shape — no trace/tenant TLVs — on a method with NO admission
-        # layer configured, the per-call RpcMeta build, the four-layer
-        # admit() walk and the ServerController construction are
-        # replaced by pooled reset-on-reuse objects, and admission
-        # accounting aggregates per BURST (admitted verdicts flush in
-        # the engine's burst_end hook; in-flight gauges are net-zero
-        # across a synchronously-completing item and are not touched —
-        # they stay exact whenever any admission layer is configured).
-        # Every escalation shape (async, errors, compressed/device/
-        # stream responses, non-bytes returns) leaves through the
-        # UNCHANGED classic completion, and the escalated controller is
-        # simply not recycled.
-        if trace is None and tenant is None \
-                and _trivial(_server, _status):
-            _cell()[0] += 1
-            try:
-                # pop-then-handle: several engine loops may run this
-                # entry's shim concurrently, and a check-then-pop pair
-                # could both pass on one pooled item
-                cntl = _pool.pop()
-            except IndexError:
-                cntl = None
-            if cntl is not None:
-                meta = cntl.request_meta
-                meta.correlation_id = cid
-                meta.attachment_size = 0
-                meta.timeout_ms = 0
-                meta.ici_domain = b""
-                cntl.reset_slim(sock.remote_side, sock.id)
-            else:
-                meta = RpcMeta()
-                meta.correlation_id = cid
-                meta.service_name = _svc
-                meta.method_name = _mth
-                cntl = ServerController(meta, sock.remote_side, sock.id,
-                                        _send)
-            cntl.server = _server
-            cntl.begin_time_us = recv_ns // 1000
-            cntl._slim_fast = True      # escalations settle recorder-
-            #                             only (no counts were taken)
-            if dom is not None:
-                sock.ici_peer_domain = dom
-                meta.ici_domain = dom
-            if nonce is not None and sock.ici_conn_token is None:
-                sock.ici_conn_token = nonce
-            if tmo is not None:
-                meta.timeout_ms = tmo
-                _arm(cntl, tmo, recv_ns // 1000)
-            na = len(att) if att is not None else 0
-            if na:
-                meta.attachment_size = na
-                ab = IOBuf()
-                ab.append_user_data(att)
-                cntl._req_att = ab
-            span = _pspan(_status.full_name, sock.remote_side)
-            if span is not None:
-                span.request_size = len(payload) + na
-                _backdate(span, recv_ns)
-                cntl.span = span
-            if tmo is not None and _shed(cntl, "slim",
-                                         _status.full_name):
-                # doomed work: the budget expired in the native batch —
-                # ERPCTIMEDOUT via the classic completion, user code
-                # never runs (identical to the classic slim path)
-                cntl.finish(None)
+        fast = trace is None and tenant is None \
+            and _trivial(_server, _status)
+        if not fast:
+            # ---- the interceptor-chain binding (ROADMAP item 1): the
+            # cross-cutting stages — admission → deadline shed → trace
+            # extract, in pinned order — run INSIDE enter; a None
+            # return means the client is already answered (rejection /
+            # shed: ELIMIT/ELAMEDUCK ride the shared classic error
+            # builder, byte-identical with every other lane) and every
+            # taken count is settled.  The stages measure from the
+            # ENGINE's CLOCK_MONOTONIC parse stamp, so native batch
+            # queueing counts against limits, deadlines and spans
+            cntl = _enter(sock, cid, len(payload), att, dom, nonce,
+                          recv_ns, trace, tmo, tenant)
+            if cntl is None:
                 return None
             try:
                 request = parse_payload(payload, _rt)
@@ -238,131 +199,103 @@ def make_slim_handler(bridge, server, entry, svc: str, mth: str):
                 cntl.finish(None)
                 return None
             if cntl.is_async:
-                # async escalation OUTLIVES the burst: the "in-flight
-                # counts are net-zero for sync items" elision no longer
-                # holds — take them now (server gauge, method gauge,
-                # '-' tenant slot) so Server.drain()/join() SEE this
-                # request and the classic completion settles each
-                # symmetrically (operability plane: an invisible async
-                # request is one a drain would cut off mid-flight)
-                cntl._slim_fast = False
-                _server.on_request_in()
-                _status.on_requested()
-                _server.admission._tenant_acquire("-")
-                return None
+                return None      # user owns completion via cntl.finish
             if (cntl.failed or cntl._accepted_stream_id
                     or cntl.response_compress_type
                     or cntl.response_device_attachment is not None
                     or not isinstance(response,
                                       (bytes, bytearray, memoryview))):
+                # anything the native frame builder cannot express:
+                # classic completion — byte-identical by construction
                 cntl.finish(response)
                 return None
+            # ---- slim fast completion: chain epilogue + native frame
             if not cntl._mark_finished_if_first():
                 return None
-            cntl._slim_fast = False
-            latency_us = _ns() // 1000 - cntl.begin_time_us
-            _status.latency << latency_us
-            if cntl._session_data is not None \
-                    and _server._session_pool is not None:
-                _server._session_pool.give_back(cntl._session_data)
-                cntl._session_data = None
             ratt = cntl._resp_att
             na_resp = len(ratt) if ratt is not None else 0
-            span = cntl.span
-            if span is not None:
-                span.response_size = len(response) + na_resp
-                span.finish(0)
+            _settle(cntl, len(response) + na_resp)
             if na_resp:
-                out = (response, ratt.as_contiguous()[0])
-            else:
-                out = response
-            # recycle only a controller NOTHING else references (a
-            # handler that stored it keeps it — reuse must never mutate
-            # state under a live reference): refs here are the local
-            # binding + getrefcount's argument.  The heavy references
-            # (attachment views pin engine buffers; spans) are dropped
-            # NOW, not at next reuse — an idle pool must not retain
-            # request payloads
-            if len(_pool) < _SC_POOL_MAX and _refs(cntl) == 2:
-                cntl._req_att = None
-                cntl._resp_att = None
-                cntl.span = None
-                _pool.append(cntl)
-            return out
-        # overload plane: the SHARED admission stage — CoDel sojourn
-        # and the method limiters both measure from the ENGINE's
-        # CLOCK_MONOTONIC parse stamp, so time spent in the native
-        # batch counts (that queue is where an overloaded server's
-        # latency lives); ELIMIT rejections ride the classic error
-        # builder, byte-identical with the classic path's
-        rej = _admit(_server, _entry, "slim", tenant, recv_ns // 1000)
-        if rej is not None:
-            # drain rejections (ELAMEDUCK) carry the lame-duck TLV so
-            # the bounced client re-resolves, not just retries
-            _send_error(sock, cid, rej.code, rej.text, server=_server)
-            return None
+                # zero-copy handoff: the engine pins the returned
+                # buffer (Py_buffer) for the writev — a single-block
+                # attachment materializes nothing here
+                return response, ratt.as_contiguous()[0]
+            return response
+        # ---- precompiled fast template (the per-call cost collapse the
+        # client lane's acceptance keys measure): for the hot request
+        # shape — no trace/tenant TLVs — on a method with NO admission
+        # layer configured, the per-call RpcMeta build, the four-layer
+        # admit() walk and the ServerController construction are
+        # replaced by pooled reset-on-reuse objects, and admission
+        # accounting aggregates per BURST (admitted verdicts flush in
+        # the engine's burst_end hook; in-flight gauges are net-zero
+        # across a synchronously-completing item and are not touched —
+        # they stay exact whenever any admission layer is configured).
+        # This is the ONE documented exception to the chain binding
+        # above: every chain stage is a provable no-op for this shape
+        # (no admission layers, no trace context, passive sampling
+        # only), so skipping the chain changes cost, not semantics.
+        # Every escalation shape (async, errors, compressed/device/
+        # stream responses, non-bytes returns) leaves through the
+        # UNCHANGED classic completion, and the escalated controller is
+        # simply not recycled.
+        _cell()[0] += 1
+        try:
+            # pop-then-handle: several engine loops may run this
+            # entry's shim concurrently, and a check-then-pop pair
+            # could both pass on one pooled item
+            cntl = _pool.pop()
+        except IndexError:
+            cntl = None
+        if cntl is not None:
+            meta = cntl.request_meta
+            meta.correlation_id = cid
+            meta.attachment_size = 0
+            meta.timeout_ms = 0
+            meta.ici_domain = b""
+            cntl.reset_slim(sock.remote_side, sock.id)
+        else:
+            meta = RpcMeta()
+            meta.correlation_id = cid
+            meta.service_name = _svc
+            meta.method_name = _mth
+            cntl = ServerController(meta, sock.remote_side, sock.id,
+                                    _send)
+        cntl.server = _server
+        cntl.begin_time_us = recv_ns // 1000
+        cntl._slim_fast = True          # escalations settle recorder-
+        #                                 only (no counts were taken)
         if dom is not None:
-            # learn the peer's device-fabric domain; the engine answers
-            # the exchange natively (cached local-domain TLV), and the
-            # meta field below keeps escalated completions identical
             sock.ici_peer_domain = dom
-        if nonce is not None and sock.ici_conn_token is None:
-            sock.ici_conn_token = nonce    # first write wins
-        meta = RpcMeta()
-        meta.correlation_id = cid
-        meta.service_name = _svc
-        meta.method_name = _mth
-        if dom is not None:
             meta.ici_domain = dom
-        if trace is not None:
-            # the request's trace context rode the slim lane: the span
-            # below is FORCED (never sampled out) and parents to the
-            # caller's span id, exactly like the classic path
-            meta.trace_id, meta.span_id, meta.parent_span_id = trace
+        if nonce is not None and sock.ici_conn_token is None:
+            sock.ici_conn_token = nonce
         if tmo is not None:
-            # None = TLV 13 absent; an explicit on-wire 0 means
-            # expired-at-arrival (real clients stamp >= 1)
             meta.timeout_ms = tmo
-        if tenant is not None:
-            meta.tenant = tenant     # fair-admission slot release keys
+            _arm(cntl, tmo, recv_ns // 1000)
         na = len(att) if att is not None else 0
         if na:
             meta.attachment_size = na
-        cntl = ServerController(meta, sock.remote_side, sock.id, _send)
-        cntl.server = _server
-        # latency measured from the ENGINE's frame-parse stamp, not
-        # shim entry: MethodStatus/limiter samples (and every
-        # completion path's latency) then include native batch
-        # queueing — the signal an adaptive concurrency limit exists
-        # to react to
-        cntl.begin_time_us = recv_ns // 1000
-        if tmo is not None:
-            # deadline anchored at the ENGINE's frame-parse time, not
-            # shim entry: native batching queueing counts against the
-            # propagated budget (that queueing is exactly where a
-            # deadline dies on a saturated server)
-            _arm(cntl, tmo, recv_ns // 1000)
-        if na:
             ab = IOBuf()
             ab.append_user_data(att)
             cntl._req_att = ab
-        span = _sample(_status.full_name, meta, sock.remote_side)
+        span = _pspan(_status.full_name, sock.remote_side)
         if span is not None:
             span.request_size = len(payload) + na
-            # span start = the ENGINE's frame-parse time, not shim
-            # entry: native read/parse/batch queueing is real latency
             _backdate(span, recv_ns)
             cntl.span = span
-        if tmo is not None and _shed(cntl, "slim", _status.full_name):
-            # doomed work: the budget expired while this frame sat in
-            # the native batch — answer ERPCTIMEDOUT via the classic
-            # completion (accounting + span finish), never run user code
+        if tmo is not None and _shed(cntl, "slim",
+                                     _status.full_name):
+            # doomed work: the budget expired in the native batch —
+            # ERPCTIMEDOUT via the classic completion, user code
+            # never runs (identical to the chain-bound path)
             cntl.finish(None)
             return None
         try:
             request = parse_payload(payload, _rt)
         except Exception as e:
-            cntl.set_failed(Errno.EREQUEST, f"request parse failed: {e}")
+            cntl.set_failed(Errno.EREQUEST,
+                            f"request parse failed: {e}")
             cntl.finish(None)
             return None
         try:
@@ -370,26 +303,35 @@ def make_slim_handler(bridge, server, entry, svc: str, mth: str):
                 response = _fn(cntl, request)
         except Exception as e:
             LOG.exception("method %s raised", _status.full_name)
-            cntl.set_failed(Errno.EINTERNAL, f"{type(e).__name__}: {e}")
+            cntl.set_failed(Errno.EINTERNAL,
+                            f"{type(e).__name__}: {e}")
             cntl.finish(None)
             return None
         if cntl.is_async:
-            return None          # user owns completion via cntl.finish
+            # async escalation OUTLIVES the burst: the "in-flight
+            # counts are net-zero for sync items" elision no longer
+            # holds — take them now (server gauge, method gauge,
+            # '-' tenant slot) so Server.drain()/join() SEE this
+            # request and the classic completion settles each
+            # symmetrically (operability plane: an invisible async
+            # request is one a drain would cut off mid-flight)
+            cntl._slim_fast = False
+            _server.on_request_in()
+            _status.on_requested()
+            _server.admission._tenant_acquire("-")
+            return None
         if (cntl.failed or cntl._accepted_stream_id
                 or cntl.response_compress_type
                 or cntl.response_device_attachment is not None
                 or not isinstance(response,
                                   (bytes, bytearray, memoryview))):
-            # anything the native frame builder cannot express: classic
-            # completion — byte-identical by construction
             cntl.finish(response)
             return None
-        # ---- slim fast completion: accounting + native frame build ----
         if not cntl._mark_finished_if_first():
             return None
+        cntl._slim_fast = False
         latency_us = _ns() // 1000 - cntl.begin_time_us
-        _status.on_responded(0, latency_us)
-        _server.on_request_out(tenant=meta.tenant, latency_us=latency_us)
+        _status.latency << latency_us
         if cntl._session_data is not None \
                 and _server._session_pool is not None:
             _server._session_pool.give_back(cntl._session_data)
@@ -398,17 +340,24 @@ def make_slim_handler(bridge, server, entry, svc: str, mth: str):
         na_resp = len(ratt) if ratt is not None else 0
         span = cntl.span
         if span is not None:
-            # sizes are known right here — record them inline and keep
-            # the call on the lane (sampled AND traced spans alike; the
-            # old behavior escalated every sampled call off the lane,
-            # making tracing change the path being observed)
             span.response_size = len(response) + na_resp
             span.finish(0)
         if na_resp:
-            # zero-copy handoff: the engine pins the returned buffer
-            # (Py_buffer) for the writev — a single-block attachment
-            # (echoes, user views) materializes nothing here
-            return response, ratt.as_contiguous()[0]
-        return response
+            out = (response, ratt.as_contiguous()[0])
+        else:
+            out = response
+        # recycle only a controller NOTHING else references (a
+        # handler that stored it keeps it — reuse must never mutate
+        # state under a live reference): refs here are the local
+        # binding + getrefcount's argument.  The heavy references
+        # (attachment views pin engine buffers; spans) are dropped
+        # NOW, not at next reuse — an idle pool must not retain
+        # request payloads
+        if len(_pool) < _SC_POOL_MAX and _refs(cntl) == 2:
+            cntl._req_att = None
+            cntl._resp_att = None
+            cntl.span = None
+            _pool.append(cntl)
+        return out
 
     return slim

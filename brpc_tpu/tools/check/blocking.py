@@ -94,6 +94,14 @@ ENTRY_POINTS: Tuple[Tuple[str, Tuple[str, ...]], ...] = (
     # un-timed-primitive lint as Server.drain
     ("brpc_tpu/streaming.py", ("drain_server_streams",)),
     ("brpc_tpu/streaming.py", ("Stream", "drain_close")),
+    # KV transfer plane (ISSUE 15): the page sweep fires from
+    # Socket.release on the owning loop; the drain settle is
+    # deadline-bounded by contract; the transport's lease settle runs
+    # on the handoff completion path (possibly a demux loop)
+    ("brpc_tpu/kv/pages.py", ("on_socket_closed",)),
+    ("brpc_tpu/kv/pages.py", ("KvPageStore", "release_owner")),
+    ("brpc_tpu/kv/pages.py", ("drain_settle",)),
+    ("brpc_tpu/kv/transport.py", ("KvTransport", "_settle")),
 )
 
 # names whose call is a handoff, not an execution: arguments/targets

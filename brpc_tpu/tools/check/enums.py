@@ -136,6 +136,19 @@ def check_enums(tree: Tree) -> List[Finding]:
                     s = _str_const(node.value)
                     if s:
                         reason_names.append((s, f"{rel} (verdict)"))
+        if rel.endswith("kv/transport.py"):
+            # the KV transfer plane's closed fallback/close enums: every
+            # member needs a test pin, like the engine name tables
+            for node in ast.walk(mod):
+                if isinstance(node, ast.Assign) \
+                        and isinstance(node.targets[0], ast.Name) \
+                        and node.targets[0].id in (
+                            "KV_FALLBACK_REASONS", "KV_CLOSE_REASONS") \
+                        and isinstance(node.value, ast.Tuple):
+                    for e in node.value.elts:
+                        s = _str_const(e)
+                        if s:
+                            reason_names.append((s, f"{rel} (kv)"))
     seen: Set[str] = set()
     for name, origin in reason_names:
         if name in seen:

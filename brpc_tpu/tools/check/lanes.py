@@ -37,10 +37,25 @@ LANES = (
         "reject": {"kind": "call", "names": {"_send_error"}},
     },
     {
+        # kind-3 slim lane — the SECOND interceptor-chain binding
+        # (mechanical port of ROADMAP item 1): the cross-cutting
+        # stages live in the compiled chain; the lane body calls
+        # enter before user code and settle after.  Its precompiled
+        # fast template (trivial shapes only — no admission layers,
+        # no trace/tenant) is the documented exception and keeps its
+        # own shed call, which this spec's chain half does not weaken:
+        # the chain is still checked end to end.
         "lane": "slim",
         "path": "brpc_tpu/server/slim_dispatch.py",
         "func": ["make_slim_handler", "slim"],
         "reject": {"kind": "call", "names": {"_send_error"}},
+        "chain": {
+            "path": "brpc_tpu/server/interceptors.py",
+            "func": ["compile_chain", "enter"],
+            "settle_func": ["compile_chain", "settle"],
+            "entry_names": {"_enter", "enter"},
+            "settle_names": {"_settle", "settle"},
+        },
     },
     {
         "lane": "http",

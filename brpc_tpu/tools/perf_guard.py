@@ -84,22 +84,38 @@ RECORDED_BASELINE = {
     "stream_tokens_per_s": 3391.3,
     "stream_ttft_p99_ms": 319.66,
     "decode_stream_sessions": 64.0,
+    # ISSUE 15 disaggregated prefill/decode keys (session box,
+    # 2026-08): shm page-plane transfer, and the two-tier A/B at c=16
+    # (disagg TTFT carries the handoff RPC; the ratio is paired).
+    # Recorded at the WORSE of two runs (quiet: 9.11 GB/s / 28.4ms /
+    # 1.52x; contended: 4.0 / 58.1 / 1.9) — conservative gates, the
+    # guard exists to catch collapses
+    "kv_transfer_gbps": 4.0,
+    "disagg_ttft_p99_ms": 58.1,
+    "disagg_vs_mono_ttft": 1.9,
+    "disagg_sessions_per_box": 16.0,
 }
 
 # keys pinned at EXACTLY zero: any non-zero value fails the gate
 # regardless of tolerance (a failed request during a rolling restart is
 # a correctness bug, not a perf regression) — the zero-base rule that
 # exempts ratio denominators must not exempt these
-PINNED_ZERO = ("rolling_restart_failed_rpcs",)
+PINNED_ZERO = ("rolling_restart_failed_rpcs",
+               # a same-host KV handoff moving payload bytes through
+               # the message path is a data-plane regression, not noise
+               "disagg_handoff_copies")
 
 _HIGHER = ("_qps", "_gbps", "gbps", "_rps", "_tok_s", "tokens_per_s",
            "_tflops", "_speedup", "_frac", "_factor_inverse",
-           "_sessions")
+           "_sessions", "_sessions_per_box")
 _LOWER = ("_us", "_ms", "_p50", "_p99", "_rss_mb")
 # gap keys measure raw/cntl — LOWER is better (a shrinking gap is the
 # win); amplification likewise
 _LOWER_RATIOS = ("cntl_vs_raw_gap", "fanout_cntl_vs_raw_gap",
-                 "retry_amplification_factor")
+                 "retry_amplification_factor",
+                 # paired two-tier/monolithic TTFT: the handoff's cost,
+                 # shrinking is the win
+                 "disagg_vs_mono_ttft")
 
 
 def direction_of(key: str) -> Optional[int]:

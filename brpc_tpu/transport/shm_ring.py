@@ -823,6 +823,26 @@ def client_prepare(sock, att, device: bool = False,
             (slot, ring.gen_of(slot)), False)
 
 
+def stage_page(data, owner: Any = None):
+    """KV transfer plane: stage one page-sized blob into the process tx
+    ring and return ``(desc_bytes, lease)`` — the 24-byte descriptor
+    the handoff manifest carries plus the generation-checked slot lease
+    to settle via :func:`client_complete` once the handoff RPC has an
+    outcome (the sync response proves the importer is done reading).
+    Returns None when the ring is unavailable or exhausted; callers
+    screen page-vs-slot sizing themselves (their fallback reasons are
+    theirs to name).  This is the shm lane's ONE staging memcpy."""
+    ring = process_tx_ring()
+    if ring is None or len(data) > ring.slot_bytes:
+        return None
+    slot = ring.alloc(owner=owner)
+    if slot is None:
+        return None
+    off, n = ring.write(slot, data)
+    return (encode_desc(ring.ring_id, slot, off, n),
+            (slot, ring.gen_of(slot)))
+
+
 def client_complete(staged_slot) -> None:
     """Settle the request slot lease once the call has an outcome (the
     sync response — or failure — proves the server is done reading

@@ -435,6 +435,10 @@ class Socket:
             shm_ring.on_socket_closed(("resp", self.id))
             shm_ring.on_socket_closed(("req", self.id))
             self.shm = None
+        # KV pages exported for this connection's sessions (kv/ handoff
+        # in flight when the client died): same sweep discipline
+        from ..kv import pages as _kv_pages
+        _kv_pages.on_socket_closed(("kv", self.id))
         _pool.release(self.id)
 
     # -- ICI ack piggybacking ----------------------------------------------

@@ -325,6 +325,66 @@ def test_drift_untimed_wait_in_stream_drain():
                for f in findings), findings
 
 
+# -- ISSUE-15 KV transfer plane drift classes --------------------------------
+
+def test_drift_unregistered_kv_reason():
+    """A KV fallback reason added to the closed enum without a test
+    pin: the enum checker must demand the anchor (the same discipline
+    as the engine name tables — an unasserted reason is free to
+    drift)."""
+    KV = "brpc_tpu/kv/transport.py"
+    # assembled at runtime: a literal here would itself count as a pin
+    unpinned = "kv_reason_nobody_" + "anchored"
+    ov = _mutate(KV, '"kv_peer_remote",',
+                 f'"kv_peer_remote", "{unpinned}",')
+    findings = check_enums(Tree(overrides=ov))
+    assert any(unpinned in f.message for f in findings), findings
+
+
+def test_drift_blocking_call_in_kv_sweep():
+    """The KV page sweep runs from Socket.release on the owning loop —
+    a sleep seeded into it must be flagged."""
+    KV_PAGES = "brpc_tpu/kv/pages.py"
+    ov = _mutate(KV_PAGES, "    if store is not None:\n"
+                 "        n = store.release_owner(owner)",
+                 "    if store is not None:\n"
+                 "        time.sleep(0.01)\n"
+                 "        n = store.release_owner(owner)")
+    ov[KV_PAGES] = ov[KV_PAGES].replace(
+        "import struct", "import struct\nimport time", 1)
+    findings = check_blocking(Tree(overrides=ov))
+    assert any("sleep" in f.message and "on_socket_closed" in f.message
+               for f in findings), findings
+
+
+def test_drift_untimed_wait_in_kv_drain_settle():
+    """The KV drain settle must stay bounded by the drain grace —
+    dropping the timeout must be flagged."""
+    KV_PAGES = "brpc_tpu/kv/pages.py"
+    ov = _mutate(KV_PAGES,
+                 "        ev.wait(0.005)     # timed: the drain path "
+                 "stays deadline-bound",
+                 "        ev.wait()")
+    findings = check_blocking(Tree(overrides=ov))
+    assert any(".wait()" in f.message and "drain_settle" in f.message
+               for f in findings), findings
+
+
+def test_drift_admission_deleted_from_slim_chain_binding():
+    """The kind-3 lane body no longer calling the compiled chain — the
+    second binding is gone even though the chain itself is intact
+    (mirrors the kind-5 negative)."""
+    ov = _mutate("brpc_tpu/server/slim_dispatch.py",
+                 "cntl = _enter(sock, cid, len(payload), att, dom, "
+                 "nonce,",
+                 "cntl = _no_chain(sock, cid, len(payload), att, dom, "
+                 "nonce,")
+    findings = check_lanes(Tree(overrides=ov))
+    assert any("[slim]" in f.message
+               and ("chain" in f.message or "enter" in f.message)
+               for f in findings), findings
+
+
 def test_allow_marker_suppresses():
     """The reviewed-exception escape hatch works (and is line-scoped)."""
     ov = _mutate(
