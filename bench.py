@@ -1005,8 +1005,20 @@ def bench_kv_disagg(extra: dict) -> None:
       against one monolithic server; TTFT p99 per arm, order
       alternated per round, ratio from per-round pairs (phase-immune).
     - ``disagg_sessions_per_box``: sessions completed by the two-tier
-      stack in the A/B (the "sessions-per-box at fixed p99" lever the
-      ROADMAP names).
+      stack with the PAGED decode tier (ISSUE 16) — 128 concurrent
+      sessions against a device page pool sized to the 16 contiguous
+      slots' bytes of the round-15 arm, overflow spilling to the host
+      tier (the "sessions-per-box at fixed p99" lever the ROADMAP
+      names, now the paged allocator's headline).
+    - ``kv_bytes_per_session``: device-pool peak bytes ÷ sessions
+      completed in that round — the KV footprint the box paid per
+      served session (contiguous would pay max_seq bytes regardless
+      of use; PERF §18).
+    - ``prefix_cache_hit_ttft_p99_ms`` / ``prefix_alias_copies``: C
+      sessions re-sending a prompt whose context pages sit in the
+      cross-session prefix cache — TTFT p99 with prefill skipped, and
+      the copy-audit total while the hits alias shared pages (PINNED
+      at exactly 0: a hit that copies is a prefix cache in name only).
     """
     import threading
 
@@ -1082,7 +1094,7 @@ def bench_kv_disagg(extra: dict) -> None:
     mono_srv.add_service(mono_lm, name="LM")
     assert mono_srv.start("127.0.0.1:0") == 0
 
-    def one_session(srv, chans, i, ttfts, done_counter, lock):
+    def one_session(srv, chans, i, ttfts, done_counter, lock, p=None):
         first = []
         t_start = time.perf_counter()
 
@@ -1096,7 +1108,8 @@ def bench_kv_disagg(extra: dict) -> None:
         stream_create(cntl, StreamOptions(
             on_received=on_recv, on_closed=lambda s: ok.set()))
         c = chans[i % len(chans)].call_method(
-            "LM.Decode", pack_generate_request(prompt, MAX_NEW),
+            "LM.Decode",
+            pack_generate_request(prompt if p is None else p, MAX_NEW),
             cntl=cntl)
         if c.failed:
             return
@@ -1105,7 +1118,7 @@ def bench_kv_disagg(extra: dict) -> None:
                 ttfts.append(first[0])
                 done_counter[0] += 1
 
-    def run_arm(srv):
+    def run_arm(srv, n=C, p=None):
         chans = []
         for _ in range(4):
             ch = Channel()
@@ -1116,8 +1129,8 @@ def bench_kv_disagg(extra: dict) -> None:
         lock = threading.Lock()
         threads = [threading.Thread(target=one_session,
                                     args=(srv, chans, i, ttfts, done,
-                                          lock))
-                   for i in range(C)]
+                                          lock, p))
+                   for i in range(n)]
         for t in threads:
             t.start()
         for t in threads:
@@ -1179,6 +1192,81 @@ def bench_kv_disagg(extra: dict) -> None:
         st = kv_transport.kv_stats()
         extra["disagg_handoff_sessions"] = st["sessions"]
         extra["disagg_local_fallbacks"] = st["local_fallbacks"]
+
+        # ---- paged decode tier: 8x the sessions on the SAME device
+        # KV byte budget (ISSUE 16).  The pool is C*pps pages — byte-
+        # identical to the 16 contiguous slots above — while 128
+        # concurrent sessions ride it; the overflow parks in the host
+        # tier and resumes as pages free.  Sessions completed is the
+        # headline (every close is a failed session, so churn cannot
+        # fake it).
+        PAGE_TOK = 16
+        PPS = cfg.max_seq // PAGE_TOK
+        C_PAGED = 128
+        page_bytes = 2 * cfg.depth * PAGE_TOK * cfg.dim * 4   # k+v, f32
+        kv_pages._reset_for_tests()
+        kv_transport._reset_for_tests()
+        pag_lm = LMService(cfg=cfg, params=dec_lm.params,
+                           decode_slots=C_PAGED, paged=True,
+                           page=PAGE_TOK, kv_pages=C * PPS + 1,
+                           kv_host_slots=2 * C_PAGED + 32)
+        pag_srv = Server(native_opts())
+        pag_srv.add_service(pag_lm, name="LM")
+        pag_srv.add_service(DecodeTierService(pag_lm), name="KV")
+        assert pag_srv.start("127.0.0.1:0") == 0
+        pch = Channel()
+        pch.init(str(pag_srv.listen_endpoint))
+        pre2 = PrefillService(cfg=cfg, params=dec_lm.params,
+                              decode_channel=pch,
+                              transport=KvTransport(),
+                              decode_slots=C_PAGED)
+        pre2_srv = Server(native_opts())
+        pre2_srv.add_service(pre2, name="LM")
+        assert pre2_srv.start("127.0.0.1:0") == 0
+        try:
+            run_arm(pre2_srv, 8)         # compile the paged step once
+            _p99, paged_done = run_arm(pre2_srv, C_PAGED)
+            if paged_done:
+                extra["disagg_sessions_per_box"] = paged_done
+                if _p99 is not None:
+                    extra["paged_ttft_p99_ms"] = round(_p99, 2)
+                kv = pag_lm.batcher().kv_stats()
+                extra["kv_bytes_per_session"] = round(
+                    page_bytes * kv["alloc"]["peak_in_use"]
+                    / paged_done)
+                extra["paged_spills"] = kv["spills"]
+        finally:
+            pre2_srv.stop()
+            pag_srv.stop()
+
+        # ---- cross-session prefix cache: TTFT with prefill skipped,
+        # and the alias-copy pin (a hit ALIASES the cached context
+        # pages — refcounts move, bytes do not)
+        kv_pages._reset_for_tests()
+        hit_lm = LMService(cfg=cfg, params=dec_lm.params,
+                           decode_slots=C, paged=True, page=PAGE_TOK)
+        hit_srv = Server(native_opts())
+        hit_srv.add_service(hit_lm, name="LM")
+        assert hit_srv.start("127.0.0.1:0") == 0
+        try:
+            # 17-token prompt: the 16-token context is exactly one
+            # full page, cached by the seeding session's prefill
+            long_p = np.arange(17, dtype=np.int32)[None, :] % cfg.vocab
+            run_arm(hit_srv, 1, long_p)          # seed + compile
+            pf = hit_lm.batcher().prefills_run
+            with copy_audit.audit() as snap:
+                hp99, hit_done = run_arm(hit_srv, C, long_p)
+                counts, _nb = snap()
+            if hit_done and hp99 is not None:
+                extra["prefix_cache_hit_ttft_p99_ms"] = round(hp99, 2)
+                extra["prefix_alias_copies"] = sum(counts.values())
+                pst = kv_pages.prefix_event_counters()
+                extra["prefix_cache_hits"] = pst["prefix_hit"] \
+                    + pst["prefix_partial_hit"]
+                extra["prefix_prefills_skipped"] = \
+                    hit_done - (hit_lm.batcher().prefills_run - pf)
+        finally:
+            hit_srv.stop()
     finally:
         pre_srv.stop()
         mono_srv.stop()

@@ -17,6 +17,7 @@ Stages (fixed vocabulary — tests diff these, no "unknown" bucket):
 - ``materialize``  IOBuf → flat bytes (``fetch``/``to_bytes``/copy_to)
 - ``gather``       multi-block scatter-gather joined into one buffer
 - ``stage_shm``    the shm lane's one staging memcpy into a ring slot
+- ``spill_host``   the KV host tier's one memcpy per spilled page
 """
 
 from __future__ import annotations
@@ -24,7 +25,7 @@ from __future__ import annotations
 import threading
 from typing import Dict, Tuple
 
-STAGES = ("ingest", "materialize", "gather", "stage_shm")
+STAGES = ("ingest", "materialize", "gather", "stage_shm", "spill_host")
 
 # copies below this size are bookkeeping (headers, metas, small
 # payloads), not data-plane traffic — the audit tracks tensor-scale

@@ -47,7 +47,13 @@ def unpack_token(chunk) -> int:
 
 class _Session:
     __slots__ = ("stream", "prompt", "max_new", "sent", "slot",
-                 "cache1", "ctx_len", "last_token")
+                 "cache1", "ctx_len", "last_token",
+                 # paged mode (kv/pages allocator): the session's
+                 # block-table pages, its prefix-cache aliases, the
+                 # teacher-forced catch-up queue, and its host-tier
+                 # parking state
+                 "pages", "n_alias", "n_priv", "forced",
+                 "host_handles", "saved_len")
 
     def __init__(self, stream, prompt: Optional[np.ndarray],
                  max_new: int):
@@ -63,6 +69,16 @@ class _Session:
         self.cache1 = None
         self.ctx_len = 0
         self.last_token = 0
+        # paged mode: block-table pages this session HOLDS (one ref
+        # each; the first n_alias are prefix-cache aliases, the next
+        # n_priv private), the teacher-forced token queue a prefix hit
+        # catches up through, and the host-tier handles while parked
+        self.pages: list = []
+        self.n_alias = 0
+        self.n_priv = 0
+        self.forced = None
+        self.host_handles = None
+        self.saved_len = 0
 
 
 def bucketed_prefill(prefill_j, cfg: LMConfig, prompt: np.ndarray):
@@ -101,14 +117,49 @@ class ContinuousBatcher:
 
     The loop runs on one daemon thread, started lazily at the first
     join and exiting after ``idle_linger_s`` with nothing to serve.
+
+    **Paged mode** (``paged=True``, the kv/pages allocator round): the
+    per-slot contiguous cache stripes are replaced by one shared page
+    pool per layer plus a per-slot block table, so a session pins only
+    ``ctx_len``-rounded pages instead of a ``max_seq`` stripe — the
+    slot count decouples from device KV bytes and sessions-per-box
+    scales with MEAN context, not max.  Three consequences ride along:
+
+    - a cross-session :class:`~brpc_tpu.kv.pages.PrefixCache` lets a
+      re-sent context ALIAS already-prefilled pages (refcounted, zero
+      bytes copied) and skip prefill for the covered prefix, any
+      partial-page remainder caught up with teacher-forced steps
+      (token identity with the uncached path by construction);
+    - when the device pool runs dry the batcher first drops LRU
+      prefix-cache entries, then SPILLS the fattest live session's
+      private pages to the :class:`~brpc_tpu.kv.pages.HostPagePool`
+      (one memcpy per page) and parks it; parked sessions resume —
+      bit-exact — when pages free up.  Exhaustion beyond that closes
+      the admitting stream under a NAMED ``KV_EVICT_REASONS`` member;
+    - mid-spill pages are drain-visible: ``Server.drain`` counts them
+      (``kv.pages.host_inflight_spills``) and expiry closes parked
+      sessions under ``kv_spill_drain_aborted`` instead of leaking.
     """
 
     def __init__(self, cfg: LMConfig, params, slots: int = 8,
-                 idle_linger_s: float = 5.0):
+                 idle_linger_s: float = 5.0, paged: bool = False,
+                 page: int = 16, pages: Optional[int] = None,
+                 host_slots: int = 0, prefix: bool = True,
+                 prefix_budget: Optional[int] = None):
         self.cfg = cfg
         self.params = params
         self.slots = int(slots)
         self.idle_linger_s = idle_linger_s
+        # paged-KV knobs (inert unless paged=True)
+        self.paged = bool(paged)
+        self.page = int(page)
+        self._pps = cfg.max_seq // self.page if self.paged else 0
+        # +1: page 0 is the allocator's reserved garbage page
+        self.num_pages = int(pages) if pages is not None \
+            else self.slots * self._pps + 1
+        self.host_slots = int(host_slots)
+        self.prefix_enabled = bool(prefix)
+        self.prefix_budget = prefix_budget
         # the HEAVY half (jit wrappers + the device KV-pool allocation)
         # is deferred to the batcher thread's first iteration: the
         # first Decode call runs on an engine loop thread inside the
@@ -126,6 +177,18 @@ class ContinuousBatcher:
         self._wake = threading.Event()
         self._thread = None
         self._steps = 0                           # decode steps run
+        # paged-mode engine state (built in _ensure_engine)
+        self._alloc = None                        # kv.pages.PageAllocator
+        self._prefix = None                       # kv.pages.PrefixCache
+        self._host = None                         # kv.pages.HostPagePool
+        self._bt = np.zeros((self.slots, max(self._pps, 1)), np.int32)
+        self._gather_j = None
+        self._scatter_j = None
+        self._setlen_j = None
+        self._parked: list = []                   # spilled sessions
+        self.prefills_run = 0
+        self.spills = 0
+        self.resumes = 0
 
     # -- public -----------------------------------------------------------
 
@@ -167,6 +230,21 @@ class ContinuousBatcher:
     def steps_run(self) -> int:
         return self._steps
 
+    def kv_stats(self) -> dict:
+        """Allocator-plane observability (paged mode; minimal shape
+        otherwise) — the bench and the capacity tests read this."""
+        out = {"paged": self.paged, "steps": self._steps,
+               "prefills_run": self.prefills_run,
+               "spills": self.spills, "resumes": self.resumes,
+               "parked": len(self._parked)}
+        if self._alloc is not None:
+            out["alloc"] = self._alloc.stats()
+        if self._prefix is not None:
+            out["prefix"] = self._prefix.stats()
+        if self._host is not None:
+            out["host"] = self._host.stats()
+        return out
+
     # -- internals (batcher thread only past the pending handoff) ---------
 
     def _ensure_engine(self) -> None:
@@ -181,6 +259,9 @@ class ContinuousBatcher:
 
         from .transformer_lm import empty_batch_cache, make_batch_decode
 
+        if self.paged:
+            self._ensure_paged_engine()
+            return
         if self._prefill is None:
             prefill, step = make_batch_decode(self.cfg)
             self._prefill = jax.jit(functools.partial(prefill,
@@ -211,6 +292,59 @@ class ContinuousBatcher:
             self._insert = jax.jit(_insert, donate_argnums=(0,))
         if self._cache is None:
             self._cache = empty_batch_cache(self.cfg, self.slots)
+
+    def _ensure_paged_engine(self) -> None:
+        """Paged-mode engine build: the shared page pools, the block-
+        paged step, the page-granular I/O programs, and the allocator /
+        prefix-cache / host-tier triple from ``kv.pages``."""
+        import functools
+
+        import jax
+        import jax.numpy as jnp
+
+        from ..kv.pages import (HostPagePool, PageAllocator,
+                                PrefixCache)
+        from .transformer_lm import (empty_paged_cache, make_paged_io,
+                                     make_paged_batch_decode,
+                                     paged_page_bytes)
+
+        if self._prefill is None:
+            prefill, step = make_paged_batch_decode(self.cfg, self.page)
+            self._prefill = jax.jit(functools.partial(prefill,
+                                                      self.params))
+            self._step = jax.jit(functools.partial(step, self.params),
+                                 donate_argnums=(0,))
+            gather, scatter, insert = make_paged_io(self.cfg, self.page)
+            self._gather_j = jax.jit(gather)
+            self._scatter_j = jax.jit(scatter, donate_argnums=(0,))
+            self._insert = jax.jit(insert, donate_argnums=(0,))
+
+            def _setlen(cache, slot, val):
+                import jax.lax as lax
+                cache = dict(cache)
+                cache["len"] = lax.dynamic_update_slice(
+                    cache["len"], val[None], (slot,))
+                return cache
+
+            self._setlen_j = jax.jit(_setlen, donate_argnums=(0,))
+        if self._cache is None:
+            self._cache = empty_paged_cache(self.cfg, self.num_pages,
+                                            self.slots, self.page)
+            self._bt[:] = 0
+        if self._alloc is None:
+            pb = paged_page_bytes(self.cfg, self.page)
+            self._alloc = PageAllocator(self.num_pages, self.page, pb)
+            self._prefix = PrefixCache(
+                self._alloc, budget_pages=self.prefix_budget) \
+                if self.prefix_enabled else None
+            if self.host_slots > 0 and self._host is None:
+                self._host = HostPagePool(self.host_slots, pb)
+
+    def _pages_for(self, ctx_len: int, max_new: int) -> int:
+        """Pages a session needs end-to-end: every position it will
+        ever write, ctx-ROUNDED — the whole point of paging (vs the
+        contiguous pool's unconditional max_seq stripe)."""
+        return max(1, -(-(ctx_len + max_new) // self.page))
 
     # credit wait bound for one step's token writes: a healthy client
     # holds megabytes of window credit per 4-byte token, so a stream
@@ -274,6 +408,9 @@ class ContinuousBatcher:
         # before the mask ever admits them.  A session imported from a
         # prefill tier (kv/ handoff) skips the prefill: its caches
         # arrived as pages and insert the same way.
+        if self.paged:
+            self._admit_paged(sess)
+            return
         free = next(i for i in range(self.slots) if not self._active[i])
         if sess.cache1 is not None:
             cache1, ctx_len = sess.cache1, sess.ctx_len
@@ -282,6 +419,7 @@ class ContinuousBatcher:
         else:
             cache1, ctx_len = bucketed_prefill(self._prefill, self.cfg,
                                                sess.prompt)
+            self.prefills_run += 1
             last = int(sess.prompt[-1])
         import jax.numpy as jnp
         self._cache = self._insert(self._cache, cache1,
@@ -293,9 +431,246 @@ class ContinuousBatcher:
         sess.sent = 0            # first token leaves on the next step
         self._sessions[free] = sess
 
+    # -- paged mode: admit / spill / park / resume -------------------------
+
+    def _alloc_with_reclaim(self, need: int):
+        """Allocate ``need`` pages, reclaiming under pressure: drop LRU
+        prefix-cache entries first (cheap — they are redundant with a
+        prefill), then spill live sessions to the host tier.  Returns
+        ``(pages, None)`` or ``(None, reason)`` with the reason a
+        KV_EVICT_REASONS member."""
+        pages = self._alloc.alloc(need)
+        while pages is None:
+            if self._prefix is not None and self._prefix.evict_lru():
+                pages = self._alloc.alloc(need)
+                continue
+            why = self._spill_one()
+            if why is not None:
+                return None, why
+            pages = self._alloc.alloc(need)
+        return pages, None
+
+    def _admit_paged(self, sess: _Session) -> None:
+        from collections import deque as _deque
+
+        import jax.numpy as jnp
+
+        from ..kv.pages import count_evict
+        if sess.cache1 is not None:
+            ctx_len = sess.ctx_len
+            aliased, covered = [], 0    # imported manifests carry no
+            #                             tokens to fingerprint
+        else:
+            ctx = sess.prompt[:-1]
+            ctx_len = len(ctx)
+            if self._prefix is not None:
+                aliased, covered = self._prefix.lookup(ctx)
+            else:
+                aliased, covered = [], 0
+        n_total = self._pages_for(ctx_len, sess.max_new)
+        priv, why = self._alloc_with_reclaim(n_total - len(aliased))
+        if priv is None:
+            for p in aliased:
+                self._alloc.release(p)
+            count_evict(why)
+            if not sess.stream.closed:
+                sess.stream.close(reason=why)
+            return
+        free = next(i for i in range(self.slots)
+                    if not self._active[i])
+        n_alias = len(aliased)
+        row = np.zeros((self._pps,), np.int32)
+        row[:n_alias] = aliased
+        row[n_alias:n_total] = priv
+        if sess.cache1 is not None:
+            # disagg import: blockify the imported contiguous cache
+            self._cache = self._insert(self._cache, jnp.asarray(row),
+                                       sess.cache1)
+            sess.cache1 = None
+            last = int(sess.last_token)
+            start_len = ctx_len
+        elif covered == 0:
+            cache1, ctx_len = bucketed_prefill(self._prefill, self.cfg,
+                                               sess.prompt)
+            self.prefills_run += 1
+            self._cache = self._insert(self._cache, jnp.asarray(row),
+                                       cache1)
+            last = int(sess.prompt[-1])
+            start_len = ctx_len
+            if self._prefix is not None:
+                # the context's FULL pages are immutable from here on
+                # (decode writes land at pos >= ctx_len) — cache them
+                self._prefix.insert(sess.prompt[:-1], priv)
+        else:
+            # prefix hit: the aliased pages ARE the covered context's
+            # KV (prefill is deterministic — identical values), no
+            # prefill and ZERO copies; the remainder catches up with
+            # teacher-forced steps, each writing its private pages
+            last = int(sess.prompt[-1]) if covered == ctx_len \
+                else int(sess.prompt[covered])
+            if covered < ctx_len:
+                sess.forced = _deque(
+                    sess.prompt[covered + 1:ctx_len].tolist()
+                    + [int(sess.prompt[-1])])
+            start_len = covered
+        self._cache = self._setlen_j(self._cache, jnp.int32(free),
+                                     jnp.int32(start_len))
+        sess.pages = list(aliased) + list(priv)
+        sess.n_alias = n_alias
+        sess.n_priv = len(priv)
+        sess.ctx_len = ctx_len
+        self._bt[free] = row
+        self._tokens[free] = last
+        self._active[free] = True
+        sess.slot = free
+        sess.sent = 0
+        self._sessions[free] = sess
+
+    def _spill_one(self) -> Optional[str]:
+        """Park ONE live session's private pages in the host tier.
+        Returns None on success, else the KV_EVICT_REASONS member
+        naming why nothing could spill."""
+        if self._host is None:
+            return "kv_pool_exhausted"
+        ab = self._host.abort_reason()
+        if ab is not None:
+            return ab
+        victims = [s for s in self._sessions.values() if s.n_priv > 0]
+        if not victims:
+            return "kv_pool_exhausted"
+        # fattest private footprint first: frees the most pages per
+        # D2H; deterministic tie-break on slot
+        victim = max(victims, key=lambda s: (s.n_priv, -s.slot))
+        return self._park(victim)
+
+    def _park(self, sess: _Session) -> Optional[str]:
+        """Move a live session's private pages device → host and free
+        its slot.  Bit-exact resume: everything the step depends on —
+        page contents, len, the last fed token, the forced queue —
+        survives in the session object + host tier."""
+        import jax.numpy as jnp
+        if not self._host.begin_spill():
+            return self._host.abort_reason() or "kv_host_tier_full"
+        handles = []
+        try:
+            blk = np.asarray(self._gather_j(
+                self._cache, jnp.asarray(self._bt[sess.slot])))
+            for j in range(sess.n_alias, sess.n_alias + sess.n_priv):
+                h = self._host.stage(
+                    blk[j].reshape(-1).view(np.uint8))
+                if h is None:
+                    for hh in handles:
+                        self._host.free(hh)
+                    return "kv_host_tier_full"
+                handles.append(h)
+        finally:
+            self._host.end_spill()
+        sess.host_handles = handles
+        sess.saved_len = int(np.asarray(self._cache["len"])[sess.slot])
+        sess.last_token = int(self._tokens[sess.slot])
+        self._alloc.release_all(sess.pages[sess.n_alias:])
+        sess.pages = sess.pages[:sess.n_alias]   # alias holds remain
+        self._sessions.pop(sess.slot, None)
+        self._active[sess.slot] = False
+        self._bt[sess.slot] = 0
+        sess.slot = -1
+        self._parked.append(sess)
+        self.spills += 1
+        return None
+
+    def _resume(self, sess: _Session) -> bool:
+        """Un-park: re-alloc private pages, land the host bytes back
+        (one H2D scatter), rebuild the block-table row, restore len and
+        the last fed token.  False = stay parked (no slot or no pages
+        yet — never an error)."""
+        import jax.numpy as jnp
+        free = next((i for i in range(self.slots)
+                     if not self._active[i]), None)
+        if free is None:
+            return False
+        priv = self._alloc.alloc(sess.n_priv)
+        while priv is None:
+            # prefix-cache holds are reclaimable — a parked session
+            # must never starve behind redundant cached pages
+            if self._prefix is not None and self._prefix.evict_lru():
+                priv = self._alloc.alloc(sess.n_priv)
+                continue
+            return False
+        hd = self.cfg.dim // self.cfg.heads
+        n_alias = sess.n_alias
+        n_used = n_alias + sess.n_priv
+        # scatter ids: private entries land in their new pages; alias
+        # and pad entries point at the garbage page (their contents
+        # are already live on device / don't exist)
+        ids = np.zeros((self._pps,), np.int32)
+        ids[n_alias:n_used] = priv
+        blk = np.zeros((self._pps, 2 * self.cfg.depth, self.page,
+                        self.cfg.heads, hd), np.float32)
+        for j, h in enumerate(sess.host_handles):
+            blk[n_alias + j] = self._host.fetch(h).view(
+                np.float32).reshape(blk.shape[1:])
+            self._host.free(h)
+        sess.host_handles = None
+        self._cache = self._scatter_j(self._cache, jnp.asarray(ids),
+                                      jnp.asarray(blk))
+        self._cache = self._setlen_j(self._cache, jnp.int32(free),
+                                     jnp.int32(sess.saved_len))
+        row = np.zeros((self._pps,), np.int32)
+        row[:n_alias] = sess.pages
+        row[n_alias:n_used] = priv
+        sess.pages = list(sess.pages) + list(priv)
+        self._bt[free] = row
+        self._tokens[free] = sess.last_token
+        self._active[free] = True
+        sess.slot = free
+        self._sessions[free] = sess
+        self.resumes += 1
+        return True
+
+    def _drop_parked(self, sess: _Session,
+                     reason: Optional[str]) -> None:
+        """A parked session that will never resume (stream gone, or
+        drain aborted the host tier): free its host slots and alias
+        holds, close under the named reason."""
+        from ..kv.pages import count_evict
+        for h in (sess.host_handles or []):
+            try:
+                self._host.free(h)
+            except Exception:
+                pass
+        sess.host_handles = None
+        self._alloc.release_all(sess.pages)
+        sess.pages = []
+        if reason is not None:
+            count_evict(reason)
+        if not sess.stream.closed:
+            sess.stream.close(reason=reason or "finished")
+
+    def _service_parked(self) -> None:
+        """Between steps: resume whatever fits, discard the dead, and
+        — after a drain abort — close everything still parked under
+        the named reason."""
+        if not self._parked:
+            return
+        ab = self._host.abort_reason() if self._host is not None \
+            else None
+        still = []
+        for sess in self._parked:
+            if sess.stream.closed:
+                self._drop_parked(sess, None)
+            elif ab is not None:
+                self._drop_parked(sess, ab)
+            elif not self._resume(sess):
+                still.append(sess)
+        self._parked = still
+
     def _evict(self, sess: _Session, reason: Optional[str]) -> None:
         self._sessions.pop(sess.slot, None)
         self._active[sess.slot] = False
+        if self.paged and sess.pages:
+            self._alloc.release_all(sess.pages)
+            sess.pages = []
+            self._bt[sess.slot] = 0
         if not sess.stream.closed:
             sess.stream.close(reason=reason or "finished")
 
@@ -304,6 +679,11 @@ class ContinuousBatcher:
         try:
             self._ensure_engine()
             while True:
+                if self.paged:
+                    # parked sessions re-enter BEFORE new admits (they
+                    # were serving first), and a drain-aborted host
+                    # tier closes them under its named reason here
+                    self._service_parked()
                 with self._lock:
                     pending = []
                     while self._pending and \
@@ -311,7 +691,7 @@ class ContinuousBatcher:
                             < self.slots:
                         pending.append(self._pending.popleft())
                     idle = not self._sessions and not pending \
-                        and not self._pending
+                        and not self._pending and not self._parked
                 if idle:
                     self._wake.clear()
                     # re-check AFTER the clear: a join landing between
@@ -335,16 +715,39 @@ class ContinuousBatcher:
                     # compile; the next step emits the first token)
                     self._admit(sess)
                 if not self._sessions:
+                    if self.paged and self._parked:
+                        # only parked sessions left and none could
+                        # resume yet (another holder must release
+                        # first): timed poll, never a busy spin
+                        import time as _time
+                        _time.sleep(0.005)
                     continue
-                cache, logits = self._step(
-                    self._cache, jnp.asarray(self._tokens),
-                    jnp.asarray(self._active))
+                if self.paged:
+                    cache, logits = self._step(
+                        self._cache, jnp.asarray(self._bt),
+                        jnp.asarray(self._tokens),
+                        jnp.asarray(self._active))
+                else:
+                    cache, logits = self._step(
+                        self._cache, jnp.asarray(self._tokens),
+                        jnp.asarray(self._active))
                 self._cache = cache
                 self._steps += 1
                 toks = np.asarray(jnp.argmax(logits, axis=-1))
                 pairs = []
                 finished = []
                 for slot, sess in list(self._sessions.items()):
+                    if sess.forced:
+                        # prefix-hit catch-up: this step WROTE the
+                        # position's KV row; its logits re-derive a
+                        # context token the client already has —
+                        # discard, feed the next context token, emit
+                        # nothing (identical to the uncached stream)
+                        if sess.stream.closed:
+                            self._evict(sess, None)
+                            continue
+                        self._tokens[slot] = sess.forced.popleft()
+                        continue
                     tok = int(toks[slot])
                     self._tokens[slot] = tok
                     sess.sent += 1
@@ -361,9 +764,10 @@ class ContinuousBatcher:
                           "sessions")
             with self._lock:
                 sessions = list(self._sessions.values()) \
-                    + list(self._pending)
+                    + list(self._pending) + list(self._parked)
                 self._sessions.clear()
                 self._pending.clear()
+                self._parked = []
                 # free every slot: a leaked _active bit would make the
                 # next incarnation's _admit run out of slots forever
                 self._active[:] = False
@@ -373,8 +777,14 @@ class ContinuousBatcher:
                 # the next incarnation's _ensure_engine rebuilds it.
                 # State reset (incl. _thread) happens BEFORE any
                 # fallible allocation: a rebuild failure under the
-                # same pressure must not wedge join() forever.
+                # same pressure must not wedge join() forever.  Paged
+                # mode drops the allocator triple with the pool: its
+                # refcounts describe rows that no longer exist.
                 self._cache = None
+                self._bt[:] = 0
+                self._alloc = None
+                self._prefix = None
+                self._host = None
                 self._thread = None
             for sess in sessions:
                 try:
@@ -390,7 +800,9 @@ class LMService(Service):
 
     def __init__(self, cfg: Optional[LMConfig] = None, params=None,
                  max_new_cap: int = 128, quantize: bool = False,
-                 decode_slots: int = 8):
+                 decode_slots: int = 8, paged: bool = False,
+                 page: int = 16, kv_pages: Optional[int] = None,
+                 kv_host_slots: int = 0, prefix: bool = True):
         import jax
 
         self.cfg = cfg or LMConfig(vocab=256, dim=64, heads=4, depth=2,
@@ -417,6 +829,12 @@ class LMService(Service):
         # Decode call (Generate-only deployments never pay the batch
         # step compile).  scan_layers configs serve Generate only.
         self.decode_slots = int(decode_slots)
+        # paged-KV serving knobs (kv/pages allocator; inert when off)
+        self.paged = bool(paged)
+        self.page = int(page)
+        self.kv_pages = kv_pages
+        self.kv_host_slots = int(kv_host_slots)
+        self.prefix = bool(prefix)
         self._batcher: Optional[ContinuousBatcher] = None
         self._batcher_lock = threading.Lock()
 
@@ -424,7 +842,11 @@ class LMService(Service):
         with self._batcher_lock:
             if self._batcher is None:
                 self._batcher = ContinuousBatcher(
-                    self.cfg, self.params, slots=self.decode_slots)
+                    self.cfg, self.params, slots=self.decode_slots,
+                    paged=self.paged, page=self.page,
+                    pages=self.kv_pages,
+                    host_slots=self.kv_host_slots,
+                    prefix=self.prefix)
             return self._batcher
 
     def Generate(self, cntl, request):

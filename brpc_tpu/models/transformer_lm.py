@@ -549,6 +549,186 @@ def empty_batch_cache(cfg: LMConfig, slots: int):
     return cache
 
 
+def make_paged_batch_decode(cfg: LMConfig, page: int):
+    """Block-paged continuous batching: :func:`make_batch_decode` with
+    the per-slot contiguous cache arrays replaced by ONE shared page
+    pool per layer plus a per-slot **block table** — the serving shape
+    where a session holds only ``ctx_len``-rounded pages instead of a
+    full ``max_seq`` stripe, and where two sessions may ALIAS the same
+    page (the cross-session prefix cache).
+
+    Layout (one logical address space across layers): logical page ``p``
+    is row-block ``p`` of EVERY layer's k/v pool, shaped
+    ``(num_pages, page, heads, hd)``.  Page 0 is the reserved garbage
+    page — unallocated block-table entries and inactive slots write
+    there, and the attention mask never admits an unwritten row (the
+    ``live`` mask only reaches rows <= pos, all of which the owning
+    session has written).
+
+    Returns ``(prefill, step)`` where
+    ``step(params, cache, bt, token[b], active[b]) -> (cache, logits)``
+    and ``bt`` is the (slots, max_seq // page) int32 block table (host-
+    owned, passed per step — NOT part of the donated cache).  The step
+    scatters the new k/v row into ``pool[bt[b, pos // page], pos % page]``
+    and gathers ``pool[bt]`` back into exactly the (b, max_seq, heads,
+    hd) array the contiguous step attends over, then runs the SAME
+    masked attention — token identity with :func:`make_batch_decode` by
+    construction, which the per-lane pins assert."""
+    import jax
+    import jax.numpy as jnp
+
+    hd = cfg.dim // cfg.heads
+    if cfg.scan_layers:
+        raise NotImplementedError(
+            "paged batch decode supports unrolled layers only")
+    if cfg.max_seq % page:
+        raise ValueError(
+            f"page size {page} must divide max_seq {cfg.max_seq}")
+    pps = cfg.max_seq // page       # pages per slot (block-table width)
+    if cfg.moe_experts > 0:
+        from .moe import forward_grouped as moe_forward
+        moe_cfg = cfg.moe_cfg()
+
+    from ..ops.quant import qmatmul
+
+    def mlp(bp, h):
+        if cfg.moe_experts > 0:
+            out, _ = moe_forward(bp["moe"], h, moe_cfg)
+            return out
+        up = qmatmul(h, bp["w1"])
+        return qmatmul(jax.nn.gelu(up), bp["w2"])
+
+    def decode_layer(bp, x, pk, pv, bt, pos):
+        """One block, one token per slot, block-table addressing."""
+        b = x.shape[0]
+        h = _rmsnorm(x, bp["ln1"])
+        qkv = qmatmul(h, bp["wqkv"])
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        shp = (b, 1, cfg.heads, hd)
+        q = _rope_at_vec(q.reshape(shp), pos, hd)
+        k = _rope_at_vec(k.reshape(shp), pos, hd)
+        v = v.reshape(shp)
+
+        # scatter this step's row into each slot's CURRENT page
+        page_idx = bt[jnp.arange(b), pos // page]
+        row = pos % page
+        pk = pk.at[page_idx, row].set(k[:, 0])
+        pv = pv.at[page_idx, row].set(v[:, 0])
+
+        # gather the block table back into the contiguous view the
+        # un-paged step attends over (unwritten pages are garbage but
+        # sit beyond the live mask by construction)
+        kc = pk[bt].reshape(b, cfg.max_seq, cfg.heads, hd)
+        vc = pv[bt].reshape(b, cfg.max_seq, cfg.heads, hd)
+        s_mat = jnp.einsum("bqhd,bkhd->bhqk", q, kc,
+                           preferred_element_type=jnp.float32
+                           ) / (hd ** 0.5)
+        live = jnp.arange(cfg.max_seq)[None, :] <= pos[:, None]
+        s_mat = jnp.where(live[:, None, None, :], s_mat, -1e30)
+        p = jax.nn.softmax(s_mat, axis=-1)
+        att = jnp.einsum("bhqk,bkhd->bqhd", p, vc,
+                         preferred_element_type=jnp.float32)
+        x = x + qmatmul(att.reshape(b, 1, cfg.dim), bp["wo"])
+        x = x + mlp(bp, _rmsnorm(x, bp["ln2"]))
+        return x, pk, pv
+
+    def step(params, cache, bt, token, active):
+        cache = dict(cache)
+        pos = jnp.minimum(cache["len"], cfg.max_seq - 1)
+        x = params["embed"][token][:, None, :]
+        for i in range(cfg.depth):
+            x, pk, pv = decode_layer(params[f"blk{i}"], x,
+                                     cache[f"pk{i}"], cache[f"pv{i}"],
+                                     bt, pos)
+            cache[f"pk{i}"], cache[f"pv{i}"] = pk, pv
+        cache["len"] = jnp.where(active, cache["len"] + 1,
+                                 cache["len"])
+        return cache, qmatmul(x[:, 0], params["unembed"])
+
+    prefill, _ = make_decode(cfg)
+    return prefill, step
+
+
+def empty_paged_cache(cfg: LMConfig, num_pages: int, slots: int,
+                      page: int):
+    """A fresh page-pool KV cache for :func:`make_paged_batch_decode`:
+    per layer one ``(num_pages, page, heads, hd)`` k and v pool (page 0
+    reserved as the garbage page) plus the per-slot ``len`` vector.
+    The block table is NOT here — it is host state
+    (``kv.pages.PageAllocator`` decides it), passed to the step."""
+    import jax.numpy as jnp
+    if cfg.max_seq % page:
+        raise ValueError(
+            f"page size {page} must divide max_seq {cfg.max_seq}")
+    hd = cfg.dim // cfg.heads
+    cache = {}
+    for i in range(cfg.depth):
+        cache[f"pk{i}"] = jnp.zeros((num_pages, page, cfg.heads, hd),
+                                    jnp.float32)
+        cache[f"pv{i}"] = jnp.zeros((num_pages, page, cfg.heads, hd),
+                                    jnp.float32)
+    cache["len"] = jnp.zeros((slots,), jnp.int32)
+    return cache
+
+
+def paged_page_bytes(cfg: LMConfig, page: int) -> int:
+    """Device bytes one LOGICAL page pins across every layer's k+v
+    pools (the allocator's per-page accounting unit)."""
+    hd = cfg.dim // cfg.heads
+    return 2 * cfg.depth * page * cfg.heads * hd * 4       # float32
+
+
+def make_paged_io(cfg: LMConfig, page: int):
+    """Page-granular device I/O for the paged cache — the spill /
+    resume / prefill-insert data motion, all fixed-shape (padded to the
+    block-table width with garbage-page entries) so each jits ONCE.
+
+    Returns ``(gather, scatter, insert)``:
+      - ``gather(cache, page_ids[pps]) -> (pps, 2*depth, page, heads,
+        hd)`` — a session's logical pages as one host-transferable
+        block (k then v per layer on axis 1);
+      - ``scatter(cache, page_ids[pps], block) -> cache`` — the
+        inverse (resume's H2D landing);
+      - ``insert(cache, page_ids[pps], src) -> cache`` — a batch-1
+        prefilled contiguous cache (``make_decode``'s) blockified into
+        the session's pages.
+    Padding entries point at page 0 and only ever write garbage there.
+    """
+    import jax.numpy as jnp
+    if cfg.max_seq % page:
+        raise ValueError(
+            f"page size {page} must divide max_seq {cfg.max_seq}")
+    pps = cfg.max_seq // page
+    hd = cfg.dim // cfg.heads
+
+    def gather(cache, page_ids):
+        blocks = []
+        for i in range(cfg.depth):
+            blocks.append(cache[f"pk{i}"][page_ids])
+            blocks.append(cache[f"pv{i}"][page_ids])
+        return jnp.stack(blocks, axis=1)
+
+    def scatter(cache, page_ids, block):
+        cache = dict(cache)
+        for i in range(cfg.depth):
+            cache[f"pk{i}"] = cache[f"pk{i}"].at[page_ids].set(
+                block[:, 2 * i])
+            cache[f"pv{i}"] = cache[f"pv{i}"].at[page_ids].set(
+                block[:, 2 * i + 1])
+        return cache
+
+    def insert(cache, page_ids, src):
+        cache = dict(cache)
+        for i in range(cfg.depth):
+            kb = src[f"k{i}"][0].reshape(pps, page, cfg.heads, hd)
+            vb = src[f"v{i}"][0].reshape(pps, page, cfg.heads, hd)
+            cache[f"pk{i}"] = cache[f"pk{i}"].at[page_ids].set(kb)
+            cache[f"pv{i}"] = cache[f"pv{i}"].at[page_ids].set(vb)
+        return cache
+
+    return gather, scatter, insert
+
+
 def make_decode_loop(cfg: LMConfig, steps: int):
     """Greedy generation as ONE compiled program: ``lax.scan`` feeds the
     argmax token back through ``decode_step`` for ``steps`` tokens, so a

@@ -16,15 +16,9 @@ from typing import Any, Optional, Tuple
 from ..butil.iobuf import IOBuf
 from ..butil.logging_util import LOG
 from ..butil.status import Errno
-from ..butil.time_utils import monotonic_us
-from ..deadline import arm as _arm_deadline
-from ..deadline import inherit_deadline, maybe_shed
-from ..deadline import parse_deadline_ms as _parse_deadline_ms
+from ..deadline import inherit_deadline
 from ..protocol.http import HttpMessage, build_response
-from ..protocol.meta import RpcMeta
 from ..transport.socket import Socket
-from .admission import admit as _admit
-from .admission import http_reject
 from .controller import ServerController
 
 
@@ -180,61 +174,33 @@ def handle_http_request(msg: HttpMessage, sock, server) -> None:
 
 def _bridge_rpc(msg: HttpMessage, sock, server, svc: str,
                 mth: str, entry, unresolved: str = "") -> None:
-    # overload plane: the shared admission stage; a rejection answers
-    # 503 with Retry-After and a reason body/header distinguishing
-    # server-cap vs method-cap vs CoDel vs tenant-quota (shared with
-    # the kind-4 slim lane so the two stay byte-identical)
-    tenant = msg.headers.get("x-tenant")
-    rej = _admit(server, entry, "http", tenant,
-                 getattr(msg, "recv_us", 0) or None)
-    if rej is not None:
-        status_code, body, extra = http_reject(rej)
-        extra, ka = drain_response_args(server, extra, msg.keep_alive)
-        sock.write(build_response(status_code, body, headers=extra,
-                                  keep_alive=ka))
-        return
-
-    meta = RpcMeta()
-    meta.service_name = svc
-    meta.method_name = mth
-    if tenant:
-        meta.tenant = tenant.encode("utf-8", "replace")
-    tp_header = msg.headers.get("traceparent")
-    if tp_header:
-        from ..rpcz import parse_traceparent
-        tp = parse_traceparent(tp_header)
-        if tp is not None:
-            # W3C trace context → the internal trace model: the server
-            # span parents to the caller's span id, exactly like the
-            # tpu_std meta's trace/span TLVs
-            meta.trace_id, meta.span_id = tp
-    # x-deadline-ms: the HTTP/1.1 spelling of tpu_std's remaining-
-    # deadline TLV 13 (0 = already expired); kept in a local too —
-    # meta.timeout_ms == 0 conventionally means "none"
-    dl_ms = _parse_deadline_ms(msg.headers.get("x-deadline-ms"))
-    if dl_ms is not None:
-        meta.timeout_ms = dl_ms
+    # cross-cutting stages (admission → trace extract → deadline
+    # arm/shed) ride the COMPILED interceptor chain — the third
+    # binding of ROADMAP item 1 (after the kind-5 streaming and kind-3
+    # slim lanes).  The lane body only builds its HTTP-flavored send
+    # closure, calls the chain's enter before user code, and settles
+    # every completion through the chain's settle half.
+    chain = getattr(entry, "_http_chain", None)
+    if chain is None:
+        from .interceptors import compile_http_chain
+        chain = compile_http_chain(server, entry)
+        try:
+            entry._http_chain = chain       # compile once per entry
+        except AttributeError:
+            pass
+    _enter, _settle = chain
 
     def send(cntl: ServerController, response: Any) -> None:
-        latency_us = monotonic_us() - cntl.begin_time_us
-        entry.status.on_responded(cntl.error_code, latency_us)
-        server.on_request_out(tenant=meta.tenant,
-                              error_code=cntl.error_code,
-                              latency_us=latency_us)
-        span = cntl.span
         s = Socket.address(cntl.socket_id)
         if s is None:
-            if span is not None:
-                span.finish(cntl.error_code)
+            _settle(cntl, 0)
             return
         if cntl.failed:
             if cntl._progressive is not None:
                 cntl._progressive._abort()
             code = http_status_for_error(cntl.error_code)
             body = cntl.error_text.encode()
-            if span is not None:
-                span.response_size = len(body)
-                span.finish(cntl.error_code)
+            _settle(cntl, len(body))
             hdrs, ka = drain_response_args(
                 server, [("x-rpc-error-code", str(cntl.error_code))],
                 msg.keep_alive)
@@ -252,9 +218,7 @@ def _bridge_rpc(msg: HttpMessage, sock, server, svc: str,
             first = b"%x\r\n" % len(body) + body + b"\r\n" if body else b""
             s.write(IOBuf(head + first))
             cntl._progressive._start()
-            if span is not None:
-                span.response_size = len(body)
-                span.finish(0)
+            _settle(cntl, len(body))
             return
         body, ctype = _encode_http_body(response)
         extra = None
@@ -265,32 +229,14 @@ def _bridge_rpc(msg: HttpMessage, sock, server, svc: str,
             # peer split (HTTP has no native side channel)
             body += att
             extra = [("x-rpc-attachment-size", str(len(att)))]
-        if span is not None:
-            span.response_size = len(body)
-            span.finish(0)
+        _settle(cntl, len(body))
         extra, ka = drain_response_args(server, extra, msg.keep_alive)
         s.write(build_response(200, body, ctype, headers=extra,
                                keep_alive=ka))
 
-    cntl = ServerController(meta, sock.remote_side, sock.id, send)
-    cntl.server = server
-    cntl.http_method = msg.method
-    cntl.http_path = msg.path
-    cntl.http_unresolved_path = unresolved
-    from ..rpcz import start_server_span
-    cntl.span = start_server_span(entry.status.full_name, meta,
-                                  sock.remote_side)
-    if cntl.span is not None:
-        cntl.span.request_size = len(msg.body)
-    if dl_ms is not None:
-        # deadline plane: anchor the propagated budget at the message's
-        # PARSE time (queueing between protocol cut and this bridge
-        # counts against it), then shed doomed work before body parsing
-        # or the handler burn any time on it
-        _arm_deadline(cntl, dl_ms, getattr(msg, "recv_us", 0) or None)
-        if maybe_shed(cntl, "http", entry.status.full_name):
-            cntl.finish(None)
-            return
+    cntl = _enter(msg, sock, svc, mth, unresolved, send)
+    if cntl is None:
+        return           # rejected or shed: the client is answered
     if msg.method in ("GET", "HEAD") and msg.query_string:
         request: Any = json.dumps(msg.query()).encode()
     else:

@@ -26,13 +26,15 @@ binds the chain cannot drop a stage or reorder admission after user
 code: the stages live HERE, the lane body only calls ``enter`` before
 user code and ``settle`` (or ``cntl.finish`` escalation) after.
 
-The chain is tpu_std-flavored (rejections serialize through the classic
-``_send_error`` builder, byte-identical with the hand-rolled lanes); a
-future HTTP binding compiles with its own rejection serializer.  The
-lane linter (tools/check/lanes.py) analyzes this module's ``enter``
-body for the admission-before-shed ordering and the lane body for the
-enter-before-user-code ordering — the binding is machine-checked, not
-a convention.
+:func:`compile_chain` is tpu_std-flavored (rejections serialize through
+the classic ``_send_error`` builder, byte-identical with the hand-rolled
+lanes); :func:`compile_http_chain` is the HTTP binding of the same
+stages — rejections serialize through the shared ``http_reject``
+helper, traces arrive as W3C ``traceparent`` headers, deadlines as
+``x-deadline-ms``.  The lane linter (tools/check/lanes.py) analyzes
+each chain's ``enter`` body for the admission-before-shed ordering and
+the lane body for the enter-before-user-code ordering — the binding is
+machine-checked, not a convention.
 """
 
 from __future__ import annotations
@@ -153,5 +155,109 @@ def compile_chain(server, entry, lane: str):
         if span is not None:
             span.response_size = response_len
             span.finish(0)
+
+    return enter, settle
+
+
+def compile_http_chain(server, entry):
+    """The HTTP binding of the interceptor chain (ROADMAP item 1's
+    third port): same stages, HTTP spellings — tenant from
+    ``x-tenant``, trace from W3C ``traceparent``, deadline from
+    ``x-deadline-ms``, rejections through the shared ``http_reject``
+    helper with the drain plane's lame-duck headers.
+
+    ``enter(msg, sock, svc, mth, unresolved, send)`` runs admission →
+    trace extract → deadline arm/shed and returns a ready
+    :class:`ServerController` (with ``send`` as its completion
+    callback), or ``None`` when the request was rejected/shed — the
+    client is already answered.
+
+    ``settle(cntl, response_len)`` is the completion epilogue every
+    response path funnels through: MethodStatus + limiter latency feed
+    + tenant slot release + span completion.  The lane's ``send``
+    closure calls it exactly once per request, right before the bytes
+    go out (or in place of them when the socket is gone)."""
+    from ..butil.time_utils import monotonic_us
+    from ..deadline import parse_deadline_ms as _parse_deadline_ms
+    from ..protocol.http import build_response
+    from ..rpcz import parse_traceparent
+    from .admission import http_reject
+    # lazy: http_dispatch imports this module to bind the chain
+    from .http_dispatch import drain_response_args
+
+    status = entry.status
+
+    def enter(msg, sock, svc, mth, unresolved, send,
+              _server=server, _entry=entry, _status=status,
+              _admit_stage=_admit, _shed=_maybe_shed,
+              _arm=_arm_deadline, _sample=start_server_span,
+              _parse_tp=parse_traceparent,
+              _parse_dl=_parse_deadline_ms, _reject=http_reject,
+              _drain_args=drain_response_args, _build=build_response):
+        # ---- admission: the ONE shared overload-plane stage, FIRST
+        # (CoDel sojourn measured from the message's parse stamp)
+        tenant = msg.headers.get("x-tenant")
+        rej = _admit_stage(_server, _entry, "http", tenant,
+                           getattr(msg, "recv_us", 0) or None)
+        if rej is not None:
+            # rejection serialization through the SHARED HTTP helper
+            # (503 + Retry-After + reason; lame-duck headers in drain)
+            status_code, body, extra = _reject(rej)
+            extra, ka = _drain_args(_server, extra, msg.keep_alive)
+            sock.write(_build(status_code, body, headers=extra,
+                              keep_alive=ka))
+            return None
+        meta = RpcMeta()
+        meta.service_name = svc
+        meta.method_name = mth
+        if tenant:
+            meta.tenant = tenant.encode("utf-8", "replace")
+        # ---- trace extract: W3C trace context → the internal trace
+        # model (the server span parents to the caller's span id,
+        # exactly like the tpu_std meta's trace/span TLVs)
+        tp_header = msg.headers.get("traceparent")
+        if tp_header:
+            tp = _parse_tp(tp_header)
+            if tp is not None:
+                meta.trace_id, meta.span_id = tp
+        # x-deadline-ms: the HTTP/1.1 spelling of tpu_std's remaining-
+        # deadline TLV 13 (0 = already expired); kept in a local too —
+        # meta.timeout_ms == 0 conventionally means "none"
+        dl_ms = _parse_dl(msg.headers.get("x-deadline-ms"))
+        if dl_ms is not None:
+            meta.timeout_ms = dl_ms
+        cntl = ServerController(meta, sock.remote_side, sock.id, send)
+        cntl.server = _server
+        cntl.http_method = msg.method
+        cntl.http_path = msg.path
+        cntl.http_unresolved_path = unresolved
+        cntl.span = _sample(_status.full_name, meta, sock.remote_side)
+        if cntl.span is not None:
+            cntl.span.request_size = len(msg.body)
+        if dl_ms is not None:
+            # deadline plane: anchor the propagated budget at the
+            # message's PARSE time (queueing between protocol cut and
+            # the bridge counts against it), then shed doomed work
+            # before body parsing or the handler burn any time on it
+            _arm(cntl, dl_ms, getattr(msg, "recv_us", 0) or None)
+            if _shed(cntl, "http", _status.full_name):
+                cntl.finish(None)
+                return None
+        return cntl
+
+    def settle(cntl, response_len,
+               _status=status, _server=server, _us=monotonic_us):
+        """Completion epilogue (every response shape — success, error,
+        progressive headers, socket-gone — funnels through here once):
+        MethodStatus settle, limiter latency feed, span completion."""
+        latency_us = _us() - cntl.begin_time_us
+        _status.on_responded(cntl.error_code, latency_us)
+        _server.on_request_out(tenant=cntl.request_meta.tenant,
+                               error_code=cntl.error_code,
+                               latency_us=latency_us)
+        span = cntl.span
+        if span is not None:
+            span.response_size = response_len
+            span.finish(cntl.error_code)
 
     return enter, settle

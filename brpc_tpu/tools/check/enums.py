@@ -149,6 +149,21 @@ def check_enums(tree: Tree) -> List[Finding]:
                         s = _str_const(e)
                         if s:
                             reason_names.append((s, f"{rel} (kv)"))
+        if rel.endswith("kv/pages.py"):
+            # the paged-KV allocator's closed enums (eviction close
+            # reasons + prefix-cache events): same pin discipline —
+            # count_evict/count_prefix assert membership at runtime,
+            # and every member needs a test anchor here
+            for node in ast.walk(mod):
+                if isinstance(node, ast.Assign) \
+                        and isinstance(node.targets[0], ast.Name) \
+                        and node.targets[0].id in (
+                            "KV_EVICT_REASONS", "PREFIX_CACHE_EVENTS") \
+                        and isinstance(node.value, ast.Tuple):
+                    for e in node.value.elts:
+                        s = _str_const(e)
+                        if s:
+                            reason_names.append((s, f"{rel} (kv)"))
     seen: Set[str] = set()
     for name, origin in reason_names:
         if name in seen:

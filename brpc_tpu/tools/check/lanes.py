@@ -58,10 +58,21 @@ LANES = (
         },
     },
     {
+        # classic HTTP bridge — the THIRD interceptor-chain binding:
+        # admission/trace/deadline live in compile_http_chain; the lane
+        # body builds its HTTP send closure, calls enter before user
+        # code, and settles every response shape through the chain
         "lane": "http",
         "path": "brpc_tpu/server/http_dispatch.py",
         "func": ["_bridge_rpc"],
-        "reject": {"kind": "call", "names": {"http_reject"}},
+        "reject": {"kind": "call", "names": {"http_reject", "_reject"}},
+        "chain": {
+            "path": "brpc_tpu/server/interceptors.py",
+            "func": ["compile_http_chain", "enter"],
+            "settle_func": ["compile_http_chain", "settle"],
+            "entry_names": {"_enter", "enter"},
+            "settle_names": {"_settle", "settle"},
+        },
     },
     {
         "lane": "http_slim",

@@ -93,7 +93,16 @@ RECORDED_BASELINE = {
     "kv_transfer_gbps": 4.0,
     "disagg_ttft_p99_ms": 58.1,
     "disagg_vs_mono_ttft": 1.9,
-    "disagg_sessions_per_box": 16.0,
+    # ISSUE 16 paged-KV allocator keys (session box, 2026-08): the
+    # sessions-per-box headline moves to the paged decode tier — 128
+    # concurrent sessions on the SAME device byte budget as the 16
+    # contiguous slots above (the overflow rides the host tier), so
+    # the recorded bar moves 16 -> 128 with the bench.  Bytes/session
+    # is near-deterministic (capped pool ÷ completed sessions); the
+    # hit-TTFT is one decode step + RPC, recorded as measured
+    "disagg_sessions_per_box": 128.0,
+    "kv_bytes_per_session": 12288.0,
+    "prefix_cache_hit_ttft_p99_ms": 17.7,
 }
 
 # keys pinned at EXACTLY zero: any non-zero value fails the gate
@@ -103,12 +112,17 @@ RECORDED_BASELINE = {
 PINNED_ZERO = ("rolling_restart_failed_rpcs",
                # a same-host KV handoff moving payload bytes through
                # the message path is a data-plane regression, not noise
-               "disagg_handoff_copies")
+               "disagg_handoff_copies",
+               # a prefix-cache hit ALIASES the cached context pages
+               # (refcounts move, bytes do not) — any copy during the
+               # hit sessions means the cache degenerated to memcpy
+               "prefix_alias_copies")
 
 _HIGHER = ("_qps", "_gbps", "gbps", "_rps", "_tok_s", "tokens_per_s",
            "_tflops", "_speedup", "_frac", "_factor_inverse",
            "_sessions", "_sessions_per_box")
-_LOWER = ("_us", "_ms", "_p50", "_p99", "_rss_mb")
+_LOWER = ("_us", "_ms", "_p50", "_p99", "_rss_mb",
+          "_bytes_per_session")
 # gap keys measure raw/cntl — LOWER is better (a shrinking gap is the
 # win); amplification likewise
 _LOWER_RATIOS = ("cntl_vs_raw_gap", "fanout_cntl_vs_raw_gap",

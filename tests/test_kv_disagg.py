@@ -91,7 +91,7 @@ def _reset_kv():
 
 def _two_tier(cfg, params, force_lane=None, decode_slots=4,
               native=False, decode_cfg=None, decode_params=None,
-              **prefill_kw):
+              decode_lm_kw=None, **prefill_kw):
     """Build a decode tier (LM + KV services) and a prefill tier
     pointed at it; returns (pre_srv, dec_srv, dec_lm, pre_svc, dch)."""
     from brpc_tpu.kv import DecodeTierService, KvTransport, \
@@ -107,7 +107,8 @@ def _two_tier(cfg, params, force_lane=None, decode_slots=4,
     dec_lm = LMService(cfg=decode_cfg or cfg,
                        params=params if decode_params is None
                        else decode_params,
-                       decode_slots=decode_slots)
+                       decode_slots=decode_slots,
+                       **(decode_lm_kw or {}))
     dec_srv = Server(opts())
     dec_srv.add_service(dec_lm, name="LM")
     dec_srv.add_service(DecodeTierService(dec_lm), name="KV")
@@ -796,6 +797,426 @@ def test_fallback_disabled_flag():
             dec_srv.stop()
     finally:
         set_flag("kv_transfer_enabled", True)
+
+
+# ---------------------------------------------------------------------------
+# Paged KV allocator (ISSUE 16): block-paged attention, cross-session
+# prefix cache, host-tier eviction
+# ---------------------------------------------------------------------------
+
+KV_EVICT_PINS = ("kv_pool_exhausted", "kv_host_tier_full",
+                 "kv_spill_drain_aborted")
+PREFIX_EVENT_PINS = ("prefix_hit", "prefix_partial_hit", "prefix_miss",
+                     "prefix_insert", "prefix_evict")
+
+
+def test_paged_enums_match_pins():
+    from brpc_tpu.kv.pages import (KV_EVICT_REASONS, PREFIX_CACHE_EVENTS,
+                                   count_evict, count_prefix,
+                                   kv_evict_counters,
+                                   prefix_event_counters)
+    assert KV_EVICT_REASONS == KV_EVICT_PINS
+    assert PREFIX_CACHE_EVENTS == PREFIX_EVENT_PINS
+    assert set(kv_evict_counters()) == set(KV_EVICT_PINS)
+    assert set(prefix_event_counters()) == set(PREFIX_EVENT_PINS)
+    with pytest.raises(AssertionError):
+        count_evict("kv_some_new_evict_reason")
+    with pytest.raises(AssertionError):
+        count_prefix("prefix_some_new_event")
+
+
+class _FakeStream:
+    """Batcher-facing stream stub on the Python write lane (the
+    batcher only touches closed/options/write/close/id/_native_tx)."""
+
+    def __init__(self):
+        self.closed = False
+        self.close_reason = None
+        self.tokens = []
+        self.id = 0
+        self._native_tx = None
+        self.options = StreamOptions()
+
+    def write(self, data):
+        self.tokens.append(struct.unpack("<i", bytes(data))[0])
+        return 0
+
+    def close(self, reason=None):
+        self.closed = True
+        self.close_reason = reason
+
+
+def _paged_run(bat, prompt, max_new, timeout=90.0):
+    """One session through a paged batcher via a fake stream."""
+    st = _FakeStream()
+    bat.join(st, prompt, max_new)
+    deadline = time.monotonic() + timeout
+    while not st.closed and time.monotonic() < deadline:
+        time.sleep(0.002)
+    assert st.closed, "paged decode session never closed"
+    return st
+
+
+def _wait(pred, timeout=30.0, msg="condition never held"):
+    deadline = time.monotonic() + timeout
+    while not pred() and time.monotonic() < deadline:
+        time.sleep(0.002)
+    assert pred(), msg
+
+
+def test_paged_decode_identity_and_prefix_hit_skips_prefill():
+    """Block-paged attention is token-identical with the monolithic
+    path, and a re-sent context ALIASES the cached pages: the second
+    session runs NO prefill, copies ZERO bytes, and streams the same
+    tokens."""
+    from brpc_tpu.butil import copy_audit
+    from brpc_tpu.kv import pages as kv_pages
+    from brpc_tpu.models.lm_service import ContinuousBatcher
+    _reset_kv()
+    cfg, params, _ = _setup()
+    prompt = np.asarray(jax.random.randint(jax.random.PRNGKey(3), (17,),
+                                           0, cfg.vocab, jnp.int32))
+    want = np.asarray(generate(params, cfg, prompt[None, :], 6))[0]
+    bat = ContinuousBatcher(cfg, params, slots=4, paged=True, page=16)
+    st1 = _paged_run(bat, prompt, 6)
+    assert st1.tokens == want.tolist()
+    assert st1.close_reason == "finished"
+    assert bat.prefills_run == 1
+    ev = kv_pages.prefix_event_counters()
+    assert ev["prefix_miss"] == 1 and ev["prefix_insert"] == 1
+    # the SAME context again: full-page prefix hit — prefill skipped,
+    # the aliased pages move zero audited bytes
+    with copy_audit.audit() as snap:
+        st2 = _paged_run(bat, prompt, 6)
+        counts, _nb = snap()
+    assert st2.tokens == want.tolist()
+    assert st2.close_reason == "finished"
+    assert bat.prefills_run == 1                  # no new prefill
+    assert kv_pages.prefix_event_counters()["prefix_hit"] == 1
+    assert sum(counts.values()) == 0, counts      # aliasing copies nothing
+    # sessions gone: only the prefix cache still holds pages
+    st = bat.kv_stats()
+    assert st["alloc"]["in_use"] == st["prefix"]["nodes"] == 1
+
+
+def test_prefix_hit_partial_page_teacher_forced_identity():
+    """A context whose FULL pages are all cached but whose tail spills
+    past them: the hit aliases the covered page and the remainder
+    catches up with teacher-forced steps — the emitted stream is
+    identical with the uncached path (the big numerics risk of
+    partial-page coverage)."""
+    from brpc_tpu.kv import pages as kv_pages
+    from brpc_tpu.models.lm_service import ContinuousBatcher
+    _reset_kv()
+    cfg, params, _ = _setup()
+    base = np.asarray(jax.random.randint(jax.random.PRNGKey(5), (16,),
+                                         0, cfg.vocab, jnp.int32))
+    pa = np.concatenate([base, np.asarray([3, 9], np.int32)])
+    pb = np.concatenate([base, np.asarray([7, 1, 4, 2, 8], np.int32)])
+    want_b = np.asarray(generate(params, cfg, pb[None, :], 6))[0]
+    bat = ContinuousBatcher(cfg, params, slots=4, paged=True, page=16)
+    _paged_run(bat, pa, 4)            # seeds the shared prefix's page
+    pf = bat.prefills_run
+    st = _paged_run(bat, pb, 6)       # ctx 20: page cached, 4 forced
+    assert st.tokens == want_b.tolist()
+    assert st.close_reason == "finished"
+    assert bat.prefills_run == pf     # covered prefix: no prefill
+    # every FULL page matched -> classified a hit (the tail is never
+    # shareable); the true partial classification is the test below
+    assert kv_pages.prefix_event_counters()["prefix_hit"] == 1
+
+
+def test_prefix_partial_hit_teacher_forced_identity():
+    """A context sharing only its FIRST of two full pages with the
+    cached prefix: partial hit — one page aliased, a full page plus
+    tail caught up with teacher-forced steps, stream identical with
+    the uncached path."""
+    from brpc_tpu.kv import pages as kv_pages
+    from brpc_tpu.models.lm_service import ContinuousBatcher
+    _reset_kv()
+    cfg = LMConfig(vocab=64, dim=32, heads=4, depth=2, max_seq=48,
+                   remat=False)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    base = np.asarray(jax.random.randint(jax.random.PRNGKey(6), (16,),
+                                         0, cfg.vocab, jnp.int32))
+    ta = np.asarray(jax.random.randint(jax.random.PRNGKey(7), (17,),
+                                       0, cfg.vocab, jnp.int32))
+    tb = np.asarray(jax.random.randint(jax.random.PRNGKey(8), (17,),
+                                       0, cfg.vocab, jnp.int32))
+    pa = np.concatenate([base, ta])   # ctx 32: two full pages cached
+    pb = np.concatenate([base, tb])   # ctx 32: only page 1 matches
+    want_b = np.asarray(generate(params, cfg, pb[None, :], 4))[0]
+    bat = ContinuousBatcher(cfg, params, slots=2, paged=True, page=16)
+    _paged_run(bat, pa, 4)
+    pf = bat.prefills_run
+    st = _paged_run(bat, pb, 4)
+    assert st.tokens == want_b.tolist()
+    assert st.close_reason == "finished"
+    assert bat.prefills_run == pf     # aliased page: no prefill
+    assert kv_pages.prefix_event_counters()["prefix_partial_hit"] == 1
+
+
+@pytest.mark.parametrize("lane", [None, "shm", "copy"],
+                         ids=["auto-ici", "shm", "copy"])
+def test_two_tier_into_paged_decode_tier_identical(lane):
+    """The disagg handoff lands in a PAGED decode tier: the imported
+    contiguous cache blockifies into allocator pages and the token
+    stream stays monolithic-identical on every lane."""
+    from brpc_tpu.kv import outstanding_pages
+    if lane == "shm":
+        from brpc_tpu.transport import shm_ring
+        if not shm_ring.shm_supported():
+            pytest.skip("no shm support in sandbox")
+    _reset_kv()
+    cfg, params, prompt = _setup()
+    pre_srv, dec_srv, dec_lm, _pre, _dch = _two_tier(
+        cfg, params, force_lane=lane,
+        decode_lm_kw={"paged": True, "page": 16})
+    try:
+        toks, reason, _ = _stream_decode(pre_srv, prompt, 6)
+        want = np.asarray(generate(params, cfg, prompt, 6))[0]
+        assert toks == want.tolist()
+        assert reason == "finished"
+        bst = dec_lm.batcher().kv_stats()
+        assert bst["paged"] and bst["steps"] >= 6
+        assert bst["alloc"]["in_use"] == 0   # imported pages settled
+        assert outstanding_pages() == 0
+    finally:
+        pre_srv.stop()
+        dec_srv.stop()
+
+
+def test_evict_resume_roundtrip_token_identity():
+    """Host-tier eviction roundtrip: admitting B under a dry pool
+    SPILLS A's private pages to host RAM and parks it; A resumes
+    bit-exact once B's pages free — both streams monolithic-identical,
+    nothing leaks."""
+    from brpc_tpu.kv.pages import host_inflight_spills
+    from brpc_tpu.models.lm_service import ContinuousBatcher
+    _reset_kv()
+    cfg, params, prompt = _setup()
+    pa = prompt[0]
+    pb = np.asarray(jax.random.randint(jax.random.PRNGKey(11), (8,),
+                                       0, cfg.vocab, jnp.int32))
+    want_a = np.asarray(generate(params, cfg, pa[None, :], 12))[0]
+    want_b = np.asarray(generate(params, cfg, pb[None, :], 6))[0]
+    # 2 usable pages (page 0 reserved): A's 2-page session fills the
+    # pool; B's 1-page admit must spill A
+    bat = ContinuousBatcher(cfg, params, slots=2, paged=True, page=16,
+                            pages=3, host_slots=8, prefix=False)
+    sta = _FakeStream()
+    bat.join(sta, pa, 12)                 # pages_for(7, 12) = 2
+    _wait(lambda: bat.live_slots() >= 1, msg="A never admitted")
+    stb = _FakeStream()
+    bat.join(stb, pb, 6)                  # pages_for(7, 6) = 1
+    _wait(lambda: sta.closed and stb.closed, timeout=90.0,
+          msg="spill/resume sessions never finished")
+    assert sta.tokens == want_a.tolist()
+    assert stb.tokens == want_b.tolist()
+    assert sta.close_reason == stb.close_reason == "finished"
+    assert bat.spills >= 1 and bat.resumes >= 1
+    st = bat.kv_stats()
+    assert st["alloc"]["in_use"] == 0
+    assert st["host"]["free"] == 8        # every host slot returned
+    assert host_inflight_spills() == 0
+
+
+def test_pool_exhausted_closes_with_named_reason():
+    """An unsatisfiable admit (no host tier to spill to) closes the
+    stream under kv_pool_exhausted — backpressure with a name, never a
+    partial grant."""
+    from brpc_tpu.kv.pages import kv_evict_counters
+    from brpc_tpu.models.lm_service import ContinuousBatcher
+    _reset_kv()
+    cfg, params, prompt = _setup()
+    bat = ContinuousBatcher(cfg, params, slots=2, paged=True, page=16,
+                            pages=2, host_slots=0, prefix=False)
+    st = _paged_run(bat, prompt[0], 12)   # needs 2 pages, pool has 1
+    assert st.close_reason == "kv_pool_exhausted"
+    assert st.tokens == []
+    assert kv_evict_counters()["kv_pool_exhausted"] == 1
+
+
+def test_host_tier_full_closes_with_named_reason():
+    """A spill that cannot fit in the host tier closes the ADMITTING
+    stream under kv_host_tier_full; the would-be victim keeps decoding
+    and stays token-identical."""
+    from brpc_tpu.kv.pages import kv_evict_counters
+    from brpc_tpu.models.lm_service import ContinuousBatcher
+    _reset_kv()
+    cfg, params, prompt = _setup()
+    pa = prompt[0]
+    pb = np.asarray(jax.random.randint(jax.random.PRNGKey(13), (8,),
+                                       0, cfg.vocab, jnp.int32))
+    want_a = np.asarray(generate(params, cfg, pa[None, :], 12))[0]
+    # host tier holds ONE page; spilling A needs two
+    bat = ContinuousBatcher(cfg, params, slots=2, paged=True, page=16,
+                            pages=3, host_slots=1, prefix=False)
+    sta = _FakeStream()
+    bat.join(sta, pa, 12)
+    _wait(lambda: bat.live_slots() >= 1, msg="A never admitted")
+    stb = _paged_run(bat, pb, 12)         # 2 pages: must spill A, can't
+    assert stb.close_reason == "kv_host_tier_full"
+    assert kv_evict_counters()["kv_host_tier_full"] == 1
+    _wait(lambda: sta.closed, timeout=90.0, msg="A never finished")
+    assert sta.tokens == want_a.tolist()
+    assert sta.close_reason == "finished"
+    assert bat.kv_stats()["host"]["free"] == 1   # staged slot rolled back
+
+
+def test_drain_counts_inflight_spills_and_aborts_at_expiry():
+    """Server.drain's settle gauge: a host-tier spill in flight holds
+    the drain open; grace expiry marks the pool aborted (named reason)
+    instead of hanging or leaking the mid-evict pages."""
+    from brpc_tpu.kv.pages import (HostPagePool, drain_settle,
+                                   host_inflight_spills)
+    _reset_kv()
+    pool = HostPagePool(2, 64)
+    assert pool.begin_spill()
+    assert host_inflight_spills() == 1
+    t0 = time.monotonic()
+    left = drain_settle(time.monotonic() + 0.15)
+    assert left == 1
+    assert time.monotonic() - t0 < 5.0
+    assert pool.abort_reason() == "kv_spill_drain_aborted"
+    assert not pool.begin_spill()         # aborted pool refuses spills
+    pool.end_spill()
+    assert drain_settle(time.monotonic() + 1.0) == 0
+    # a spill landing INSIDE the grace is observed
+    pool2 = HostPagePool(2, 64)
+    assert pool2.begin_spill()
+    threading.Timer(0.1, pool2.end_spill).start()
+    assert drain_settle(time.monotonic() + 5.0) == 0
+
+
+def test_drain_abort_closes_parked_under_named_reason():
+    """A parked (spilled) session at drain-abort time force-closes
+    under kv_spill_drain_aborted and frees its host slots; the live
+    session is untouched."""
+    from brpc_tpu.kv.pages import kv_evict_counters
+    from brpc_tpu.models.lm_service import ContinuousBatcher
+    _reset_kv()
+    cfg, params, prompt = _setup()
+    pa = prompt[0]
+    pb = np.asarray(jax.random.randint(jax.random.PRNGKey(17), (8,),
+                                       0, cfg.vocab, jnp.int32))
+    want_b = np.asarray(generate(params, cfg, pb[None, :], 20))[0]
+    bat = ContinuousBatcher(cfg, params, slots=2, paged=True, page=16,
+                            pages=3, host_slots=4, prefix=False)
+    sta = _FakeStream()
+    bat.join(sta, pa, 24)                 # 2 pages
+    _wait(lambda: bat.live_slots() >= 1, msg="A never admitted")
+    stb = _FakeStream()
+    bat.join(stb, pb, 20)                 # 2 pages: spills A
+    _wait(lambda: bat.spills >= 1, msg="A never spilled")
+    # drain-grace expiry while A sits parked: the pool aborts, the
+    # batcher closes A under the named reason between steps
+    bat._host.drain_abort("kv_spill_drain_aborted")
+    _wait(lambda: sta.closed, msg="parked session never closed")
+    assert sta.close_reason == "kv_spill_drain_aborted"
+    assert kv_evict_counters()["kv_spill_drain_aborted"] >= 1
+    _wait(lambda: stb.closed, timeout=90.0, msg="B never finished")
+    assert stb.tokens == want_b.tolist()
+    assert stb.close_reason == "finished"
+    st = bat.kv_stats()
+    assert st["alloc"]["in_use"] == 0
+    assert st["host"]["free"] == 4        # parked slots reclaimed
+
+
+def test_allocator_and_host_pool_loud_double_free():
+    """The loud-failure matrix for the allocator planes: double page
+    release raises, aliasing a dead page raises, host-slot double free
+    and stale fetch raise, an oversized spill raises."""
+    from brpc_tpu.kv import KvPageError
+    from brpc_tpu.kv.pages import HostPagePool, PageAllocator
+    _reset_kv()
+    a = PageAllocator(4, 16)
+    pages = a.alloc(2)
+    assert pages is not None and 0 not in pages   # page 0 reserved
+    a.release(pages[0])
+    with pytest.raises(KvPageError, match="double/stale"):
+        a.release(pages[0])
+    with pytest.raises(KvPageError, match="dead"):
+        a.ref(pages[0])                   # aliasing a freed page
+    # an aliased page survives the first release, frees on the last
+    a.ref(pages[1])
+    a.release(pages[1])
+    assert a.refcount(pages[1]) == 1
+    a.release(pages[1])
+    assert a.in_use() == 0
+    with pytest.raises(ValueError):
+        PageAllocator(1, 16)              # garbage page + >= 1 real
+
+    pool = HostPagePool(2, 64)
+    h = pool.stage(np.arange(64, dtype=np.uint8))
+    assert bytes(pool.fetch(h)) == bytes(range(64))
+    pool.free(h)
+    with pytest.raises(KvPageError, match="double/stale"):
+        pool.free(h)
+    with pytest.raises(KvPageError, match="stale"):
+        pool.fetch(h)
+    with pytest.raises(KvPageError, match="exceeds"):
+        pool.stage(np.zeros(65, np.uint8))
+
+
+def test_prefix_cache_refcounts_aliased_pages():
+    """An aliased page never returns to the free list while any holder
+    (session or cache) remains, and the last release frees it — the
+    invariant the generation check turns into an assertion."""
+    from brpc_tpu.kv.pages import PageAllocator, PrefixCache
+    _reset_kv()
+    a = PageAllocator(4, 4)
+    cache = PrefixCache(a)
+    toks = list(range(4))
+    (pg,) = a.alloc(1)
+    cache.insert(toks, [pg])              # the cache takes its own hold
+    assert a.refcount(pg) == 2
+    a.release(pg)                         # the prefilling session leaves
+    assert a.refcount(pg) == 1            # cached page stays live
+    pages, covered = cache.lookup(toks)
+    assert pages == [pg] and covered == 4
+    assert a.refcount(pg) == 2            # the hit session's hold
+    a.release(pg)
+    assert cache.evict_all() == 1         # last holder: page frees
+    assert a.in_use() == 0
+    pages, covered = cache.lookup(toks)   # cold again
+    assert pages == [] and covered == 0
+
+
+def test_paged_leak_pin_1k_sessions_alias_and_evict():
+    """1000 sessions over two alternating contexts on a paged batcher:
+    every stream is monolithic-identical (aliased pages included), and
+    afterwards the allocator holds exactly the prefix cache's pages —
+    evict_all returns the pool to empty.  The alias/evict leak pin."""
+    from brpc_tpu.kv import pages as kv_pages
+    from brpc_tpu.models.lm_service import ContinuousBatcher
+    _reset_kv()
+    cfg, params, _ = _setup()
+    pa = np.asarray(jax.random.randint(jax.random.PRNGKey(21), (17,),
+                                       0, cfg.vocab, jnp.int32))
+    pb = np.asarray(jax.random.randint(jax.random.PRNGKey(22), (17,),
+                                       0, cfg.vocab, jnp.int32))
+    want = {0: np.asarray(generate(params, cfg, pa[None, :], 2))[0],
+            1: np.asarray(generate(params, cfg, pb[None, :], 2))[0]}
+    bat = ContinuousBatcher(cfg, params, slots=4, paged=True, page=16)
+    streams = []
+    for i in range(1000):
+        st = _FakeStream()
+        streams.append((i % 2, st))
+        bat.join(st, pa if i % 2 == 0 else pb, 2)
+    _wait(lambda: all(st.closed for _k, st in streams), timeout=300.0,
+          msg="1k paged sessions never drained")
+    for k, st in streams:
+        assert st.close_reason == "finished"
+        assert st.tokens == want[k].tolist()
+    ev = kv_pages.prefix_event_counters()
+    assert ev["prefix_hit"] + ev["prefix_partial_hit"] >= 990
+    st = bat.kv_stats()
+    held = st["prefix"]["nodes"]
+    assert st["alloc"]["in_use"] == held     # only the cache holds pages
+    bat._prefix.evict_all()
+    assert bat.kv_stats()["alloc"]["in_use"] == 0
+    assert kv_pages.prefix_event_counters()["prefix_evict"] >= held
 
 
 def test_strict_tier_closes_with_named_reason():

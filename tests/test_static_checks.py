@@ -102,10 +102,11 @@ def test_drift_shim_arity_changed_python_side():
 
 
 def test_drift_admission_deleted_from_one_lane():
-    """Removing the shared admission call from the classic HTTP lane
-    (rename → the stage is simply no longer invoked)."""
-    ov = _mutate(HTTP_DISPATCH, 'rej = _admit(server, entry, "http", tenant,',
-                 'rej = _noadmit(server, entry, "http", tenant,')
+    """Removing the shared admission call from the classic HTTP lane's
+    compiled chain (rename → the stage is simply no longer invoked)."""
+    ov = _mutate("brpc_tpu/server/interceptors.py",
+                 'rej = _admit_stage(_server, _entry, "http", tenant,',
+                 'rej = _noadmit_stage(_server, _entry, "http", tenant,')
     findings = check_lanes(Tree(overrides=ov))
     assert any("[http]" in f.message and "admission" in f.message
                for f in findings), findings
@@ -152,8 +153,8 @@ def test_drift_shed_after_user_code():
 
 def test_drift_private_rejection_shape():
     """A lane serializing rejections around the shared helper."""
-    ov = _mutate(HTTP_DISPATCH,
-                 "status_code, body, extra = http_reject(rej)",
+    ov = _mutate("brpc_tpu/server/interceptors.py",
+                 "status_code, body, extra = _reject(rej)",
                  "status_code, body, extra = 503, b'busy', []")
     findings = check_lanes(Tree(overrides=ov))
     assert any("[http]" in f.message and "shared helper" in f.message
@@ -337,6 +338,19 @@ def test_drift_unregistered_kv_reason():
     unpinned = "kv_reason_nobody_" + "anchored"
     ov = _mutate(KV, '"kv_peer_remote",',
                  f'"kv_peer_remote", "{unpinned}",')
+    findings = check_enums(Tree(overrides=ov))
+    assert any(unpinned in f.message for f in findings), findings
+
+
+def test_drift_unregistered_evict_reason():
+    """A paged-KV eviction reason added to the closed enum without a
+    test pin: the allocator's close reasons follow the same discipline
+    as the transfer plane's fallback/close enums."""
+    KV_PAGES = "brpc_tpu/kv/pages.py"
+    # assembled at runtime: a literal here would itself count as a pin
+    unpinned = "kv_evict_nobody_" + "anchored"
+    ov = _mutate(KV_PAGES, '"kv_pool_exhausted",',
+                 f'"kv_pool_exhausted", "{unpinned}",')
     findings = check_enums(Tree(overrides=ov))
     assert any(unpinned in f.message for f in findings), findings
 
