@@ -1273,6 +1273,250 @@ def bench_kv_disagg(extra: dict) -> None:
         dec_srv.stop()
 
 
+def bench_slo_sched(extra: dict) -> None:
+    """§19 SLO-tiered batch scheduler (ISSUE 17), direct-batcher
+    benches (no RPC: the scheduler itself is the unit under test):
+
+    - ``decode_itl_p99_ms`` / ``decode_itl_p99_ms_chunked_off`` /
+      ``decode_itl_idle_p99_ms`` / ``slo_chunked_itl_gain``: a live
+      decode session's inter-token latency p99 while long-prompt
+      sessions join — PAIRED interleaved A/B, chunked prefill ON
+      (budget 16) vs OFF (whole-prompt prefill between steps, the
+      head-of-line block); idle p99 from the same session before the
+      joins start; the gain ratio is OFF/ON from per-round pairs
+      (phase-immune).
+    - ``spec_decode_tokens_per_s`` / ``spec_decode_tokens_per_s_plain``
+      / ``spec_accept_rate``: paired A/B of the draft+verify batcher
+      mode (k=3, self-draft) vs plain decode on the same paged config;
+      acceptance from the spec counters.  NOTE (PARITY §19): with
+      random init weights the draft and verify programs split argmax
+      near-ties, so acceptance — and therefore the speedup — is far
+      below a trained model's; the recorded baseline gates collapse,
+      it does not claim a win on this box.
+    - ``slo_tier_victim_goodput``: an INTERACTIVE session live while a
+      batch session coexists and a third join forces a spill — time to
+      complete the interactive stream with the tier registry ON
+      (batch victim parked) vs OFF (fattest-first parks the
+      interactive one); ratio is OFF/ON medians over interleaved
+      rounds, mirroring ``overload_fairness_victim_goodput``.
+    """
+    import jax
+    import numpy as np
+
+    from brpc_tpu.models.lm_service import (ContinuousBatcher,
+                                            TierRegistry,
+                                            _reset_sched_for_tests,
+                                            spec_counters)
+    from brpc_tpu.models.transformer_lm import LMConfig, init_params
+    from brpc_tpu.kv import pages as kv_pages
+    from brpc_tpu.streaming import StreamOptions
+
+    class Rec:
+        """Batcher-facing stream stub recording per-token arrival."""
+
+        def __init__(self):
+            self.closed = False
+            self.close_reason = None
+            self.stamps = []
+            self.id = 0
+            self._native_tx = None
+            self.options = StreamOptions()
+
+        def write(self, data):
+            self.stamps.append(time.perf_counter())
+            return 0
+
+        def close(self, reason=None):
+            self.closed = True
+            self.close_reason = reason
+
+    def wait(pred, timeout=120.0):
+        deadline = time.perf_counter() + timeout
+        while not pred() and time.perf_counter() < deadline:
+            time.sleep(0.001)
+        return pred()
+
+    def p99(vals):
+        s = sorted(vals)
+        return s[min(len(s) - 1, int(len(s) * 0.99))] * 1e3 if s else None
+
+    # ---- (a) chunked-prefill ITL A/B ---------------------------------
+    # prefill cost must dominate a decode step for the HOL block to be
+    # visible: 192-token context, 4 layers
+    cfg = LMConfig(vocab=256, dim=128, heads=4, depth=4, max_seq=256,
+                   remat=False)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    live_p = np.arange(8, dtype=np.int32) % cfg.vocab
+    long_p = (np.arange(193, dtype=np.int32) * 7) % cfg.vocab
+
+    def itl_arm(chunk):
+        """Returns (idle_p99_ms, loaded_p99_ms) for one arm."""
+        _reset_sched_for_tests()
+        bat = ContinuousBatcher(cfg, params, slots=8,
+                                prefill_chunk_tokens=chunk)
+        live = Rec()
+        bat.join(live, live_p, 140)
+        if not wait(lambda: len(live.stamps) >= 10):
+            return None, None
+        idle_from = len(live.stamps)
+        # idle and loaded windows get comparable sample counts (p99
+        # of a small sample is its max; asymmetry would skew the ratio)
+        wait(lambda: len(live.stamps) >= idle_from + 60)
+        idle = np.diff(live.stamps[idle_from:]).tolist()
+        # long-prompt joins arrive while the live session decodes
+        joiners = []
+        load_from = len(live.stamps)
+        for _ in range(3):
+            j = Rec()
+            joiners.append(j)
+            bat.join(j, long_p, 4)
+            time.sleep(0.05)
+        wait(lambda: all(j.closed for j in joiners))
+        loaded = np.diff(live.stamps[load_from:len(live.stamps)])
+        loaded = loaded.tolist()
+        wait(lambda: live.closed)
+        return p99(idle), p99(loaded)
+
+    on_idle, on_load, off_load, gains = [], [], [], []
+    for r in range(3):
+        arms = [(16, True), (None, False)]
+        if r % 2:
+            arms.reverse()
+        pair = {}
+        for chunk, is_on in arms:
+            i, l = itl_arm(chunk)
+            if l is None:
+                continue
+            pair[is_on] = l
+            if is_on:
+                on_load.append(l)
+                if i is not None:
+                    on_idle.append(i)
+            else:
+                off_load.append(l)
+        if True in pair and False in pair and pair[True] > 0:
+            gains.append(pair[False] / pair[True])
+    if on_load:
+        extra["decode_itl_p99_ms"] = round(statistics.median(on_load), 2)
+    if on_idle:
+        extra["decode_itl_idle_p99_ms"] = \
+            round(statistics.median(on_idle), 2)
+    if off_load:
+        extra["decode_itl_p99_ms_chunked_off"] = \
+            round(statistics.median(off_load), 2)
+    if gains:
+        extra["slo_chunked_itl_gain"] = \
+            round(statistics.median(gains), 3)
+
+    # ---- (b) speculative decoding A/B --------------------------------
+    cfg2 = LMConfig(vocab=256, dim=64, heads=4, depth=2, max_seq=96,
+                    remat=False)
+    params2 = init_params(jax.random.PRNGKey(1), cfg2)
+    sp_prompt = np.arange(8, dtype=np.int32) % cfg2.vocab
+
+    def spec_arm(spec):
+        kv_pages._reset_for_tests()
+        _reset_sched_for_tests()
+        kw = dict(spec_decode_k=3, draft_params=params2) if spec else {}
+        bat = ContinuousBatcher(cfg2, params2, slots=4, paged=True,
+                                page=16, **kw)
+        # warm the programs off the clock
+        w = Rec()
+        bat.join(w, sp_prompt, 4)
+        if not wait(lambda: w.closed):
+            return None, None
+        sc0 = spec_counters()
+        recs = [Rec() for _ in range(4)]
+        t0 = time.perf_counter()
+        for rec in recs:
+            bat.join(rec, sp_prompt, 64)
+        if not wait(lambda: all(rec.closed for rec in recs)):
+            return None, None
+        dt = time.perf_counter() - t0
+        sc1 = spec_counters()
+        acc = sc1["spec_accept"] - sc0["spec_accept"]
+        rej = sc1["spec_reject"] - sc0["spec_reject"]
+        rate = acc / (acc + rej) if (acc + rej) else None
+        return 4 * 64 / dt, rate
+
+    sp_on, sp_off, rates = [], [], []
+    for r in range(2):
+        arms = [True, False]
+        if r % 2:
+            arms.reverse()
+        for spec in arms:
+            tps, rate = spec_arm(spec)
+            if tps is None:
+                continue
+            (sp_on if spec else sp_off).append(tps)
+            if spec and rate is not None:
+                rates.append(rate)
+    if sp_on:
+        extra["spec_decode_tokens_per_s"] = \
+            round(statistics.median(sp_on), 1)
+    if sp_off:
+        extra["spec_decode_tokens_per_s_plain"] = \
+            round(statistics.median(sp_off), 1)
+    if rates:
+        extra["spec_accept_rate"] = round(statistics.median(rates), 3)
+
+    # ---- (c) tier-aware victim choice --------------------------------
+    cfg3 = LMConfig(vocab=64, dim=32, heads=4, depth=2, max_seq=32,
+                    remat=False)
+    params3 = init_params(jax.random.PRNGKey(0), cfg3)
+    pi = np.arange(14, dtype=np.int32) % cfg3.vocab    # 6 pages
+    pb = np.arange(10, dtype=np.int32) % cfg3.vocab    # 4 pages
+    pc = np.arange(6, dtype=np.int32) % cfg3.vocab     # 3 pages
+
+    def victim_arm(tiered):
+        """Interactive session's wall time to complete while a spill
+        lands; 10 usable pages of 4 — A(6) + B(4) fill the pool, C(3)
+        forces one park."""
+        kv_pages._reset_for_tests()
+        _reset_sched_for_tests()
+        reg = None
+        if tiered:
+            reg = TierRegistry()
+            reg.set_tier(b"vic", "interactive")
+            reg.set_tier(b"hog", "batch")
+        bat = ContinuousBatcher(cfg3, params3, slots=3, paged=True,
+                                page=4, pages=11, host_slots=64,
+                                prefix=False, tiers=reg)
+        a, b, c = Rec(), Rec(), Rec()
+        bat.join(a, pi, 11, tenant=b"vic")
+        if not wait(lambda: a.stamps):
+            return None
+        bat.join(b, pb, 7, tenant=b"hog")
+        if not wait(lambda: b.stamps):
+            return None
+        # clock starts at the CONTENDING join (per-batcher compiles
+        # landed above): the window is the contested phase only
+        t0 = time.perf_counter()
+        bat.join(c, pc, 7)
+        if not wait(lambda: a.closed and b.closed and c.closed):
+            return None
+        return (a.stamps[-1] - t0) * 1e3 if a.stamps else None
+
+    vic_on, vic_off = [], []
+    for r in range(3):
+        arms = [True, False]
+        if r % 2:
+            arms.reverse()
+        for tiered in arms:
+            d = victim_arm(tiered)
+            if d is not None:
+                (vic_on if tiered else vic_off).append(d)
+    if vic_on:
+        extra["slo_tier_victim_ms"] = \
+            round(statistics.median(vic_on), 1)
+    if vic_off:
+        extra["slo_tier_victim_ms_untiered"] = \
+            round(statistics.median(vic_off), 1)
+    if vic_on and vic_off and statistics.median(vic_on) > 0:
+        extra["slo_tier_victim_goodput"] = round(
+            statistics.median(vic_off) / statistics.median(vic_on), 3)
+
+
 def bench_fanout(extra: dict) -> None:
     """ParallelChannel over 3 sub-servers.  Primary keys use the
     framework's intended partition-serving shape — raw echo parts on
@@ -2872,6 +3116,7 @@ def main() -> None:
                      ("streaming", bench_streaming),
                      ("decode_stream", bench_decode_stream),
                      ("kv_disagg", bench_kv_disagg),
+                     ("slo_sched", bench_slo_sched),
                      ("fanout", bench_fanout),
                      ("http", bench_http),
                      ("trace", bench_trace),

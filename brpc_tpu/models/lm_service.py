@@ -23,6 +23,8 @@ import numpy as np
 
 from ..butil.logging_util import LOG
 from ..butil.status import Errno
+from ..bvar.multi_dimension import PassiveDimension
+from ..server.admission import _MAX_TENANTS, normalize_tenant
 from ..server.service import Service
 from .transformer_lm import LMConfig, init_params
 
@@ -45,14 +47,120 @@ def unpack_token(chunk) -> int:
     return tok
 
 
+# -- SLO tiers ---------------------------------------------------------------
+
+# Per-tenant latency classes the batcher schedules by.  Rank = index:
+# lower ranks win the chunk budget, drain first from pending, and are
+# spilled LAST under pool pressure.
+SLO_TIERS = ("interactive", "standard", "batch")
+_TIER_RANK = {t: i for i, t in enumerate(SLO_TIERS)}
+_RANK_BATCH = _TIER_RANK["batch"]
+
+
+class TierRegistry:
+    """Tenant → SLO tier, keyed on the SAME normalized TLV-22 identity
+    the admission plane uses (``normalize_tenant``) so one tenant name
+    means one thing across fair admission and the batch scheduler.
+    Unregistered tenants get the default tier.  Bounded at the
+    admission plane's tenant cardinality cap — an operator config
+    table, not an unbounded per-request map."""
+
+    def __init__(self, default: str = "standard"):
+        if default not in SLO_TIERS:
+            raise ValueError(f"unknown SLO tier: {default}")
+        self._default = default
+        self._map: dict = {}
+        self._lock = threading.Lock()
+
+    def set_tier(self, tenant, tier: str) -> None:
+        if tier not in SLO_TIERS:
+            raise ValueError(f"unknown SLO tier: {tier}")
+        key = normalize_tenant(tenant)
+        with self._lock:
+            if key not in self._map and len(self._map) >= _MAX_TENANTS:
+                raise ValueError("tier registry full")
+            self._map[key] = tier
+
+    def tier_of(self, tenant) -> str:
+        with self._lock:
+            return self._map.get(normalize_tenant(tenant),
+                                 self._default)
+
+    def rank_of(self, tenant) -> int:
+        return _TIER_RANK[self.tier_of(tenant)]
+
+
+# CLOSED enums (tools/check/enums.py pins every member to a test): the
+# scheduler's named decisions and the spec-decode round outcomes.
+# count_* assert membership so an unregistered name fails loudly at the
+# first count, not silently in a dashboard.
+SLO_SCHED_EVENTS = (
+    "sched_chunk_slice",        # one bounded prefill slice ran
+    "sched_catchup_slice",      # slice replaying past a partial prefix hit
+    "sched_interactive_first",  # interactive outranked lower tiers for budget
+    "sched_preempt_batch",      # batch-tier victim spilled under pressure
+)
+
+SPEC_DECODE_EVENTS = (
+    "spec_round",               # one draft+verify round ran
+    "spec_accept",              # draft token confirmed by the target
+    "spec_reject",              # draft token refuted by the target
+    "spec_fallback_plain",      # round fell back to one plain step
+)
+
+_sched_lock = threading.Lock()
+_sched = {r: 0 for r in SLO_SCHED_EVENTS}
+_spec = {r: 0 for r in SPEC_DECODE_EVENTS}
+
+
+def count_sched(event: str, n: int = 1) -> None:
+    assert event in _sched, f"unregistered scheduler event: {event}"
+    with _sched_lock:
+        _sched[event] += n
+
+
+def count_spec(event: str, n: int = 1) -> None:
+    assert event in _spec, f"unregistered spec-decode event: {event}"
+    with _sched_lock:
+        _spec[event] += n
+
+
+def sched_counters() -> dict:
+    with _sched_lock:
+        return dict(_sched)
+
+
+def spec_counters() -> dict:
+    with _sched_lock:
+        return dict(_spec)
+
+
+def _reset_sched_for_tests() -> None:
+    with _sched_lock:
+        for k in _sched:
+            _sched[k] = 0
+        for k in _spec:
+            _spec[k] = 0
+
+
+_sched_var = PassiveDimension(("event",), lambda: sched_counters(),
+                              name="lm_slo_sched_total")
+_spec_var = PassiveDimension(("event",), lambda: spec_counters(),
+                             name="lm_spec_decode_total")
+
+
 class _Session:
     __slots__ = ("stream", "prompt", "max_new", "sent", "slot",
                  "cache1", "ctx_len", "last_token",
+                 # SLO scheduling: resolved tier + rank, and the
+                 # chunked-prefill fill watermark (context positions
+                 # written so far; fill < ctx_len means the session
+                 # occupies its slot but is NOT yet decoding)
+                 "tier", "tier_rank", "fill",
                  # paged mode (kv/pages allocator): the session's
-                 # block-table pages, its prefix-cache aliases, the
-                 # teacher-forced catch-up queue, and its host-tier
-                 # parking state
-                 "pages", "n_alias", "n_priv", "forced",
+                 # block-table pages, its prefix-cache aliases, and
+                 # its host-tier parking state
+                 "pages", "n_alias", "n_priv",
                  "host_handles", "saved_len")
 
     def __init__(self, stream, prompt: Optional[np.ndarray],
@@ -62,6 +170,9 @@ class _Session:
         self.max_new = max_new
         self.sent = 0
         self.slot = -1
+        self.tier = "standard"
+        self.tier_rank = _TIER_RANK["standard"]
+        self.fill = 0
         # disaggregated serving (kv/): a session whose prefill ran on
         # ANOTHER tier joins with its imported per-layer caches instead
         # of a prompt — the batcher inserts them into a slot between
@@ -71,12 +182,10 @@ class _Session:
         self.last_token = 0
         # paged mode: block-table pages this session HOLDS (one ref
         # each; the first n_alias are prefix-cache aliases, the next
-        # n_priv private), the teacher-forced token queue a prefix hit
-        # catches up through, and the host-tier handles while parked
+        # n_priv private), and the host-tier handles while parked
         self.pages: list = []
         self.n_alias = 0
         self.n_priv = 0
-        self.forced = None
         self.host_handles = None
         self.saved_len = 0
 
@@ -98,6 +207,42 @@ def bucketed_prefill(prefill_j, cfg: LMConfig, prompt: np.ndarray):
     padded[:len(ctx)] = ctx
     cache1, _logits = prefill_j(padded[None, :])
     return cache1, len(ctx)
+
+
+def _contig_insert(cfg: LMConfig):
+    """Jittable contiguous-pool slot insert with the pool DONATED: an
+    eager .at[].set chain would copy the whole (slots, max_seq, ...)
+    pool 2*depth+1 times per join, stalling every live session between
+    steps in proportion to pool size.  ONE home for the def — the
+    contiguous batcher's cache and the spec-decode DRAFT cache insert
+    through exactly this."""
+
+    def _insert(cache, cache1, slot, ctx_len):
+        import jax.lax as lax
+        cache = dict(cache)
+        for i in range(cfg.depth):
+            cache[f"k{i}"] = lax.dynamic_update_slice(
+                cache[f"k{i}"], cache1[f"k{i}"],
+                (slot, 0, 0, 0))
+            cache[f"v{i}"] = lax.dynamic_update_slice(
+                cache[f"v{i}"], cache1[f"v{i}"],
+                (slot, 0, 0, 0))
+        cache["len"] = lax.dynamic_update_slice(
+            cache["len"], ctx_len[None], (slot,))
+        return cache
+
+    return _insert
+
+
+def _setlen(cache, slot, val):
+    """Jittable per-slot ``len`` poke (layout-agnostic: jit re-traces
+    per cache pytree, so one def serves paged, contiguous, and the
+    spec-decode draft cache)."""
+    import jax.lax as lax
+    cache = dict(cache)
+    cache["len"] = lax.dynamic_update_slice(cache["len"], val[None],
+                                            (slot,))
+    return cache
 
 
 class ContinuousBatcher:
@@ -128,8 +273,8 @@ class ContinuousBatcher:
     - a cross-session :class:`~brpc_tpu.kv.pages.PrefixCache` lets a
       re-sent context ALIAS already-prefilled pages (refcounted, zero
       bytes copied) and skip prefill for the covered prefix, any
-      partial-page remainder caught up with teacher-forced steps
-      (token identity with the uncached path by construction);
+      remainder caught up through chunked-prefill slices (token
+      identity with the uncached path by construction);
     - when the device pool runs dry the batcher first drops LRU
       prefix-cache entries, then SPILLS the fattest live session's
       private pages to the :class:`~brpc_tpu.kv.pages.HostPagePool`
@@ -139,13 +284,43 @@ class ContinuousBatcher:
     - mid-spill pages are drain-visible: ``Server.drain`` counts them
       (``kv.pages.host_inflight_spills``) and expiry closes parked
       sessions under ``kv_spill_drain_aborted`` instead of leaking.
+
+    **SLO-tiered scheduling** (ROADMAP item 4): the step loop is a
+    latency-SLO scheduler over three per-tenant tiers resolved from
+    the TLV-22 identity via a :class:`TierRegistry`:
+
+    - **chunked prefill** (``prefill_chunk_tokens``, Sarathi-style):
+      each loop round runs ONE decode step plus a bounded budget of
+      prefill slices, so a long prompt never head-of-line-blocks live
+      sessions' next token.  A joining session occupies its slot
+      immediately but stays INACTIVE (``fill < ctx_len``) while chunk
+      rounds scatter its context; its first generated token is
+      teacher-forced identically to a whole-prompt prefill.  The
+      interactive tier spends the budget first;
+    - **priority preemption**: pending joins drain interactive-first,
+      and under pool pressure the spill victim is chosen
+      tier-then-footprint (batch-tier sessions park before standard,
+      interactive last) with batch victims taken even BEFORE
+      prefix-cache holds when the requester outranks them.  Every
+      decision counts under the closed ``SLO_SCHED_EVENTS`` enum;
+    - **speculative decoding** (``spec_decode_k``, paged mode): a
+      small draft model proposes k tokens per active slot (k cheap
+      contiguous steps), the target verifies all of them in ONE
+      batched multi-token program, accepted prefixes advance the page
+      table and rejections are a pure ``len`` rewind (the refuted
+      rows sit beyond the mask and are rewritten before ever being
+      admitted) — token identity with plain decode holds on both
+      paths.  Acceptance telemetry rides ``SPEC_DECODE_EVENTS``.
     """
 
     def __init__(self, cfg: LMConfig, params, slots: int = 8,
                  idle_linger_s: float = 5.0, paged: bool = False,
                  page: int = 16, pages: Optional[int] = None,
                  host_slots: int = 0, prefix: bool = True,
-                 prefix_budget: Optional[int] = None):
+                 prefix_budget: Optional[int] = None,
+                 prefill_chunk_tokens: Optional[int] = None,
+                 spec_decode_k: int = 0, draft_params=None,
+                 tiers: Optional[TierRegistry] = None):
         self.cfg = cfg
         self.params = params
         self.slots = int(slots)
@@ -160,6 +335,25 @@ class ContinuousBatcher:
         self.host_slots = int(host_slots)
         self.prefix_enabled = bool(prefix)
         self.prefix_budget = prefix_budget
+        # SLO scheduler knobs.  chunk_budget == 0 means chunked
+        # prefill is OFF for fresh prompts (legacy whole bucketed
+        # prefill) — but a chunk program is still built at _chunk_w:
+        # partial prefix-cache hits ALWAYS catch up through chunk
+        # slices (round-19 REMAINING thread), budget-unbounded when
+        # the scheduler is off.
+        self.chunk_budget = int(prefill_chunk_tokens) \
+            if prefill_chunk_tokens else 0
+        self._chunk_w = min(self.chunk_budget, cfg.max_seq) \
+            if self.chunk_budget else min(64, cfg.max_seq)
+        self.spec_k = int(spec_decode_k)
+        self.draft_params = draft_params
+        if self.spec_k > 0 and not self.paged:
+            raise ValueError("spec_decode_k requires paged=True "
+                             "(rejection rollback is a block-table "
+                             "len rewind)")
+        if self.spec_k > 0 and draft_params is None:
+            raise ValueError("spec_decode_k requires draft_params")
+        self.tiers = tiers
         # the HEAVY half (jit wrappers + the device KV-pool allocation)
         # is deferred to the batcher thread's first iteration: the
         # first Decode call runs on an engine loop thread inside the
@@ -185,6 +379,14 @@ class ContinuousBatcher:
         self._gather_j = None
         self._scatter_j = None
         self._setlen_j = None
+        self._chunk_j = None                      # chunked prefill slice
+        # spec-decode engine state (built when spec_k > 0)
+        self._d_prefill = None
+        self._d_step = None
+        self._d_insert = None
+        self._d_cache = None
+        self._verify_j = None
+        self._d_sync_j = None
         self._parked: list = []                   # spilled sessions
         self.prefills_run = 0
         self.spills = 0
@@ -192,14 +394,23 @@ class ContinuousBatcher:
 
     # -- public -----------------------------------------------------------
 
-    def join(self, stream, prompt: np.ndarray, max_new: int) -> None:
-        """Queue a session; it enters the live batch between steps."""
+    def join(self, stream, prompt: np.ndarray, max_new: int,
+             tenant=None) -> None:
+        """Queue a session; it enters the live batch between steps.
+        ``tenant`` (the request's TLV-22 identity, bytes or str)
+        resolves the session's SLO tier through the registry."""
         sess = _Session(stream, np.ascontiguousarray(prompt, np.int32),
                         int(max_new))
+        self._assign_tier(sess, tenant)
         self._enqueue(sess)
 
+    def _assign_tier(self, sess: _Session, tenant) -> None:
+        if self.tiers is not None:
+            sess.tier = self.tiers.tier_of(tenant)
+            sess.tier_rank = _TIER_RANK[sess.tier]
+
     def join_imported(self, stream, last_token: int, ctx_len: int,
-                      max_new: int, cache1) -> None:
+                      max_new: int, cache1, tenant=None) -> None:
         """Disaggregated serving (kv/): admit a session whose prefill
         ran on ANOTHER tier.  ``cache1`` is the imported per-layer
         cache dict (``decode_cache_from_pages`` layout, batch 1); it
@@ -211,6 +422,7 @@ class ContinuousBatcher:
         sess.cache1 = cache1
         sess.ctx_len = int(ctx_len)
         sess.last_token = int(last_token)
+        self._assign_tier(sess, tenant)
         self._enqueue(sess)
 
     def _enqueue(self, sess: _Session) -> None:
@@ -236,7 +448,8 @@ class ContinuousBatcher:
         out = {"paged": self.paged, "steps": self._steps,
                "prefills_run": self.prefills_run,
                "spills": self.spills, "resumes": self.resumes,
-               "parked": len(self._parked)}
+               "parked": len(self._parked),
+               "sched": sched_counters(), "spec": spec_counters()}
         if self._alloc is not None:
             out["alloc"] = self._alloc.stats()
         if self._prefix is not None:
@@ -263,33 +476,18 @@ class ContinuousBatcher:
             self._ensure_paged_engine()
             return
         if self._prefill is None:
-            prefill, step = make_batch_decode(self.cfg)
+            prefill, step, chunk_step = make_batch_decode(
+                self.cfg, chunk=self._chunk_w)
             self._prefill = jax.jit(functools.partial(prefill,
                                                       self.params))
             self._step = jax.jit(functools.partial(step, self.params),
                                  donate_argnums=(0,))
-
-            # jitted slot insert with the pool cache DONATED: an eager
-            # .at[].set chain would copy the whole (slots, max_seq, ...)
-            # pool 2*depth+1 times per join, stalling every live
-            # session between steps in proportion to pool size
-            cfg = self.cfg
-
-            def _insert(cache, cache1, slot, ctx_len):
-                import jax.lax as lax
-                cache = dict(cache)
-                for i in range(cfg.depth):
-                    cache[f"k{i}"] = lax.dynamic_update_slice(
-                        cache[f"k{i}"], cache1[f"k{i}"],
-                        (slot, 0, 0, 0))
-                    cache[f"v{i}"] = lax.dynamic_update_slice(
-                        cache[f"v{i}"], cache1[f"v{i}"],
-                        (slot, 0, 0, 0))
-                cache["len"] = lax.dynamic_update_slice(
-                    cache["len"], ctx_len[None], (slot,))
-                return cache
-
-            self._insert = jax.jit(_insert, donate_argnums=(0,))
+            self._chunk_j = jax.jit(
+                functools.partial(chunk_step, self.params),
+                donate_argnums=(0,))
+            self._insert = jax.jit(_contig_insert(self.cfg),
+                                   donate_argnums=(0,))
+            self._setlen_j = jax.jit(_setlen, donate_argnums=(0,))
         if self._cache is None:
             self._cache = empty_batch_cache(self.cfg, self.slots)
 
@@ -304,8 +502,11 @@ class ContinuousBatcher:
 
         from ..kv.pages import (HostPagePool, PageAllocator,
                                 PrefixCache)
-        from .transformer_lm import (empty_paged_cache, make_paged_io,
+        from .transformer_lm import (empty_batch_cache,
+                                     empty_paged_cache, make_paged_io,
+                                     make_batch_decode,
                                      make_paged_batch_decode,
+                                     make_paged_spec_verify,
                                      paged_page_bytes)
 
         if self._prefill is None:
@@ -314,23 +515,50 @@ class ContinuousBatcher:
                                                       self.params))
             self._step = jax.jit(functools.partial(step, self.params),
                                  donate_argnums=(0,))
-            gather, scatter, insert = make_paged_io(self.cfg, self.page)
+            gather, scatter, insert, chunk_prefill = make_paged_io(
+                self.cfg, self.page, chunk=self._chunk_w)
             self._gather_j = jax.jit(gather)
             self._scatter_j = jax.jit(scatter, donate_argnums=(0,))
             self._insert = jax.jit(insert, donate_argnums=(0,))
-
-            def _setlen(cache, slot, val):
-                import jax.lax as lax
-                cache = dict(cache)
-                cache["len"] = lax.dynamic_update_slice(
-                    cache["len"], val[None], (slot,))
-                return cache
-
+            self._chunk_j = jax.jit(
+                functools.partial(chunk_prefill, self.params),
+                donate_argnums=(0,))
             self._setlen_j = jax.jit(_setlen, donate_argnums=(0,))
+            if self.spec_k > 0:
+                # draft engine: the SMALL model runs k cheap
+                # contiguous steps per round; the target verifies all
+                # k proposals in one width-(k+1) program.  Draft len
+                # sync is a pure arithmetic rewind — after k draft
+                # steps len = L + k, the target accepted m, so the
+                # draft keeps rows for L..L+m and rewinds k-1-m.
+                d_prefill, d_step = make_batch_decode(self.cfg)
+                self._d_prefill = jax.jit(functools.partial(
+                    d_prefill, self.draft_params))
+                self._d_step = jax.jit(functools.partial(
+                    d_step, self.draft_params), donate_argnums=(0,))
+                self._d_insert = jax.jit(_contig_insert(self.cfg),
+                                         donate_argnums=(0,))
+                verify = make_paged_spec_verify(self.cfg, self.page,
+                                                self.spec_k + 1)
+                self._verify_j = jax.jit(
+                    functools.partial(verify, self.params),
+                    donate_argnums=(0,))
+                k = self.spec_k
+
+                def _d_sync(cache, m, active):
+                    cache = dict(cache)
+                    cache["len"] = jnp.where(
+                        active, cache["len"] - (k - 1 - m),
+                        cache["len"])
+                    return cache
+
+                self._d_sync_j = jax.jit(_d_sync, donate_argnums=(0,))
         if self._cache is None:
             self._cache = empty_paged_cache(self.cfg, self.num_pages,
                                             self.slots, self.page)
             self._bt[:] = 0
+        if self.spec_k > 0 and self._d_cache is None:
+            self._d_cache = empty_batch_cache(self.cfg, self.slots)
         if self._alloc is None:
             pb = paged_page_bytes(self.cfg, self.page)
             self._alloc = PageAllocator(self.num_pages, self.page, pb)
@@ -411,7 +639,25 @@ class ContinuousBatcher:
         if self.paged:
             self._admit_paged(sess)
             return
-        free = next(i for i in range(self.slots) if not self._active[i])
+        import jax.numpy as jnp
+        # free = unOCCUPIED, not merely inactive: a chunk-filling
+        # session holds its slot while _active is still False
+        free = next(i for i in range(self.slots)
+                    if i not in self._sessions)
+        if sess.cache1 is None and self.chunk_budget \
+                and len(sess.prompt) > 1:
+            # chunked admit: take the slot now, let _chunk_round
+            # scatter the context under the per-step budget; the
+            # session activates (and teacher-forces its last prompt
+            # token) when fill reaches ctx_len
+            self._cache = self._setlen_j(self._cache, jnp.int32(free),
+                                         jnp.int32(0))
+            sess.ctx_len = len(sess.prompt) - 1
+            sess.fill = 0
+            sess.slot = free
+            sess.sent = 0
+            self._sessions[free] = sess
+            return
         if sess.cache1 is not None:
             cache1, ctx_len = sess.cache1, sess.ctx_len
             last = int(sess.last_token)
@@ -421,10 +667,11 @@ class ContinuousBatcher:
                                                sess.prompt)
             self.prefills_run += 1
             last = int(sess.prompt[-1])
-        import jax.numpy as jnp
         self._cache = self._insert(self._cache, cache1,
                                    jnp.int32(free),
                                    jnp.int32(ctx_len))
+        sess.ctx_len = ctx_len
+        sess.fill = ctx_len      # fully prefilled = active
         self._tokens[free] = last
         self._active[free] = True
         sess.slot = free
@@ -433,14 +680,20 @@ class ContinuousBatcher:
 
     # -- paged mode: admit / spill / park / resume -------------------------
 
-    def _alloc_with_reclaim(self, need: int):
-        """Allocate ``need`` pages, reclaiming under pressure: drop LRU
-        prefix-cache entries first (cheap — they are redundant with a
-        prefill), then spill live sessions to the host tier.  Returns
-        ``(pages, None)`` or ``(None, reason)`` with the reason a
-        KV_EVICT_REASONS member."""
+    def _alloc_with_reclaim(self, need: int, rank: int = 1):
+        """Allocate ``need`` pages, reclaiming under pressure in SLO
+        order: when the requester outranks the batch tier, spill a
+        BATCH-tier victim first (its pages already ride the host
+        tier), then drop LRU prefix-cache entries (cheap — redundant
+        with a prefill), then spill whatever the tier-then-footprint
+        policy picks.  Returns ``(pages, None)`` or ``(None, reason)``
+        with the reason a KV_EVICT_REASONS member."""
         pages = self._alloc.alloc(need)
         while pages is None:
+            if rank < _RANK_BATCH \
+                    and self._spill_one(min_rank=_RANK_BATCH) is None:
+                pages = self._alloc.alloc(need)
+                continue
             if self._prefix is not None and self._prefix.evict_lru():
                 pages = self._alloc.alloc(need)
                 continue
@@ -451,8 +704,6 @@ class ContinuousBatcher:
         return pages, None
 
     def _admit_paged(self, sess: _Session) -> None:
-        from collections import deque as _deque
-
         import jax.numpy as jnp
 
         from ..kv.pages import count_evict
@@ -468,7 +719,8 @@ class ContinuousBatcher:
             else:
                 aliased, covered = [], 0
         n_total = self._pages_for(ctx_len, sess.max_new)
-        priv, why = self._alloc_with_reclaim(n_total - len(aliased))
+        priv, why = self._alloc_with_reclaim(n_total - len(aliased),
+                                             rank=sess.tier_rank)
         if priv is None:
             for p in aliased:
                 self._alloc.release(p)
@@ -476,12 +728,16 @@ class ContinuousBatcher:
             if not sess.stream.closed:
                 sess.stream.close(reason=why)
             return
+        # free = unOCCUPIED, not merely inactive: a chunk-filling
+        # session holds its slot while _active is still False
         free = next(i for i in range(self.slots)
-                    if not self._active[i])
+                    if i not in self._sessions)
         n_alias = len(aliased)
         row = np.zeros((self._pps,), np.int32)
         row[:n_alias] = aliased
         row[n_alias:n_total] = priv
+        filling = False
+        last = 0
         if sess.cache1 is not None:
             # disagg import: blockify the imported contiguous cache
             self._cache = self._insert(self._cache, jnp.asarray(row),
@@ -489,7 +745,13 @@ class ContinuousBatcher:
             sess.cache1 = None
             last = int(sess.last_token)
             start_len = ctx_len
-        elif covered == 0:
+        elif covered == ctx_len:
+            # full prefix hit (or empty context): the aliased pages
+            # ARE the covered context's KV (prefill is deterministic —
+            # identical values), no prefill and ZERO copies
+            last = int(sess.prompt[-1])
+            start_len = ctx_len
+        elif covered == 0 and not self.chunk_budget:
             cache1, ctx_len = bucketed_prefill(self._prefill, self.cfg,
                                                sess.prompt)
             self.prefills_run += 1
@@ -502,16 +764,14 @@ class ContinuousBatcher:
                 # (decode writes land at pos >= ctx_len) — cache them
                 self._prefix.insert(sess.prompt[:-1], priv)
         else:
-            # prefix hit: the aliased pages ARE the covered context's
-            # KV (prefill is deterministic — identical values), no
-            # prefill and ZERO copies; the remainder catches up with
-            # teacher-forced steps, each writing its private pages
-            last = int(sess.prompt[-1]) if covered == ctx_len \
-                else int(sess.prompt[covered])
-            if covered < ctx_len:
-                sess.forced = _deque(
-                    sess.prompt[covered + 1:ctx_len].tolist()
-                    + [int(sess.prompt[-1])])
+            # chunked fill: a fresh prompt under the chunk budget, or
+            # a PARTIAL prefix hit whose remainder catches up through
+            # chunk slices (covered rows are aliased and immutable;
+            # slices scatter only private pages from fill onward) —
+            # the session holds its slot but stays inactive until
+            # _chunk_round completes the context
+            filling = True
+            sess.fill = covered
             start_len = covered
         self._cache = self._setlen_j(self._cache, jnp.int32(free),
                                      jnp.int32(start_len))
@@ -520,34 +780,48 @@ class ContinuousBatcher:
         sess.n_priv = len(priv)
         sess.ctx_len = ctx_len
         self._bt[free] = row
-        self._tokens[free] = last
-        self._active[free] = True
         sess.slot = free
         sess.sent = 0
         self._sessions[free] = sess
+        if filling:
+            return
+        sess.fill = ctx_len
+        self._tokens[free] = last
+        self._active[free] = True
+        if self.spec_k > 0:
+            self._draft_admit(sess)
 
-    def _spill_one(self) -> Optional[str]:
+    def _spill_one(self, min_rank: int = 0) -> Optional[str]:
         """Park ONE live session's private pages in the host tier.
-        Returns None on success, else the KV_EVICT_REASONS member
-        naming why nothing could spill."""
+        Victim choice is TIER-then-footprint: the worst SLO rank
+        spills first (batch before standard before interactive — an
+        interactive session is never parked while any batch-tier
+        victim exists), fattest private footprint within a tier (frees
+        the most pages per D2H), deterministic tie-break on slot.
+        ``min_rank`` restricts candidates to ranks >= it (used to take
+        batch victims before prefix-cache holds).  Returns None on
+        success, else the KV_EVICT_REASONS member naming why nothing
+        could spill."""
         if self._host is None:
             return "kv_pool_exhausted"
         ab = self._host.abort_reason()
         if ab is not None:
             return ab
-        victims = [s for s in self._sessions.values() if s.n_priv > 0]
+        victims = [s for s in self._sessions.values()
+                   if s.n_priv > 0 and s.tier_rank >= min_rank]
         if not victims:
             return "kv_pool_exhausted"
-        # fattest private footprint first: frees the most pages per
-        # D2H; deterministic tie-break on slot
-        victim = max(victims, key=lambda s: (s.n_priv, -s.slot))
+        victim = max(victims,
+                     key=lambda s: (s.tier_rank, s.n_priv, -s.slot))
+        if victim.tier_rank >= _RANK_BATCH:
+            count_sched("sched_preempt_batch")
         return self._park(victim)
 
     def _park(self, sess: _Session) -> Optional[str]:
         """Move a live session's private pages device → host and free
         its slot.  Bit-exact resume: everything the step depends on —
-        page contents, len, the last fed token, the forced queue —
-        survives in the session object + host tier."""
+        page contents, len, the last fed token, the chunk-fill
+        watermark — survives in the session object + host tier."""
         import jax.numpy as jnp
         if not self._host.begin_spill():
             return self._host.abort_reason() or "kv_host_tier_full"
@@ -585,7 +859,7 @@ class ContinuousBatcher:
         yet — never an error)."""
         import jax.numpy as jnp
         free = next((i for i in range(self.slots)
-                     if not self._active[i]), None)
+                     if i not in self._sessions), None)
         if free is None:
             return False
         priv = self._alloc.alloc(sess.n_priv)
@@ -621,9 +895,22 @@ class ContinuousBatcher:
         sess.pages = list(sess.pages) + list(priv)
         self._bt[free] = row
         self._tokens[free] = sess.last_token
-        self._active[free] = True
+        # a session parked MID-FILL resumes still inactive and the
+        # chunk rounds finish its context; an active one re-enters the
+        # decode batch directly
+        self._active[free] = sess.fill >= sess.ctx_len
         sess.slot = free
         self._sessions[free] = sess
+        if self._active[free] and self.spec_k > 0 \
+                and sess.prompt is not None:
+            # re-seed the DRAFT context for the resumed slot; rows for
+            # already-GENERATED tokens are not replayed, so acceptance
+            # dips until the draft re-anchors — correctness is the
+            # target's verify either way
+            self._draft_admit(sess)
+            self._d_cache = self._setlen_j(self._d_cache,
+                                           jnp.int32(free),
+                                           jnp.int32(sess.saved_len))
         self.resumes += 1
         return True
 
@@ -655,6 +942,9 @@ class ContinuousBatcher:
         ab = self._host.abort_reason() if self._host is not None \
             else None
         still = []
+        # SLO order: interactive parkees resume first (stable within a
+        # tier — spill order)
+        self._parked.sort(key=lambda s: s.tier_rank)
         for sess in self._parked:
             if sess.stream.closed:
                 self._drop_parked(sess, None)
@@ -663,6 +953,183 @@ class ContinuousBatcher:
             elif not self._resume(sess):
                 still.append(sess)
         self._parked = still
+
+    # -- SLO scheduler: chunk rounds, spec rounds, plain rounds ------------
+
+    def _draft_admit(self, sess: _Session) -> None:
+        """Seed the DRAFT model's contiguous cache for a newly active
+        slot (spec mode).  The draft is small — one bucketed prefill
+        here is cheap, and it keeps the draft's rows position-aligned
+        with the target's context."""
+        if self._d_cache is None or sess.prompt is None:
+            return
+        import jax.numpy as jnp
+        cache1, ctx_len = bucketed_prefill(self._d_prefill, self.cfg,
+                                           sess.prompt)
+        self._d_cache = self._d_insert(self._d_cache, cache1,
+                                       jnp.int32(sess.slot),
+                                       jnp.int32(ctx_len))
+
+    def _activate(self, sess: _Session) -> None:
+        """A fully chunk-filled session goes live: the prompt's LAST
+        token rides the next batch step — the same teacher-forcing as
+        a whole-prompt prefill, so the emitted stream is identical by
+        construction — and a fresh chunked context enters the prefix
+        cache exactly like a prefilled one would."""
+        slot = sess.slot
+        sess.fill = sess.ctx_len
+        self._tokens[slot] = int(sess.prompt[-1])
+        self._active[slot] = True
+        if sess.n_alias == 0 and sess.ctx_len > 0:
+            # a chunk-filled context counts as one prefill (capacity
+            # accounting); prefix-hit catch-up does NOT — the hit
+            # avoided it
+            self.prefills_run += 1
+            if self.paged and self._prefix is not None:
+                self._prefix.insert(sess.prompt[:-1],
+                                    sess.pages[sess.n_alias:])
+        if self.spec_k > 0:
+            self._draft_admit(sess)
+
+    def _chunk_round(self) -> None:
+        """Spend this round's chunk budget: bounded prefill slices
+        over the chunk-filling sessions, INTERACTIVE tier first — the
+        Sarathi-style half of the step loop (each round = one decode
+        step + at most ``prefill_chunk_tokens`` of prefill work), so a
+        long prompt costs live sessions one bounded slice per token
+        instead of a whole prefill.  Safe interleaving is the pooled
+        garbage-beyond-mask argument: a filling slot's rows beyond
+        ``fill`` are junk, but the attention mask admits a row only
+        once ``len`` passes it, and every admissible row has been
+        rewritten by a slice first."""
+        filling = [s for s in self._sessions.values()
+                   if s.fill < s.ctx_len]
+        if not filling:
+            return
+        import jax.numpy as jnp
+        filling.sort(key=lambda s: (s.tier_rank, s.slot))
+        if filling[0].tier_rank == _TIER_RANK["interactive"] \
+                and any(s.tier_rank > filling[0].tier_rank
+                        for s in filling):
+            count_sched("sched_interactive_first")
+        budget = self.chunk_budget if self.chunk_budget else (1 << 30)
+        for sess in filling:
+            if budget <= 0:
+                break
+            if sess.stream.closed:
+                self._evict(sess, None)
+                continue
+            catchup = sess.n_alias > 0
+            while budget > 0 and sess.fill < sess.ctx_len:
+                n = int(min(self._chunk_w, sess.ctx_len - sess.fill,
+                            budget))
+                ids = np.zeros((self._chunk_w,), np.int32)
+                ids[:n] = sess.prompt[sess.fill:sess.fill + n]
+                if self.paged:
+                    self._cache = self._chunk_j(
+                        self._cache, jnp.asarray(self._bt[sess.slot]),
+                        jnp.int32(sess.slot), jnp.int32(sess.fill),
+                        jnp.int32(n), jnp.asarray(ids))
+                else:
+                    self._cache = self._chunk_j(
+                        self._cache, jnp.int32(sess.slot),
+                        jnp.int32(sess.fill), jnp.int32(n),
+                        jnp.asarray(ids))
+                sess.fill += n
+                budget -= n
+                count_sched("sched_catchup_slice" if catchup
+                            else "sched_chunk_slice")
+            if sess.fill >= sess.ctx_len:
+                self._activate(sess)
+
+    def _spec_ok(self) -> bool:
+        """Spec rounds need width = k+1 rows of headroom in EVERY
+        active slot, and a prompt to draft from (a disagg-imported
+        session has none) — otherwise the round falls back to one
+        plain step."""
+        for slot, sess in self._sessions.items():
+            if not self._active[slot]:
+                continue
+            if sess.prompt is None:
+                return False
+            if sess.ctx_len + sess.sent + self.spec_k + 1 \
+                    > self.cfg.max_seq:
+                return False
+        return True
+
+    def _plain_round(self):
+        """One plain decode step over the active slots; returns
+        ``(pairs, finished)`` for the emit/evict epilogue."""
+        import jax.numpy as jnp
+        if self.paged:
+            cache, logits = self._step(
+                self._cache, jnp.asarray(self._bt),
+                jnp.asarray(self._tokens), jnp.asarray(self._active))
+        else:
+            cache, logits = self._step(
+                self._cache, jnp.asarray(self._tokens),
+                jnp.asarray(self._active))
+        self._cache = cache
+        self._steps += 1
+        toks = np.asarray(jnp.argmax(logits, axis=-1))
+        pairs, finished = [], []
+        for slot, sess in list(self._sessions.items()):
+            if not self._active[slot]:
+                continue
+            tok = int(toks[slot])
+            self._tokens[slot] = tok
+            sess.sent += 1
+            pairs.append((sess, tok))
+            if sess.sent >= sess.max_new:
+                finished.append(sess)
+        return pairs, finished
+
+    def _spec_round(self):
+        """One speculative round: k draft proposals per active slot
+        (k cheap contiguous draft steps), ONE width-(k+1) target
+        verification, host-side emission of the accepted prefix plus
+        the target's own next token.  Token identity with plain decode
+        holds on BOTH paths: an accepted row holds exactly the k/v a
+        plain step would have written there, and a rejection is a pure
+        ``len`` rewind — the refuted rows sit beyond the mask and the
+        next round rewrites them before they are ever admitted (see
+        ``make_paged_spec_verify``)."""
+        import jax.numpy as jnp
+        k = self.spec_k
+        count_spec("spec_round")
+        active = self._active.copy()
+        act_j = jnp.asarray(active)
+        cur = self._tokens.copy()
+        drafts = []
+        for _ in range(k):
+            self._d_cache, dl = self._d_step(self._d_cache,
+                                             jnp.asarray(cur), act_j)
+            cur = np.asarray(jnp.argmax(dl, axis=-1)).astype(np.int32)
+            drafts.append(cur)
+        u = np.stack([self._tokens] + drafts, axis=1).astype(np.int32)
+        self._cache, out, m = self._verify_j(
+            self._cache, jnp.asarray(self._bt), jnp.asarray(u), act_j)
+        out = np.asarray(out)
+        m = np.asarray(m)
+        self._d_cache = self._d_sync_j(self._d_cache, jnp.asarray(m),
+                                       act_j)
+        self._steps += 1
+        pairs, finished = [], []
+        for slot, sess in list(self._sessions.items()):
+            if not active[slot]:
+                continue
+            acc = int(m[slot])
+            count_spec("spec_accept", acc)
+            count_spec("spec_reject", k - 1 - acc)
+            emit = min(acc + 1, sess.max_new - sess.sent)
+            for j in range(emit):
+                tok = int(out[slot, j])
+                self._tokens[slot] = tok
+                sess.sent += 1
+                pairs.append((sess, tok))
+            if sess.sent >= sess.max_new:
+                finished.append(sess)
+        return pairs, finished
 
     def _evict(self, sess: _Session, reason: Optional[str]) -> None:
         self._sessions.pop(sess.slot, None)
@@ -675,7 +1142,6 @@ class ContinuousBatcher:
             sess.stream.close(reason=reason or "finished")
 
     def _run(self) -> None:
-        import jax.numpy as jnp
         try:
             self._ensure_engine()
             while True:
@@ -685,6 +1151,12 @@ class ContinuousBatcher:
                     # tier closes them under its named reason here
                     self._service_parked()
                 with self._lock:
+                    if len(self._pending) > 1:
+                        # SLO order: interactive joins drain first
+                        # (stable within a tier — FIFO)
+                        self._pending = deque(sorted(
+                            self._pending,
+                            key=lambda s: s.tier_rank))
                     pending = []
                     while self._pending and \
                             len(self._sessions) + len(pending) \
@@ -712,8 +1184,14 @@ class ContinuousBatcher:
                     # join-mid-batch: bucketed prefill + slot insert,
                     # BETWEEN steps (bucketing keeps a fresh prompt
                     # length from stalling live sessions on an XLA
-                    # compile; the next step emits the first token)
+                    # compile; the next step emits the first token) —
+                    # or, chunked, just the slot grab: _chunk_round
+                    # below scatters the context under the budget
                     self._admit(sess)
+                # the Sarathi half BEFORE the decode round: a fill
+                # completed this round teacher-forces its first token
+                # on THIS round's step
+                self._chunk_round()
                 if not self._sessions:
                     if self.paged and self._parked:
                         # only parked sessions left and none could
@@ -722,42 +1200,25 @@ class ContinuousBatcher:
                         import time as _time
                         _time.sleep(0.005)
                     continue
-                if self.paged:
-                    cache, logits = self._step(
-                        self._cache, jnp.asarray(self._bt),
-                        jnp.asarray(self._tokens),
-                        jnp.asarray(self._active))
+                if not self._active.any():
+                    continue    # every occupied slot still filling
+                if self.spec_k > 0:
+                    if self._spec_ok():
+                        pairs, finished = self._spec_round()
+                    else:
+                        count_spec("spec_fallback_plain")
+                        pairs, finished = self._plain_round()
                 else:
-                    cache, logits = self._step(
-                        self._cache, jnp.asarray(self._tokens),
-                        jnp.asarray(self._active))
-                self._cache = cache
-                self._steps += 1
-                toks = np.asarray(jnp.argmax(logits, axis=-1))
-                pairs = []
-                finished = []
-                for slot, sess in list(self._sessions.items()):
-                    if sess.forced:
-                        # prefix-hit catch-up: this step WROTE the
-                        # position's KV row; its logits re-derive a
-                        # context token the client already has —
-                        # discard, feed the next context token, emit
-                        # nothing (identical to the uncached stream)
-                        if sess.stream.closed:
-                            self._evict(sess, None)
-                            continue
-                        self._tokens[slot] = sess.forced.popleft()
-                        continue
-                    tok = int(toks[slot])
-                    self._tokens[slot] = tok
-                    sess.sent += 1
-                    pairs.append((sess, tok))
-                    if sess.sent >= sess.max_new:
-                        finished.append(sess)
+                    pairs, finished = self._plain_round()
+                evicted = set()
                 for sess, reason in self._emit(pairs):
-                    self._evict(sess, reason)
+                    # a spec round emits several tokens per session —
+                    # one eviction decision each
+                    if id(sess) not in evicted:
+                        evicted.add(id(sess))
+                        self._evict(sess, reason)
                 for sess in finished:
-                    if sess.slot in self._sessions:
+                    if self._sessions.get(sess.slot) is sess:
                         self._evict(sess, "finished")
         except Exception:
             LOG.exception("continuous batcher crashed; closing "
@@ -781,6 +1242,7 @@ class ContinuousBatcher:
                 # mode drops the allocator triple with the pool: its
                 # refcounts describe rows that no longer exist.
                 self._cache = None
+                self._d_cache = None   # the draft pool donated too
                 self._bt[:] = 0
                 self._alloc = None
                 self._prefix = None
@@ -802,7 +1264,10 @@ class LMService(Service):
                  max_new_cap: int = 128, quantize: bool = False,
                  decode_slots: int = 8, paged: bool = False,
                  page: int = 16, kv_pages: Optional[int] = None,
-                 kv_host_slots: int = 0, prefix: bool = True):
+                 kv_host_slots: int = 0, prefix: bool = True,
+                 prefill_chunk_tokens: Optional[int] = None,
+                 spec_decode_k: int = 0, draft_params=None,
+                 tiers: Optional[TierRegistry] = None):
         import jax
 
         self.cfg = cfg or LMConfig(vocab=256, dim=64, heads=4, depth=2,
@@ -835,6 +1300,11 @@ class LMService(Service):
         self.kv_pages = kv_pages
         self.kv_host_slots = int(kv_host_slots)
         self.prefix = bool(prefix)
+        # SLO-scheduler knobs (ContinuousBatcher docstring)
+        self.prefill_chunk_tokens = prefill_chunk_tokens
+        self.spec_decode_k = int(spec_decode_k)
+        self.draft_params = draft_params
+        self.tiers = tiers
         self._batcher: Optional[ContinuousBatcher] = None
         self._batcher_lock = threading.Lock()
 
@@ -846,7 +1316,11 @@ class LMService(Service):
                     paged=self.paged, page=self.page,
                     pages=self.kv_pages,
                     host_slots=self.kv_host_slots,
-                    prefix=self.prefix)
+                    prefix=self.prefix,
+                    prefill_chunk_tokens=self.prefill_chunk_tokens,
+                    spec_decode_k=self.spec_decode_k,
+                    draft_params=self.draft_params,
+                    tiers=self.tiers)
             return self._batcher
 
     def Generate(self, cntl, request):
@@ -951,7 +1425,12 @@ class LMService(Service):
         if parsed is None:
             return None
         prompt, max_new, stream = parsed
-        self.batcher().join(stream, prompt[0].copy(), max_new)
+        # the request's TLV-22 identity picks the session's SLO tier
+        meta = getattr(cntl, "request_meta", None)
+        tenant = getattr(meta, "tenant", b"") if meta is not None \
+            else b""
+        self.batcher().join(stream, prompt[0].copy(), max_new,
+                            tenant=tenant)
         return struct.pack("<I", max_new)
 
     def Info(self, cntl, request):

@@ -451,7 +451,36 @@ def _rope_at_vec(x, pos, head_dim: int):
     return _rope(x, jnp.sin(ang), jnp.cos(ang))
 
 
-def make_batch_decode(cfg: LMConfig):
+def _rope_span_vec(x, pos, head_dim: int):
+    """Rotary embedding for a SPAN of positions shared across batch —
+    the chunked-prefill variant: ``x`` is (b, s, heads, hd) and ``pos``
+    is an (s,) position vector (typically ``start + arange(chunk)``),
+    the exact math :func:`_rope_tables` produces for ``arange(s)`` —
+    so a chunk slice rotates identically with the whole-prompt pass."""
+    import jax.numpy as jnp
+    half = head_dim // 2
+    freq = jnp.exp(-math.log(10000.0)
+                   * jnp.arange(half, dtype=jnp.float32) / half)
+    ang = pos.astype(jnp.float32)[None, :, None, None] \
+        * freq[None, None, None, :]
+    return _rope(x, jnp.sin(ang), jnp.cos(ang))
+
+
+def _rope_at_mat(x, pos, head_dim: int):
+    """Rotary embedding at PER-(slot, offset) positions — the
+    speculative-verify variant: ``x`` is (b, w, heads, hd) and ``pos``
+    is a (b, w) position matrix (each slot's ``len + arange(w)``).
+    Same rotation, same single home."""
+    import jax.numpy as jnp
+    half = head_dim // 2
+    freq = jnp.exp(-math.log(10000.0)
+                   * jnp.arange(half, dtype=jnp.float32) / half)
+    ang = pos.astype(jnp.float32)[:, :, None, None] \
+        * freq[None, None, None, :]
+    return _rope(x, jnp.sin(ang), jnp.cos(ang))
+
+
+def make_batch_decode(cfg: LMConfig, chunk: Optional[int] = None):
     """Continuous-batching decode: one compiled step over a FIXED pool
     of session slots, each at its OWN position — the serving shape
     where new sessions join the live batch between steps and finished
@@ -466,6 +495,19 @@ def make_batch_decode(cfg: LMConfig):
         per-slot (b,) int32 position vector (vs the scalar in
         :func:`make_decode`); inactive slots are position-clamped and
         never advance, and their logits are garbage by contract.
+
+    With ``chunk`` set, a third program is returned — the
+    chunk-scatter path of SLO-tiered scheduling (Sarathi-style chunked
+    prefill): ``chunk_step(params, cache, slot, start, n, ids[chunk])
+    -> cache`` prefills ``n`` context tokens of one slot at positions
+    ``start..start+n-1`` and sets that slot's len to ``start + n``.
+    Padding entries (``j >= n``) write their garbage k/v into row
+    ``max_seq - 1``, which every admissible session rewrites before
+    the live mask admits it (``ctx + max_new <= max_seq`` with
+    ``max_new >= 1`` keeps valid context rows strictly below it).
+    The slice attends with the same masked softmax as the decode step,
+    so a fully chunk-prefilled slot is identical-by-construction to a
+    whole-prompt prefill insert — the chunked-prefill identity pin.
 
     Per-element math is independent (attention never crosses the batch
     axis), so an active slot's tokens are identical with a solo
@@ -536,7 +578,58 @@ def make_batch_decode(cfg: LMConfig):
         return cache, qmatmul(x[:, 0], params["unembed"])
 
     prefill, _ = make_decode(cfg)
-    return prefill, step
+    if chunk is None:
+        return prefill, step
+
+    cw = int(chunk)
+
+    def chunk_layer(bp, x, kc, vc, slot, rows, pos):
+        """One block of a chunked prefill slice for ONE slot: scatter
+        the slice's k/v rows, then attend each slice query over the
+        slot's full cached stripe under the same causal live mask the
+        decode step uses."""
+        h = _rmsnorm(x, bp["ln1"])
+        qkv = qmatmul(h, bp["wqkv"])
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        shp = (1, cw, cfg.heads, hd)
+        q = _rope_span_vec(q.reshape(shp), pos, hd)
+        k = _rope_span_vec(k.reshape(shp), pos, hd)
+        v = v.reshape(shp)
+        kc = kc.at[slot, rows].set(k[0])
+        vc = vc.at[slot, rows].set(v[0])
+        kcs = kc[slot]                    # (max_seq, heads, hd)
+        vcs = vc[slot]
+        s_mat = jnp.einsum("qhd,khd->hqk", q[0], kcs,
+                           preferred_element_type=jnp.float32
+                           ) / (hd ** 0.5)
+        live = jnp.arange(cfg.max_seq)[None, :] <= pos[:, None]
+        s_mat = jnp.where(live[None, :, :], s_mat, -1e30)
+        p = jax.nn.softmax(s_mat, axis=-1)
+        att = jnp.einsum("hqk,khd->qhd", p, vcs,
+                         preferred_element_type=jnp.float32)
+        x = x + qmatmul(att.reshape(1, cw, cfg.dim), bp["wo"])
+        x = x + mlp(bp, _rmsnorm(x, bp["ln2"]))
+        return x, kc, vc
+
+    def chunk_step(params, cache, slot, start, n, ids):
+        cache = dict(cache)
+        j = jnp.arange(cw)
+        valid = j < n
+        pos = start + j
+        # invalid (padding) rows land on max_seq-1: a garbage row every
+        # admissible session overwrites before its mask admits it
+        rows = jnp.where(valid, jnp.minimum(pos, cfg.max_seq - 1),
+                         cfg.max_seq - 1)
+        x = params["embed"][ids][None]            # (1, chunk, dim)
+        for i in range(cfg.depth):
+            x, kc, vc = chunk_layer(params[f"blk{i}"], x,
+                                    cache[f"k{i}"], cache[f"v{i}"],
+                                    slot, rows, pos)
+            cache[f"k{i}"], cache[f"v{i}"] = kc, vc
+        cache["len"] = cache["len"].at[slot].set(start + n)
+        return cache
+
+    return prefill, step, chunk_step
 
 
 def empty_batch_cache(cfg: LMConfig, slots: int):
@@ -678,7 +771,7 @@ def paged_page_bytes(cfg: LMConfig, page: int) -> int:
     return 2 * cfg.depth * page * cfg.heads * hd * 4       # float32
 
 
-def make_paged_io(cfg: LMConfig, page: int):
+def make_paged_io(cfg: LMConfig, page: int, chunk: Optional[int] = None):
     """Page-granular device I/O for the paged cache — the spill /
     resume / prefill-insert data motion, all fixed-shape (padded to the
     block-table width with garbage-page entries) so each jits ONCE.
@@ -693,7 +786,20 @@ def make_paged_io(cfg: LMConfig, page: int):
         prefilled contiguous cache (``make_decode``'s) blockified into
         the session's pages.
     Padding entries point at page 0 and only ever write garbage there.
-    """
+
+    With ``chunk`` set a FOURTH program rides along — the block-paged
+    chunk-scatter path of SLO-tiered scheduling:
+    ``chunk_prefill(params, cache, bt_row[pps], slot, start, n,
+    ids[chunk]) -> cache`` prefills ``n`` context tokens of one slot
+    at positions ``start..start+n-1``, scattering each row into
+    ``bt_row[pos // page]`` and setting the slot's len to
+    ``start + n``.  Padding entries write the garbage page 0 (the
+    established paged-padding idiom), and a partial prefix hit's
+    catch-up starts at a page-aligned ``covered`` — so aliased prefix
+    pages are never written.  The slice gathers the block table back
+    into the contiguous view and attends under the decode step's own
+    live mask: a fully chunk-prefilled slot is
+    identical-by-construction to a whole-prompt prefill insert."""
     import jax.numpy as jnp
     if cfg.max_seq % page:
         raise ValueError(
@@ -726,7 +832,174 @@ def make_paged_io(cfg: LMConfig, page: int):
             cache[f"pv{i}"] = cache[f"pv{i}"].at[page_ids].set(vb)
         return cache
 
-    return gather, scatter, insert
+    if chunk is None:
+        return gather, scatter, insert
+
+    import jax
+    from ..ops.quant import qmatmul
+    if cfg.moe_experts > 0:
+        from .moe import forward_grouped as moe_forward
+        moe_cfg = cfg.moe_cfg()
+    cw = int(chunk)
+
+    def mlp(bp, h):
+        if cfg.moe_experts > 0:
+            out, _ = moe_forward(bp["moe"], h, moe_cfg)
+            return out
+        up = qmatmul(h, bp["w1"])
+        return qmatmul(jax.nn.gelu(up), bp["w2"])
+
+    def chunk_layer(bp, x, pk, pv, bt_row, page_idx, row, pos):
+        h = _rmsnorm(x, bp["ln1"])
+        qkv = qmatmul(h, bp["wqkv"])
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        shp = (1, cw, cfg.heads, hd)
+        q = _rope_span_vec(q.reshape(shp), pos, hd)
+        k = _rope_span_vec(k.reshape(shp), pos, hd)
+        v = v.reshape(shp)
+        pk = pk.at[page_idx, row].set(k[0])
+        pv = pv.at[page_idx, row].set(v[0])
+        kcs = pk[bt_row].reshape(cfg.max_seq, cfg.heads, hd)
+        vcs = pv[bt_row].reshape(cfg.max_seq, cfg.heads, hd)
+        s_mat = jnp.einsum("qhd,khd->hqk", q[0], kcs,
+                           preferred_element_type=jnp.float32
+                           ) / (hd ** 0.5)
+        live = jnp.arange(cfg.max_seq)[None, :] <= pos[:, None]
+        s_mat = jnp.where(live[None, :, :], s_mat, -1e30)
+        p = jax.nn.softmax(s_mat, axis=-1)
+        att = jnp.einsum("hqk,khd->qhd", p, vcs,
+                         preferred_element_type=jnp.float32)
+        x = x + qmatmul(att.reshape(1, cw, cfg.dim), bp["wo"])
+        x = x + mlp(bp, _rmsnorm(x, bp["ln2"]))
+        return x, pk, pv
+
+    def chunk_prefill(params, cache, bt_row, slot, start, n, ids):
+        cache = dict(cache)
+        j = jnp.arange(cw)
+        valid = j < n
+        posc = jnp.minimum(start + j, cfg.max_seq - 1)
+        # padding entries write the reserved garbage page 0
+        page_idx = jnp.where(valid, bt_row[posc // page], 0)
+        row = posc % page
+        x = params["embed"][ids][None]            # (1, chunk, dim)
+        for i in range(cfg.depth):
+            x, pk, pv = chunk_layer(params[f"blk{i}"], x,
+                                    cache[f"pk{i}"], cache[f"pv{i}"],
+                                    bt_row, page_idx, row, start + j)
+            cache[f"pk{i}"], cache[f"pv{i}"] = pk, pv
+        cache["len"] = cache["len"].at[slot].set(start + n)
+        return cache
+
+    return gather, scatter, insert, chunk_prefill
+
+
+def make_paged_spec_verify(cfg: LMConfig, page: int, width: int):
+    """Speculative-decoding TARGET verification over the paged cache —
+    one multi-token step per round: ``width = k + 1`` candidate tokens
+    ``[x0, d1..dk]`` (the slot's pending token plus the draft model's
+    proposals) are scattered and attended in ONE program, and the
+    longest accepted prefix is computed on-device.
+
+    Returns ``verify(params, cache, bt, tokens[b, w], active[b]) ->
+    (cache, out[b, w], accepted[b])``:
+
+    - row ``j`` of ``out`` is the greedy argmax at position
+      ``len + j`` given context rows ``0..len+j`` — exactly the token
+      the plain decode step would emit after feeding ``tokens[:, :j+1]``
+      (same scatter-before-gather, same live mask, same einsum
+      attention), which is the spec-decode token-identity contract;
+    - ``accepted`` is the per-slot length ``m`` of the draft prefix
+      matching the target (``d_i == out_{i-1}``), CAPPED at ``k - 1``
+      so the draft cache — which holds k/v for inputs ``u_0..u_{k-1}``
+      only — never runs ahead of a row it wrote (the standard
+      discard-the-bonus-token rule);
+    - ``len`` advances by ``m + 1`` for active slots (the emitted
+      tokens ``out[:, :m+1]``).  REJECTED rows ``len+m+1..len+k`` keep
+      their scattered garbage: they sit beyond the new len, and the
+      garbage-beyond-mask invariant (every admissible row is rewritten
+      by a later scatter before the live mask admits it) makes the
+      rollback a pure len rewind — no page-table mutation.
+
+    The caller must guarantee ``len + width <= max_seq`` for every
+    active slot (the batcher falls back to a plain step otherwise)."""
+    import jax
+    import jax.numpy as jnp
+
+    hd = cfg.dim // cfg.heads
+    if cfg.scan_layers:
+        raise NotImplementedError(
+            "spec verify supports unrolled layers only")
+    if cfg.max_seq % page:
+        raise ValueError(
+            f"page size {page} must divide max_seq {cfg.max_seq}")
+    w = int(width)
+    if w < 2:
+        raise ValueError("spec verify needs width >= 2 (k >= 1)")
+    if cfg.moe_experts > 0:
+        from .moe import forward_grouped as moe_forward
+        moe_cfg = cfg.moe_cfg()
+
+    from ..ops.quant import qmatmul
+
+    def mlp(bp, h):
+        if cfg.moe_experts > 0:
+            out, _ = moe_forward(bp["moe"], h, moe_cfg)
+            return out
+        up = qmatmul(h, bp["w1"])
+        return qmatmul(jax.nn.gelu(up), bp["w2"])
+
+    def verify_layer(bp, x, pk, pv, bt, pos):
+        b = x.shape[0]
+        h = _rmsnorm(x, bp["ln1"])
+        qkv = qmatmul(h, bp["wqkv"])
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        shp = (b, w, cfg.heads, hd)
+        q = _rope_at_mat(q.reshape(shp), pos, hd)
+        k = _rope_at_mat(k.reshape(shp), pos, hd)
+        v = v.reshape(shp)
+        # scatter all w candidate rows (rejected ones become the
+        # garbage a later scatter overwrites — see docstring)
+        page_idx = bt[jnp.arange(b)[:, None], pos // page]
+        row = pos % page
+        pk = pk.at[page_idx, row].set(k)
+        pv = pv.at[page_idx, row].set(v)
+        kc = pk[bt].reshape(b, cfg.max_seq, cfg.heads, hd)
+        vc = pv[bt].reshape(b, cfg.max_seq, cfg.heads, hd)
+        s_mat = jnp.einsum("bqhd,bkhd->bhqk", q, kc,
+                           preferred_element_type=jnp.float32
+                           ) / (hd ** 0.5)
+        live = jnp.arange(cfg.max_seq)[None, None, :] <= pos[:, :, None]
+        s_mat = jnp.where(live[:, None, :, :], s_mat, -1e30)
+        p = jax.nn.softmax(s_mat, axis=-1)
+        att = jnp.einsum("bhqk,bkhd->bqhd", p, vc,
+                         preferred_element_type=jnp.float32)
+        x = x + qmatmul(att.reshape(b, w, cfg.dim), bp["wo"])
+        x = x + mlp(bp, _rmsnorm(x, bp["ln2"]))
+        return x, pk, pv
+
+    def verify(params, cache, bt, tokens, active):
+        cache = dict(cache)
+        pos = jnp.minimum(
+            cache["len"][:, None] + jnp.arange(w)[None, :],
+            cfg.max_seq - 1)                       # (b, w)
+        x = params["embed"][tokens]                # (b, w, dim)
+        for i in range(cfg.depth):
+            x, pk, pv = verify_layer(params[f"blk{i}"], x,
+                                     cache[f"pk{i}"], cache[f"pv{i}"],
+                                     bt, pos)
+            cache[f"pk{i}"], cache[f"pv{i}"] = pk, pv
+        logits = qmatmul(x, params["unembed"])     # (b, w, vocab)
+        out = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        # accepted prefix: d_i (= tokens[:, i]) vs out[:, i-1], capped
+        # at k-1 = w-2 (the bonus-token discard)
+        match = (tokens[:, 1:] == out[:, :w - 1]).astype(jnp.int32)
+        m = jnp.minimum(jnp.cumprod(match, axis=1).sum(axis=1),
+                        w - 2).astype(jnp.int32)   # (b,)
+        cache["len"] = jnp.where(active, cache["len"] + m + 1,
+                                 cache["len"])
+        return cache, out, m
+
+    return verify
 
 
 def make_decode_loop(cfg: LMConfig, steps: int):

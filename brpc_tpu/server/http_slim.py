@@ -73,17 +73,9 @@ from urllib.parse import unquote_plus
 from ..butil.iobuf import IOBuf
 from ..butil.logging_util import LOG
 from ..butil.status import Errno
-from ..butil.time_utils import monotonic_us
-from ..deadline import arm as arm_deadline
-from ..deadline import inherit_deadline, maybe_shed
-from ..deadline import parse_deadline_ms
+from ..deadline import inherit_deadline
 from ..protocol.http import build_response
-from ..protocol.meta import RpcMeta
-from ..rpcz import backdate_span, parse_traceparent, start_server_span
 from ..transport.socket import Socket
-from .admission import admit as _admit
-from .admission import http_reject
-from .controller import ServerController
 from .http_dispatch import _encode_http_body, http_status_for_error
 
 _EREQUEST = int(Errno.EREQUEST)
@@ -118,50 +110,33 @@ def make_http_slim_handler(bridge, server, entry, svc: str, mth: str,
                            http_method: str):
     """Build the kind-4 shim for one (service, method, HTTP-method)
     route.  All per-entry state is bound into closure cells — the
-    steady-state call touches no module globals."""
-    status = entry.status
+    steady-state call touches no module globals.
+
+    The cross-cutting stages (admission → trace extract → deadline
+    arm/shed, and the completion epilogue) live in the compiled
+    interceptor chain — ``compile_http_slim_chain`` — the FOURTH chain
+    binding of ROADMAP item 1.  The shim body keeps only what is
+    lane-SPECIFIC: the inline-cell completion plumbing, request body /
+    attachment / json2pb parsing, and the user-code call."""
+    from .interceptors import compile_http_slim_chain
+
     fn = entry.fn
     req_type = entry.request_type
-    full_name = status.full_name
-    path = f"/{svc}/{mth}"
+    full_name = entry.status.full_name
     socks = bridge._socks          # conn_id -> NativeSocket (live dict)
     is_get = http_method in ("GET", "HEAD")
+    enter, settle = compile_http_slim_chain(server, entry, svc, mth,
+                                            http_method)
 
     # ARITY CONTRACT (machine-checked): the engine's kind-4 call site
     # passes exactly these nine params — tools/check gates both sides
+    # (the underscore defaults are chain bindings, not public params)
     def slim(body, query, ctype, attsz, conn_id, recv_ns,
-             traceparent=None, deadline=None, tenant=None):
+             traceparent=None, deadline=None, tenant=None,
+             _enter=enter, _settle=settle):
         sock = socks.get(conn_id)
         if sock is None:
             return None          # connection died mid-burst
-        # overload plane: the SHARED admission stage — CoDel sojourn
-        # and the method limiters measure from the ENGINE's parse
-        # stamp; rejections serialize natively with the burst as a
-        # 503 + Retry-After tuple byte-identical with the classic
-        # bridge's build_response output
-        rej = _admit(server, entry, "http_slim", tenant,
-                     recv_ns // 1000)
-        if rej is not None:
-            st, rbody, extra = http_reject(rej)
-            return st, _hdr_block("text/plain", extra), rbody
-
-        meta = RpcMeta()
-        meta.service_name = svc
-        meta.method_name = mth
-        if tenant is not None:
-            meta.tenant = tenant     # fair-admission slot release keys
-        if traceparent is not None:
-            tp = parse_traceparent(traceparent)
-            if tp is not None:
-                # W3C header → the internal trace model: the span below
-                # is forced and parents to the caller's span id
-                meta.trace_id, meta.span_id = tp
-        # x-deadline-ms: remaining budget; 0 = already expired (meta
-        # keeps it for observability; the cntl deadline below is what
-        # enforcement reads)
-        dl_ms = parse_deadline_ms(deadline)
-        if dl_ms is not None:
-            meta.timeout_ms = dl_ms
 
         # Completion plumbing: while `inline` holds, the send closure
         # parks its response in `cell` and the engine serializes it into
@@ -190,20 +165,14 @@ def make_http_slim_handler(bridge, server, entry, svc: str, mth: str,
                                        headers=extra, keep_alive=ka))
 
         def send(cntl, response):
-            latency_us = monotonic_us() - cntl.begin_time_us
-            status.on_responded(cntl.error_code, latency_us)
-            server.on_request_out(tenant=meta.tenant,
-                                  error_code=cntl.error_code,
-                                  latency_us=latency_us)
-            span = cntl.span
+            # every response shape settles through the chain exactly
+            # once (MethodStatus + limiter feed + span completion)
             if cntl.failed:
                 if cntl._progressive is not None:
                     cntl._progressive._abort()
                 code = http_status_for_error(cntl.error_code)
                 body_ = cntl.error_text.encode()
-                if span is not None:
-                    span.response_size = len(body_)
-                    span.finish(cntl.error_code)
+                _settle(cntl, len(body_))
                 _deliver(code, body_, "text/plain",
                          [("x-rpc-error-code", str(cntl.error_code))])
                 return
@@ -223,9 +192,7 @@ def make_http_slim_handler(bridge, server, entry, svc: str, mth: str,
                 if s is not None and not s.failed:
                     s.write(IOBuf(head + first))
                     cntl._progressive._start()
-                if span is not None:
-                    span.response_size = len(body_)
-                    span.finish(0)
+                _settle(cntl, len(body_))
                 return
             body_, ctype_ = _encode_http_body(response)
             extra = None
@@ -234,34 +201,18 @@ def make_http_slim_handler(bridge, server, entry, svc: str, mth: str,
             if att:
                 body_ += att
                 extra = [("x-rpc-attachment-size", str(len(att)))]
-            if span is not None:
-                span.response_size = len(body_)
-                span.finish(0)
+            _settle(cntl, len(body_))
             _deliver(200, body_, ctype_, extra)
 
-        cntl = ServerController(meta, sock.remote_side, sock.id, send)
-        cntl.server = server
-        # latency anchored at the ENGINE's parse stamp, not shim entry:
-        # limiter/MethodStatus samples include native batch queueing
-        cntl.begin_time_us = recv_ns // 1000
-        cntl.http_method = http_method
-        cntl.http_path = path
-        cntl.http_unresolved_path = ""
-        if dl_ms is not None:
-            # deadline anchored at the ENGINE's parse time: native
-            # batching queueing counts against the propagated budget
-            arm_deadline(cntl, dl_ms, recv_ns // 1000)
-        span = start_server_span(full_name, meta, sock.remote_side)
-        if span is not None:
-            span.request_size = len(body)
-            # span start = the ENGINE's parse time, not shim entry:
-            # native read/parse/batch queueing is real latency
-            backdate_span(span, recv_ns)
-            cntl.span = span
-        if dl_ms is not None and maybe_shed(cntl, "http_slim", full_name):
-            # doomed work shed: the inline-tuple error completion below
-            # serializes 500 + x-rpc-error-code natively with the burst
-            cntl.finish(None)
+        # chain enter: admission → trace extract → deadline arm/shed.
+        # A rejection comes back as the inline tuple; a shed already
+        # completed through `send` and parked its tuple in the cell.
+        cntl, early = _enter(len(body) if body is not None else 0,
+                             sock.id, sock.remote_side, recv_ns, send,
+                             traceparent, deadline, tenant)
+        if cntl is None:
+            if early is not None:
+                return early
             return cell[0] if cell else None
 
         # request build — mirror of _bridge_rpc

@@ -261,3 +261,116 @@ def compile_http_chain(server, entry):
             span.finish(cntl.error_code)
 
     return enter, settle
+
+
+def compile_http_slim_chain(server, entry, svc: str, mth: str,
+                            http_method: str):
+    """The kind-4 (slim native HTTP) binding of the interceptor chain
+    — ROADMAP item 1's FOURTH port: same stages as
+    :func:`compile_http_chain`, slim-lane spellings.  The engine hands
+    the shim raw header VALUES (``traceparent`` / ``x-deadline-ms`` /
+    ``x-tenant``) instead of a parsed message, timestamps are the
+    engine's CLOCK_MONOTONIC parse stamp (spans backdated over native
+    queueing), and a rejection serializes as the lane's
+    ``(status, header_block, body)`` tuple riding the burst's single
+    coalesced writev — byte-identical with ``build_response``'s
+    output.
+
+    ``enter(body_len, conn_id, remote_side, recv_ns, send,
+    traceparent, deadline, tenant)`` returns ``(cntl, early)``:
+    ``(cntl, None)`` when the request may proceed, ``(None, tuple)``
+    for an admission rejection (the tuple is the engine's inline
+    response), ``(None, None)`` when the deadline shed already
+    completed through ``send`` (the lane returns its parked cell).
+
+    ``settle(cntl, response_len)`` is the completion epilogue the
+    lane's ``send`` closure funnels every response shape through."""
+    from ..butil.time_utils import monotonic_us
+    from ..deadline import parse_deadline_ms as _parse_deadline_ms
+    from ..rpcz import parse_traceparent
+    from .admission import http_reject
+    # lazy: http_slim imports this module to bind the chain
+    from .http_slim import _hdr_block
+
+    status = entry.status
+    full_name = status.full_name
+    path = f"/{svc}/{mth}"
+
+    def enter(body_len, conn_id, remote_side, recv_ns, send,
+              traceparent, deadline, tenant,
+              _server=server, _entry=entry, _status=status, _svc=svc,
+              _mth=mth, _http_method=http_method, _path=path,
+              _full=full_name, _admit_stage=_admit,
+              _shed=_maybe_shed, _arm=_arm_deadline,
+              _sample=start_server_span, _backdate=backdate_span,
+              _parse_tp=parse_traceparent,
+              _parse_dl=_parse_deadline_ms, _reject=http_reject,
+              _hdr=_hdr_block):
+        # ---- admission: the ONE shared overload-plane stage, FIRST —
+        # CoDel sojourn and the limiters measure from the ENGINE's
+        # parse stamp, so native batch queueing counts
+        rej = _admit_stage(_server, _entry, "http_slim", tenant,
+                           recv_ns // 1000)
+        if rej is not None:
+            # rejection serialization through the SHARED HTTP helper,
+            # as a slim tuple the engine coalesces into the burst's
+            # writev (503 + Retry-After; lame-duck headers in drain)
+            st, rbody, extra = _reject(rej)
+            return None, (st, _hdr("text/plain", extra), rbody)
+        meta = RpcMeta()
+        meta.service_name = _svc
+        meta.method_name = _mth
+        if tenant is not None:
+            meta.tenant = tenant        # fair-admission slot release
+        # ---- trace extract: raw W3C header value → the internal
+        # trace model (explicitly traced requests STAY on the slim
+        # lane, span parented to the caller)
+        if traceparent is not None:
+            tp = _parse_tp(traceparent)
+            if tp is not None:
+                meta.trace_id, meta.span_id = tp
+        # x-deadline-ms: remaining budget, 0 = already expired (meta
+        # keeps it for observability; the armed cntl deadline is what
+        # enforcement reads)
+        dl_ms = _parse_dl(deadline)
+        if dl_ms is not None:
+            meta.timeout_ms = dl_ms
+        cntl = ServerController(meta, remote_side, conn_id, send)
+        cntl.server = _server
+        # latency anchored at the ENGINE's parse stamp, not shim
+        # entry: limiter/MethodStatus samples include native queueing
+        cntl.begin_time_us = recv_ns // 1000
+        cntl.http_method = _http_method
+        cntl.http_path = _path
+        cntl.http_unresolved_path = ""
+        if dl_ms is not None:
+            _arm(cntl, dl_ms, recv_ns // 1000)
+        span = _sample(_full, meta, remote_side)
+        if span is not None:
+            span.request_size = body_len
+            _backdate(span, recv_ns)
+            cntl.span = span
+        # ---- deadline shed, AFTER admission, BEFORE user code: the
+        # finish below completes through the lane's send closure,
+        # which parks the 500 + x-rpc-error-code tuple in its cell
+        if dl_ms is not None and _shed(cntl, "http_slim", _full):
+            cntl.finish(None)
+            return None, None
+        return cntl, None
+
+    def settle(cntl, response_len,
+               _status=status, _server=server, _us=monotonic_us):
+        """Completion epilogue (every response shape — success, error,
+        progressive headers — funnels through here exactly once):
+        MethodStatus settle, limiter latency feed, span completion."""
+        latency_us = _us() - cntl.begin_time_us
+        _status.on_responded(cntl.error_code, latency_us)
+        _server.on_request_out(tenant=cntl.request_meta.tenant,
+                               error_code=cntl.error_code,
+                               latency_us=latency_us)
+        span = cntl.span
+        if span is not None:
+            span.response_size = response_len
+            span.finish(cntl.error_code)
+
+    return enter, settle

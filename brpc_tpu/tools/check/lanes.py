@@ -75,10 +75,22 @@ LANES = (
         },
     },
     {
+        # kind-4 slim HTTP lane — the FOURTH interceptor-chain binding:
+        # admission/trace/deadline-shed live in compile_http_slim_chain
+        # (rejections and sheds come back as inline slim tuples); the
+        # shim body keeps only the cell/deliver plumbing and settles
+        # every response shape through the chain
         "lane": "http_slim",
         "path": "brpc_tpu/server/http_slim.py",
         "func": ["make_http_slim_handler", "slim"],
-        "reject": {"kind": "call", "names": {"http_reject"}},
+        "reject": {"kind": "call", "names": {"http_reject", "_reject"}},
+        "chain": {
+            "path": "brpc_tpu/server/interceptors.py",
+            "func": ["compile_http_slim_chain", "enter"],
+            "settle_func": ["compile_http_slim_chain", "settle"],
+            "entry_names": {"_enter", "enter"},
+            "settle_names": {"_settle", "settle"},
+        },
     },
     {
         "lane": "grpc",

@@ -399,6 +399,44 @@ def test_drift_admission_deleted_from_slim_chain_binding():
                for f in findings), findings
 
 
+def test_drift_unregistered_sched_event():
+    """A member added to the scheduler's closed enum with NO test pin
+    (the name is assembled at runtime so this file itself never
+    anchors it) must be flagged by the enum analyzer."""
+    LM = "brpc_tpu/models/lm_service.py"
+    unpinned = "sched_nobody_" + "anchored"
+    ov = _mutate(LM, '"sched_chunk_slice",',
+                 f'"sched_chunk_slice", "{unpinned}",')
+    findings = check_enums(Tree(overrides=ov))
+    assert any(unpinned in f.message for f in findings), findings
+
+
+def test_drift_blocking_call_in_chunk_round():
+    """A blocking primitive seeded into the batcher's chunk-prefill
+    round (every live session's next token waits on it) must be
+    caught by the step-loop entry points."""
+    LM = "brpc_tpu/models/lm_service.py"
+    ov = _mutate(LM,
+                 "filling.sort(key=lambda s: (s.tier_rank, s.slot))",
+                 "import time; time.sleep(0.01); "
+                 "filling.sort(key=lambda s: (s.tier_rank, s.slot))")
+    findings = check_blocking(Tree(overrides=ov))
+    assert any("_chunk_round" in f.message and "sleep" in f.message
+               for f in findings), findings
+
+
+def test_drift_http_slim_chain_binding_dropped():
+    """The kind-4 shim no longer calling the compiled chain — the
+    fourth binding is gone even though the chain itself is intact."""
+    ov = _mutate("brpc_tpu/server/http_slim.py",
+                 "cntl, early = _enter(",
+                 "cntl, early = _no_chain(")
+    findings = check_lanes(Tree(overrides=ov))
+    assert any("[http_slim]" in f.message
+               and ("chain" in f.message or "enter" in f.message)
+               for f in findings), findings
+
+
 def test_allow_marker_suppresses():
     """The reviewed-exception escape hatch works (and is line-scoped)."""
     ov = _mutate(
