@@ -70,7 +70,10 @@ class DecodeTierService(Service):
         return encode_probe_response()
 
     def ImportSession(self, cntl, request):
+        from time import monotonic_ns
+
         from ..streaming import find_stream
+        recv_ns = monotonic_ns()
         try:
             man = decode_manifest(bytes(request))
         except (KvPageError, struct.error) as e:
@@ -117,9 +120,28 @@ class DecodeTierService(Service):
             cntl.set_failed(Errno.ERESPONSE,
                             f"kv_import_rejected: {e}")
             return None
+        # decode-tier half of the stitched trace: the handoff RPC
+        # carried the prefill request's trace id in its ordinary trace
+        # TLVs, so cntl.span (when present) is already forced under
+        # that id — the session span is its child, backdated to the
+        # import's arrival so the transfer+import time it covers is
+        # honest (rpcz.backdate_span, the PR 4 stitcher's convention)
+        span = None
+        req_span = getattr(cntl, "span", None)
+        if req_span is not None:
+            from ..rpcz import Span, backdate_span
+            span = Span("KV.DecodeTierSession",
+                        trace_id=req_span.trace_id,
+                        parent_span_id=req_span.span_id)
+            span.remote_side = req_span.remote_side
+            backdate_span(span, recv_ns)
+        meta = getattr(cntl, "request_meta", None)
+        tenant = getattr(meta, "tenant", b"") if meta is not None \
+            else b""
         self.lm.batcher().join_imported(stream, man.last_token,
                                         man.ctx_len, man.max_new,
-                                        cache1)
+                                        cache1, tenant=tenant,
+                                        span=span)
         return b"ok"
 
 
@@ -164,15 +186,28 @@ class PrefillService(LMService):
         if parsed is None:
             return None
         prompt, max_new, stream = parsed
+        # prefill-tier half of the stitched trace: a traced Decode
+        # gets a forced session span whose chunk-slice event covers
+        # the whole-prompt prefill this tier runs
+        span = self._session_span(cntl)
+        if span is not None:
+            span.annotate("lm_join")
         cache1, ctx_len = bucketed_prefill(self._ensure_prefill(),
                                            self.cfg, prompt[0])
+        if span is not None:
+            span.annotate("lm_chunk_slice")
         last_token = int(prompt[0][-1])
         pages = export_decode_cache(self.cfg, cache1)
         res = self.transport.handoff(
             self.decode_channel, stream.id, ctx_len, last_token,
             max_new, self.model_fingerprint(), pages,
-            owner=("kv", cntl.socket_id))
+            owner=("kv", cntl.socket_id),
+            trace=(span.trace_id, span.span_id)
+            if span is not None else None)
         if res.ok:
+            if span is not None:
+                span.annotate("lm_handoff")
+                span.finish(0)
             return struct.pack("<I", max_new)
         if self.fallback_local and not res.ambiguous:
             # monolithic fallback: the SAME cache1 joins the local
@@ -185,10 +220,17 @@ class PrefillService(LMService):
             # the named reason instead and the client retries
             LOG.info("kv handoff fell back to local decode (%s)",
                      res.reason)
+            meta = getattr(cntl, "request_meta", None)
+            tenant = getattr(meta, "tenant", b"") \
+                if meta is not None else b""
             self.batcher().join_imported(stream, last_token, ctx_len,
-                                         max_new, cache1)
+                                         max_new, cache1,
+                                         tenant=tenant, span=span)
             return struct.pack("<I", max_new)
         stream.close(reason="kv_handoff_failed")
+        if span is not None:
+            span.annotate("lm_evict:kv_handoff_failed")
+            span.finish(int(Errno.EINTERNAL))
         cntl.set_failed(Errno.EINTERNAL,
                         f"kv handoff failed: {res.reason}")
         return None

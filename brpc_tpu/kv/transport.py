@@ -411,10 +411,15 @@ class KvTransport:
 
     def handoff(self, channel, stream_id: int, ctx_len: int,
                 last_token: int, max_new: int, model_fp: bytes,
-                pages, owner: Any = None) -> HandoffResult:
+                pages, owner: Any = None,
+                trace: Any = None) -> HandoffResult:
         """Hand one live session to ``channel``'s peer.  ``pages`` is
         the ordered ``(device_array, nbytes)`` list from the model's
-        cache export.  Never raises: a False result means the caller
+        cache export.  ``trace`` (optional ``(trace_id, span_id)``)
+        rides the ImportSession RPC's EXISTING trace TLVs, so the
+        decode tier's half of the session lands under the prefill
+        request's trace id — distributed rpcz stitching with no new
+        wire format.  Never raises: a False result means the caller
         still owns the session (decode locally or close with a named
         reason) and every lease is settled."""
         if channel is None:
@@ -441,6 +446,8 @@ class KvTransport:
         from ..client import Controller
         cntl = Controller()
         cntl.timeout_ms = self.import_timeout_ms
+        if trace is not None:
+            cntl.trace_id, cntl.span_id = trace
         try:
             c = channel.call_method("KV.ImportSession",
                                     encode_manifest(m), cntl=cntl,

@@ -17,6 +17,7 @@ from __future__ import annotations
 import struct
 import threading
 from collections import deque
+from time import monotonic_ns as _mono_ns
 from typing import Optional
 
 import numpy as np
@@ -26,6 +27,13 @@ from ..butil.status import Errno
 from ..bvar.multi_dimension import PassiveDimension
 from ..server.admission import _MAX_TENANTS, normalize_tenant
 from ..server.service import Service
+from . import lm_telemetry as _lmt
+from .lm_telemetry import (PH_CATCHUP_SLICE, PH_CHUNK_SLICE,
+                           PH_DECODE_ROUND, PH_HOST_RESUME,
+                           PH_HOST_SPILL, PH_PAGE_ALLOC,
+                           PH_PREFIX_LOOKUP, PH_SPEC_DRAFT,
+                           PH_SPEC_VERIFY, PH_STREAM_EMIT)
+from .lm_telemetry import record_phase as _rec_phase
 from .transformer_lm import LMConfig, init_params
 
 
@@ -70,6 +78,7 @@ class TierRegistry:
             raise ValueError(f"unknown SLO tier: {default}")
         self._default = default
         self._map: dict = {}
+        self._slo: dict = {}       # tier -> (ttft_ms, itl_ms) targets
         self._lock = threading.Lock()
 
     def set_tier(self, tenant, tier: str) -> None:
@@ -88,6 +97,23 @@ class TierRegistry:
 
     def rank_of(self, tenant) -> int:
         return _TIER_RANK[self.tier_of(tenant)]
+
+    def set_slo(self, tier: str, ttft_ms: Optional[float] = None,
+                itl_ms: Optional[float] = None) -> None:
+        """Per-tier latency targets the SLO attainment verdicts
+        (``lm_telemetry.LM_SLO_VERDICTS``) are judged against at
+        session close.  A tier with no targets judges
+        ``slo_untargeted``."""
+        if tier not in SLO_TIERS:
+            raise ValueError(f"unknown SLO tier: {tier}")
+        with self._lock:
+            self._slo[tier] = (ttft_ms, itl_ms)
+
+    def slo_of(self, tier: str) -> tuple:
+        # deliberately lock-free: the batcher reads targets while
+        # finalizing a session inside its loop, and a dict.get of an
+        # immutable tuple is GIL-atomic
+        return self._slo.get(tier, (None, None))
 
 
 # CLOSED enums (tools/check/enums.py pins every member to a test): the
@@ -161,7 +187,12 @@ class _Session:
                  # block-table pages, its prefix-cache aliases, and
                  # its host-tier parking state
                  "pages", "n_alias", "n_priv",
-                 "host_handles", "saved_len")
+                 "host_handles", "saved_len",
+                 # observability: the session's timeline record
+                 # (lm_telemetry.SessionTimeline, None when telemetry
+                 # is off) and its forced rpcz decode-session span
+                 # (None when the request was untraced)
+                 "tl", "span")
 
     def __init__(self, stream, prompt: Optional[np.ndarray],
                  max_new: int):
@@ -188,6 +219,8 @@ class _Session:
         self.n_priv = 0
         self.host_handles = None
         self.saved_len = 0
+        self.tl = None
+        self.span = None
 
 
 def bucketed_prefill(prefill_j, cfg: LMConfig, prompt: np.ndarray):
@@ -395,13 +428,21 @@ class ContinuousBatcher:
     # -- public -----------------------------------------------------------
 
     def join(self, stream, prompt: np.ndarray, max_new: int,
-             tenant=None) -> None:
+             tenant=None, span=None) -> None:
         """Queue a session; it enters the live batch between steps.
         ``tenant`` (the request's TLV-22 identity, bytes or str)
-        resolves the session's SLO tier through the registry."""
+        resolves the session's SLO tier through the registry.
+        ``span`` (optional rpcz Span) is the session's decode-session
+        span — the batcher annotates its step events and finishes it
+        at evict."""
         sess = _Session(stream, np.ascontiguousarray(prompt, np.int32),
                         int(max_new))
         self._assign_tier(sess, tenant)
+        sess.span = span
+        sess.tl = _lmt.open_timeline(sess.tier, tenant, len(prompt),
+                                     int(max_new), "fresh")
+        if span is not None:
+            span.annotate("lm_join")
         self._enqueue(sess)
 
     def _assign_tier(self, sess: _Session, tenant) -> None:
@@ -410,7 +451,8 @@ class ContinuousBatcher:
             sess.tier_rank = _TIER_RANK[sess.tier]
 
     def join_imported(self, stream, last_token: int, ctx_len: int,
-                      max_new: int, cache1, tenant=None) -> None:
+                      max_new: int, cache1, tenant=None,
+                      span=None) -> None:
         """Disaggregated serving (kv/): admit a session whose prefill
         ran on ANOTHER tier.  ``cache1`` is the imported per-layer
         cache dict (``decode_cache_from_pages`` layout, batch 1); it
@@ -423,6 +465,12 @@ class ContinuousBatcher:
         sess.ctx_len = int(ctx_len)
         sess.last_token = int(last_token)
         self._assign_tier(sess, tenant)
+        sess.span = span
+        sess.tl = _lmt.open_timeline(sess.tier, tenant,
+                                     int(ctx_len) + 1, int(max_new),
+                                     "imported")
+        if span is not None:
+            span.annotate("lm_join")
         self._enqueue(sess)
 
     def _enqueue(self, sess: _Session) -> None:
@@ -449,7 +497,8 @@ class ContinuousBatcher:
                "prefills_run": self.prefills_run,
                "spills": self.spills, "resumes": self.resumes,
                "parked": len(self._parked),
-               "sched": sched_counters(), "spec": spec_counters()}
+               "sched": sched_counters(), "spec": spec_counters(),
+               "phases": _lmt.phase_counters()}
         if self._alloc is not None:
             out["alloc"] = self._alloc.stats()
         if self._prefix is not None:
@@ -707,7 +756,8 @@ class ContinuousBatcher:
         import jax.numpy as jnp
 
         from ..kv.pages import count_evict
-        if sess.cache1 is not None:
+        imported = sess.cache1 is not None
+        if imported:
             ctx_len = sess.ctx_len
             aliased, covered = [], 0    # imported manifests carry no
             #                             tokens to fingerprint
@@ -715,18 +765,23 @@ class ContinuousBatcher:
             ctx = sess.prompt[:-1]
             ctx_len = len(ctx)
             if self._prefix is not None:
+                t0 = _mono_ns()
                 aliased, covered = self._prefix.lookup(ctx)
+                _rec_phase(PH_PREFIX_LOOKUP, _mono_ns() - t0)
             else:
                 aliased, covered = [], 0
         n_total = self._pages_for(ctx_len, sess.max_new)
+        t0 = _mono_ns()
         priv, why = self._alloc_with_reclaim(n_total - len(aliased),
                                              rank=sess.tier_rank)
+        _rec_phase(PH_PAGE_ALLOC, _mono_ns() - t0)
         if priv is None:
             for p in aliased:
                 self._alloc.release(p)
             count_evict(why)
             if not sess.stream.closed:
                 sess.stream.close(reason=why)
+            self._finalize_obs(sess, why)
             return
         # free = unOCCUPIED, not merely inactive: a chunk-filling
         # session holds its slot while _active is still False
@@ -779,6 +834,15 @@ class ContinuousBatcher:
         sess.n_alias = n_alias
         sess.n_priv = len(priv)
         sess.ctx_len = ctx_len
+        tl = sess.tl
+        if tl is not None:
+            if not imported:
+                tl.prefix = "prefix_hit" if (n_alias and
+                                             covered == ctx_len) \
+                    else "prefix_partial" if covered > 0 \
+                    else "prefix_miss"
+            if len(sess.pages) > tl.pages_peak:
+                tl.pages_peak = len(sess.pages)
         self._bt[free] = row
         sess.slot = free
         sess.sent = 0
@@ -815,6 +879,8 @@ class ContinuousBatcher:
                      key=lambda s: (s.tier_rank, s.n_priv, -s.slot))
         if victim.tier_rank >= _RANK_BATCH:
             count_sched("sched_preempt_batch")
+            if victim.tl is not None:
+                victim.tl.preempts += 1
         return self._park(victim)
 
     def _park(self, sess: _Session) -> Optional[str]:
@@ -823,6 +889,7 @@ class ContinuousBatcher:
         page contents, len, the last fed token, the chunk-fill
         watermark — survives in the session object + host tier."""
         import jax.numpy as jnp
+        t0 = _mono_ns()
         if not self._host.begin_spill():
             return self._host.abort_reason() or "kv_host_tier_full"
         handles = []
@@ -850,6 +917,11 @@ class ContinuousBatcher:
         sess.slot = -1
         self._parked.append(sess)
         self.spills += 1
+        if sess.tl is not None:
+            sess.tl.spills += 1
+        if sess.span is not None:
+            sess.span.annotate("lm_spill")
+        _rec_phase(PH_HOST_SPILL, _mono_ns() - t0)
         return None
 
     def _resume(self, sess: _Session) -> bool:
@@ -862,6 +934,7 @@ class ContinuousBatcher:
                      if i not in self._sessions), None)
         if free is None:
             return False
+        t0 = _mono_ns()
         priv = self._alloc.alloc(sess.n_priv)
         while priv is None:
             # prefix-cache holds are reclaimable — a parked session
@@ -912,6 +985,14 @@ class ContinuousBatcher:
                                            jnp.int32(free),
                                            jnp.int32(sess.saved_len))
         self.resumes += 1
+        tl = sess.tl
+        if tl is not None:
+            tl.resumes += 1
+            if len(sess.pages) > tl.pages_peak:
+                tl.pages_peak = len(sess.pages)
+        if sess.span is not None:
+            sess.span.annotate("lm_resume")
+        _rec_phase(PH_HOST_RESUME, _mono_ns() - t0)
         return True
 
     def _drop_parked(self, sess: _Session,
@@ -932,6 +1013,7 @@ class ContinuousBatcher:
             count_evict(reason)
         if not sess.stream.closed:
             sess.stream.close(reason=reason or "finished")
+        self._finalize_obs(sess, reason or "finished")
 
     def _service_parked(self) -> None:
         """Between steps: resume whatever fits, discard the dead, and
@@ -1021,6 +1103,7 @@ class ContinuousBatcher:
                 continue
             catchup = sess.n_alias > 0
             while budget > 0 and sess.fill < sess.ctx_len:
+                t0 = _mono_ns()
                 n = int(min(self._chunk_w, sess.ctx_len - sess.fill,
                             budget))
                 ids = np.zeros((self._chunk_w,), np.int32)
@@ -1039,6 +1122,10 @@ class ContinuousBatcher:
                 budget -= n
                 count_sched("sched_catchup_slice" if catchup
                             else "sched_chunk_slice")
+                _rec_phase(PH_CATCHUP_SLICE if catchup
+                           else PH_CHUNK_SLICE, _mono_ns() - t0)
+                if sess.span is not None:
+                    sess.span.annotate("lm_chunk_slice")
             if sess.fill >= sess.ctx_len:
                 self._activate(sess)
 
@@ -1061,6 +1148,7 @@ class ContinuousBatcher:
         """One plain decode step over the active slots; returns
         ``(pairs, finished)`` for the emit/evict epilogue."""
         import jax.numpy as jnp
+        t0 = _mono_ns()
         if self.paged:
             cache, logits = self._step(
                 self._cache, jnp.asarray(self._bt),
@@ -1071,6 +1159,7 @@ class ContinuousBatcher:
                 jnp.asarray(self._active))
         self._cache = cache
         self._steps += 1
+        _rec_phase(PH_DECODE_ROUND, _mono_ns() - t0)
         toks = np.asarray(jnp.argmax(logits, axis=-1))
         pairs, finished = [], []
         for slot, sess in list(self._sessions.items()):
@@ -1097,6 +1186,7 @@ class ContinuousBatcher:
         import jax.numpy as jnp
         k = self.spec_k
         count_spec("spec_round")
+        t_round = _mono_ns()
         active = self._active.copy()
         act_j = jnp.asarray(active)
         cur = self._tokens.copy()
@@ -1106,14 +1196,18 @@ class ContinuousBatcher:
                                              jnp.asarray(cur), act_j)
             cur = np.asarray(jnp.argmax(dl, axis=-1)).astype(np.int32)
             drafts.append(cur)
+        t_verify = _mono_ns()
+        _rec_phase(PH_SPEC_DRAFT, t_verify - t_round)
         u = np.stack([self._tokens] + drafts, axis=1).astype(np.int32)
         self._cache, out, m = self._verify_j(
             self._cache, jnp.asarray(self._bt), jnp.asarray(u), act_j)
         out = np.asarray(out)
         m = np.asarray(m)
+        _rec_phase(PH_SPEC_VERIFY, _mono_ns() - t_verify)
         self._d_cache = self._d_sync_j(self._d_cache, jnp.asarray(m),
                                        act_j)
         self._steps += 1
+        _rec_phase(PH_DECODE_ROUND, _mono_ns() - t_round)
         pairs, finished = [], []
         for slot, sess in list(self._sessions.items()):
             if not active[slot]:
@@ -1131,6 +1225,23 @@ class ContinuousBatcher:
                 finished.append(sess)
         return pairs, finished
 
+    def _finalize_obs(self, sess: _Session, reason: str) -> None:
+        """Session-close observability (batcher thread): judge and
+        count the SLO verdict, move the timeline into the ring, close
+        out the decode-session span.  Lock-free — runs inside the step
+        loop's evict epilogue."""
+        tl = sess.tl
+        if tl is not None:
+            sess.tl = None
+            ttft_t, itl_t = self.tiers.slo_of(sess.tier) \
+                if self.tiers is not None else (None, None)
+            _lmt.close_timeline(tl, reason, ttft_t, itl_t)
+        sp = sess.span
+        if sp is not None:
+            sess.span = None
+            sp.annotate("lm_evict:" + reason)
+            sp.finish(0)
+
     def _evict(self, sess: _Session, reason: Optional[str]) -> None:
         self._sessions.pop(sess.slot, None)
         self._active[sess.slot] = False
@@ -1140,6 +1251,7 @@ class ContinuousBatcher:
             self._bt[sess.slot] = 0
         if not sess.stream.closed:
             sess.stream.close(reason=reason or "finished")
+        self._finalize_obs(sess, reason or "finished")
 
     def _run(self) -> None:
         try:
@@ -1210,8 +1322,12 @@ class ContinuousBatcher:
                         pairs, finished = self._plain_round()
                 else:
                     pairs, finished = self._plain_round()
+                t0 = _mono_ns()
+                dead = self._emit(pairs)
+                _rec_phase(PH_STREAM_EMIT, _mono_ns() - t0)
+                _lmt.on_emit(pairs)
                 evicted = set()
-                for sess, reason in self._emit(pairs):
+                for sess, reason in dead:
                     # a spec round emits several tokens per session —
                     # one eviction decision each
                     if id(sess) not in evicted:
@@ -1251,6 +1367,10 @@ class ContinuousBatcher:
             for sess in sessions:
                 try:
                     sess.stream.close(reason="decode_error")
+                except Exception:
+                    pass
+                try:
+                    self._finalize_obs(sess, "decode_error")
                 except Exception:
                     pass
 
@@ -1430,8 +1550,29 @@ class LMService(Service):
         tenant = getattr(meta, "tenant", b"") if meta is not None \
             else b""
         self.batcher().join(stream, prompt[0].copy(), max_new,
-                            tenant=tenant)
+                            tenant=tenant,
+                            span=self._session_span(cntl))
         return struct.pack("<I", max_new)
+
+    def _session_span(self, cntl):
+        """Decode-session rpcz span: when the Decode RPC itself is
+        traced (its server span exists — forced for a propagated trace
+        id, or passively sampled), the session outliving the RPC gets
+        its own FORCED child span under the SAME trace id, so the
+        batcher's step events (join / chunk slices / first token /
+        evict) land in the request's trace — across a disagg handoff
+        too, both halves stitch under one id with no new wire format
+        (the handoff Controller propagates the trace TLVs any request
+        carries)."""
+        req_span = getattr(cntl, "span", None)
+        if req_span is None:
+            return None
+        from ..rpcz import Span
+        span = Span("LMService.DecodeSession",
+                    trace_id=req_span.trace_id,
+                    parent_span_id=req_span.span_id)
+        span.remote_side = req_span.remote_side
+        return span
 
     def Info(self, cntl, request):
         import json

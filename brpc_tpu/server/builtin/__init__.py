@@ -453,6 +453,79 @@ def _native(server, msg, rest):
     return 200, "application/json", json.dumps(out, indent=1)
 
 
+def _lm(server, msg, rest):
+    """/lm — the serving-plane telemetry page (ISSUE 18): live decode
+    sessions, recently finished session timelines, per-tier TTFT/ITL
+    percentiles and SLO attainment, the batcher step-phase histograms,
+    KV pool / prefix cache / host tier occupancy, and the WINDOWED
+    spec-accept and prefix-hit ratios (current behavior — the lifetime
+    cumulative keys stay on the bench/perf_guard plane).  One
+    LmTelemetryCache window renders the whole page, same discipline as
+    /native's one engine snapshot."""
+    from ...models import lm_telemetry as lmt
+
+    lm = None
+    for (svc, mth), entry in sorted(server.methods.items()):
+        if mth == "Decode" and hasattr(entry.service, "batcher"):
+            lm = entry.service
+            break
+    cache = lmt.telemetry_cache()
+    prev, cur, dt = cache.window()
+    phases = {}
+    for p, buckets in cur["phase_hists"].items():
+        c = cur["phases"][p]
+        tot = cur["phase_ns"][p]
+        phases[p] = {
+            "count": c,
+            "avg_us": round(tot / c / 1e3, 1) if c else 0,
+            "buckets_ns": {lmt.bucket_label(i): n
+                           for i, n in enumerate(buckets) if n},
+        }
+    # scheduler event RATES over the cache window (the counters
+    # themselves are on /vars as lm_slo_sched_total)
+    sched_rate = {}
+    if prev is not None:
+        for k, v in cur["sched"].items():
+            sched_rate[k] = round((v - prev["sched"].get(k, 0)) / dt, 2)
+    # KV occupancy from the batcher that already exists — never
+    # CREATE one from an observability page
+    bat = getattr(lm, "_batcher", None) if lm is not None else None
+    kv = bat.kv_stats() if bat is not None else {}
+    out = {
+        "live_sessions": cur["live"],
+        "recent_sessions": cur["ring"][-32:],
+        "ttft_ms": {f"{t}|{q}": v
+                    for (t, q), v in sorted(cur["ttft_ms"].items())},
+        "itl_ms": {f"{t}|{q}": v
+                   for (t, q), v in sorted(cur["itl_ms"].items())},
+        "slo_attained_total": {f"{t}|{v}": n for (t, v), n
+                               in sorted(cur["slo"].items())},
+        "phases": phases,
+        "windowed": {
+            "window_s": round(dt, 3),
+            "spec_accept_rate":
+                round(lmt.windowed_spec_accept_rate(cache), 4),
+            "prefix_cache_hit_ratio":
+                round(lmt.windowed_prefix_hit_ratio(cache), 4),
+            "sched_rate_per_s": sched_rate,
+        },
+        "lifetime": {
+            "spec_accept_rate":
+                round(lmt.lifetime_spec_accept_rate(), 4),
+            "prefix_cache_hit_ratio":
+                round(lmt.lifetime_prefix_hit_ratio(), 4),
+        },
+        "sched": cur["sched"],
+        "spec": cur["spec"],
+        "prefix_events": cur["prefix_events"],
+        "kv": kv,
+        "timeline_ring": {"len": lmt.ring_len(),
+                          "max": lmt.ring_maxlen()},
+        "enabled": lmt.telemetry_enabled(),
+    }
+    return 200, "application/json", json.dumps(out, indent=1)
+
+
 def _overload(server, msg, rest):
     """/overload — the admission plane's live state: per-(tenant,
     verdict) admission counters (closed verdict enum, no "unknown"
@@ -727,3 +800,4 @@ register_builtin("fibers", _fibers)
 register_builtin("rpcz", _rpcz)
 register_builtin("native", _native)
 register_builtin("overload", _overload)
+register_builtin("lm", _lm)

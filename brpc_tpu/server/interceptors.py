@@ -159,6 +159,149 @@ def compile_chain(server, entry, lane: str):
     return enter, settle
 
 
+def compile_rpc_chain(server, entry):
+    """The FULL tpu_std lane's binding of the interceptor chain —
+    ROADMAP item 1's FIFTH (and final) port: the classic fiber-task
+    dispatch path (``rpc_dispatch.process_rpc_request``) now binds the
+    same compiled stages the slim/HTTP/streaming lanes do, instead of
+    hand-replicating them.
+
+    ``enter(msg, sock, send)`` runs the cross-cutting prologue on a
+    parsed :class:`RpcMessage`: running check → admission → controller
+    construction (with the lane's ``send`` funnel as its completion
+    callback) → attachment split → ici domain/conn/descriptor staging
+    → shm negotiation → trace extract → deadline arm + shed.  Returns
+    a ready :class:`ServerController`, or ``None`` when the request
+    was rejected/shed — the client is already answered through the
+    classic ``_send_error`` builder and every taken count undone.
+
+    ``settle(cntl, response)`` is the accounting epilogue every
+    completion funnels through (the lane's ``send`` closure calls it
+    right before the wire serializer): MethodStatus settle + limiter
+    latency feed — including the trivial-shape slim escalation's
+    recorder-only variant, symmetric with the slim template's own
+    completion."""
+    status = entry.status
+    _EREQUEST = int(Errno.EREQUEST)
+
+    def enter(msg, sock, send,
+              _server=server, _entry=entry, _status=status,
+              _admit_stage=_admit, _shed=_maybe_shed,
+              _arm=_arm_deadline, _sample=start_server_span):
+        meta = msg.meta
+        cid = meta.correlation_id
+        if not _server.running:
+            _send_error(sock, cid, _ELOGOFF, "server is stopping",
+                        request_meta=meta)
+            return None
+        # ---- admission: the ONE shared overload-plane stage, FIRST —
+        # server cap, adaptive method cap, CoDel queue discipline,
+        # per-tenant fair admission; a rejected request is answered
+        # ELIMIT before auth/parse/handler burn any time on it
+        rej = _admit_stage(_server, _entry, "tpu_std", meta.tenant,
+                           getattr(msg, "recv_us", 0) or None)
+        if rej is not None:
+            # rejection serialization through the SHARED classic error
+            # builder (drain rejections carry the lame-duck TLV)
+            _send_error(sock, cid, rej.code, rej.text,
+                        request_meta=meta, server=_server)
+            return None
+        cntl = ServerController(meta, sock.remote_side, sock.id, send)
+        cntl.server = _server
+        try:
+            cntl.request_attachment = msg.split_attachment()
+        except ValueError as e:
+            _status.on_responded(_EREQUEST, 0)
+            _server.on_request_out(tenant=meta.tenant)
+            _send_error(sock, cid, _EREQUEST, str(e), request_meta=meta)
+            return None
+        if meta.ici_domain:
+            # learn the peer's device-fabric domain (enables device-
+            # resident response attachments from the very first exchange)
+            sock.ici_peer_domain = meta.ici_domain
+        if meta.ici_conn and sock.ici_conn_token is None:
+            # pin the initiator's connection nonce (first write wins):
+            # the conn identity descriptor binding uses on both ends
+            sock.ici_conn_token = meta.ici_conn
+        if meta.ici_desc:
+            from ..ici.endpoint import split_device_attachment
+            cntl.request_attachment, cntl.request_device_attachment = \
+                split_device_attachment(meta, cntl.request_attachment,
+                                        sock.id)
+        if meta.shm_offer or meta.shm_accept or meta.shm_release \
+                or meta.shm_desc:
+            # shm data plane: process ring negotiation/credit TLVs and
+            # resolve a request descriptor into a zero-copy view of the
+            # client's ring (the attachment never rode the frame)
+            from ..transport import shm_ring
+            view, handle, accept = \
+                shm_ring.server_on_request_meta(sock, meta)
+            cntl._shm_extra = accept
+            cntl._shm_handle = handle
+            if view is not None:
+                ab = IOBuf()
+                # file_ref lets this block spill via os.sendfile if user
+                # code forwards it onto a TCP byte lane (proxy shapes)
+                ab.append_user_data(view, file_ref=handle.file_ref)
+                cntl.request_attachment = ab
+            elif meta.shm_desc:
+                # the client believes the attachment lives at this
+                # descriptor; failing loudly beats handing user code an
+                # empty attachment
+                _status.on_responded(_EREQUEST, 0)
+                _server.on_request_out(tenant=meta.tenant)
+                _send_error(sock, cid, _EREQUEST,
+                            "unresolvable shm attachment descriptor",
+                            request_meta=meta)
+                return None
+        # ---- trace extract: sampled spans + forced spans for traced
+        # requests
+        span = _sample(_status.full_name, meta, sock.remote_side)
+        if span is not None:
+            span.request_size = len(msg.payload) \
+                + len(cntl.request_attachment)
+            cntl.span = span
+        # ---- deadline plane, AFTER admission (rejections are cheaper
+        # than armed deadlines), BEFORE user code: anchor TLV 13's
+        # remaining budget at the message's PARSE time (fiber-pool
+        # queueing between cut and dispatch counts against it), then
+        # shed doomed work.  An explicit on-wire 0 (clients stamp ≥ 1)
+        # means expired-at-arrival.
+        if meta.timeout_ms or getattr(meta, "timeout_present", False):
+            _arm(cntl, meta.timeout_ms,
+                 getattr(msg, "recv_us", 0) or None)
+            if _shed(cntl, "tpu_std", _status.full_name):
+                cntl.finish(None)
+                return None
+        return cntl
+
+    def settle(cntl, response,
+               _status=status, _server=server, _ns=_mono_ns):
+        """Accounting epilogue (every completion shape — sync return,
+        async finish, error escalation — funnels through here exactly
+        once, inside the lane's send closure): MethodStatus settle +
+        limiter latency feed."""
+        latency_us = _ns() // 1000 - cntl.begin_time_us
+        if cntl._slim_fast:
+            # trivial-shape slim fast item escalated to the classic
+            # completion: no admission layer is configured and its
+            # in-flight counts were never taken (net-zero within the
+            # burst; admitted verdicts flush per burst) — feed the
+            # per-method recorders only
+            cntl._slim_fast = False
+            if cntl.error_code == 0:
+                _status.latency << latency_us
+            else:
+                _status.errors << 1
+            return
+        _status.on_responded(cntl.error_code, latency_us)
+        _server.on_request_out(tenant=cntl.request_meta.tenant,
+                               error_code=cntl.error_code,
+                               latency_us=latency_us)
+
+    return enter, settle
+
+
 def compile_http_chain(server, entry):
     """The HTTP binding of the interceptor chain (ROADMAP item 1's
     third port): same stages, HTTP spellings — tenant from

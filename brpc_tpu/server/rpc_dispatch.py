@@ -10,21 +10,16 @@ from __future__ import annotations
 
 from typing import Any
 
-from time import monotonic_ns as _mono_ns
-
 from ..butil.flags import get_flag
 from ..butil.iobuf import IOBuf
 from ..butil.logging_util import LOG
 from ..butil.status import Errno
-from ..deadline import arm as _arm_deadline
-from ..deadline import inherit_deadline, maybe_shed
+from ..deadline import inherit_deadline
 from ..protocol import compress as compress_mod
 from ..protocol.meta import RpcMeta
 from ..protocol.tpu_std import RpcMessage, pack_frame, parse_payload, serialize_payload
-from ..rpcz import start_server_span
 from ..tools import rpc_dump as _rpc_dump
 from ..transport.socket import Socket
-from .admission import admit as _admit
 from .controller import ServerController
 
 
@@ -70,26 +65,31 @@ def _domain_tlv() -> bytes:
     return _domain_tlv_cache
 
 
+def _chain_for(server, entry):
+    """The entry's compiled tpu_std interceptor chain, built once per
+    (server, method) and cached on the entry (the import is lazy:
+    interceptors binds this module's error/wire builders at its top)."""
+    chain = entry.chain
+    if chain is None:
+        from .interceptors import compile_rpc_chain
+        chain = entry.chain = compile_rpc_chain(server, entry)
+    return chain
+
+
 def _send_response(server, entry, cntl: ServerController,
                    response: Any) -> None:
+    """Classic completion: the chain's accounting settle (MethodStatus
+    + limiter feed — including the slim escalation's recorder-only
+    variant) then the wire serializer.  Slim-lane escalations land
+    here directly; the full lane funnels through its own send closure,
+    which spells the same two halves."""
+    _chain_for(server, entry)[1](cntl, response)
+    _respond_wire(server, entry, cntl, response)
+
+
+def _respond_wire(server, entry, cntl: ServerController,
+                  response: Any) -> None:
     sock = Socket.address(cntl.socket_id)
-    latency_us = _mono_ns() // 1000 - cntl.begin_time_us
-    if cntl._slim_fast:
-        # trivial-shape slim fast item escalated here: no admission
-        # layer is configured and its in-flight counts were never taken
-        # (net-zero within the burst; admitted verdicts flush per burst)
-        # — feed the per-method recorders only, symmetric with the slim
-        # template's own completion
-        cntl._slim_fast = False
-        if cntl.error_code == 0:
-            entry.status.latency << latency_us
-        else:
-            entry.status.errors << 1
-    else:
-        entry.status.on_responded(cntl.error_code, latency_us)
-        server.on_request_out(tenant=cntl.request_meta.tenant,
-                              error_code=cntl.error_code,
-                              latency_us=latency_us)
     if cntl.request_device_attachment is not None:
         # invariant the client's sync fast lane relies on: the credit-
         # return for a request descriptor always PRECEDES the response
@@ -252,85 +252,24 @@ def process_rpc_request(msg: RpcMessage, sock: Socket, server) -> None:
                     f"unknown {meta.service_name}.{meta.method_name}",
                     request_meta=meta)
         return
-    if not server.running:
-        _send_error(sock, cid, Errno.ELOGOFF, "server is stopping",
-                    request_meta=meta)
-        return
-    # overload plane: the shared admission stage (server cap, adaptive
-    # method cap, CoDel queue discipline, per-tenant fair admission) —
-    # a rejected request is answered ELIMIT before auth/parse/handler
-    rej = _admit(server, entry, "tpu_std", meta.tenant,
-                 getattr(msg, "recv_us", 0) or None)
-    if rej is not None:
-        _send_error(sock, cid, rej.code, rej.text, request_meta=meta,
-                    server=server)
-        return
 
-    cntl = ServerController(
-        meta, sock.remote_side, sock.id,
-        send_response=lambda c, r: _send_response(server, entry, c, r))
-    cntl.server = server
-    try:
-        cntl.request_attachment = msg.split_attachment()
-    except ValueError as e:
-        entry.status.on_responded(int(Errno.EREQUEST), 0)
-        server.on_request_out(tenant=meta.tenant)
-        _send_error(sock, cid, Errno.EREQUEST, str(e), request_meta=meta)
-        return
-    if meta.ici_domain:
-        # learn the peer's device-fabric domain (enables device-resident
-        # response attachments from the very first exchange)
-        sock.ici_peer_domain = meta.ici_domain
-    if meta.ici_conn and sock.ici_conn_token is None:
-        # pin the initiator's connection nonce (first write wins): the
-        # conn identity descriptor binding uses on both ends
-        sock.ici_conn_token = meta.ici_conn
-    if meta.ici_desc:
-        from ..ici.endpoint import split_device_attachment
-        cntl.request_attachment, cntl.request_device_attachment = \
-            split_device_attachment(meta, cntl.request_attachment, sock.id)
-    if meta.shm_offer or meta.shm_accept or meta.shm_release \
-            or meta.shm_desc:
-        # shm data plane: process ring negotiation/credit TLVs and
-        # resolve a request descriptor into a zero-copy view of the
-        # client's ring (the attachment never rode the frame)
-        from ..transport import shm_ring
-        view, handle, accept = shm_ring.server_on_request_meta(sock, meta)
-        cntl._shm_extra = accept
-        cntl._shm_handle = handle
-        if view is not None:
-            ab = IOBuf()
-            # file_ref lets this block spill via os.sendfile if user
-            # code forwards it onto a TCP byte lane (proxy shapes)
-            ab.append_user_data(view, file_ref=handle.file_ref)
-            cntl.request_attachment = ab
-        elif meta.shm_desc:
-            # the client believes the attachment lives at this
-            # descriptor; failing loudly beats handing user code an
-            # empty attachment
-            entry.status.on_responded(int(Errno.EREQUEST), 0)
-            server.on_request_out(tenant=meta.tenant)
-            _send_error(sock, cid, Errno.EREQUEST,
-                        "unresolvable shm attachment descriptor",
-                        request_meta=meta)
-            return
-    cntl.span = start_server_span(entry.status.full_name, meta,
-                                  sock.remote_side)
-    if cntl.span is not None:
-        cntl.span.request_size = len(msg.payload) \
-            + len(cntl.request_attachment)
+    # the compiled interceptor chain (ROADMAP item 1's FIFTH binding):
+    # running check → admission → controller/attachment/ici/shm staging
+    # → trace extract → deadline arm+shed all live in the chain's enter;
+    # this lane body keeps only the protocol concerns (auth, user
+    # interceptor, decompress/parse, user code)
+    _enter, _settle = _chain_for(server, entry)
 
-    # deadline plane: anchor TLV 13's remaining budget at the message's
-    # PARSE time (fiber-pool queueing between cut and this dispatch
-    # counts against it), then shed doomed work — a request whose caller
-    # already gave up must not burn auth/parse/handler time.  An
-    # explicit on-wire 0 (clients stamp ≥ 1) means expired-at-arrival.
-    if meta.timeout_ms or getattr(meta, "timeout_present", False):
-        _arm_deadline(cntl, meta.timeout_ms,
-                      getattr(msg, "recv_us", 0) or None)
-        if maybe_shed(cntl, "tpu_std", entry.status.full_name):
-            cntl.finish(None)
-            return
+    def _send(cntl, response):
+        # completion funnel — every response shape (sync return, async
+        # finish, error escalation) settles through the chain exactly
+        # once, then serializes on the classic wire builder
+        _settle(cntl, response)
+        _respond_wire(server, entry, cntl, response)
+
+    cntl = _enter(msg, sock, _send)
+    if cntl is None:
+        return      # rejected/shed: the client is already answered
 
     # auth on first message of the connection (≈ Protocol::verify)
     auth = server.options.auth

@@ -270,7 +270,7 @@ class ServerOptions:
 
 class _MethodEntry:
     __slots__ = ("fn", "request_type", "status", "service", "method_name",
-                 "grpc_streaming", "raw_fn", "native_kind")
+                 "grpc_streaming", "raw_fn", "native_kind", "chain")
 
     def __init__(self, fn, request_type, status, service, method_name,
                  grpc_streaming=False, raw_fn=None, native_kind=None):
@@ -282,6 +282,7 @@ class _MethodEntry:
         self.method_name = method_name
         self.raw_fn = raw_fn     # bytes-in/bytes-out latency-lane handler
         self.native_kind = native_kind   # C++ semantic ("echo"/"const")
+        self.chain = None   # lazily-compiled tpu_std interceptor chain
 
 
 class Server:

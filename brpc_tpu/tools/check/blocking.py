@@ -118,6 +118,17 @@ ENTRY_POINTS: Tuple[Tuple[str, Tuple[str, ...]], ...] = (
      ("compile_http_slim_chain", "enter")),
     ("brpc_tpu/server/interceptors.py",
      ("compile_http_slim_chain", "settle")),
+    # serving observability (ISSUE 18): every write-side telemetry hook
+    # runs inside the batcher's step loop — a lock or sleep there is an
+    # ITL stall for the whole slot pool, so the write paths are plain
+    # GIL-atomic list/dict increments on the ONE batcher thread (the
+    # reader side, LmTelemetryCache, holds its snapshot lock off-loop
+    # and is deliberately NOT entry-listed)
+    ("brpc_tpu/models/lm_telemetry.py", ("record_phase",)),
+    ("brpc_tpu/models/lm_telemetry.py", ("on_emit",)),
+    ("brpc_tpu/models/lm_telemetry.py", ("open_timeline",)),
+    ("brpc_tpu/models/lm_telemetry.py", ("close_timeline",)),
+    ("brpc_tpu/models/lm_telemetry.py", ("count_slo",)),
 )
 
 # names whose call is a handoff, not an execution: arguments/targets

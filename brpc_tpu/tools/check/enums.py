@@ -165,6 +165,23 @@ def check_enums(tree: Tree) -> List[Finding]:
                         s = _str_const(e)
                         if s:
                             reason_names.append((s, f"{rel} (sched)"))
+        if rel.endswith("models/lm_telemetry.py"):
+            # the serving-observability plane's closed enums (step-loop
+            # phase names + SLO attainment verdicts): record_phase
+            # indexes the phase table and count_slo asserts verdict
+            # membership at runtime; every member needs a test anchor
+            # here — an unpinned phase or verdict is free to drift out
+            # of the /lm + Prometheus surface
+            for node in ast.walk(mod):
+                if isinstance(node, ast.Assign) \
+                        and isinstance(node.targets[0], ast.Name) \
+                        and node.targets[0].id in (
+                            "LM_STEP_PHASES", "LM_SLO_VERDICTS") \
+                        and isinstance(node.value, ast.Tuple):
+                    for e in node.value.elts:
+                        s = _str_const(e)
+                        if s:
+                            reason_names.append((s, f"{rel} (lm_obs)"))
         if rel.endswith("kv/pages.py"):
             # the paged-KV allocator's closed enums (eviction close
             # reasons + prefix-cache events): same pin discipline —

@@ -31,10 +31,24 @@ from .base import Finding, Tree, call_name
 # as each stage, and how a rejection must serialize
 LANES = (
     {
+        # full tpu_std lane — the FIFTH (final) interceptor-chain
+        # binding, completing ROADMAP item 1: admission → controller/
+        # attachment/ici/shm staging → trace extract → deadline
+        # arm+shed live in compile_rpc_chain; the lane body keeps only
+        # the protocol concerns (find_method, auth, user interceptor,
+        # decompress/parse, user code) and funnels every completion
+        # through the chain settle inside its send closure
         "lane": "tpu_std",
         "path": "brpc_tpu/server/rpc_dispatch.py",
         "func": ["process_rpc_request"],
         "reject": {"kind": "call", "names": {"_send_error"}},
+        "chain": {
+            "path": "brpc_tpu/server/interceptors.py",
+            "func": ["compile_rpc_chain", "enter"],
+            "settle_func": ["compile_rpc_chain", "settle"],
+            "entry_names": {"_enter", "enter"},
+            "settle_names": {"_settle", "settle"},
+        },
     },
     {
         # kind-3 slim lane — the SECOND interceptor-chain binding

@@ -66,6 +66,11 @@ WATCHED_RATIOS = (
     "slo_chunked_itl_gain",
     "slo_tier_victim_goodput",
     "spec_accept_rate",
+    # inference-plane observability (ISSUE 18): 1.0 when the serving
+    # telemetry's A/B overhead sits within the same-methodology
+    # control noise (the raw lm_telemetry_*_pct keys are recorded
+    # unscored — a pct next to an unknown noise floor gates nothing)
+    "lm_telemetry_within_noise",
 )
 
 # Recorded baselines for keys that predate any BENCH_r*.json capture —
@@ -130,6 +135,11 @@ RECORDED_BASELINE = {
     "spec_accept_rate": 1.0,
     "slo_tier_victim_ms": 588.2,
     "slo_tier_victim_goodput": 1.29,
+    # ISSUE 18 observability gate (session box, 2026-08): the step
+    # profiler + timelines are lock/alloc-free per sample by design,
+    # so the bar is the boolean "within the control noise floor", not
+    # an absolute pct (which would gate scheduler jitter, not code)
+    "lm_telemetry_within_noise": 1.0,
 }
 
 # keys pinned at EXACTLY zero: any non-zero value fails the gate

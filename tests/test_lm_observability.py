@@ -1,0 +1,499 @@
+"""Inference-plane observability (ISSUE 18): batcher step profiler,
+per-session token timelines, SLO attainment, the /lm portal, and the
+stitched decode-session rpcz trace.
+
+Five planes:
+
+- CLOSED ENUMS: ``LM_STEP_PHASES`` / ``LM_SLO_VERDICTS`` pinned
+  member-by-member (the static enum checker requires every name
+  anchored here); an unregistered verdict asserts loudly at the first
+  count;
+- PROFILER INVARIANTS: per-phase histogram mass equals the phase
+  count, counts are monotonic across sessions, and the decode-round
+  count equals the batcher's step counter exactly — the profiler is
+  wired to the loop, not near it;
+- SLO ATTAINMENT: per-tier verdict deltas against
+  ``TierRegistry.set_slo`` targets (ok / ttft-miss / itl-miss /
+  untargeted), judged at session close;
+- STITCHED TRACE: one traced ``LM.Decode`` through the disaggregated
+  prefill→decode handoff produces ONE trace id carrying both tiers'
+  session spans — chunk-slice on the prefill side, first-token on the
+  decode side — with no new wire format (the handoff RPC's ordinary
+  trace TLVs);
+- SURFACES: /lm + Prometheus exposition smoke, the
+  one-snapshot-per-interval cache pin, windowed-vs-lifetime ratio
+  semantics, bounded-ring eviction.
+"""
+
+import http.client
+import json
+import struct
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from brpc_tpu.client import Channel, Controller
+from brpc_tpu.models import lm_telemetry as lmt
+from brpc_tpu.models.lm_service import (ContinuousBatcher, LMService,
+                                        TierRegistry,
+                                        _reset_sched_for_tests,
+                                        pack_generate_request,
+                                        unpack_token)
+from brpc_tpu.models.transformer_lm import LMConfig, init_params
+from brpc_tpu.rpcz import global_span_store
+from brpc_tpu.server import Server
+from brpc_tpu.streaming import StreamOptions, stream_create
+
+# ---------------------------------------------------------------------------
+# Closed-enum pins (tools/check/enums.py requires every member of the
+# observability enums anchored under tests/ — this is the anchor)
+# ---------------------------------------------------------------------------
+
+LM_STEP_PHASE_PINS = (
+    "decode_round", "chunk_slice", "catchup_slice", "spec_draft",
+    "spec_verify", "prefix_lookup", "page_alloc", "host_spill",
+    "host_resume", "stream_emit",
+)
+LM_SLO_VERDICT_PINS = ("slo_ok", "slo_ttft_miss", "slo_itl_miss",
+                       "slo_untargeted")
+
+
+def test_lm_obs_enums_match_pins():
+    assert lmt.LM_STEP_PHASES == LM_STEP_PHASE_PINS
+    assert lmt.LM_SLO_VERDICTS == LM_SLO_VERDICT_PINS
+    assert set(lmt.phase_counters()) == set(LM_STEP_PHASE_PINS)
+    # the index constants ARE the write-side API: drift fails here
+    for i, name in enumerate(LM_STEP_PHASE_PINS):
+        assert getattr(lmt, "PH_" + name.upper()) == i
+        assert lmt.phase_index(name) == i
+    with pytest.raises(AssertionError):
+        lmt.phase_index("some_new_phase")
+    with pytest.raises(AssertionError):
+        lmt.count_slo("standard", "slo_some_new_verdict")
+    with pytest.raises(AssertionError):
+        lmt.count_slo("platinum", "slo_ok")
+
+
+def test_tier_registry_slo_targets():
+    reg = TierRegistry()
+    assert reg.slo_of("interactive") == (None, None)
+    reg.set_slo("interactive", ttft_ms=250.0, itl_ms=50.0)
+    reg.set_slo("batch", itl_ms=1000.0)
+    assert reg.slo_of("interactive") == (250.0, 50.0)
+    assert reg.slo_of("batch") == (None, 1000.0)
+    with pytest.raises(ValueError, match="unknown SLO tier"):
+        reg.set_slo("platinum", ttft_ms=1.0)
+
+
+# ---------------------------------------------------------------------------
+# Harness (the direct-batcher idiom from test_slo_sched)
+# ---------------------------------------------------------------------------
+
+def _setup(seed=0, **kw):
+    cfg = LMConfig(vocab=64, dim=32, heads=4, depth=2, max_seq=32,
+                   remat=False, **kw)
+    return cfg, init_params(jax.random.PRNGKey(seed), cfg)
+
+
+def _reset():
+    from brpc_tpu.kv import pages as kv_pages
+    from brpc_tpu.kv import transport as kv_transport
+    kv_pages._reset_for_tests()
+    kv_transport._reset_for_tests()
+    _reset_sched_for_tests()
+    lmt._reset_for_tests()
+
+
+class _FakeStream:
+    def __init__(self):
+        self.closed = False
+        self.close_reason = None
+        self.tokens = []
+        self.id = 0
+        self._native_tx = None
+        self.options = StreamOptions()
+
+    def write(self, data):
+        self.tokens.append(struct.unpack("<i", bytes(data))[0])
+        return 0
+
+    def close(self, reason=None):
+        self.closed = True
+        self.close_reason = reason
+
+
+def _join(bat, prompt, max_new, tenant=None):
+    st = _FakeStream()
+    bat.join(st, prompt, max_new, tenant=tenant)
+    return st
+
+
+def _finish(*streams, timeout=120.0):
+    deadline = time.monotonic() + timeout
+    while not all(s.closed for s in streams) \
+            and time.monotonic() < deadline:
+        time.sleep(0.002)
+    assert all(s.closed for s in streams), "decode session never closed"
+
+
+def _prompt(seed, n, vocab=64):
+    return np.asarray(jax.random.randint(jax.random.PRNGKey(seed),
+                                         (n,), 0, vocab, jnp.int32))
+
+
+# ---------------------------------------------------------------------------
+# Step profiler: histogram/count invariants, count == steps
+# ---------------------------------------------------------------------------
+
+def test_phase_profiler_invariants():
+    """Histogram mass == phase count for every phase; the decode-round
+    count equals the batcher's own step counter EXACTLY (the profiler
+    brackets the loop, one sample per round); counts are monotonic
+    across sessions; total_ns is consistent with the counts."""
+    _reset()
+    cfg, params = _setup()
+    bat = ContinuousBatcher(cfg, params, slots=2, paged=True, page=16,
+                            prefill_chunk_tokens=4)
+    st = _join(bat, _prompt(3, 17), 6)
+    _finish(st)
+    c1 = lmt.phase_counters()
+    assert c1["decode_round"] == bat.steps_run()
+    assert c1["chunk_slice"] >= 4                # ceil(16/4) slices
+    assert c1["prefix_lookup"] >= 1
+    assert c1["page_alloc"] >= 1
+    assert c1["stream_emit"] >= 1
+    for name in lmt.LM_STEP_PHASES:
+        hist = lmt.phase_histogram(name)
+        assert len(hist) == lmt.NBUCKETS
+        assert sum(hist) == c1[name], name
+        assert all(v >= 0 for v in hist)
+    totals = lmt.phase_total_ns()
+    assert totals["decode_round"] > 0
+    assert totals["host_spill"] == 0             # nothing spilled here
+    # monotonic across a second session, and still step-exact
+    st2 = _join(bat, _prompt(4, 9), 4)
+    _finish(st2)
+    c2 = lmt.phase_counters()
+    assert all(c2[p] >= c1[p] for p in lmt.LM_STEP_PHASES)
+    assert c2["decode_round"] == bat.steps_run()
+    assert sum(lmt.phase_histogram("decode_round")) \
+        == c2["decode_round"]
+
+
+def test_spec_round_phases_recorded():
+    _reset()
+    cfg, params = _setup()
+    bat = ContinuousBatcher(cfg, params, slots=2, paged=True, page=16,
+                            spec_decode_k=3, draft_params=params)
+    st = _join(bat, _prompt(4, 8), 6)
+    _finish(st)
+    c = lmt.phase_counters()
+    assert c["spec_draft"] >= 1
+    assert c["spec_verify"] >= 1
+    assert c["decode_round"] == bat.steps_run()
+
+
+def test_profiler_disable_flag_stops_sampling():
+    from brpc_tpu.butil.flags import set_flag
+    _reset()
+    cfg, params = _setup()
+    bat = ContinuousBatcher(cfg, params, slots=2)
+    assert set_flag("lm_telemetry", "false")
+    try:
+        assert not lmt.telemetry_enabled()
+        st = _join(bat, _prompt(5, 6), 3)
+        _finish(st)
+        assert lmt.phase_counters()["decode_round"] == 0
+        assert lmt.live_sessions() == [] and lmt.ring_len() == 0
+    finally:
+        assert set_flag("lm_telemetry", "true")
+    assert lmt.telemetry_enabled()
+
+
+# ---------------------------------------------------------------------------
+# SLO attainment: per-tier verdict deltas at session close
+# ---------------------------------------------------------------------------
+
+def test_slo_verdicts_per_tier():
+    _reset()
+    cfg, params = _setup()
+    reg = TierRegistry()
+    reg.set_tier(b"alice", "interactive")
+    reg.set_tier(b"bob", "batch")
+    # generous targets: a toy decode on CPU finishes well inside 10 min
+    reg.set_slo("interactive", ttft_ms=600_000.0, itl_ms=600_000.0)
+    # impossible targets: a negative bound no real session can meet
+    reg.set_slo("batch", ttft_ms=-1.0)
+    # the default tier ("standard") configures no targets
+    bat = ContinuousBatcher(cfg, params, slots=3, tiers=reg)
+    st_a = _join(bat, _prompt(6, 6), 3, tenant=b"alice")
+    st_b = _join(bat, _prompt(7, 6), 3, tenant=b"bob")
+    st_c = _join(bat, _prompt(8, 6), 3, tenant=b"carol")
+    _finish(st_a, st_b, st_c)
+    slo = lmt.slo_counters()
+    assert slo[("interactive", "slo_ok")] == 1
+    assert slo[("batch", "slo_ttft_miss")] == 1
+    assert slo[("standard", "slo_untargeted")] == 1
+    # itl-miss: ttft untargeted, itl target impossible — a session
+    # with a second token always exceeds it
+    reg.set_slo("batch", itl_ms=-1.0)
+    st_d = _join(bat, _prompt(9, 6), 3, tenant=b"bob")
+    _finish(st_d)
+    assert lmt.slo_counters()[("batch", "slo_itl_miss")] == 1
+    # the finished sessions moved into the ring with their verdicts
+    recs = lmt.timeline_records()
+    assert len(recs) == 4 and lmt.live_sessions() == []
+    by_tier = {r["tier"]: r for r in recs}
+    assert by_tier["interactive"]["verdict"] == "slo_ok"
+    assert by_tier["standard"]["verdict"] == "slo_untargeted"
+    assert all(r["close_reason"] == "finished" for r in recs)
+    assert all(r["tokens"] == 3 for r in recs)
+    assert by_tier["interactive"]["ttft_ms"] is not None
+
+
+def test_timeline_ring_bounded():
+    _reset()
+    lmt._reset_for_tests(ring=4)
+    try:
+        seqs = []
+        for i in range(6):
+            tl = lmt.open_timeline("standard", f"t{i}", 8, 2, "fresh")
+            seqs.append(tl.seq)
+            lmt.close_timeline(tl, "finished")
+        assert lmt.ring_len() == 4 and lmt.ring_maxlen() == 4
+        kept = [r["seq"] for r in lmt.timeline_records()]
+        assert kept == seqs[-4:]             # oldest two evicted
+        assert lmt.live_sessions() == []
+    finally:
+        lmt._reset_for_tests()
+
+
+# ---------------------------------------------------------------------------
+# Snapshot cache: one build per interval; windowed vs lifetime ratios
+# ---------------------------------------------------------------------------
+
+def test_one_snapshot_per_interval():
+    _reset()
+    cache = lmt.LmTelemetryCache(ttl_s=60.0)
+    for _ in range(25):
+        cache.get()
+        cache.window()
+    assert cache.builds == 1
+
+
+def test_windowed_ratios_reflect_current_window():
+    """Lifetime counters carry history; the windowed ratios are deltas
+    between consecutive snapshots — stale history cannot dilute them."""
+    from brpc_tpu.models.lm_service import count_spec
+    _reset()
+    # seed old history: 9 accepts, 1 reject (lifetime rate 0.9)
+    for _ in range(9):
+        count_spec("spec_accept")
+    count_spec("spec_reject")
+    assert lmt.lifetime_spec_accept_rate() == pytest.approx(0.9)
+    cache = lmt.LmTelemetryCache(ttl_s=0.0)      # every call refreshes
+    cache.get()                                  # baseline snapshot
+    # the current window: 1 accept, 3 rejects
+    count_spec("spec_accept")
+    for _ in range(3):
+        count_spec("spec_reject")
+    assert lmt.windowed_spec_accept_rate(cache) == pytest.approx(0.25)
+    # lifetime is untouched by the windowing
+    assert lmt.lifetime_spec_accept_rate() == pytest.approx(10 / 14)
+
+
+def test_windowed_prefix_ratio():
+    from brpc_tpu.kv.pages import count_prefix
+    _reset()
+    count_prefix("prefix_miss")                  # history
+    cache = lmt.LmTelemetryCache(ttl_s=0.0)
+    cache.get()
+    count_prefix("prefix_hit")
+    count_prefix("prefix_partial_hit")
+    count_prefix("prefix_miss")
+    count_prefix("prefix_hit")
+    assert lmt.windowed_prefix_hit_ratio(cache) == pytest.approx(0.75)
+
+
+# ---------------------------------------------------------------------------
+# Stitched disagg trace: ONE trace id across prefill + decode tiers
+# ---------------------------------------------------------------------------
+
+def _stream_decode_traced(srv, prompt, max_new, trace_id,
+                          timeout=120.0):
+    toks, closed = [], []
+
+    def on_received(st, msgs):
+        toks.extend(unpack_token(m) for m in msgs)
+
+    ch = Channel()
+    ch.init(str(srv.listen_endpoint))
+    cntl = Controller()
+    cntl.timeout_ms = int(timeout * 1000)
+    cntl.trace_id = trace_id
+    stream_create(cntl, StreamOptions(
+        on_received=on_received,
+        on_closed=lambda st: closed.append(st.close_reason)))
+    c = ch.call_method("LM.Decode",
+                       pack_generate_request(prompt, max_new),
+                       cntl=cntl)
+    assert not c.failed, (c.error_code, c.error_text)
+    deadline = time.monotonic() + timeout
+    while not closed and time.monotonic() < deadline:
+        time.sleep(0.005)
+    assert closed, "decode stream never closed"
+    return toks, closed[0]
+
+
+def _spans_by_method(trace_id, want, timeout=10.0):
+    """The decode-tier session span finishes on the batcher thread at
+    evict — poll briefly so the assert races nothing."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        spans = global_span_store().by_trace(trace_id)
+        have = {s.full_method for s in spans}
+        if want <= have:
+            return {m: [s for s in spans if s.full_method == m]
+                    for m in have}
+        time.sleep(0.01)
+    raise AssertionError(
+        f"trace {trace_id:x} never collected {want - have}; "
+        f"has {sorted(have)}")
+
+
+def test_disagg_decode_session_trace_stitched():
+    """The acceptance pin: a single traced LM.Decode through the
+    disaggregated prefill→decode handoff yields ONE trace id holding
+    both tiers' session spans — the prefill side's chunk-slice and
+    handoff events, the decode side's first-token and evict events —
+    parented to their tiers' server spans.  The trace context crossed
+    tiers on the handoff RPC's EXISTING trace TLVs (no new wire
+    format)."""
+    from test_kv_disagg import _setup as _kv_setup
+    from test_kv_disagg import _two_tier
+    _reset()
+    global_span_store().clear()
+    cfg, params, prompt = _kv_setup()
+    trace_id = 0x1517_0018
+    pre_srv, dec_srv, dec_lm, _pre, _dch = _two_tier(cfg, params)
+    try:
+        toks, reason = _stream_decode_traced(pre_srv, prompt, 6,
+                                             trace_id)
+        assert reason == "finished" and len(toks) == 6
+        by = _spans_by_method(trace_id, {
+            "LMService.DecodeSession", "KV.DecodeTierSession",
+            "LM.Decode", "KV.ImportSession"})
+        # prefill tier: the session span parents to the Decode server
+        # span and carries the join/chunk-slice/handoff events
+        (pre_sess,) = by["LMService.DecodeSession"]
+        dec_server = [s for s in by["LM.Decode"] if s.is_server]
+        assert pre_sess.parent_span_id in {s.span_id
+                                           for s in dec_server}
+        pre_notes = [t for _, t in pre_sess.annotations]
+        assert pre_notes[0] == "lm_join"
+        assert "lm_chunk_slice" in pre_notes
+        assert pre_notes[-1] == "lm_handoff"
+        # decode tier: the session span parents to the ImportSession
+        # server span (which is forced under the SAME trace id because
+        # the handoff controller carried it) and sees the first token
+        (dec_sess,) = by["KV.DecodeTierSession"]
+        imp_server = [s for s in by["KV.ImportSession"] if s.is_server]
+        assert dec_sess.parent_span_id in {s.span_id
+                                           for s in imp_server}
+        dec_notes = [t for _, t in dec_sess.annotations]
+        assert "lm_first_token" in dec_notes
+        assert dec_notes[-1] == "lm_evict:finished"
+        assert dec_sess.trace_id == pre_sess.trace_id == trace_id
+    finally:
+        pre_srv.stop()
+        dec_srv.stop()
+        global_span_store().clear()
+
+
+def test_monolithic_decode_session_span():
+    """Single-tier shape: a traced Decode gets one session span with
+    join → first-token → evict, child of the Decode server span."""
+    _reset()
+    global_span_store().clear()
+    cfg, params = _setup()
+    lm = LMService(cfg=cfg, params=params, decode_slots=2)
+    srv = Server()
+    srv.add_service(lm, name="LM")
+    assert srv.start("127.0.0.1:0") == 0
+    try:
+        trace_id = 0xA11CE
+        toks, reason = _stream_decode_traced(
+            srv, _prompt(2, 8)[None, :], 4, trace_id)
+        assert reason == "finished" and len(toks) == 4
+        by = _spans_by_method(trace_id, {"LMService.DecodeSession",
+                                         "LM.Decode"})
+        (sess,) = by["LMService.DecodeSession"]
+        notes = [t for _, t in sess.annotations]
+        assert notes[0] == "lm_join"
+        assert "lm_first_token" in notes
+        assert notes[-1] == "lm_evict:finished"
+        server_ids = {s.span_id for s in by["LM.Decode"]
+                      if s.is_server}
+        assert sess.parent_span_id in server_ids
+    finally:
+        srv.stop()
+        global_span_store().clear()
+
+
+# ---------------------------------------------------------------------------
+# Surfaces: /lm portal page + Prometheus exposition
+# ---------------------------------------------------------------------------
+
+def _http_get(ep, path):
+    conn = http.client.HTTPConnection(ep.host, ep.port, timeout=10)
+    conn.request("GET", path)
+    r = conn.getresponse()
+    body = r.read()
+    conn.close()
+    return r.status, body
+
+
+def test_lm_portal_and_metrics_exposition():
+    _reset()
+    cfg, params = _setup()
+    lm = LMService(cfg=cfg, params=params, decode_slots=2)
+    srv = Server()
+    srv.add_service(lm, name="LM")
+    assert srv.start("127.0.0.1:0") == 0
+    try:
+        st = _FakeStream()
+        lm.batcher().join(st, _prompt(2, 8), 4)
+        _finish(st)
+        ep = srv.listen_endpoint
+        status, body = _http_get(ep, "/lm")
+        assert status == 200
+        page = json.loads(body)
+        assert page["enabled"] is True
+        assert page["phases"]["decode_round"]["count"] \
+            == lm.batcher().steps_run()
+        assert page["phases"]["decode_round"]["buckets_ns"]
+        recent = page["recent_sessions"]
+        assert len(recent) == 1 and recent[0]["tokens"] == 4
+        assert recent[0]["verdict"] == "slo_untargeted"
+        assert page["live_sessions"] == []
+        assert "spec_accept_rate" in page["windowed"]
+        assert "prefix_cache_hit_ratio" in page["windowed"]
+        assert page["lifetime"]["spec_accept_rate"] == 0.0
+        assert page["timeline_ring"]["len"] == 1
+        assert page["kv"]["phases"]["decode_round"] \
+            == lm.batcher().steps_run()
+        # the same counters ride the Prometheus exposition
+        status, body = _http_get(ep, "/metrics")
+        assert status == 200
+        text = body.decode()
+        assert 'lm_step_phase_total{phase="decode_round"}' in text
+        assert 'lm_slo_attained_total{tier="standard",' \
+            'verdict="slo_untargeted"}' in text
+        assert 'lm_ttft_ms{tier="standard",quantile="p50"}' in text
+        assert 'lm_windowed{ratio="spec_accept_rate"}' in text
+        assert 'lm_step_phase_ns{phase="decode_round",bin=' in text
+    finally:
+        srv.stop()

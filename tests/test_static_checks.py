@@ -437,6 +437,37 @@ def test_drift_http_slim_chain_binding_dropped():
                for f in findings), findings
 
 
+def test_drift_unregistered_slo_verdict():
+    """A new SLO verdict grown into the closed enum without a test pin
+    anywhere under tests/ (the name is assembled at runtime so this
+    file itself never anchors it) — the observability surface would
+    silently widen past what anything asserts on."""
+    LM_TEL = "brpc_tpu/models/lm_telemetry.py"
+    unpinned = "slo_nobody_" + "anchored"
+    ov = _mutate(LM_TEL, '"slo_untargeted",',
+                 f'"slo_untargeted", "{unpinned}",')
+    findings = check_enums(Tree(overrides=ov))
+    assert any(unpinned in f.message for f in findings), findings
+
+
+def test_drift_lock_in_step_loop_profiler():
+    """A lock acquisition seeded into the per-sample profiler write
+    path (record_phase runs inside every batcher decode round) must be
+    caught by the step-loop entry points — the ZERO-locks hot-path
+    contract is linter-enforced, not reviewed-by-hope."""
+    LM_TEL = "brpc_tpu/models/lm_telemetry.py"
+    ov = _mutate(LM_TEL, "    _phase_buckets[idx][b] += 1",
+                 "    _obs_lock.acquire()\n"
+                 "    _phase_buckets[idx][b] += 1")
+    ov[LM_TEL] = ov[LM_TEL].replace(
+        '_live = [bool(get_flag("lm_telemetry", True))]',
+        "_obs_lock = threading.Lock()\n"
+        '_live = [bool(get_flag("lm_telemetry", True))]', 1)
+    findings = check_blocking(Tree(overrides=ov))
+    assert any("record_phase" in f.message and "acquire" in f.message
+               for f in findings), findings
+
+
 def test_allow_marker_suppresses():
     """The reviewed-exception escape hatch works (and is line-scoped)."""
     ov = _mutate(
