@@ -51,9 +51,10 @@ class _NodeBreaker:
         self.last_trip = 0.0
         self.lock = threading.Lock()
 
-    def on_call(self, error) -> None:
+    def on_call(self, error) -> bool:
         """``error``: bool, or a float error weight in [0, 1] (the
-        overload plane feeds ELIMIT bounces at reduced weight)."""
+        overload plane feeds ELIMIT bounces at reduced weight).
+        Returns True when THIS call tripped isolation."""
         e = float(error)
         with self.lock:
             self.samples += 1
@@ -77,6 +78,8 @@ class _NodeBreaker:
                 self.short_ema = 0.0
                 self.long_ema = 0.0
                 self.samples = 0
+                return True
+        return False
 
     def isolated(self) -> bool:
         return time.monotonic() < self.isolated_until
@@ -105,7 +108,13 @@ class CircuitBreakerMap:
             e = _ELIMIT_WEIGHT      # busy, not broken: reduced weight
         else:
             e = 1.0
-        self._node(ep).on_call(e)
+        if self._node(ep).on_call(e):
+            # a trip is a fleet-postmortem event: which peer, when
+            try:
+                from .. import fleet
+                fleet.record_event("fleet_breaker_trip", str(ep))
+            except Exception:
+                pass
 
     def isolated(self, ep: EndPoint) -> bool:
         if not self.enabled:

@@ -67,7 +67,17 @@ class DecodeTierService(Service):
         return "KV"
 
     def Probe(self, cntl, request):
-        return encode_probe_response()
+        # capability answer + the fleet load-report tail (versioned,
+        # ignored by pre-fleet probers): the prefill tier's admission /
+        # LB side reads live slot availability from the same handshake
+        # it already makes before moving a byte
+        try:
+            from .. import fleet
+            report = fleet.report_cache().get(getattr(cntl, "server",
+                                                      None))
+        except Exception:
+            report = None
+        return encode_probe_response(report=report)
 
     def ImportSession(self, cntl, request):
         from time import monotonic_ns
@@ -228,6 +238,12 @@ class PrefillService(LMService):
                                          tenant=tenant, span=span)
             return struct.pack("<I", max_new)
         stream.close(reason="kv_handoff_failed")
+        try:
+            from .. import fleet
+            fleet.record_event("fleet_kv_handoff_failed",
+                               str(res.reason))
+        except Exception:
+            pass
         if span is not None:
             span.annotate("lm_evict:kv_handoff_failed")
             span.finish(int(Errno.EINTERNAL))

@@ -109,6 +109,11 @@ def count_evict(reason: str) -> None:
     assert reason in _evicts, f"unnamed kv evict reason {reason!r}"
     with _evict_lock:
         _evicts[reason] += 1
+    try:
+        from .. import fleet
+        fleet.record_event("fleet_kv_evict", reason)
+    except Exception:
+        pass
 
 
 def count_prefix(event: str) -> None:

@@ -29,6 +29,7 @@ request.  Only the page PAYLOAD is special-cased off the message path.
 from __future__ import annotations
 
 import hashlib
+import json
 import os
 import struct
 import threading
@@ -199,18 +200,29 @@ def decode_manifest(data: bytes) -> SessionManifest:
                            fp, descs)
 
 
-def encode_probe_response() -> bytes:
+def encode_probe_response(report: Optional[dict] = None) -> bytes:
     """The decode tier's capability answer: fabric domain token, host
     token, shm availability — everything the sender needs to pick the
-    cheapest lane BEFORE moving a byte."""
+    cheapest lane BEFORE moving a byte.
+
+    When ``report`` is given (a ``fleet.build_load_report`` dict), a
+    versioned load-report tail is APPENDED after the capability
+    fields: ``<I len> + json``.  Old decoders stop at the shm byte and
+    never look at trailing bytes, so the extension is wire-compatible
+    in both directions (old server → new client: no tail, report is
+    None; new server → old client: tail ignored)."""
     from ..ici.fabric import local_domain_id
     from ..transport import shm_ring
     dom = local_domain_id()
     host = shm_ring._host_token()
-    return (_PROBE_MAGIC
-            + struct.pack("<H", len(dom)) + dom
-            + struct.pack("<H", len(host)) + host
-            + struct.pack("<B", 1 if shm_ring.lane_enabled() else 0))
+    out = (_PROBE_MAGIC
+           + struct.pack("<H", len(dom)) + dom
+           + struct.pack("<H", len(host)) + host
+           + struct.pack("<B", 1 if shm_ring.lane_enabled() else 0))
+    if report is not None:
+        blob = json.dumps(report, default=str).encode("utf-8")
+        out += struct.pack("<I", len(blob)) + blob
+    return out
 
 
 def decode_probe_response(data: bytes):
@@ -229,6 +241,29 @@ def decode_probe_response(data: bytes):
         (shm_ok,) = struct.unpack_from("<B", data, off)
         return dom, host, bool(shm_ok)
     except struct.error:
+        return None
+
+
+def decode_probe_report(data: bytes) -> Optional[dict]:
+    """The versioned load-report tail of a KV.Probe response, or None
+    (pre-fleet peer / no tail / malformed tail).  Capability parsing
+    above is unaffected either way."""
+    try:
+        if data[:4] != _PROBE_MAGIC:
+            return None
+        (dl,) = struct.unpack_from("<H", data, 4)
+        off = 6 + dl
+        (hl,) = struct.unpack_from("<H", data, off)
+        off += 2 + hl + 1                      # host + shm byte
+        if off + 4 > len(data):
+            return None
+        (rl,) = struct.unpack_from("<I", data, off)
+        off += 4
+        if rl == 0 or off + rl > len(data):
+            return None
+        report = json.loads(data[off:off + rl].decode("utf-8"))
+        return report if isinstance(report, dict) else None
+    except (struct.error, ValueError, UnicodeDecodeError):
         return None
 
 

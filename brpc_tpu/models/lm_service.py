@@ -917,6 +917,12 @@ class ContinuousBatcher:
         sess.slot = -1
         self._parked.append(sess)
         self.spills += 1
+        try:
+            from .. import fleet
+            fleet.record_event("fleet_host_spill",
+                               f"tier={getattr(sess, 'tier', '?')}")
+        except Exception:
+            pass
         if sess.tl is not None:
             sess.tl.spills += 1
         if sess.span is not None:

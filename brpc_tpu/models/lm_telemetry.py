@@ -496,6 +496,21 @@ def windowed_prefix_hit_ratio(cache=None) -> float:
     return (hit + part) / denom if denom > 0 else 0.0
 
 
+def windowed_slo_deltas(cache=None) -> dict:
+    """Per-tier SLO attainment DELTAS over the last snapshot window,
+    as ``{tier: {verdict: count}}`` — the fleet load report's answer
+    to 'how is this node attaining NOW' (lifetime counters drift
+    toward their historical mean and stop moving under incidents)."""
+    prev, cur, _dt = (cache or telemetry_cache()).window()
+    p = prev["slo"] if prev is not None else None
+    out: dict = {}
+    for (tier, verdict), n in cur["slo"].items():
+        d = n - p.get((tier, verdict), 0) if p is not None else n
+        if d:
+            out.setdefault(tier, {})[verdict] = d
+    return out
+
+
 def lifetime_spec_accept_rate() -> float:
     """The cumulative ratio (perf_guard continuity — the windowed
     variant above is what /vars shows)."""

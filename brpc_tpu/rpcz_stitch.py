@@ -71,12 +71,52 @@ def fetch_remote_spans(remote: str, trace_id: int,
         conn.close()
 
 
+def locate_trace_root(fleet: str, trace_id: int,
+                      timeout_s: float = 2.0) -> List[str]:
+    """Ask a fleet registry host which member(s) report the ROOT span
+    of ``trace_id`` (the /fleet trace index, fed by every member's
+    load report).  Before this, a stitch could only BFS from a process
+    that already held part of the trace — now any process can start
+    from the registry and land on the root holder directly.  Raises on
+    transport errors; returns [] when no member claims the root (TTL'd
+    out of the members' bounded root lists, or never traced)."""
+    import http.client
+    host, _, port = str(fleet).rpartition(":")
+    conn = http.client.HTTPConnection(host, int(port), timeout=timeout_s)
+    try:
+        conn.request("GET", f"/fleet?trace_id={trace_id:x}")
+        resp = conn.getresponse()
+        data = resp.read()
+        if resp.status != 200:
+            raise RuntimeError(f"/fleet on {fleet}: HTTP {resp.status}")
+        return list(json.loads(data.decode("utf-8",
+                                           "replace")).get("owners", []))
+    finally:
+        conn.close()
+
+
+def collect_trace_via_fleet(fleet: str, trace_id: int,
+                            **kwargs) -> Dict:
+    """Fleet-seeded stitch: locate the root-holding member(s) through
+    the registry's trace index, then run :func:`collect_trace` with
+    those instances pre-seeded on the BFS frontier (the local store
+    still contributes whatever it holds).  A dead or index-less
+    registry degrades to the plain local-seeded walk."""
+    try:
+        seeds = locate_trace_root(fleet, trace_id,
+                                  timeout_s=kwargs.get("timeout_s", 2.0))
+    except Exception as e:
+        LOG.warning("rpcz stitch: fleet index %s failed: %s", fleet, e)
+        seeds = []
+    return collect_trace(trace_id, seed_remotes=seeds, **kwargs)
+
+
 def collect_trace(trace_id: int, limit: int = 512,
                   max_hops: int = DEFAULT_MAX_HOPS,
                   timeout_s: float = 2.0,
                   budget_s: float = DEFAULT_BUDGET_S,
                   fetch: Callable = fetch_remote_spans,
-                  skip=()) -> Dict:
+                  skip=(), seed_remotes=()) -> Dict:
     """Stitch one trace across processes.
 
     Returns ``{"spans": [describe-dicts + "source"], "remotes":
@@ -92,7 +132,12 @@ def collect_trace(trace_id: int, limit: int = 512,
     the /rpcz handler passes its own listen address so a stitch
     launched from inside a traced process never RPCs itself (on a
     single-loop inline server that self-call would wait out its own
-    timeout: the handler occupies the loop the fetch needs)."""
+    timeout: the handler occupies the loop the fetch needs).
+
+    ``seed_remotes``: addresses to place on the BFS frontier BEFORE
+    any local client span is followed — the fleet trace index's way of
+    starting the walk at the root-holding process
+    (:func:`collect_trace_via_fleet`)."""
     spans: Dict[int, Dict] = {}
 
     def _ingest(records, source: str) -> List[str]:
@@ -108,7 +153,8 @@ def collect_trace(trace_id: int, limit: int = 512,
                 new_remotes.append(rec["remote"])
         return new_remotes
 
-    frontier = _ingest(
+    frontier = list(seed_remotes)
+    frontier += _ingest(
         [s.describe() for s in
          global_span_store().by_trace(trace_id, limit)], "local")
     visited = set(str(a) for a in skip)

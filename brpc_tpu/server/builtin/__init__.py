@@ -154,6 +154,15 @@ def _vars(server, msg, rest):
 
 
 def _metrics(server, msg, rest):
+    if msg.query().get("fleet") == "1":
+        # federation view: every live member's families merged under
+        # an instance label (registry hosts only; one scrape sweep per
+        # interval — the cache inside federate())
+        from ... import fleet as fleet_mod
+        reg = fleet_mod.registry_of(server)
+        if reg is None:
+            return 404, "text/plain", "no fleet registry on this server\n"
+        return 200, "text/plain; version=0.0.4", reg.federate()
     return 200, "text/plain; version=0.0.4", render_prometheus()
 
 
@@ -778,6 +787,56 @@ def _trackme(server, msg, rest):
             json.dumps(handle_trackme_query(ver)))
 
 
+def _fleet(server, msg, rest):
+    """/fleet — the fleet observability portal (ISSUE 19).
+
+    Query modes:
+      (none) / ?format=json   on a registry host: member table (state =
+                              ok/draining/stale/seeded, report age,
+                              slots/kv/slo/busy from the newest load
+                              report), fleet SLO rollups + top-k
+                              outliers, and the merged flight-recorder
+                              timeline; on a plain member: this node's
+                              own report + local event ring
+      ?self=1                 this node's own load report (the
+                              pull-on-demand path — same build the
+                              KV.Probe tail and the cadence push share)
+      ?trace_id=HEX           trace-index lookup: which member(s)
+                              report the ROOT span of this trace
+                              (rpcz_stitch seeds its BFS there)
+    """
+    from ... import fleet as fleet_mod
+    q = msg.query()
+    if q.get("self") == "1":
+        report = fleet_mod.report_cache().get(server)
+        return (200, "application/json",
+                json.dumps(report, default=str, indent=1))
+    reg = fleet_mod.registry_of(server)
+    if "trace_id" in q:
+        if reg is None:
+            return 404, "text/plain", "no fleet registry on this server\n"
+        tid = q["trace_id"].lower()
+        return (200, "application/json",
+                json.dumps({"trace_id": tid,
+                            "owners": reg.trace_owners(tid)}))
+    if reg is None:
+        body = {"registry": False,
+                "self": fleet_mod.report_cache().get(server),
+                "events": fleet_mod.recent_events(64)}
+        return (200, "application/json",
+                json.dumps(body, default=str, indent=1))
+    body = {
+        "registry": True,
+        "ttl_s": reg.ttl_s,
+        "members": reg.members(),
+        "rollups": reg.rollups(),
+        "timeline": reg.timeline(128),
+        "trace_index": reg.trace_index(),
+    }
+    return (200, "application/json",
+            json.dumps(body, default=str, indent=1))
+
+
 register_builtin("trackme", _trackme)
 register_builtin("sockets", _sockets)
 register_builtin("threads", _threads)
@@ -801,3 +860,4 @@ register_builtin("rpcz", _rpcz)
 register_builtin("native", _native)
 register_builtin("overload", _overload)
 register_builtin("lm", _lm)
+register_builtin("fleet", _fleet)

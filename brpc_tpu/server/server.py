@@ -682,6 +682,8 @@ class Server:
         self._stopped_event.clear()
         from ..bvar.dump import ensure_dumper
         ensure_dumper()     # no-op unless the bvar_dump flag is on
+        from .. import fleet as _fleet
+        _fleet.on_server_start(self)    # flight recorder: restart event
         LOG.info("Server started at %s (%d services, %d methods)",
                  self._listen_endpoint, len(self._services),
                  len(self._methods))
@@ -838,6 +840,12 @@ class Server:
         self._drain_deadline_mono = deadline
         self._drain_state = DRAIN_DRAINING
         self.unpublish()
+        # fleet visibility within ONE report interval: the drain +
+        # lame-duck flight-recorder events, a final report that says
+        # "draining", and an explicit registry deregister (bounded 1s
+        # RPCs inside fleet — the grace budget is not spent here)
+        from .. import fleet as _fleet
+        _fleet.on_server_drain(self)
         if self._acceptor is not None:
             self._acceptor.pause_accept()
         if self._native_bridge is not None:
@@ -888,6 +896,8 @@ class Server:
         self._started = False
         self._drain_state = DRAIN_STOPPED
         self.unpublish()
+        from .. import fleet as _fleet
+        _fleet.on_server_stop(self)     # flight recorder + reporter reap
         if self._acceptor is not None:
             self._acceptor.stop_accept()
         if self._native_bridge is not None:

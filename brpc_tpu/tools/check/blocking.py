@@ -129,6 +129,13 @@ ENTRY_POINTS: Tuple[Tuple[str, Tuple[str, ...]], ...] = (
     ("brpc_tpu/models/lm_telemetry.py", ("open_timeline",)),
     ("brpc_tpu/models/lm_telemetry.py", ("close_timeline",)),
     ("brpc_tpu/models/lm_telemetry.py", ("count_slo",)),
+    # fleet observability: the flight-recorder write path runs inside
+    # Server.drain and the KV evict/spill paths, and the report builder
+    # runs inside the KV.Probe handler — neither may ever grow a sleep,
+    # an untimed wait, or socket work (cadence + transport live in
+    # FleetReporter, which is a plain daemon thread)
+    ("brpc_tpu/fleet.py", ("record_event",)),
+    ("brpc_tpu/fleet.py", ("build_load_report",)),
 )
 
 # names whose call is a handoff, not an execution: arguments/targets

@@ -197,6 +197,20 @@ def check_enums(tree: Tree) -> List[Finding]:
                         s = _str_const(e)
                         if s:
                             reason_names.append((s, f"{rel} (kv)"))
+        if rel.endswith("brpc_tpu/fleet.py"):
+            # the fleet flight recorder's closed event enum:
+            # record_event asserts membership at runtime, and every
+            # member needs a test anchor here — an unpinned event would
+            # silently vanish from the /fleet postmortem timeline
+            for node in ast.walk(mod):
+                if isinstance(node, ast.Assign) \
+                        and isinstance(node.targets[0], ast.Name) \
+                        and node.targets[0].id in ("FLEET_EVENTS",) \
+                        and isinstance(node.value, ast.Tuple):
+                    for e in node.value.elts:
+                        s = _str_const(e)
+                        if s:
+                            reason_names.append((s, f"{rel} (fleet)"))
     seen: Set[str] = set()
     for name, origin in reason_names:
         if name in seen:

@@ -71,6 +71,11 @@ WATCHED_RATIOS = (
     # control noise (the raw lm_telemetry_*_pct keys are recorded
     # unscored — a pct next to an unknown noise floor gates nothing)
     "lm_telemetry_within_noise",
+    # fleet observability (ISSUE 19): same shape as the serving-
+    # telemetry gate one line up — the serving path pays a flag read
+    # and a deque append, so the bar is "A/B median inside the
+    # zero-effect control envelope", not an absolute pct
+    "fleet_obs_within_noise",
 )
 
 # Recorded baselines for keys that predate any BENCH_r*.json capture —
@@ -140,6 +145,13 @@ RECORDED_BASELINE = {
     # so the bar is the boolean "within the control noise floor", not
     # an absolute pct (which would gate scheduler jitter, not code)
     "lm_telemetry_within_noise": 1.0,
+    # ISSUE 19 fleet observability (session box, 2026-08): one report
+    # push → visible on the registry's /fleet page over HTTP, end to
+    # end (RPC ingest + page render + one poll round-trip).  Recorded
+    # at the worse of two runs (11.6 / 19.4ms — the poll loop re-renders
+    # the whole fleet page per probe, so this is an upper bound)
+    "fleet_report_p99_ms": 19.4,
+    "fleet_obs_within_noise": 1.0,
 }
 
 # keys pinned at EXACTLY zero: any non-zero value fails the gate

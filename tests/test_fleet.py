@@ -1,0 +1,485 @@
+"""Fleet observability plane tests (ISSUE 19): closed FLEET_EVENTS
+pins, the flight-recorder ring, the one-build-per-interval report
+cache, registry TTL/drain semantics, the /fleet portal +
+/metrics?fleet=1 federation, the KV.Probe load-report tail, the fleet
+trace index, and the 3-process soak (register / kill -9 → stale /
+drain → draining)."""
+
+import json
+import http.client
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from brpc_tpu import fleet
+from brpc_tpu.server import Server, Service
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _fresh_fleet():
+    fleet._reset_for_tests()
+    yield
+    fleet._reset_for_tests()
+
+
+# ---------------------------------------------------------------------------
+# Flight recorder: closed enum + bounded ring
+# ---------------------------------------------------------------------------
+
+# one literal pin per FLEET_EVENTS member (tools/check/enums.py scans
+# this file's text for every name — keep them spelled out)
+FLEET_EVENT_PINS = (
+    "fleet_restart",
+    "fleet_drain",
+    "fleet_lame_duck",
+    "fleet_stop",
+    "fleet_register",
+    "fleet_deregister",
+    "fleet_member_stale",
+    "fleet_breaker_trip",
+    "fleet_kv_handoff_failed",
+    "fleet_kv_evict",
+    "fleet_host_spill",
+)
+
+
+def test_fleet_events_closed_and_pinned():
+    assert set(FLEET_EVENT_PINS) == set(fleet.FLEET_EVENTS)
+    for e in FLEET_EVENT_PINS:
+        fleet.record_event(e, "pin")
+    counts = fleet.event_counters()
+    for e in FLEET_EVENT_PINS:
+        assert counts[e] == 1, e
+    # closed: an unregistered event fails loudly at the first record
+    with pytest.raises(AssertionError):
+        fleet.record_event("fleet_" + "unregistered")
+
+
+def test_flight_recorder_ring_bounded():
+    fleet._reset_for_tests(ring=8)
+    for i in range(30):
+        fleet.record_event("fleet_kv_evict", f"n{i}")
+    rows = fleet.recent_events(100)
+    assert len(rows) == 8
+    assert rows[-1]["detail"] == "n29"          # newest kept
+    assert rows[0]["detail"] == "n22"           # oldest evicted
+    assert fleet.event_counters()["fleet_kv_evict"] == 30
+
+
+def test_flight_recorder_flag_gated():
+    from brpc_tpu.butil.flags import set_flag
+    set_flag("fleet_obs", False)
+    try:
+        fleet.record_event("fleet_kv_evict", "off")
+        assert fleet.event_counters()["fleet_kv_evict"] == 0
+        assert fleet.recent_events() == []
+    finally:
+        set_flag("fleet_obs", True)
+    fleet.record_event("fleet_kv_evict", "on")
+    assert fleet.event_counters()["fleet_kv_evict"] == 1
+
+
+# ---------------------------------------------------------------------------
+# Load report + snapshot cache
+# ---------------------------------------------------------------------------
+
+def test_load_report_shape():
+    r = fleet.build_load_report()
+    assert r["v"] == fleet.LOAD_REPORT_VERSION
+    assert r["drain"] == "serving"
+    assert isinstance(r["events"], list)
+    assert isinstance(r["trace_roots"], list)
+    # seq is per-process monotonic
+    assert fleet.build_load_report()["seq"] == r["seq"] + 1
+
+
+def test_report_cache_one_build_per_interval():
+    cache = fleet.report_cache()
+    for _ in range(20):
+        cache.get()
+    assert cache.builds == 1            # the one-build-per-interval pin
+
+
+def test_probe_response_carries_report_tail():
+    from brpc_tpu.kv.transport import (decode_probe_report,
+                                       decode_probe_response,
+                                       encode_probe_response)
+    report = fleet.build_load_report()
+    report["instance"] = "10.0.0.1:99"
+    data = encode_probe_response(report=report)
+    # capability parse is unchanged by the tail
+    cap = decode_probe_response(data)
+    assert cap is not None and isinstance(cap[2], bool)
+    tail = decode_probe_report(data)
+    assert tail is not None
+    assert tail["instance"] == "10.0.0.1:99"
+    assert tail["v"] == fleet.LOAD_REPORT_VERSION
+    # a pre-fleet probe (no tail) parses as capabilities-only
+    bare = encode_probe_response()
+    assert decode_probe_response(bare) is not None
+    assert decode_probe_report(bare) is None
+
+
+# ---------------------------------------------------------------------------
+# Registry semantics
+# ---------------------------------------------------------------------------
+
+def _mk_report(instance, drain="serving", trace_roots=()):
+    r = fleet.build_load_report()
+    r["instance"] = instance
+    r["drain"] = drain
+    r["trace_roots"] = list(trace_roots)
+    return r
+
+
+def test_registry_fresh_stale_draining():
+    reg = fleet.FleetRegistry(ttl_s=0.4)
+    assert reg.ingest(_mk_report("a:1")) == 0
+    assert reg.ingest(_mk_report("b:2")) == 0
+    states = {m["instance"]: m["state"] for m in reg.members()}
+    assert states == {"a:1": "ok", "b:2": "ok"}
+    time.sleep(0.5)
+    # TTL'd out: LOUDLY stale, never dropped, one event per transition
+    states = {m["instance"]: m["state"] for m in reg.members()}
+    assert states == {"a:1": "stale", "b:2": "stale"}
+    reg.members()
+    assert fleet.event_counters()["fleet_member_stale"] == 2
+    # a fresh report revives; an explicit deregister flips to draining
+    assert reg.ingest(_mk_report("a:1")) == 0
+    assert reg.deregister("b:2") == 0
+    states = {m["instance"]: m["state"] for m in reg.members()}
+    assert states == {"a:1": "ok", "b:2": "draining"}
+    # re-registration after a restart clears the deregister
+    assert reg.ingest(_mk_report("b:2")) == 0
+    assert {m["instance"]: m["state"]
+            for m in reg.members()}["b:2"] == "ok"
+
+
+def test_registry_rejects_unaddressable():
+    reg = fleet.FleetRegistry()
+    assert reg.ingest({"v": 1}) == -1           # no instance
+    assert reg.ingest({"instance": "a:1"}) == -1  # no version
+    assert reg.ingest("junk") == -1
+
+
+def test_registry_seed_from_file(tmp_path):
+    p = tmp_path / "fleet.naming"
+    p.write_text("10.0.0.1:80\n# comment\n10.0.0.2:80 extra\n\n")
+    reg = fleet.FleetRegistry()
+    assert reg.seed_from_url(f"file://{p}") == 2
+    states = {m["instance"]: m["state"] for m in reg.members()}
+    assert states == {"10.0.0.1:80": "seeded", "10.0.0.2:80": "seeded"}
+    # a seeded member's first report promotes it
+    assert reg.ingest(_mk_report("10.0.0.1:80")) == 0
+    assert {m["instance"]: m["state"]
+            for m in reg.members()}["10.0.0.1:80"] == "ok"
+
+
+def test_registry_trace_index():
+    reg = fleet.FleetRegistry()
+    reg.ingest(_mk_report("a:1", trace_roots=("dead0", "beef1")))
+    reg.ingest(_mk_report("b:2", trace_roots=("beef1",)))
+    assert reg.trace_owners("dead0") == ["a:1"]
+    assert reg.trace_owners("beef1") == ["a:1", "b:2"]
+    assert reg.trace_owners("cafe2") == []
+    idx = reg.trace_index()
+    assert idx["dead0"] == ["a:1"]
+
+
+def test_registry_timeline_merges_member_events():
+    fleet.record_event("fleet_restart", "registry-local")
+    reg = fleet.FleetRegistry()
+    rep = _mk_report("a:1")
+    rep["events"] = [{"seq": 1, "wall_s": time.time(),
+                      "event": "fleet_drain", "detail": "member-side"}]
+    reg.ingest(rep)
+    rows = reg.timeline()
+    insts = {r["instance"] for r in rows}
+    assert "a:1" in insts and "(registry)" in insts
+    evs = {r["event"] for r in rows}
+    assert "fleet_drain" in evs and "fleet_restart" in evs
+
+
+def test_rollups_and_outliers():
+    reg = fleet.FleetRegistry()
+    for i, busy in enumerate((0.9, 0.2, 0.5)):
+        rep = _mk_report(f"n:{i}")
+        rep["busy_ratio"] = busy
+        rep["slo"] = {"interactive": {"slo_ok": 8, "slo_ttft_miss": 2}}
+        rep["slots"] = {"live": 3, "total": 8}
+        reg.ingest(rep)
+    roll = reg.rollups()
+    assert roll["slo"]["interactive"]["slo_ok"] == 24
+    assert roll["slots"] == {"live": 9, "total": 24}
+    assert roll["top_busy"][0]["instance"] == "n:0"
+    assert roll["top_slo_miss"][0]["miss_ratio"] == pytest.approx(0.2)
+
+
+def test_federation_injects_instance_label():
+    reg = fleet.FleetRegistry()
+    reg.ingest(_mk_report("a:1"))
+    reg.ingest(_mk_report("b:2"))
+
+    def fake_fetch(instance, timeout_s=1.0):
+        return ('# TYPE x_total counter\nx_total 5\n'
+                'y{lane="shm"} 2\n')
+
+    body = reg.federate(fetch=fake_fetch)
+    assert 'x_total{instance="a:1"} 5' in body
+    assert 'y{instance="b:2",lane="shm"} 2' in body
+    assert 'fleet_members{state="ok"} 2' in body
+    # valid exposition: every sample line is `name{labels} value`
+    for line in body.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        series, _, value = line.rpartition(" ")
+        assert series and value, line
+        float(value)
+    # one scrape sweep per interval (cached)
+    reg.federate(fetch=fake_fetch)
+    assert reg.fed_builds == 1
+
+
+# ---------------------------------------------------------------------------
+# In-process end-to-end: registry server + member server
+# ---------------------------------------------------------------------------
+
+class Echo(Service):
+    def Echo(self, cntl, request):
+        return request
+
+
+def _http_get(addr, path):
+    host, _, port = addr.rpartition(":")
+    conn = http.client.HTTPConnection(host, int(port), timeout=5.0)
+    try:
+        conn.request("GET", path)
+        resp = conn.getresponse()
+        return resp.status, resp.read().decode("utf-8", "replace")
+    finally:
+        conn.close()
+
+
+def _wait(pred, timeout=10.0, step=0.05):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(step)
+    return False
+
+
+def test_fleet_end_to_end_two_servers():
+    reg_srv = Server()
+    reg_srv.add_service(Echo(), name="E")
+    reg = fleet.host_registry(reg_srv, ttl_s=3.0)
+    assert reg_srv.start("127.0.0.1:0") == 0
+    mem_srv = Server()
+    mem_srv.add_service(Echo(), name="E")
+    assert mem_srv.start("127.0.0.1:0") == 0
+    reg_addr = str(reg_srv.listen_endpoint)
+    mem_addr = str(mem_srv.listen_endpoint)
+    try:
+        fleet.attach_reporter(mem_srv, reg_addr, interval_s=0.2)
+        # registration → visible on /fleet
+        assert _wait(lambda: any(
+            m["instance"] == mem_addr and m["state"] == "ok"
+            for m in reg.members()))
+        st, body = _http_get(reg_addr, "/fleet?format=json")
+        assert st == 200
+        doc = json.loads(body)
+        assert doc["registry"] is True
+        row = next(m for m in doc["members"]
+                   if m["instance"] == mem_addr)
+        assert row["state"] == "ok"
+        assert row["report"]["v"] == fleet.LOAD_REPORT_VERSION
+        # pull-on-demand: the member's own /fleet?self=1
+        st, body = _http_get(mem_addr, "/fleet?self=1")
+        assert st == 200
+        assert json.loads(body)["instance"] == mem_addr
+        # a plain member hosts no registry
+        st, _ = _http_get(mem_addr, "/metrics?fleet=1")
+        assert st == 404
+        # federation on the registry host: per-instance labels
+        st, fed = _http_get(reg_addr, "/metrics?fleet=1")
+        assert st == 200
+        assert f'instance="{mem_addr}"' in fed
+        assert 'fleet_members{state="ok"} 1' in fed
+        # drain: the member flips to draining within ~one interval,
+        # not the TTL, and the drain events hit the flight recorder
+        assert mem_srv.drain(grace_ms=1000) in (0, -1)
+        assert _wait(lambda: next(
+            m["state"] for m in reg.members()
+            if m["instance"] == mem_addr) == "draining", timeout=2.0)
+        counts = fleet.event_counters()
+        assert counts["fleet_drain"] >= 1
+        assert counts["fleet_deregister"] >= 1
+    finally:
+        mem_srv.stop()
+        reg_srv.stop()
+
+
+def test_fleet_vars_exposed():
+    from brpc_tpu.bvar.variable import find_exposed
+    fleet.expose_fleet_variables()
+    assert find_exposed("fleet_events_total") is not None
+    assert find_exposed("fleet_members") is not None
+    assert find_exposed("fleet_report_builds") is not None
+
+
+def test_stitch_seed_remotes():
+    from brpc_tpu.rpcz_stitch import collect_trace
+    fetched = []
+
+    def fake_fetch(remote, trace_id, timeout_s=2.0, limit=512):
+        fetched.append(remote)
+        return [{"span_id": 42, "trace_id": f"{trace_id:x}",
+                 "parent_span_id": 0, "side": "server",
+                 "received_us": 1}]
+
+    out = collect_trace(0xF1EE7, fetch=fake_fetch,
+                        seed_remotes=("10.9.9.9:1",))
+    assert fetched == ["10.9.9.9:1"]
+    assert any(s["span_id"] == 42 for s in out["spans"])
+    assert out["remotes"]["10.9.9.9:1"] == "ok"
+
+
+# ---------------------------------------------------------------------------
+# 3-process soak: register / kill -9 → stale / drain → draining,
+# trace-index lookup across processes, federation over live members
+# ---------------------------------------------------------------------------
+
+_CHILD = r"""
+import os, sys
+os.environ["JAX_PLATFORMS"] = "cpu"
+sys.path.insert(0, %(repo)r)
+from brpc_tpu.server import Server, Service
+from brpc_tpu import fleet
+from brpc_tpu.client import Channel, Controller
+
+class E(Service):
+    def Echo(self, cntl, request):
+        return request
+
+srv = Server()
+srv.add_service(E(), name="E")
+assert srv.start("127.0.0.1:0") == 0
+inst = str(srv.listen_endpoint)
+# one traced self-call so THIS process holds a trace ROOT the load
+# report can index
+trace_id = %(trace_id)d
+ch = Channel()
+ch.init(inst)
+cntl = Controller()
+cntl.timeout_ms = 5000
+cntl.trace_id = trace_id
+c = ch.call_method("E.Echo", b"traced", cntl=cntl)
+assert not c.failed, c.error_text
+fleet.attach_reporter(srv, %(registry)r, interval_s=0.25)
+print("PORT=%%d" %% srv.listen_endpoint.port, flush=True)
+for line in sys.stdin:
+    if line.strip() == "drain":
+        srv.drain(grace_ms=1000)
+        print("DRAINED", flush=True)
+srv.stop()
+"""
+
+
+def _spawn_child(registry_addr, trace_id):
+    proc = subprocess.Popen(
+        [sys.executable, "-c",
+         _CHILD % {"repo": REPO, "registry": registry_addr,
+                   "trace_id": trace_id}],
+        stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+        stderr=subprocess.DEVNULL, text=True)
+    port = [None]
+
+    def _read():
+        for line in proc.stdout:
+            if line.startswith("PORT="):
+                port[0] = int(line.strip().split("=", 1)[1])
+                return
+
+    reader = threading.Thread(target=_read, daemon=True)
+    reader.start()
+    reader.join(timeout=180)
+    if port[0] is None:
+        proc.kill()
+        raise RuntimeError("fleet child did not report a port")
+    return proc, f"127.0.0.1:{port[0]}"
+
+
+def test_three_process_fleet_soak():
+    reg_srv = Server()
+    reg = fleet.host_registry(reg_srv, ttl_s=2.0)
+    assert reg_srv.start("127.0.0.1:0") == 0
+    reg_addr = str(reg_srv.listen_endpoint)
+    t1, t2 = 0xF1EE70001, 0xF1EE70002
+    p1 = p2 = None
+    try:
+        p1, a1 = _spawn_child(reg_addr, t1)
+        p2, a2 = _spawn_child(reg_addr, t2)
+
+        def _states():
+            return {m["instance"]: m["state"] for m in reg.members()}
+
+        # both register with fresh reports
+        assert _wait(lambda: _states().get(a1) == "ok"
+                     and _states().get(a2) == "ok", timeout=30.0), \
+            _states()
+        # fresh report content is visible on /fleet
+        st, body = _http_get(reg_addr, "/fleet?format=json")
+        assert st == 200
+        doc = json.loads(body)
+        rows = {m["instance"]: m for m in doc["members"]}
+        assert rows[a1]["report"]["drain"] == "serving"
+        assert rows[a1]["age_s"] < 2.0
+
+        # trace-index lookup finds the root-holding process
+        st, body = _http_get(reg_addr, f"/fleet?trace_id={t1:x}")
+        assert st == 200
+        assert json.loads(body)["owners"] == [a1]
+        from brpc_tpu.rpcz_stitch import locate_trace_root
+        assert locate_trace_root(reg_addr, t2) == [a2]
+
+        # federation is valid exposition with per-instance labels
+        st, fed = _http_get(reg_addr, "/metrics?fleet=1")
+        assert st == 200
+        assert f'instance="{a1}"' in fed and f'instance="{a2}"' in fed
+        for line in fed.splitlines():
+            if not line or line.startswith("#"):
+                continue
+            series, _, value = line.rpartition(" ")
+            float(value)
+            assert "{" not in value and series
+
+        # kill -9 one member → stale within TTL (never dropped)
+        p1.kill()
+        p1.wait(timeout=10)
+        assert _wait(lambda: _states().get(a1) == "stale",
+                     timeout=8.0), _states()
+        assert _states().get(a2) == "ok"
+
+        # drained member → draining within ~one report interval
+        p2.stdin.write("drain\n")
+        p2.stdin.flush()
+        assert _wait(lambda: _states().get(a2) == "draining",
+                     timeout=5.0), _states()
+    finally:
+        for p in (p1, p2):
+            if p is not None:
+                try:
+                    p.stdin.close()
+                except Exception:
+                    pass
+                try:
+                    p.wait(timeout=10)
+                except Exception:
+                    p.kill()
+        reg_srv.stop()

@@ -220,10 +220,10 @@ def test_drift_sleep_in_drain_path():
     and entry-listed in the blocking pass — a time.sleep seeded into
     it must be flagged."""
     SERVER = "brpc_tpu/server/server.py"
-    ov = _mutate(SERVER, "        self.unpublish()\n"
+    ov = _mutate(SERVER, "        _fleet.on_server_drain(self)\n"
                  "        if self._acceptor is not None:\n"
                  "            self._acceptor.pause_accept()",
-                 "        self.unpublish()\n"
+                 "        _fleet.on_server_drain(self)\n"
                  "        _time.sleep(0.5)\n"
                  "        if self._acceptor is not None:\n"
                  "            self._acceptor.pause_accept()")
@@ -465,6 +465,33 @@ def test_drift_lock_in_step_loop_profiler():
         '_live = [bool(get_flag("lm_telemetry", True))]', 1)
     findings = check_blocking(Tree(overrides=ov))
     assert any("record_phase" in f.message and "acquire" in f.message
+               for f in findings), findings
+
+
+def test_drift_unregistered_fleet_event():
+    """A new flight-recorder event grown into the closed FLEET_EVENTS
+    enum without a test pin anywhere under tests/ (runtime-assembled
+    name so this file never anchors it) — the /fleet postmortem
+    timeline would widen past what anything asserts on."""
+    FLEET = "brpc_tpu/fleet.py"
+    unpinned = "fleet_nobody_" + "anchored"
+    ov = _mutate(FLEET, '"fleet_host_spill",',
+                 f'"fleet_host_spill", "{unpinned}",')
+    findings = check_enums(Tree(overrides=ov))
+    assert any(unpinned in f.message for f in findings), findings
+
+
+def test_drift_sleep_in_fleet_report_builder():
+    """A time.sleep grown into build_load_report — the entry-listed
+    report builder runs inside the KV.Probe handler (engine loop on a
+    native server), where a sleep stalls every pinned connection."""
+    FLEET = "brpc_tpu/fleet.py"
+    ov = _mutate(FLEET, "    report = {",
+                 "    time.sleep(0.01)\n    report = {")
+    ov[FLEET] = ov[FLEET].replace(
+        "import threading", "import threading\nimport time", 1)
+    findings = check_blocking(Tree(overrides=ov))
+    assert any("build_load_report" in f.message and "sleep" in f.message
                for f in findings), findings
 
 

@@ -156,3 +156,34 @@ def test_press_cli(server):
     rc = main(["--server", str(server.listen_endpoint),
                "--method", "E.Echo", "--duration", "0.3", "--qps", "100"])
     assert rc == 0
+
+
+def test_fleet_dump_cli(capsys):
+    """fleet_dump against a live registry host: member table + merged
+    event timeline render, --json passthrough parses."""
+    from brpc_tpu import fleet
+    from brpc_tpu.tools.fleet_dump import main
+    fleet._reset_for_tests()
+    srv = Server()
+    srv.add_service(Echo(), name="E")
+    reg = fleet.host_registry(srv, ttl_s=5.0)
+    assert srv.start("127.0.0.1:0") == 0
+    addr = str(srv.listen_endpoint)
+    try:
+        rep = fleet.build_load_report(srv)
+        rep["instance"] = addr
+        assert reg.ingest(rep) == 0
+        fleet.record_event("fleet_restart", addr)
+        assert main([addr]) == 0
+        out = capsys.readouterr().out
+        assert addr in out and "ok" in out
+        assert "timeline" in out and "fleet_restart" in out
+        assert main([addr, "--json"]) == 0
+        import json as _json
+        doc = _json.loads(capsys.readouterr().out)
+        assert doc["registry"] is True
+        assert main([addr, "--self"]) == 0
+        assert main(["127.0.0.1:1", "--timeout", "0.3"]) == 1
+    finally:
+        srv.stop()
+        fleet._reset_for_tests()
